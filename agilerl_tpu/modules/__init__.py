@@ -1,0 +1,16 @@
+from agilerl_tpu.modules.base import (
+    EvolvableModule,
+    ModuleDict,
+    mutation,
+    preserve_params,
+)
+from agilerl_tpu.modules.mlp import EvolvableMLP, MLPConfig
+
+__all__ = [
+    "EvolvableModule",
+    "ModuleDict",
+    "mutation",
+    "preserve_params",
+    "EvolvableMLP",
+    "MLPConfig",
+]

@@ -1,0 +1,301 @@
+"""Evolvable-module core: (static config, params pytree) pairs whose architecture
+mutations are pure ``config -> config'`` transitions plus weight-preserving pytree
+surgery.
+
+Parity target: agilerl/modules/base.py (EvolvableModule, @mutation decorator,
+preserve_parameters:472, mutation-method discovery:629,687, clone:713,
+ModuleDict:804). Design difference (TPU-first): the reference mutates live torch
+``nn.Module`` objects and re-instantiates networks; here a module *is* an immutable
+architecture config plus a dict-of-arrays params tree. Mutating = producing a new
+config, initialising fresh params for it, then copying every overlapping slab of
+the old weights in. The jitted apply function is derived from the (hashable)
+config, so XLA recompilation happens exactly when the architecture changes and
+never when only weights/HPs change.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.typing import MutationMethod, MutationType
+
+Params = Any
+
+
+# --------------------------------------------------------------------------- #
+# Mutation decorator + discovery
+# --------------------------------------------------------------------------- #
+
+
+def mutation(mutation_type: MutationType, shrink_params: bool = False):
+    """Mark a method as an architecture mutation (parity: modules/base.py:27).
+
+    The wrapped method must return a dict of mutation metadata (possibly empty);
+    the wrapper records ``last_mutation_attr`` / ``last_mutation`` on the module
+    so the HPO engine can mirror the same mutation onto sibling networks
+    (e.g. actor -> critics, parity: hpo/mutation.py:829).
+    """
+
+    def decorator(fn: Callable) -> Callable:
+        def wrapper(self, *args, **kwargs):
+            result = fn(self, *args, **kwargs)
+            self.last_mutation_attr = fn.__name__
+            self.last_mutation = result if isinstance(result, dict) else {}
+            return self.last_mutation
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        wrapper._mutation = MutationMethod(fn, mutation_type, shrink_params)
+        return wrapper
+
+    return decorator
+
+
+class EvolvableModule:
+    """Base class for all evolvable neural modules.
+
+    Subclasses define:
+      - a frozen dataclass ``Config`` (hashable => usable as a jit static arg)
+      - ``init_params(key, config) -> params`` (staticmethod)
+      - ``apply(config, params, x, **kw) -> out`` (pure staticmethod)
+      - mutation methods decorated with ``@mutation(...)`` that build a new
+        config and call ``self._morph(new_config)``.
+    """
+
+    def __init__(self, config, key: jax.Array, device: Optional[str] = None):
+        self.config = config
+        self._key = key
+        self.params = self.init_params(self._next_key(), config)
+        self.last_mutation_attr: Optional[str] = None
+        self.last_mutation: Dict[str, Any] = {}
+
+    # -- RNG plumbing ------------------------------------------------------- #
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- abstract ----------------------------------------------------------- #
+    @staticmethod
+    def init_params(key: jax.Array, config) -> Params:  # pragma: no cover
+        raise NotImplementedError
+
+    @staticmethod
+    def apply(config, params: Params, x, **kwargs):  # pragma: no cover
+        raise NotImplementedError
+
+    # -- convenience -------------------------------------------------------- #
+    def __call__(self, x, **kwargs):
+        return type(self).apply(self.config, self.params, x, **kwargs)
+
+    def forward(self, x, **kwargs):
+        return self(x, **kwargs)
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        """Kwargs able to reconstruct this module (parity: base.py:713)."""
+        return {"config": self.config}
+
+    # -- mutation machinery ------------------------------------------------- #
+    @classmethod
+    def get_mutation_methods(cls) -> Dict[str, MutationMethod]:
+        """Discover @mutation-decorated methods (parity: base.py:629)."""
+        out: Dict[str, MutationMethod] = {}
+        for name in dir(cls):
+            attr = getattr(cls, name, None)
+            meta = getattr(attr, "_mutation", None)
+            if meta is not None:
+                out[name] = meta
+        return out
+
+    @classmethod
+    def layer_mutation_methods(cls) -> List[str]:
+        return [
+            n for n, m in cls.get_mutation_methods().items()
+            if m.mutation_type == MutationType.LAYER
+        ]
+
+    @classmethod
+    def node_mutation_methods(cls) -> List[str]:
+        return [
+            n for n, m in cls.get_mutation_methods().items()
+            if m.mutation_type == MutationType.NODE
+        ]
+
+    def sample_mutation_method(
+        self, new_layer_prob: float = 0.2, rng: Optional[np.random.Generator] = None
+    ) -> str:
+        """Sample a mutation method name, preferring node mutations
+        (parity: base.py:687 — layer mutations chosen with prob new_layer_prob)."""
+        rng = rng or np.random.default_rng()
+        layers = self.layer_mutation_methods()
+        nodes = self.node_mutation_methods()
+        if layers and (not nodes or rng.random() < new_layer_prob):
+            return str(rng.choice(layers))
+        if nodes:
+            return str(rng.choice(nodes))
+        raise ValueError(f"{type(self).__name__} has no mutation methods")
+
+    def apply_mutation(self, name: str, rng: Optional[np.random.Generator] = None) -> Dict:
+        method = getattr(self, name)
+        try:
+            return method(rng=rng)
+        except TypeError:
+            return method()
+
+    # -- architecture morphing --------------------------------------------- #
+    def _morph(self, new_config) -> None:
+        """Re-initialise params for ``new_config`` and preserve old weights.
+
+        Parity: recreate_network + preserve_parameters (modules/base.py:472).
+        """
+        new_params = self.init_params(self._next_key(), new_config)
+        self.params = preserve_params(self.params, new_params)
+        self.config = new_config
+
+    # -- cloning / state ---------------------------------------------------- #
+    def clone(self) -> "EvolvableModule":
+        new = object.__new__(type(self))
+        new.__dict__.update(
+            {k: v for k, v in self.__dict__.items() if k != "params"}
+        )
+        new.params = jax.tree_util.tree_map(jnp.copy, self.params)
+        return new
+
+    def state_dict(self) -> Params:
+        return self.params
+
+    def load_state_dict(self, params: Params) -> None:
+        self.params = params
+
+    def param_count(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
+
+
+# --------------------------------------------------------------------------- #
+# Weight-preserving pytree surgery
+# --------------------------------------------------------------------------- #
+
+
+def preserve_params(old: Params, new: Params) -> Params:
+    """Copy every overlapping slab of ``old`` into ``new`` where tree paths match.
+
+    For each leaf present (by path) in both trees, the top-left
+    ``min(old.shape, new.shape)`` hyper-rectangle of the old weights is copied
+    into the new tensor; any newly-grown region keeps its fresh initialisation.
+    This matches the reference's preserve_parameters / shrink_preserve_parameters
+    semantics (agilerl/modules/base.py:472, modules/cnn.py:418) as a single pure
+    pytree function.
+    """
+    old_flat = _flatten_with_paths(old)
+    new_flat = _flatten_with_paths(new)
+    out = dict(new_flat)
+    for path, old_leaf in old_flat.items():
+        if path not in new_flat:
+            continue
+        new_leaf = new_flat[path]
+        if old_leaf.ndim != new_leaf.ndim:
+            continue
+        if old_leaf.shape == new_leaf.shape:
+            out[path] = old_leaf
+            continue
+        slices = tuple(
+            slice(0, min(o, n)) for o, n in zip(old_leaf.shape, new_leaf.shape)
+        )
+        out[path] = new_leaf.at[slices].set(old_leaf[slices])
+    return _unflatten_from_paths(out, new)
+
+
+def _flatten_with_paths(tree: Params) -> Dict[Tuple, jax.Array]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = tuple(
+            getattr(p, "key", getattr(p, "idx", getattr(p, "name", str(p))))
+            for p in path
+        )
+        flat[key] = leaf
+    return flat
+
+
+def _unflatten_from_paths(flat: Dict[Tuple, jax.Array], template: Params) -> Params:
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths_leaves:
+        key = tuple(
+            getattr(p, "key", getattr(p, "idx", getattr(p, "name", str(p))))
+            for p in path
+        )
+        leaves.append(flat.get(key, leaf))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# --------------------------------------------------------------------------- #
+# ModuleDict (per-agent nets for multi-agent algos; parity: base.py:804)
+# --------------------------------------------------------------------------- #
+
+
+class ModuleDict:
+    """An ordered dict of EvolvableModules keyed by agent id."""
+
+    def __init__(self, modules: Dict[str, EvolvableModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, k: str) -> EvolvableModule:
+        return self._modules[k]
+
+    def __setitem__(self, k: str, v: EvolvableModule) -> None:
+        self._modules[k] = v
+
+    def __iter__(self):
+        return iter(self._modules)
+
+    def __len__(self):
+        return len(self._modules)
+
+    def keys(self):
+        return self._modules.keys()
+
+    def values(self):
+        return self._modules.values()
+
+    def items(self):
+        return self._modules.items()
+
+    @property
+    def params(self) -> Dict[str, Params]:
+        return {k: m.params for k, m in self._modules.items()}
+
+    def load_params(self, params: Dict[str, Params]) -> None:
+        for k, p in params.items():
+            self._modules[k].params = p
+
+    def clone(self) -> "ModuleDict":
+        return ModuleDict({k: m.clone() for k, m in self._modules.items()})
+
+
+def config_replace(config, **changes):
+    """dataclasses.replace for frozen config dataclasses."""
+    return dataclasses.replace(config, **changes)
+
+
+def tuple_insert(t: Tuple, idx: int, value) -> Tuple:
+    lst = list(t)
+    lst.insert(idx, value)
+    return tuple(lst)
+
+
+def tuple_remove(t: Tuple, idx: int) -> Tuple:
+    lst = list(t)
+    lst.pop(idx)
+    return tuple(lst)
+
+
+def tuple_set(t: Tuple, idx: int, value) -> Tuple:
+    lst = list(t)
+    lst[idx] = value
+    return tuple(lst)

@@ -1,0 +1,216 @@
+"""Evolvable CNN (parity: agilerl/modules/cnn.py — EvolvableCNN:224, mutable
+kernel sizes MutableKernelSizes:55, mutations add/remove layer/channel + kernel
+changes :583-737, shrink_preserve_parameters:418).
+
+TPU-first: NHWC layout (torch reference is NCHW), lax.conv_general_dilated on the
+MXU, uint8 obs rescaled on-device. A kernel-size mutation changes the static
+config -> XLA recompiles; weights are preserved slab-wise per conv layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import (
+    EvolvableModule,
+    config_replace,
+    mutation,
+    tuple_set,
+)
+from agilerl_tpu.typing import MutationType
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNConfig:
+    input_shape: Tuple[int, ...]  # (H, W, C) — NHWC
+    num_outputs: int
+    channel_size: Tuple[int, ...] = (32, 32)
+    kernel_size: Tuple[int, ...] = (3, 3)
+    stride_size: Tuple[int, ...] = (1, 1)
+    activation: str = "ReLU"
+    output_activation: Optional[str] = None
+    min_hidden_layers: int = 1
+    max_hidden_layers: int = 6
+    min_channel_size: int = 16
+    max_channel_size: int = 256
+    layer_norm: bool = True
+    init_layers: bool = True
+
+    def __post_init__(self):
+        assert len(self.input_shape) == 3, "CNN input must be (H, W, C)"
+        assert (
+            len(self.channel_size) == len(self.kernel_size) == len(self.stride_size)
+        ), "channel/kernel/stride must align"
+
+
+def _spatial_dims(config: CNNConfig) -> Tuple[int, int]:
+    h, w, _ = config.input_shape
+    for k, s in zip(config.kernel_size, config.stride_size):
+        h = L.conv_out_size(h, k, s)
+        w = L.conv_out_size(w, k, s)
+    return h, w
+
+
+def _valid_arch(config: CNNConfig) -> bool:
+    h, w = _spatial_dims(config)
+    return h >= 1 and w >= 1
+
+
+class EvolvableCNN(EvolvableModule):
+    Config = CNNConfig
+
+    def __init__(
+        self,
+        input_shape: Optional[Tuple[int, ...]] = None,
+        num_outputs: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[CNNConfig] = None,
+        **kwargs,
+    ):
+        if config is None:
+            config = CNNConfig(input_shape=tuple(input_shape), num_outputs=num_outputs, **kwargs)
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def init_params(key: jax.Array, config: CNNConfig) -> Dict:
+        params: Dict = {}
+        in_c = config.input_shape[-1]
+        chans = (in_c,) + config.channel_size
+        keys = jax.random.split(key, len(config.channel_size) + 1)
+        for i, (k, _s) in enumerate(zip(config.kernel_size, config.stride_size)):
+            params[f"conv_{i}"] = L.conv2d_init(keys[i], k, k, chans[i], chans[i + 1])
+            if config.layer_norm:
+                params[f"norm_{i}"] = L.layer_norm_init(chans[i + 1])
+        h, w = _spatial_dims(config)
+        flat = h * w * config.channel_size[-1]
+        params["output"] = L.dense_init(keys[-1], flat, config.num_outputs)
+        return params
+
+    @staticmethod
+    def apply(config: CNNConfig, params: Dict, x: jax.Array, **_) -> jax.Array:
+        act = L.get_activation(config.activation)
+        out_act = L.get_activation(config.output_activation)
+        h = L.maybe_rescale_image(x)
+        squeeze = False
+        if h.ndim == 3:  # unbatched
+            h = h[None]
+            squeeze = True
+        for i, s in enumerate(config.stride_size):
+            h = L.conv2d_apply(params[f"conv_{i}"], h, stride=s)
+            if config.layer_norm:
+                h = L.layer_norm_apply(params[f"norm_{i}"], h)
+            h = act(h)
+        h = h.reshape(h.shape[0], -1)
+        h = out_act(L.dense_apply(params["output"], h))
+        return h[0] if squeeze else h
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        """Append a conv layer (parity: cnn.py:583)."""
+        cfg = self.config
+        if len(cfg.channel_size) >= cfg.max_hidden_layers:
+            return self.add_channel(rng=rng)
+        new = config_replace(
+            cfg,
+            channel_size=cfg.channel_size + (cfg.channel_size[-1],),
+            kernel_size=cfg.kernel_size + (3,),
+            stride_size=cfg.stride_size + (1,),
+        )
+        if not _valid_arch(new):
+            return self.add_channel(rng=rng)
+        self._morph(new)
+        return {}
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        """Drop the last conv layer (parity: cnn.py:659)."""
+        cfg = self.config
+        if len(cfg.channel_size) <= cfg.min_hidden_layers:
+            return self.add_channel(rng=rng)
+        self._morph(
+            config_replace(
+                cfg,
+                channel_size=cfg.channel_size[:-1],
+                kernel_size=cfg.kernel_size[:-1],
+                stride_size=cfg.stride_size[:-1],
+            )
+        )
+        return {}
+
+    @mutation(MutationType.NODE)
+    def add_channel(
+        self,
+        hidden_layer: Optional[int] = None,
+        numb_new_channels: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        """Grow channels of a random conv layer (parity: cnn.py:707)."""
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if hidden_layer is None:
+            hidden_layer = int(rng.integers(0, len(cfg.channel_size)))
+        hidden_layer = min(hidden_layer, len(cfg.channel_size) - 1)
+        if numb_new_channels is None:
+            numb_new_channels = int(rng.choice([8, 16, 32]))
+        new_c = min(cfg.channel_size[hidden_layer] + numb_new_channels, cfg.max_channel_size)
+        self._morph(
+            config_replace(cfg, channel_size=tuple_set(cfg.channel_size, hidden_layer, new_c))
+        )
+        return {"hidden_layer": hidden_layer, "numb_new_channels": numb_new_channels}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_channel(
+        self,
+        hidden_layer: Optional[int] = None,
+        numb_new_channels: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        """Shrink channels of a random conv layer (parity: cnn.py:737)."""
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if hidden_layer is None:
+            hidden_layer = int(rng.integers(0, len(cfg.channel_size)))
+        hidden_layer = min(hidden_layer, len(cfg.channel_size) - 1)
+        if numb_new_channels is None:
+            numb_new_channels = int(rng.choice([8, 16, 32]))
+        new_c = max(cfg.channel_size[hidden_layer] - numb_new_channels, cfg.min_channel_size)
+        self._morph(
+            config_replace(cfg, channel_size=tuple_set(cfg.channel_size, hidden_layer, new_c))
+        )
+        return {"hidden_layer": hidden_layer, "numb_new_channels": numb_new_channels}
+
+    @mutation(MutationType.NODE)
+    def change_kernel(
+        self,
+        kernel_size: Optional[int] = None,
+        hidden_layer: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        """Mutate a kernel size (parity: cnn.py:675, MutableKernelSizes:55)."""
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if len(cfg.channel_size) > 1:
+            if hidden_layer is None:
+                hidden_layer = int(rng.integers(1, len(cfg.channel_size)))
+        else:
+            hidden_layer = 0
+        hidden_layer = min(hidden_layer, len(cfg.channel_size) - 1)
+        if kernel_size is None:
+            kernel_size = int(rng.choice([3, 4, 5, 7]))
+        new = config_replace(
+            cfg, kernel_size=tuple_set(cfg.kernel_size, hidden_layer, kernel_size)
+        )
+        if not _valid_arch(new):
+            return {"hidden_layer": hidden_layer, "kernel_size": cfg.kernel_size[hidden_layer]}
+        self._morph(new)
+        return {"hidden_layer": hidden_layer, "kernel_size": kernel_size}

@@ -1,0 +1,239 @@
+"""Functional neural-net layer library: pure init/apply pairs over dict params.
+
+This is the substrate for all evolvable modules. Parameters are plain nested
+dicts of jax.Array so that weight-preserving architecture morphs (the core of
+evolutionary architecture mutation — parity with agilerl/modules/base.py:472
+``preserve_parameters``) are straightforward pytree surgery.
+
+Everything here is jit/vmap-friendly: inits take explicit PRNG keys, applies are
+pure. Matmul-heavy paths keep operands in float32 params with optional bfloat16
+compute (TPU MXU native dtype).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, jax.Array]
+
+# --------------------------------------------------------------------------- #
+# Activations (parity: agilerl mlp/cnn activation choices, utils/evolvable_networks)
+# --------------------------------------------------------------------------- #
+
+ACTIVATIONS: Dict[str, Callable[[jax.Array], jax.Array]] = {
+    "ReLU": jax.nn.relu,
+    "Tanh": jnp.tanh,
+    "Sigmoid": jax.nn.sigmoid,
+    "GELU": jax.nn.gelu,
+    "ELU": jax.nn.elu,
+    "LeakyReLU": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "Softsign": jax.nn.soft_sign,
+    "Softplus": jax.nn.softplus,
+    "PReLU": lambda x: jax.nn.leaky_relu(x, 0.25),
+    "Identity": lambda x: x,
+    "Mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "SiLU": jax.nn.silu,
+}
+
+
+def get_activation(name: Optional[str]) -> Callable[[jax.Array], jax.Array]:
+    if name is None:
+        return ACTIVATIONS["Identity"]
+    if name not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation {name!r}; choose from {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[name]
+
+
+# --------------------------------------------------------------------------- #
+# Initializers
+# --------------------------------------------------------------------------- #
+
+
+def kaiming_uniform(key: jax.Array, shape: Tuple[int, ...], fan_in: int) -> jax.Array:
+    bound = math.sqrt(1.0 / max(fan_in, 1))
+    return jax.random.uniform(key, shape, minval=-bound, maxval=bound, dtype=jnp.float32)
+
+
+def orthogonal(key: jax.Array, shape: Tuple[int, int], scale: float = 1.0) -> jax.Array:
+    return jax.nn.initializers.orthogonal(scale)(key, shape, jnp.float32)
+
+
+# --------------------------------------------------------------------------- #
+# Dense
+# --------------------------------------------------------------------------- #
+
+
+def dense_init(key: jax.Array, in_dim: int, out_dim: int) -> Params:
+    wkey, bkey = jax.random.split(key)
+    return {
+        "kernel": kaiming_uniform(wkey, (in_dim, out_dim), in_dim),
+        "bias": kaiming_uniform(bkey, (out_dim,), in_dim),
+    }
+
+
+def dense_apply(params: Params, x: jax.Array) -> jax.Array:
+    return x @ params["kernel"] + params["bias"]
+
+
+# --------------------------------------------------------------------------- #
+# Noisy dense (factorised Gaussian noise; parity: NoisyLinear,
+# agilerl/modules/custom_components.py:38 — used by Rainbow DQN)
+# --------------------------------------------------------------------------- #
+
+
+def noisy_dense_init(key: jax.Array, in_dim: int, out_dim: int, std_init: float = 0.5) -> Params:
+    wkey, bkey = jax.random.split(key)
+    mu_range = 1.0 / math.sqrt(in_dim)
+    return {
+        "kernel_mu": jax.random.uniform(wkey, (in_dim, out_dim), minval=-mu_range, maxval=mu_range),
+        "kernel_sigma": jnp.full((in_dim, out_dim), std_init / math.sqrt(in_dim), jnp.float32),
+        "bias_mu": jax.random.uniform(bkey, (out_dim,), minval=-mu_range, maxval=mu_range),
+        "bias_sigma": jnp.full((out_dim,), std_init / math.sqrt(out_dim), jnp.float32),
+    }
+
+
+def _scaled_noise(key: jax.Array, n: int) -> jax.Array:
+    x = jax.random.normal(key, (n,))
+    return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+def noisy_dense_apply(
+    params: Params, x: jax.Array, key: Optional[jax.Array] = None
+) -> jax.Array:
+    """Apply a noisy linear layer. key=None -> deterministic (eval) path."""
+    if key is None:
+        return x @ params["kernel_mu"] + params["bias_mu"]
+    in_dim, out_dim = params["kernel_mu"].shape
+    kin, kout = jax.random.split(key)
+    eps_in = _scaled_noise(kin, in_dim)
+    eps_out = _scaled_noise(kout, out_dim)
+    kernel = params["kernel_mu"] + params["kernel_sigma"] * jnp.outer(eps_in, eps_out)
+    bias = params["bias_mu"] + params["bias_sigma"] * eps_out
+    return x @ kernel + bias
+
+
+# --------------------------------------------------------------------------- #
+# LayerNorm
+# --------------------------------------------------------------------------- #
+
+
+def layer_norm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layer_norm_apply(params: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    norm = (x - mean) * lax.rsqrt(var + eps)
+    return norm * params["scale"] + params["bias"]
+
+
+def rms_norm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32)}
+
+
+def rms_norm_apply(params: Params, x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * lax.rsqrt(var + eps) * params["scale"]
+
+
+# --------------------------------------------------------------------------- #
+# Conv2D (NHWC — TPU-native layout; the reference uses torch NCHW)
+# --------------------------------------------------------------------------- #
+
+
+def conv2d_init(key: jax.Array, kh: int, kw: int, in_c: int, out_c: int) -> Params:
+    wkey, bkey = jax.random.split(key)
+    fan_in = kh * kw * in_c
+    return {
+        "kernel": kaiming_uniform(wkey, (kh, kw, in_c, out_c), fan_in),
+        "bias": kaiming_uniform(bkey, (out_c,), fan_in),
+    }
+
+
+def conv2d_apply(
+    params: Params, x: jax.Array, stride: int = 1, padding: str = "VALID"
+) -> jax.Array:
+    y = lax.conv_general_dilated(
+        x,
+        params["kernel"],
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + params["bias"]
+
+
+def conv_out_size(size: int, kernel: int, stride: int, padding: int = 0) -> int:
+    return (size + 2 * padding - kernel) // stride + 1
+
+
+# --------------------------------------------------------------------------- #
+# BatchNorm-free image normalisation helper
+# --------------------------------------------------------------------------- #
+
+
+def maybe_rescale_image(x: jax.Array) -> jax.Array:
+    """Rescale uint8 images to [0, 1] floats."""
+    if x.dtype == jnp.uint8:
+        return x.astype(jnp.float32) / 255.0
+    return x.astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------- #
+# LSTM (fused-gate cell; parity: EvolvableLSTM, agilerl/modules/lstm.py:11)
+# --------------------------------------------------------------------------- #
+
+
+def lstm_cell_init(key: jax.Array, in_dim: int, hidden: int) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "wi": kaiming_uniform(k1, (in_dim, 4 * hidden), in_dim),
+        "wh": kaiming_uniform(k2, (hidden, 4 * hidden), hidden),
+        "bi": kaiming_uniform(k3, (4 * hidden,), in_dim),
+        "bh": kaiming_uniform(k4, (4 * hidden,), hidden),
+    }
+
+
+def lstm_cell_apply(
+    params: Params, carry: Tuple[jax.Array, jax.Array], x: jax.Array
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    h, c = carry
+    gates = x @ params["wi"] + params["bi"] + h @ params["wh"] + params["bh"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def lstm_scan(
+    params: Params, x_seq: jax.Array, h0: jax.Array, c0: jax.Array
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Run one LSTM layer over a [T, B, D] sequence with lax.scan."""
+
+    def step(carry, x):
+        carry, h = lstm_cell_apply(params, carry, x)
+        return carry, h
+
+    (h, c), outs = lax.scan(step, (h0, c0), x_seq)
+    return outs, (h, c)
+
+
+# --------------------------------------------------------------------------- #
+# Embedding
+# --------------------------------------------------------------------------- #
+
+
+def embedding_init(key: jax.Array, vocab: int, dim: int, scale: float = 0.02) -> Params:
+    return {"embedding": scale * jax.random.normal(key, (vocab, dim), jnp.float32)}
+
+
+def embedding_apply(params: Params, ids: jax.Array) -> jax.Array:
+    return jnp.take(params["embedding"], ids, axis=0)

@@ -1,0 +1,145 @@
+"""Evolvable LSTM encoder (parity: agilerl/modules/lstm.py — EvolvableLSTM:11,
+mutations :239-280, hidden_state_architecture:94 for recurrent PPO).
+
+TPU-first: the recurrence runs as lax.scan over time; multi-layer stacks scan
+layer-by-layer (static depth). Hidden state is an explicit pytree the caller
+threads, never hidden module state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
+from agilerl_tpu.typing import MutationType
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTMConfig:
+    num_inputs: int
+    num_outputs: int
+    hidden_size: int = 64
+    num_layers: int = 1
+    min_hidden_size: int = 16
+    max_hidden_size: int = 500
+    min_layers: int = 1
+    max_layers: int = 3
+    output_activation: Optional[str] = None
+
+    def __post_init__(self):
+        assert self.num_inputs > 0 and self.num_outputs > 0
+        assert self.min_layers <= self.num_layers <= self.max_layers
+
+
+class EvolvableLSTM(EvolvableModule):
+    Config = LSTMConfig
+
+    def __init__(
+        self,
+        num_inputs: Optional[int] = None,
+        num_outputs: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[LSTMConfig] = None,
+        **kwargs,
+    ):
+        if config is None:
+            config = LSTMConfig(num_inputs=num_inputs, num_outputs=num_outputs, **kwargs)
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: LSTMConfig) -> Dict:
+        params: Dict = {}
+        keys = jax.random.split(key, config.num_layers + 1)
+        in_dim = config.num_inputs
+        for i in range(config.num_layers):
+            params[f"lstm_{i}"] = L.lstm_cell_init(keys[i], in_dim, config.hidden_size)
+            in_dim = config.hidden_size
+        params["output"] = L.dense_init(keys[-1], config.hidden_size, config.num_outputs)
+        return params
+
+    @staticmethod
+    def initial_hidden(config: LSTMConfig, batch: int) -> Dict[str, jax.Array]:
+        """Zero hidden state pytree (parity: hidden_state_architecture, lstm.py:94)."""
+        return {
+            "h": jnp.zeros((config.num_layers, batch, config.hidden_size)),
+            "c": jnp.zeros((config.num_layers, batch, config.hidden_size)),
+        }
+
+    @staticmethod
+    def apply(
+        config: LSTMConfig,
+        params: Dict,
+        x: jax.Array,
+        hidden: Optional[Dict[str, jax.Array]] = None,
+        return_hidden: bool = False,
+        **_,
+    ):
+        """x: [B, D] single step or [T, B, D] sequence. Returns output at final
+        timestep (and new hidden state if return_hidden)."""
+        single_step = x.ndim == 2
+        if single_step:
+            x = x[None]
+        batch = x.shape[1]
+        if hidden is None:
+            hidden = EvolvableLSTM.initial_hidden(config, batch)
+        hs, cs = [], []
+        seq = x.astype(jnp.float32)
+        for i in range(config.num_layers):
+            seq, (h, c) = L.lstm_scan(params[f"lstm_{i}"], seq, hidden["h"][i], hidden["c"][i])
+            hs.append(h)
+            cs.append(c)
+        out = L.dense_apply(params["output"], seq[-1])
+        out_act = L.get_activation(config.output_activation)
+        out = out_act(out)
+        if return_hidden:
+            return out, {"h": jnp.stack(hs), "c": jnp.stack(cs)}
+        return out
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.num_layers >= cfg.max_layers:
+            return self.add_node(rng=rng)
+        self._morph(config_replace(cfg, num_layers=cfg.num_layers + 1))
+        return {}
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.num_layers <= cfg.min_layers:
+            return self.add_node(rng=rng)
+        self._morph(config_replace(cfg, num_layers=cfg.num_layers - 1))
+        return {}
+
+    @mutation(MutationType.NODE)
+    def add_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([16, 32, 64]))
+        cfg = self.config
+        new = min(cfg.hidden_size + numb_new_nodes, cfg.max_hidden_size)
+        self._morph(config_replace(cfg, hidden_size=new))
+        return {"numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([16, 32, 64]))
+        cfg = self.config
+        new = max(cfg.hidden_size - numb_new_nodes, cfg.min_hidden_size)
+        self._morph(config_replace(cfg, hidden_size=new))
+        return {"numb_new_nodes": numb_new_nodes}

@@ -1,0 +1,183 @@
+"""Evolvable MLP (parity: agilerl/modules/mlp.py — EvolvableMLP:10, mutations
+add_layer:228, remove_layer:242, add_node:255, remove_node:285).
+
+TPU-first notes: the whole net is a pure function of a frozen config; a node/layer
+mutation builds a new config and re-uses every overlapping weight slab (see
+modules/base.py preserve_params). Dense widths are kept free — XLA pads onto MXU
+tiles; population benchmarks should prefer multiples of 128 via net-config choice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation, tuple_set
+from agilerl_tpu.typing import MutationType
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    num_inputs: int
+    num_outputs: int
+    hidden_size: Tuple[int, ...] = (64, 64)
+    activation: str = "ReLU"
+    output_activation: Optional[str] = None
+    min_hidden_layers: int = 1
+    max_hidden_layers: int = 3
+    min_mlp_nodes: int = 64
+    max_mlp_nodes: int = 500
+    layer_norm: bool = True
+    output_layernorm: bool = False
+    output_vanish: bool = True
+    init_layers: bool = True
+    noisy: bool = False
+    noise_std: float = 0.5
+
+    def __post_init__(self):
+        assert len(self.hidden_size) >= 1, "MLP needs at least one hidden layer"
+        assert self.num_inputs > 0 and self.num_outputs > 0
+
+
+class EvolvableMLP(EvolvableModule):
+    Config = MLPConfig
+
+    def __init__(
+        self,
+        num_inputs: Optional[int] = None,
+        num_outputs: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[MLPConfig] = None,
+        **kwargs,
+    ):
+        if config is None:
+            config = MLPConfig(num_inputs=num_inputs, num_outputs=num_outputs, **kwargs)
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def init_params(key: jax.Array, config: MLPConfig) -> Dict:
+        sizes = (config.num_inputs,) + config.hidden_size
+        params: Dict = {}
+        keys = jax.random.split(key, len(config.hidden_size) + 1)
+        make = L.noisy_dense_init if config.noisy else L.dense_init
+        if config.noisy:
+            make = lambda k, i, o: L.noisy_dense_init(k, i, o, config.noise_std)  # noqa: E731
+        for i in range(len(config.hidden_size)):
+            params[f"layer_{i}"] = make(keys[i], sizes[i], sizes[i + 1])
+            if config.layer_norm:
+                params[f"norm_{i}"] = L.layer_norm_init(sizes[i + 1])
+        out = make(keys[-1], sizes[-1], config.num_outputs)
+        if config.output_vanish and not config.noisy:
+            out = {k: v * 0.1 for k, v in out.items()}
+        params["output"] = out
+        if config.output_layernorm:
+            params["norm_out"] = L.layer_norm_init(config.num_outputs)
+        return params
+
+    @staticmethod
+    def apply(
+        config: MLPConfig,
+        params: Dict,
+        x: jax.Array,
+        key: Optional[jax.Array] = None,
+        **_,
+    ) -> jax.Array:
+        act = L.get_activation(config.activation)
+        out_act = L.get_activation(config.output_activation)
+        n = len(config.hidden_size)
+        if config.noisy:
+            keys = (
+                jax.random.split(key, n + 1) if key is not None else [None] * (n + 1)
+            )
+            dense = L.noisy_dense_apply
+        else:
+            keys = [None] * (n + 1)
+            dense = lambda p, h, k: L.dense_apply(p, h)  # noqa: E731
+        h = x.astype(jnp.float32)
+        for i in range(n):
+            h = (
+                dense(params[f"layer_{i}"], h, keys[i])
+                if config.noisy
+                else L.dense_apply(params[f"layer_{i}"], h)
+            )
+            if config.layer_norm:
+                h = L.layer_norm_apply(params[f"norm_{i}"], h)
+            h = act(h)
+        h = (
+            dense(params["output"], h, keys[-1])
+            if config.noisy
+            else L.dense_apply(params["output"], h)
+        )
+        if config.output_layernorm:
+            h = L.layer_norm_apply(params["norm_out"], h)
+        return out_act(h)
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        """Append a hidden layer (parity: mlp.py:228)."""
+        cfg = self.config
+        if len(cfg.hidden_size) >= cfg.max_hidden_layers:
+            return self.add_node(rng=rng)
+        new_hidden = cfg.hidden_size + (cfg.hidden_size[-1],)
+        self._morph(config_replace(cfg, hidden_size=new_hidden))
+        return {}
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        """Drop the last hidden layer (parity: mlp.py:242)."""
+        cfg = self.config
+        if len(cfg.hidden_size) <= cfg.min_hidden_layers:
+            return self.add_node(rng=rng)
+        self._morph(config_replace(cfg, hidden_size=cfg.hidden_size[:-1]))
+        return {}
+
+    @mutation(MutationType.NODE)
+    def add_node(
+        self,
+        hidden_layer: Optional[int] = None,
+        numb_new_nodes: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        """Grow a random hidden layer by {16,32,64} nodes (parity: mlp.py:255)."""
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if hidden_layer is None:
+            hidden_layer = int(rng.integers(0, len(cfg.hidden_size)))
+        hidden_layer = min(hidden_layer, len(cfg.hidden_size) - 1)
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([16, 32, 64]))
+        new_size = min(cfg.hidden_size[hidden_layer] + numb_new_nodes, cfg.max_mlp_nodes)
+        self._morph(
+            config_replace(cfg, hidden_size=tuple_set(cfg.hidden_size, hidden_layer, new_size))
+        )
+        return {"hidden_layer": hidden_layer, "numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_node(
+        self,
+        hidden_layer: Optional[int] = None,
+        numb_new_nodes: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        """Shrink a random hidden layer (parity: mlp.py:285)."""
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if hidden_layer is None:
+            hidden_layer = int(rng.integers(0, len(cfg.hidden_size)))
+        hidden_layer = min(hidden_layer, len(cfg.hidden_size) - 1)
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([16, 32, 64]))
+        new_size = max(cfg.hidden_size[hidden_layer] - numb_new_nodes, cfg.min_mlp_nodes)
+        self._morph(
+            config_replace(cfg, hidden_size=tuple_set(cfg.hidden_size, hidden_layer, new_size))
+        )
+        return {"hidden_layer": hidden_layer, "numb_new_nodes": numb_new_nodes}

@@ -1,0 +1,146 @@
+"""Evolvable ResNet image encoder (parity: agilerl/modules/resnet.py —
+EvolvableResNet:12, block/channel mutations :197-241; ResidualBlock in
+custom_components.py:152).
+
+NHWC, group-norm-free (layer norm over channels), SAME-padded 3x3 convs so block
+count mutations never invalidate spatial dims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
+from agilerl_tpu.typing import MutationType
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    input_shape: Tuple[int, ...]  # (H, W, C)
+    num_outputs: int
+    channel_size: int = 32
+    num_blocks: int = 2
+    min_blocks: int = 1
+    max_blocks: int = 4
+    min_channel_size: int = 16
+    max_channel_size: int = 128
+    output_activation: Optional[str] = None
+
+    def __post_init__(self):
+        assert len(self.input_shape) == 3
+
+
+class EvolvableResNet(EvolvableModule):
+    Config = ResNetConfig
+
+    def __init__(
+        self,
+        input_shape: Optional[Tuple[int, ...]] = None,
+        num_outputs: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[ResNetConfig] = None,
+        **kwargs,
+    ):
+        if config is None:
+            config = ResNetConfig(
+                input_shape=tuple(input_shape), num_outputs=num_outputs, **kwargs
+            )
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: ResNetConfig) -> Dict:
+        params: Dict = {}
+        c = config.channel_size
+        keys = jax.random.split(key, 2 * config.num_blocks + 2)
+        params["stem"] = L.conv2d_init(keys[0], 3, 3, config.input_shape[-1], c)
+        for i in range(config.num_blocks):
+            params[f"block_{i}"] = {
+                "conv1": L.conv2d_init(keys[2 * i + 1], 3, 3, c, c),
+                "norm1": L.layer_norm_init(c),
+                "conv2": L.conv2d_init(keys[2 * i + 2], 3, 3, c, c),
+                "norm2": L.layer_norm_init(c),
+            }
+        h, w, _ = config.input_shape
+        params["output"] = L.dense_init(keys[-1], c, config.num_outputs)
+        return params
+
+    @staticmethod
+    def apply(config: ResNetConfig, params: Dict, x: jax.Array, **_) -> jax.Array:
+        h = L.maybe_rescale_image(x)
+        squeeze = False
+        if h.ndim == 3:
+            h = h[None]
+            squeeze = True
+        h = L.conv2d_apply(params["stem"], h, stride=1, padding="SAME")
+        for i in range(config.num_blocks):
+            blk = params[f"block_{i}"]
+            r = jax.nn.relu(
+                L.layer_norm_apply(blk["norm1"], L.conv2d_apply(blk["conv1"], h, 1, "SAME"))
+            )
+            r = L.layer_norm_apply(blk["norm2"], L.conv2d_apply(blk["conv2"], r, 1, "SAME"))
+            h = jax.nn.relu(h + r)
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        out = L.dense_apply(params["output"], h)
+        out = L.get_activation(config.output_activation)(out)
+        return out[0] if squeeze else out
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_block(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.num_blocks >= cfg.max_blocks:
+            return self.add_channel(rng=rng)
+        self._morph(config_replace(cfg, num_blocks=cfg.num_blocks + 1))
+        return {}
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_block(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.num_blocks <= cfg.min_blocks:
+            return self.add_channel(rng=rng)
+        self._morph(config_replace(cfg, num_blocks=cfg.num_blocks - 1))
+        return {}
+
+    @mutation(MutationType.NODE)
+    def add_channel(
+        self,
+        numb_new_channels: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        if numb_new_channels is None:
+            numb_new_channels = int(rng.choice([8, 16, 32]))
+        cfg = self.config
+        self._morph(
+            config_replace(
+                cfg,
+                channel_size=min(cfg.channel_size + numb_new_channels, cfg.max_channel_size),
+            )
+        )
+        return {"numb_new_channels": numb_new_channels}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_channel(
+        self,
+        numb_new_channels: Optional[int] = None,
+        rng: Optional[np.random.Generator] = None,
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        if numb_new_channels is None:
+            numb_new_channels = int(rng.choice([8, 16, 32]))
+        cfg = self.config
+        self._morph(
+            config_replace(
+                cfg,
+                channel_size=max(cfg.channel_size - numb_new_channels, cfg.min_channel_size),
+            )
+        )
+        return {"numb_new_channels": numb_new_channels}

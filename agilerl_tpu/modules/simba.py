@@ -1,0 +1,124 @@
+"""Evolvable SimBa encoder — residual-block MLP (parity: agilerl/modules/simba.py
+EvolvableSimBa:10, SimbaResidualBlock in custom_components.py:224; mutations
+add/remove block, add/remove node :147-185).
+
+Block = LayerNorm -> Dense(4h) -> ReLU -> Dense(h) + skip; input projection then
+final LayerNorm, matching the SimBa architecture (Lee et al., 2024).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
+from agilerl_tpu.typing import MutationType
+
+
+@dataclasses.dataclass(frozen=True)
+class SimBaConfig:
+    num_inputs: int
+    num_outputs: int
+    hidden_size: int = 128
+    num_blocks: int = 2
+    min_blocks: int = 1
+    max_blocks: int = 4
+    min_nodes: int = 64
+    max_nodes: int = 500
+    output_activation: Optional[str] = None
+    scale_factor: int = 4
+
+
+class EvolvableSimBa(EvolvableModule):
+    Config = SimBaConfig
+
+    def __init__(
+        self,
+        num_inputs: Optional[int] = None,
+        num_outputs: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[SimBaConfig] = None,
+        **kwargs,
+    ):
+        if config is None:
+            config = SimBaConfig(num_inputs=num_inputs, num_outputs=num_outputs, **kwargs)
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: SimBaConfig) -> Dict:
+        params: Dict = {}
+        keys = jax.random.split(key, 2 * config.num_blocks + 2)
+        params["proj"] = L.dense_init(keys[0], config.num_inputs, config.hidden_size)
+        wide = config.hidden_size * config.scale_factor
+        for i in range(config.num_blocks):
+            params[f"block_{i}"] = {
+                "norm": L.layer_norm_init(config.hidden_size),
+                "fc1": L.dense_init(keys[2 * i + 1], config.hidden_size, wide),
+                "fc2": L.dense_init(keys[2 * i + 2], wide, config.hidden_size),
+            }
+        params["norm_out"] = L.layer_norm_init(config.hidden_size)
+        params["output"] = L.dense_init(keys[-1], config.hidden_size, config.num_outputs)
+        return params
+
+    @staticmethod
+    def apply(config: SimBaConfig, params: Dict, x: jax.Array, **_) -> jax.Array:
+        h = L.dense_apply(params["proj"], x.astype(jnp.float32))
+        for i in range(config.num_blocks):
+            blk = params[f"block_{i}"]
+            r = L.layer_norm_apply(blk["norm"], h)
+            r = jax.nn.relu(L.dense_apply(blk["fc1"], r))
+            r = L.dense_apply(blk["fc2"], r)
+            h = h + r
+        h = L.layer_norm_apply(params["norm_out"], h)
+        out = L.dense_apply(params["output"], h)
+        return L.get_activation(config.output_activation)(out)
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_block(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.num_blocks >= cfg.max_blocks:
+            return self.add_node(rng=rng)
+        self._morph(config_replace(cfg, num_blocks=cfg.num_blocks + 1))
+        return {}
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_block(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.num_blocks <= cfg.min_blocks:
+            return self.add_node(rng=rng)
+        self._morph(config_replace(cfg, num_blocks=cfg.num_blocks - 1))
+        return {}
+
+    @mutation(MutationType.NODE)
+    def add_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([16, 32, 64]))
+        cfg = self.config
+        self._morph(
+            config_replace(cfg, hidden_size=min(cfg.hidden_size + numb_new_nodes, cfg.max_nodes))
+        )
+        return {"numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([16, 32, 64]))
+        cfg = self.config
+        self._morph(
+            config_replace(cfg, hidden_size=max(cfg.hidden_size - numb_new_nodes, cfg.min_nodes))
+        )
+        return {"numb_new_nodes": numb_new_nodes}

@@ -1,0 +1,45 @@
+"""Type aliases and shared enums (parity: agilerl/typing.py, agilerl/protocols.py).
+
+The reference defines runtime Protocol classes for torch modules; here the
+contracts are lighter because modules are (static config, params-pytree) pairs
+and algorithms are thin stateful shells around pure jitted functions.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, Tuple, Union
+
+import jax
+import numpy as np
+
+ArrayLike = Union[jax.Array, np.ndarray, float, int]
+Params = Any  # pytree of jax.Array leaves
+PyTree = Any
+KeyArray = jax.Array
+ObservationType = Union[jax.Array, np.ndarray, Dict[str, Any], Tuple[Any, ...]]
+ExperiencesType = Dict[str, Any]
+GymSpaceType = Any  # gymnasium.spaces.Space (kept Any to avoid hard import here)
+ApplyFn = Callable[..., Any]
+
+
+class MutationType(enum.Enum):
+    """Classes of architecture mutation a module method can implement.
+
+    Parity: agilerl/protocols.py:39 (MutationType LAYER/NODE/ACTIVATION).
+    """
+
+    LAYER = "layer"
+    NODE = "node"
+    ACTIVATION = "activation"
+
+
+class MutationMethod:
+    """Descriptor metadata attached by the @mutation decorator."""
+
+    __slots__ = ("fn", "mutation_type", "shrink_params")
+
+    def __init__(self, fn, mutation_type: MutationType, shrink_params: bool = False):
+        self.fn = fn
+        self.mutation_type = mutation_type
+        self.shrink_params = shrink_params

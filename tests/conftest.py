@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Parity with the reference's test strategy (SURVEY.md §4): the reference fakes a
+single-process DeepSpeed world (tests/subprocess_runner.py:37-50); JAX lets us do
+better — a real 8-device mesh on CPU so collectives and shardings are exercised
+for real.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# This image's sitecustomize registers the axon TPU PJRT plugin and force-sets
+# jax_platforms="axon,cpu"; any backend touch would then dial the TPU tunnel
+# (minutes when contended). Tests must run on the virtual 8-device CPU mesh, so
+# force the config back *after* jax import but before any backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(42)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,120 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.modules import EvolvableMLP
+from agilerl_tpu.modules.base import preserve_params
+
+
+def make_mlp(key, **kw):
+    defaults = dict(num_inputs=4, num_outputs=2, hidden_size=(32, 32))
+    defaults.update(kw)
+    return EvolvableMLP(key=key, **defaults)
+
+
+def test_forward_shape(key):
+    mlp = make_mlp(key)
+    x = jnp.ones((8, 4))
+    out = mlp(x)
+    assert out.shape == (8, 2)
+    assert jnp.isfinite(out).all()
+
+
+def test_forward_jit_consistent(key):
+    mlp = make_mlp(key)
+    x = jax.random.normal(key, (5, 4))
+    eager = mlp(x)
+    jitted = jax.jit(lambda p, x: EvolvableMLP.apply(mlp.config, p, x))(mlp.params, x)
+    np.testing.assert_allclose(eager, jitted, rtol=1e-5)
+
+
+@pytest.mark.parametrize("activation", ["ReLU", "Tanh", "GELU", "ELU", "LeakyReLU"])
+def test_activations(key, activation):
+    mlp = make_mlp(key, activation=activation)
+    assert mlp(jnp.ones((1, 4))).shape == (1, 2)
+
+
+def test_noisy(key):
+    mlp = make_mlp(key, noisy=True)
+    x = jnp.ones((3, 4))
+    det = mlp(x)
+    noisy1 = mlp(x, key=jax.random.PRNGKey(0))
+    noisy2 = mlp(x, key=jax.random.PRNGKey(1))
+    assert det.shape == (3, 2)
+    assert not jnp.allclose(noisy1, noisy2)
+
+
+def test_add_layer_preserves_weights(key):
+    mlp = make_mlp(key)
+    old_l0 = mlp.params["layer_0"]["kernel"]
+    mlp.add_layer()
+    assert len(mlp.config.hidden_size) == 3
+    np.testing.assert_array_equal(mlp.params["layer_0"]["kernel"], old_l0)
+    assert mlp(jnp.ones((2, 4))).shape == (2, 2)
+    assert mlp.last_mutation_attr == "add_layer"
+
+
+def test_remove_layer(key):
+    mlp = make_mlp(key, hidden_size=(32, 32, 32))
+    mlp.remove_layer()
+    assert len(mlp.config.hidden_size) == 2
+    assert mlp(jnp.ones((2, 4))).shape == (2, 2)
+
+
+def test_add_node_preserves_slab(key, rng):
+    mlp = make_mlp(key)
+    old = mlp.params["layer_0"]["kernel"]
+    info = mlp.add_node(hidden_layer=0, numb_new_nodes=16)
+    assert mlp.config.hidden_size[0] == 48
+    assert info["numb_new_nodes"] == 16
+    new = mlp.params["layer_0"]["kernel"]
+    assert new.shape == (4, 48)
+    np.testing.assert_array_equal(new[:, :32], old)
+    assert mlp(jnp.ones((2, 4))).shape == (2, 2)
+
+
+def test_remove_node_respects_min(key):
+    mlp = make_mlp(key, hidden_size=(70,), min_mlp_nodes=64)
+    mlp.remove_node(hidden_layer=0, numb_new_nodes=32)
+    assert mlp.config.hidden_size[0] == 64
+
+
+def test_layer_bounds(key, rng):
+    mlp = make_mlp(key, hidden_size=(32,), min_hidden_layers=1, max_hidden_layers=1)
+    # both mutations should fall back to node mutation
+    mlp.add_layer(rng=rng)
+    assert len(mlp.config.hidden_size) == 1
+    mlp.remove_layer(rng=rng)
+    assert len(mlp.config.hidden_size) == 1
+
+
+def test_clone_independent(key):
+    mlp = make_mlp(key)
+    clone = mlp.clone()
+    np.testing.assert_array_equal(
+        clone.params["layer_0"]["kernel"], mlp.params["layer_0"]["kernel"]
+    )
+    clone.add_node(hidden_layer=0, numb_new_nodes=16)
+    assert mlp.config.hidden_size[0] == 32
+    assert clone.config.hidden_size[0] == 48
+
+
+def test_mutation_discovery():
+    methods = EvolvableMLP.get_mutation_methods()
+    assert set(methods) == {"add_layer", "remove_layer", "add_node", "remove_node"}
+    assert set(EvolvableMLP.layer_mutation_methods()) == {"add_layer", "remove_layer"}
+
+
+def test_sample_mutation_method(key, rng):
+    mlp = make_mlp(key)
+    names = {mlp.sample_mutation_method(rng=rng) for _ in range(50)}
+    assert names <= {"add_layer", "remove_layer", "add_node", "remove_node"}
+    assert names & {"add_node", "remove_node"}
+
+
+def test_preserve_params_shrink(key):
+    a = {"w": jnp.arange(12.0).reshape(3, 4)}
+    b = {"w": jnp.zeros((2, 2))}
+    out = preserve_params(a, b)
+    np.testing.assert_array_equal(out["w"], jnp.array([[0.0, 1.0], [4.0, 5.0]]))
