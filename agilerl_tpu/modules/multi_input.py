@@ -1,0 +1,186 @@
+"""Evolvable multi-input encoder for Dict/Tuple observation spaces
+(parity: agilerl/modules/multi_input.py — EvolvableMultiInput:65,
+build_feature_extractor:353, latent mutations :483,501).
+
+Per-key feature extractors (CNN for image subspaces, MLP for vector subspaces)
+are fused by concatenation into a final dense latent layer. Sub-extractors are
+themselves evolvable modules, so architecture mutations recurse into a randomly
+chosen subnetwork — mirroring the reference's nested-module mutation recursion
+(modules/base.py:629) — while latent-dim mutations act on the fusion layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
+from agilerl_tpu.modules.cnn import CNNConfig, EvolvableCNN
+from agilerl_tpu.modules.mlp import EvolvableMLP, MLPConfig
+from agilerl_tpu.typing import MutationType
+
+# Sub-configs are stored in a tuple of (key, kind, config) so the whole config
+# stays hashable/static.
+SubCfg = Tuple[str, str, Any]  # (obs key, "cnn"|"mlp", sub config)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiInputConfig:
+    sub_configs: Tuple[SubCfg, ...]
+    num_outputs: int
+    latent_dim: int = 64
+    vector_spaces_mlp: bool = True
+    output_activation: Optional[str] = None
+    min_latent_dim: int = 16
+    max_latent_dim: int = 256
+
+
+def _build_sub_configs(
+    observation_space, feature_dim: int = 64
+) -> Tuple[SubCfg, ...]:
+    """Auto-derive per-key extractor configs from a Dict/Tuple gym space."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.utils.spaces import image_shape_nhwc, is_image_space, obs_dim
+
+    if isinstance(observation_space, gspaces.Dict):
+        items = list(observation_space.spaces.items())
+    else:  # Tuple space
+        items = [(str(i), s) for i, s in enumerate(observation_space.spaces)]
+    subs = []
+    for key, space in items:
+        if is_image_space(space):
+            cfg = CNNConfig(
+                input_shape=image_shape_nhwc(space),
+                num_outputs=feature_dim,
+                channel_size=(16, 16),
+                kernel_size=(3, 3),
+                stride_size=(2, 2),
+            )
+            subs.append((key, "cnn", cfg))
+        else:
+            cfg = MLPConfig(
+                num_inputs=obs_dim(space),
+                num_outputs=feature_dim,
+                hidden_size=(64,),
+                output_vanish=False,
+            )
+            subs.append((key, "mlp", cfg))
+    return tuple(subs)
+
+
+_SUB_TYPES = {"cnn": EvolvableCNN, "mlp": EvolvableMLP}
+
+
+class EvolvableMultiInput(EvolvableModule):
+    Config = MultiInputConfig
+
+    def __init__(
+        self,
+        observation_space=None,
+        num_outputs: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[MultiInputConfig] = None,
+        **kwargs,
+    ):
+        if config is None:
+            sub_configs = _build_sub_configs(observation_space)
+            config = MultiInputConfig(
+                sub_configs=sub_configs, num_outputs=num_outputs, **kwargs
+            )
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: MultiInputConfig) -> Dict:
+        params: Dict = {}
+        keys = jax.random.split(key, len(config.sub_configs) + 2)
+        total = 0
+        for i, (name, kind, sub_cfg) in enumerate(config.sub_configs):
+            params[f"sub_{name}"] = _SUB_TYPES[kind].init_params(keys[i], sub_cfg)
+            total += sub_cfg.num_outputs
+        params["fusion"] = L.dense_init(keys[-2], total, config.latent_dim)
+        params["output"] = L.dense_init(keys[-1], config.latent_dim, config.num_outputs)
+        return params
+
+    @staticmethod
+    def apply(config: MultiInputConfig, params: Dict, x: Any, **_) -> jax.Array:
+        feats = []
+        for name, kind, sub_cfg in config.sub_configs:
+            obs = x[name] if isinstance(x, dict) else x[int(name)]
+            feats.append(_SUB_TYPES[kind].apply(sub_cfg, params[f"sub_{name}"], obs))
+        h = jnp.concatenate([f.astype(jnp.float32) for f in feats], axis=-1)
+        h = jax.nn.relu(L.dense_apply(params["fusion"], h))
+        out = L.dense_apply(params["output"], h)
+        return L.get_activation(config.output_activation)(out)
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.NODE)
+    def add_latent_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        """Grow the fusion latent dim (parity: multi_input.py:483)."""
+        rng = rng or np.random.default_rng()
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([8, 16, 32]))
+        cfg = self.config
+        self._morph(
+            config_replace(
+                cfg, latent_dim=min(cfg.latent_dim + numb_new_nodes, cfg.max_latent_dim)
+            )
+        )
+        return {"numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_latent_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        """Shrink the fusion latent dim (parity: multi_input.py:501)."""
+        rng = rng or np.random.default_rng()
+        if numb_new_nodes is None:
+            numb_new_nodes = int(rng.choice([8, 16, 32]))
+        cfg = self.config
+        self._morph(
+            config_replace(
+                cfg, latent_dim=max(cfg.latent_dim - numb_new_nodes, cfg.min_latent_dim)
+            )
+        )
+        return {"numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.LAYER)
+    def add_sub_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        """Add a layer to a random sub-extractor (nested-module mutation;
+        parity: the reference recurses @mutation calls into sub-modules,
+        modules/base.py:629)."""
+        return self._mutate_sub("add_layer", "add_block", rng)
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_sub_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        return self._mutate_sub("remove_layer", "remove_block", rng)
+
+    def _mutate_sub(self, mlp_method: str, _alt: str, rng) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        idx = int(rng.integers(0, len(cfg.sub_configs)))
+        name, kind, sub_cfg = cfg.sub_configs[idx]
+        # materialise the sub-module, mutate it, write back config + params
+        sub_cls = _SUB_TYPES[kind]
+        sub = object.__new__(sub_cls)
+        sub.config = sub_cfg
+        sub._key = self._next_key()
+        sub.params = self.params[f"sub_{name}"]
+        sub.last_mutation_attr = None
+        sub.last_mutation = {}
+        method = mlp_method if hasattr(sub, mlp_method) else _alt
+        getattr(sub, method)(rng=rng)
+        new_subs = list(cfg.sub_configs)
+        new_subs[idx] = (name, kind, sub.config)
+        self.params[f"sub_{name}"] = sub.params
+        self.config = config_replace(cfg, sub_configs=tuple(new_subs))
+        return {"sub": name, "method": method}

@@ -1,0 +1,21 @@
+from agilerl_tpu.networks.actors import DeterministicActor, StochasticActor
+from agilerl_tpu.networks.base import EvolvableNetwork, NetworkConfig
+from agilerl_tpu.networks.q_networks import (
+    ContinuousQNetwork,
+    QNetwork,
+    RainbowConfig,
+    RainbowQNetwork,
+)
+from agilerl_tpu.networks.value_networks import ValueNetwork
+
+__all__ = [
+    "EvolvableNetwork",
+    "NetworkConfig",
+    "QNetwork",
+    "RainbowQNetwork",
+    "RainbowConfig",
+    "ContinuousQNetwork",
+    "DeterministicActor",
+    "StochasticActor",
+    "ValueNetwork",
+]

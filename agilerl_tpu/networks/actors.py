@@ -1,0 +1,101 @@
+"""Actor networks (parity: agilerl/networks/actors.py — DeterministicActor:33
+with rescale_action:149, StochasticActor:225 wrapping an EvolvableDistribution).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from agilerl_tpu.modules.mlp import EvolvableMLP
+from agilerl_tpu.networks import distributions as D
+from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.utils.spaces import action_dim
+
+
+class DeterministicActor(EvolvableNetwork):
+    """Deterministic policy for DDPG/TD3: obs -> tanh -> rescaled Box action."""
+
+    def __init__(self, observation_space, action_space, **kwargs):
+        assert isinstance(action_space, spaces.Box), "DeterministicActor needs Box actions"
+        self.action_space = action_space
+        kwargs.setdefault("head_config", {})
+        kwargs["head_config"] = {**kwargs["head_config"], "output_activation": "Tanh"}
+        super().__init__(observation_space, num_outputs=action_dim(action_space), **kwargs)
+        self.action_low = jnp.asarray(action_space.low, jnp.float32)
+        self.action_high = jnp.asarray(action_space.high, jnp.float32)
+
+    @staticmethod
+    def rescale(action: jax.Array, low: jax.Array, high: jax.Array) -> jax.Array:
+        """Map tanh output [-1,1] onto [low, high] (parity: actors.py:149)."""
+        return low + (action + 1.0) * 0.5 * (high - low)
+
+    def __call__(self, obs, **kw):
+        raw = type(self).apply(self.config, self.params, obs, **kw)
+        return self.rescale(raw, self.action_low, self.action_high)
+
+    @property
+    def init_dict(self):
+        d = super().init_dict
+        d["action_space"] = self.action_space
+        return d
+
+
+class StochasticActor(EvolvableNetwork):
+    """Stochastic policy for PPO/IPPO/GRPO-classic: head outputs distribution
+    params; the distribution family is derived from the action space
+    (parity: actors.py:225 + EvolvableDistribution)."""
+
+    def __init__(self, observation_space, action_space, **kwargs):
+        self.action_space = action_space
+        self.dist_config = D.dist_config_from_space(action_space)
+        super().__init__(
+            observation_space, num_outputs=D.head_output_dim(self.dist_config), **kwargs
+        )
+        extra = D.extra_params(self.dist_config)
+        if extra:
+            self.params["dist"] = extra
+
+    @staticmethod
+    def init_params(key: jax.Array, config) -> Dict:
+        params = EvolvableNetwork.init_params(key, config)
+        return params
+
+    def logits(self, obs, **kw) -> jax.Array:
+        return type(self).apply(self.config, self.params, obs, **kw)
+
+    def __call__(
+        self,
+        obs,
+        key: Optional[jax.Array] = None,
+        action_mask: Optional[jax.Array] = None,
+        deterministic: bool = False,
+        **kw,
+    ):
+        """Sample (action, log_prob, entropy)."""
+        logits = self.logits(obs, **kw)
+        dist_extra = self.params.get("dist")
+        if deterministic or key is None:
+            action = D.mode(self.dist_config, logits, mask=action_mask)
+        else:
+            action = D.sample(self.dist_config, logits, key, dist_extra, mask=action_mask)
+        logp = D.log_prob(self.dist_config, logits, action, dist_extra, mask=action_mask)
+        ent = D.entropy(self.dist_config, logits, dist_extra, mask=action_mask)
+        return action, logp, ent
+
+    def evaluate_actions(self, obs, actions, action_mask=None, **kw):
+        logits = self.logits(obs, **kw)
+        dist_extra = self.params.get("dist")
+        logp = D.log_prob(self.dist_config, logits, actions, dist_extra, mask=action_mask)
+        ent = D.entropy(self.dist_config, logits, dist_extra, mask=action_mask)
+        return logp, ent
+
+    @property
+    def init_dict(self):
+        d = super().init_dict
+        d["action_space"] = self.action_space
+        return d

@@ -1,0 +1,238 @@
+"""EvolvableNetwork: encoder (auto-selected from the observation space) + task
+head, with latent-space mutations and prefixed delegation into sub-modules.
+
+Parity: agilerl/networks/base.py — EvolvableNetwork:134, encoder auto-selection
+via get_default_encoder_config (utils/evolvable_networks.py:168), latent
+mutations add_latent_node/remove_latent_node:458,476, simba/recurrent switches
+:182.
+
+TPU-first: a network is (static NetworkConfig, params dict {"encoder","head"}).
+The mutation namespace is flat strings — "add_latent_node", "encoder.add_layer",
+"head.add_node" — so the HPO engine can sample one method on the policy net and
+replay the identical method name on critics/targets (parity with
+hpo/mutation.py:829's same-mutation-across-networks rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from agilerl_tpu.modules.base import EvolvableModule, config_replace, preserve_params
+from agilerl_tpu.modules.cnn import CNNConfig, EvolvableCNN
+from agilerl_tpu.modules.lstm import EvolvableLSTM, LSTMConfig
+from agilerl_tpu.modules.mlp import EvolvableMLP, MLPConfig
+from agilerl_tpu.modules.multi_input import (
+    EvolvableMultiInput,
+    MultiInputConfig,
+    _build_sub_configs,
+)
+from agilerl_tpu.modules.simba import EvolvableSimBa, SimBaConfig
+from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.spaces import image_shape_nhwc, is_image_space, obs_dim
+
+ENCODER_TYPES = {
+    "mlp": EvolvableMLP,
+    "cnn": EvolvableCNN,
+    "multi_input": EvolvableMultiInput,
+    "lstm": EvolvableLSTM,
+    "simba": EvolvableSimBa,
+}
+
+
+def default_encoder_config(
+    observation_space: Any,
+    latent_dim: int,
+    simba: bool = False,
+    recurrent: bool = False,
+    encoder_config: Optional[dict] = None,
+) -> Tuple[str, Any]:
+    """Pick encoder kind + config from the obs space
+    (parity: utils/evolvable_networks.py:168)."""
+    encoder_config = dict(encoder_config or {})
+    if isinstance(observation_space, (spaces.Dict, spaces.Tuple)):
+        subs = _build_sub_configs(observation_space)
+        return "multi_input", MultiInputConfig(
+            sub_configs=subs, num_outputs=latent_dim, **encoder_config
+        )
+    if is_image_space(observation_space):
+        encoder_config.setdefault("channel_size", (32, 32))
+        encoder_config.setdefault("kernel_size", (8, 4))
+        encoder_config.setdefault("stride_size", (4, 2))
+        return "cnn", CNNConfig(
+            input_shape=image_shape_nhwc(observation_space),
+            num_outputs=latent_dim,
+            **encoder_config,
+        )
+    dim = obs_dim(observation_space)
+    if recurrent:
+        return "lstm", LSTMConfig(num_inputs=dim, num_outputs=latent_dim, **encoder_config)
+    if simba:
+        return "simba", SimBaConfig(num_inputs=dim, num_outputs=latent_dim, **encoder_config)
+    encoder_config.setdefault("hidden_size", (64,))
+    encoder_config.setdefault("output_vanish", False)
+    return "mlp", MLPConfig(num_inputs=dim, num_outputs=latent_dim, **encoder_config)
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkConfig:
+    encoder_kind: str
+    encoder: Any  # encoder config dataclass
+    head: MLPConfig
+    latent_dim: int = 32
+    min_latent_dim: int = 8
+    max_latent_dim: int = 128
+
+
+class EvolvableNetwork:
+    """Composite evolvable net = encoder -> latent -> head."""
+
+    def __init__(
+        self,
+        observation_space: Any,
+        num_outputs: int,
+        key: Optional[jax.Array] = None,
+        latent_dim: int = 32,
+        simba: bool = False,
+        recurrent: bool = False,
+        encoder_config: Optional[dict] = None,
+        head_config: Optional[dict] = None,
+        config: Optional[NetworkConfig] = None,
+    ):
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self._key = key
+        self.observation_space = observation_space
+        if config is None:
+            kind, enc_cfg = default_encoder_config(
+                observation_space, latent_dim, simba, recurrent, encoder_config
+            )
+            head_kwargs = dict(head_config or {})
+            head_kwargs.setdefault("hidden_size", (64,))
+            head = MLPConfig(num_inputs=latent_dim, num_outputs=num_outputs, **head_kwargs)
+            config = NetworkConfig(
+                encoder_kind=kind, encoder=enc_cfg, head=head, latent_dim=latent_dim
+            )
+        self.config = config
+        self.params = self.init_params(self._next_key(), config)
+        self.last_mutation_attr: Optional[str] = None
+        self.last_mutation: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    @staticmethod
+    def init_params(key: jax.Array, config: NetworkConfig) -> Dict:
+        k1, k2 = jax.random.split(key)
+        enc_cls = ENCODER_TYPES[config.encoder_kind]
+        return {
+            "encoder": enc_cls.init_params(k1, config.encoder),
+            "head": EvolvableMLP.init_params(k2, config.head),
+        }
+
+    @staticmethod
+    def encode(config: NetworkConfig, params: Dict, obs: Any, **kw) -> jax.Array:
+        enc_cls = ENCODER_TYPES[config.encoder_kind]
+        return enc_cls.apply(config.encoder, params["encoder"], obs, **kw)
+
+    @staticmethod
+    def apply(config: NetworkConfig, params: Dict, obs: Any, **kw) -> jax.Array:
+        latent = EvolvableNetwork.encode(config, params, obs, **kw)
+        return EvolvableMLP.apply(config.head, params["head"], latent)
+
+    def __call__(self, obs: Any, **kw):
+        return type(self).apply(self.config, self.params, obs, **kw)
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {"observation_space": self.observation_space, "config": self.config}
+
+    # -- mutation namespace --------------------------------------------- #
+    def mutation_methods(self) -> List[str]:
+        enc_cls = ENCODER_TYPES[self.config.encoder_kind]
+        names = ["add_latent_node", "remove_latent_node"]
+        names += [f"encoder.{n}" for n in enc_cls.get_mutation_methods()]
+        names += [f"head.{n}" for n in EvolvableMLP.get_mutation_methods()]
+        return names
+
+    def sample_mutation_method(
+        self, new_layer_prob: float = 0.2, rng: Optional[np.random.Generator] = None
+    ) -> str:
+        rng = rng or np.random.default_rng()
+        enc_cls = ENCODER_TYPES[self.config.encoder_kind]
+        layer_methods = [f"encoder.{n}" for n in enc_cls.layer_mutation_methods()]
+        layer_methods += [f"head.{n}" for n in EvolvableMLP.layer_mutation_methods()]
+        node_methods = ["add_latent_node", "remove_latent_node"]
+        node_methods += [f"encoder.{n}" for n in enc_cls.node_mutation_methods()]
+        node_methods += [f"head.{n}" for n in EvolvableMLP.node_mutation_methods()]
+        if layer_methods and rng.random() < new_layer_prob:
+            return str(rng.choice(layer_methods))
+        return str(rng.choice(node_methods))
+
+    def apply_mutation(self, name: str, rng: Optional[np.random.Generator] = None) -> Dict:
+        """Apply a mutation by namespaced name; returns mutation metadata."""
+        rng = rng or np.random.default_rng()
+        self.last_mutation_attr = name
+        if name == "add_latent_node":
+            return self._change_latent(+int(rng.choice([8, 16, 32])))
+        if name == "remove_latent_node":
+            return self._change_latent(-int(rng.choice([8, 16, 32])))
+        scope, method = name.split(".", 1)
+        if scope == "encoder":
+            sub_cls = ENCODER_TYPES[self.config.encoder_kind]
+            sub = self._materialise(sub_cls, self.config.encoder, self.params["encoder"])
+            info = sub.apply_mutation(method, rng=rng)
+            self.config = config_replace(self.config, encoder=sub.config)
+            self.params["encoder"] = sub.params
+        else:
+            sub = self._materialise(EvolvableMLP, self.config.head, self.params["head"])
+            info = sub.apply_mutation(method, rng=rng)
+            self.config = config_replace(self.config, head=sub.config)
+            self.params["head"] = sub.params
+        self.last_mutation = info
+        return info
+
+    def _materialise(self, cls, cfg, params) -> EvolvableModule:
+        sub = object.__new__(cls)
+        sub.config = cfg
+        sub._key = self._next_key()
+        sub.params = params
+        sub.last_mutation_attr = None
+        sub.last_mutation = {}
+        return sub
+
+    def _change_latent(self, delta: int) -> Dict:
+        cfg = self.config
+        new_latent = int(
+            np.clip(cfg.latent_dim + delta, cfg.min_latent_dim, cfg.max_latent_dim)
+        )
+        if new_latent == cfg.latent_dim:
+            return {"numb_new_nodes": 0}
+        enc_cfg = config_replace(cfg.encoder, num_outputs=new_latent)
+        head_cfg = config_replace(cfg.head, num_inputs=new_latent)
+        new_cfg = config_replace(cfg, encoder=enc_cfg, head=head_cfg, latent_dim=new_latent)
+        new_params = self.init_params(self._next_key(), new_cfg)
+        self.params = preserve_params(self.params, new_params)
+        self.config = new_cfg
+        self.last_mutation = {"numb_new_nodes": abs(delta)}
+        return self.last_mutation
+
+    # -- cloning / state ------------------------------------------------ #
+    def clone(self) -> "EvolvableNetwork":
+        new = object.__new__(type(self))
+        new.__dict__.update({k: v for k, v in self.__dict__.items() if k != "params"})
+        new.params = jax.tree_util.tree_map(jnp.copy, self.params)
+        return new
+
+    def state_dict(self) -> Dict:
+        return self.params
+
+    def load_state_dict(self, params: Dict) -> None:
+        self.params = params

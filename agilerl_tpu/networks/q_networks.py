@@ -1,0 +1,208 @@
+"""Q-networks (parity: agilerl/networks/q_networks.py — QNetwork:20,
+RainbowQNetwork:140 (dueling + C51 distributional + noisy), ContinuousQNetwork:302).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from agilerl_tpu.modules.base import config_replace, preserve_params
+from agilerl_tpu.modules.mlp import EvolvableMLP, MLPConfig
+from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.utils.spaces import action_dim
+
+
+class QNetwork(EvolvableNetwork):
+    """Discrete-action state-action value net Q(s) -> [num_actions]."""
+
+    def __init__(self, observation_space, action_space, **kwargs):
+        assert isinstance(
+            action_space, (spaces.Discrete, spaces.MultiDiscrete)
+        ), "QNetwork requires a discrete action space"
+        self.action_space = action_space
+        super().__init__(observation_space, num_outputs=action_dim(action_space), **kwargs)
+
+    @property
+    def init_dict(self):
+        d = super().init_dict
+        d["action_space"] = self.action_space
+        return d
+
+
+class ContinuousQNetwork(EvolvableNetwork):
+    """Q(s, a) critic: obs -> encoder -> latent ⊕ action -> head -> scalar
+    (parity: q_networks.py:302). The action is concatenated at the latent
+    boundary, keeping image encoders reusable."""
+
+    def __init__(self, observation_space, action_space, **kwargs):
+        self.action_space = action_space
+        self.action_dim = action_dim(action_space)
+        kwargs.setdefault("head_config", {})
+        super().__init__(observation_space, num_outputs=1, **kwargs)
+        # head consumes latent ⊕ action
+        if self.config.head.num_inputs != self.config.latent_dim + self.action_dim:
+            head_cfg = config_replace(
+                self.config.head, num_inputs=self.config.latent_dim + self.action_dim
+            )
+            new_cfg = config_replace(self.config, head=head_cfg)
+            new_params = self.init_params(self._next_key(), new_cfg)
+            self.params = preserve_params(self.params, new_params)
+            self.config = new_cfg
+
+    @staticmethod
+    def apply(config, params: Dict, obs: Any, action: jax.Array = None, **kw) -> jax.Array:
+        latent = EvolvableNetwork.encode(config, params, obs, **kw)
+        h = jnp.concatenate([latent, action.astype(jnp.float32)], axis=-1)
+        q = EvolvableMLP.apply(config.head, params["head"], h)
+        return q[..., 0]
+
+    def __call__(self, obs, action, **kw):
+        return type(self).apply(self.config, self.params, obs, action=action, **kw)
+
+    def _change_latent(self, delta: int) -> Dict:
+        cfg = self.config
+        new_latent = int(
+            np.clip(cfg.latent_dim + delta, cfg.min_latent_dim, cfg.max_latent_dim)
+        )
+        if new_latent == cfg.latent_dim:
+            return {"numb_new_nodes": 0}
+        enc_cfg = config_replace(cfg.encoder, num_outputs=new_latent)
+        head_cfg = config_replace(cfg.head, num_inputs=new_latent + self.action_dim)
+        new_cfg = config_replace(cfg, encoder=enc_cfg, head=head_cfg, latent_dim=new_latent)
+        new_params = self.init_params(self._next_key(), new_cfg)
+        self.params = preserve_params(self.params, new_params)
+        self.config = new_cfg
+        self.last_mutation = {"numb_new_nodes": abs(delta)}
+        return self.last_mutation
+
+    @property
+    def init_dict(self):
+        d = super().init_dict
+        d["action_space"] = self.action_space
+        return d
+
+
+import dataclasses
+
+from agilerl_tpu.networks.base import NetworkConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class RainbowConfig(NetworkConfig):
+    num_atoms: int = 51
+    num_actions: int = 2
+    v_min: float = -100.0
+    v_max: float = 100.0
+
+
+class RainbowQNetwork(EvolvableNetwork):
+    """Dueling C51 distributional Q-net with noisy heads
+    (parity: q_networks.py:140).
+
+    Head params: advantage stream (latent -> actions*atoms) and value stream
+    (latent -> atoms), both noisy MLPs. __call__ returns expected Q-values;
+    apply_dist returns atom log-probabilities."""
+
+    def __init__(
+        self,
+        observation_space,
+        action_space,
+        num_atoms: int = 51,
+        v_min: float = -100.0,
+        v_max: float = 100.0,
+        noise_std: float = 0.5,
+        config: Optional[RainbowConfig] = None,
+        **kwargs,
+    ):
+        assert isinstance(action_space, spaces.Discrete)
+        self.action_space = action_space
+        num_actions = int(action_space.n)
+        if config is None:
+            kwargs.setdefault("head_config", {})
+            kwargs["head_config"] = {
+                **kwargs["head_config"],
+                "noisy": True,
+                "noise_std": noise_std,
+                "layer_norm": True,
+                "output_vanish": False,
+            }
+            super().__init__(
+                observation_space, num_outputs=num_actions * num_atoms, **kwargs
+            )
+            self.config = RainbowConfig(
+                **dataclasses.asdict_shallow(self.config)
+                if hasattr(dataclasses, "asdict_shallow")
+                else {f.name: getattr(self.config, f.name) for f in dataclasses.fields(self.config)},
+                num_atoms=num_atoms,
+                num_actions=num_actions,
+                v_min=v_min,
+                v_max=v_max,
+            )
+            # re-init params so the value stream exists
+            self.params = self.init_params(self._next_key(), self.config)
+        else:
+            super().__init__(observation_space, num_outputs=num_actions * num_atoms,
+                             config=config, **kwargs)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: RainbowConfig) -> Dict:
+        k1, k2, k3 = jax.random.split(key, 3)
+        from agilerl_tpu.networks.base import ENCODER_TYPES
+
+        enc_cls = ENCODER_TYPES[config.encoder_kind]
+        num_atoms = getattr(config, "num_atoms", 51)
+        return {
+            "encoder": enc_cls.init_params(k1, config.encoder),
+            "head": EvolvableMLP.init_params(k2, config.head),
+            "value": EvolvableMLP.init_params(
+                k3, config_replace(config.head, num_outputs=num_atoms)
+            ),
+        }
+
+    @staticmethod
+    def apply_dist(
+        config: RainbowConfig,
+        params: Dict,
+        obs: Any,
+        key: Optional[jax.Array] = None,
+        **kw,
+    ) -> jax.Array:
+        """Return atom log-probabilities [..., actions, atoms]."""
+        latent = EvolvableNetwork.encode(config, params, obs, **kw)
+        atoms, actions = config.num_atoms, config.num_actions
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
+        adv = EvolvableMLP.apply(config.head, params["head"], latent, key=k1)
+        val = EvolvableMLP.apply(
+            config_replace(config.head, num_outputs=atoms), params["value"], latent, key=k2
+        )
+        adv = adv.reshape(*adv.shape[:-1], actions, atoms)
+        val = val.reshape(*val.shape[:-1], 1, atoms)
+        q_atoms = val + adv - jnp.mean(adv, axis=-2, keepdims=True)
+        return jax.nn.log_softmax(q_atoms, axis=-1)
+
+    @staticmethod
+    def apply(config: RainbowConfig, params: Dict, obs: Any, key=None, **kw) -> jax.Array:
+        logp = RainbowQNetwork.apply_dist(config, params, obs, key=key, **kw)
+        support = jnp.linspace(config.v_min, config.v_max, config.num_atoms)
+        return jnp.sum(jnp.exp(logp) * support, axis=-1)
+
+    def support(self) -> jax.Array:
+        return jnp.linspace(self.config.v_min, self.config.v_max, self.config.num_atoms)
+
+    def __call__(self, obs, key=None, q_values: bool = True, **kw):
+        if q_values:
+            return self.apply(self.config, self.params, obs, key=key, **kw)
+        return self.apply_dist(self.config, self.params, obs, key=key, **kw)
+
+    @property
+    def init_dict(self):
+        d = super().init_dict
+        d.update(action_space=self.action_space)
+        return d

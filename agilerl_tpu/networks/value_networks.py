@@ -1,0 +1,18 @@
+"""State-value network V(s) (parity: agilerl/networks/value_networks.py:12)."""
+
+from __future__ import annotations
+
+import jax
+
+from agilerl_tpu.networks.base import EvolvableNetwork
+
+
+class ValueNetwork(EvolvableNetwork):
+    """obs -> scalar value (PPO critic)."""
+
+    def __init__(self, observation_space, **kwargs):
+        super().__init__(observation_space, num_outputs=1, **kwargs)
+
+    def __call__(self, obs, **kw) -> jax.Array:
+        v = type(self).apply(self.config, self.params, obs, **kw)
+        return v[..., 0]

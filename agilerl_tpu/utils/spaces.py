@@ -1,0 +1,115 @@
+"""Gymnasium-space introspection helpers (parity: agilerl/utils/evolvable_networks.py
+get_default_encoder_config:168 and agilerl/utils/algo_utils.py obs utilities).
+
+Observation conversion targets NHWC float32/uint8 jax arrays; discrete obs are
+one-hot encoded on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+
+def is_image_space(space: Any) -> bool:
+    return isinstance(space, spaces.Box) and len(space.shape) == 3
+
+
+def is_vector_space(space: Any) -> bool:
+    return (
+        isinstance(space, (spaces.Discrete, spaces.MultiDiscrete, spaces.MultiBinary))
+        or (isinstance(space, spaces.Box) and len(space.shape) <= 1)
+    )
+
+
+def obs_dim(space: Any) -> int:
+    """Flat feature dimension of a non-image space."""
+    if isinstance(space, spaces.Discrete):
+        return int(space.n)
+    if isinstance(space, spaces.MultiDiscrete):
+        return int(np.sum(space.nvec))
+    if isinstance(space, spaces.MultiBinary):
+        return int(np.prod(space.shape))
+    if isinstance(space, spaces.Box):
+        return int(np.prod(space.shape)) if space.shape else 1
+    raise TypeError(f"Unsupported observation space {type(space)}")
+
+
+def image_shape_nhwc(space: spaces.Box) -> Tuple[int, int, int]:
+    """Return (H, W, C). Accepts CHW (torch-style) or HWC boxes; a leading dim
+    of <= 4 with trailing square dims is treated as channels-first."""
+    s = space.shape
+    assert len(s) == 3
+    if s[0] <= 4 and s[1] == s[2]:
+        return (s[1], s[2], s[0])
+    return (s[0], s[1], s[2])
+
+
+def action_dim(space: Any) -> int:
+    if isinstance(space, spaces.Discrete):
+        return int(space.n)
+    if isinstance(space, spaces.MultiDiscrete):
+        return int(np.sum(space.nvec))
+    if isinstance(space, spaces.MultiBinary):
+        return int(np.prod(space.shape))
+    if isinstance(space, spaces.Box):
+        return int(np.prod(space.shape))
+    raise TypeError(f"Unsupported action space {type(space)}")
+
+
+def preprocess_observation(space: Any, obs: Any) -> Any:
+    """Convert a host/raw observation into network-ready jax arrays
+    (parity: agilerl/utils/algo_utils.py:889 preprocess_observation).
+
+    - Discrete -> one-hot float32
+    - MultiDiscrete -> concatenated one-hots
+    - Box images: CHW inputs transposed to NHWC
+    - Dict/Tuple: recursed per subspace
+    Vectorised over any number of leading batch dims.
+    """
+    if isinstance(space, spaces.Dict):
+        return {k: preprocess_observation(space.spaces[k], obs[k]) for k in space.spaces}
+    if isinstance(space, spaces.Tuple):
+        return tuple(
+            preprocess_observation(s, o) for s, o in zip(space.spaces, obs)
+        )
+    x = jnp.asarray(obs)
+    if isinstance(space, spaces.Discrete):
+        return jax.nn.one_hot(x.astype(jnp.int32), space.n)
+    if isinstance(space, spaces.MultiDiscrete):
+        parts = [
+            jax.nn.one_hot(x[..., i].astype(jnp.int32), int(n))
+            for i, n in enumerate(space.nvec)
+        ]
+        return jnp.concatenate(parts, axis=-1)
+    if isinstance(space, spaces.MultiBinary):
+        return x.astype(jnp.float32).reshape(*x.shape[: x.ndim - len(space.shape)], -1)
+    if isinstance(space, spaces.Box):
+        if len(space.shape) == 3:
+            s = space.shape
+            if s[0] <= 4 and s[1] == s[2] and x.shape[-3:] == tuple(s):
+                # channels-first input -> NHWC
+                x = jnp.moveaxis(x, -3, -1)
+            return x
+        if len(space.shape) <= 1 and space.shape != x.shape[x.ndim - len(space.shape):]:
+            pass
+        flat_from = x.ndim - len(space.shape) if space.shape else x.ndim
+        if len(space.shape) > 1:
+            x = x.reshape(*x.shape[:flat_from], -1)
+        elif space.shape == ():
+            x = x[..., None]
+        return x.astype(jnp.float32)
+    raise TypeError(f"Unsupported observation space {type(space)}")
+
+
+def sample_obs(space: Any, batch: int = 1) -> Any:
+    """Draw a batched numpy observation sample for smoke tests/tracing."""
+    if isinstance(space, spaces.Dict):
+        return {k: sample_obs(s, batch) for k, s in space.spaces.items()}
+    if isinstance(space, spaces.Tuple):
+        return tuple(sample_obs(s, batch) for s in space.spaces)
+    return np.stack([space.sample() for _ in range(batch)])

@@ -1,0 +1,124 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.networks import (
+    ContinuousQNetwork,
+    DeterministicActor,
+    QNetwork,
+    RainbowQNetwork,
+    StochasticActor,
+    ValueNetwork,
+)
+from agilerl_tpu.utils.spaces import preprocess_observation, sample_obs
+
+BOX = spaces.Box(-1, 1, (4,))
+IMG = spaces.Box(0, 255, (16, 16, 3), dtype=np.uint8)
+DISC = spaces.Discrete(3)
+DICT = spaces.Dict({"img": spaces.Box(0, 255, (16, 16, 3), dtype=np.uint8),
+                    "vec": spaces.Box(-1, 1, (5,))})
+
+
+@pytest.mark.parametrize("obs_space", [BOX, IMG, DISC, DICT])
+def test_qnetwork_encoder_autoselect(key, obs_space):
+    net = QNetwork(obs_space, DISC, key=key)
+    obs = preprocess_observation(obs_space, sample_obs(obs_space, 6))
+    q = net(obs)
+    assert q.shape == (6, 3)
+    assert jnp.isfinite(q).all()
+
+
+def test_latent_mutation(key):
+    net = QNetwork(BOX, DISC, key=key, latent_dim=32)
+    info = net.apply_mutation("add_latent_node")
+    assert net.config.latent_dim > 32
+    assert net.config.head.num_inputs == net.config.latent_dim
+    obs = preprocess_observation(BOX, sample_obs(BOX, 2))
+    assert net(obs).shape == (2, 3)
+
+
+def test_encoder_and_head_mutations(key, rng):
+    net = QNetwork(BOX, DISC, key=key)
+    for name in ["encoder.add_layer", "head.add_node", "encoder.add_node", "head.add_layer"]:
+        net.apply_mutation(name, rng=rng)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 2))
+    assert net(obs).shape == (2, 3)
+
+
+def test_continuous_q(key):
+    act_space = spaces.Box(-2, 2, (2,))
+    net = ContinuousQNetwork(BOX, act_space, key=key)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 5))
+    q = net(obs, jnp.zeros((5, 2)))
+    assert q.shape == (5,)
+    net.apply_mutation("add_latent_node")
+    q2 = net(obs, jnp.zeros((5, 2)))
+    assert q2.shape == (5,)
+
+
+def test_deterministic_actor_rescale(key):
+    act_space = spaces.Box(np.array([-2.0, 0.0]), np.array([2.0, 10.0]))
+    actor = DeterministicActor(BOX, act_space, key=key)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 7))
+    a = actor(obs)
+    assert a.shape == (7, 2)
+    assert (a[:, 0] >= -2).all() and (a[:, 0] <= 2).all()
+    assert (a[:, 1] >= 0).all() and (a[:, 1] <= 10).all()
+
+
+@pytest.mark.parametrize(
+    "act_space",
+    [DISC, spaces.Box(-1, 1, (2,)), spaces.MultiDiscrete([3, 4]), spaces.MultiBinary(3)],
+)
+def test_stochastic_actor(key, act_space):
+    actor = StochasticActor(BOX, act_space, key=key)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 5))
+    action, logp, ent = actor(obs, key=jax.random.PRNGKey(1))
+    assert logp.shape == (5,)
+    assert ent.shape == (5,)
+    assert jnp.isfinite(logp).all()
+    logp2, ent2 = actor.evaluate_actions(obs, action)
+    np.testing.assert_allclose(logp, logp2, rtol=1e-5)
+
+
+def test_stochastic_actor_masking(key):
+    actor = StochasticActor(BOX, DISC, key=key)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 100))
+    mask = jnp.tile(jnp.array([[True, False, True]]), (100, 1))
+    action, _, _ = actor(obs, key=jax.random.PRNGKey(0), action_mask=mask)
+    assert not (action == 1).any()
+
+
+def test_value_network(key):
+    net = ValueNetwork(BOX, key=key)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 4))
+    v = net(obs)
+    assert v.shape == (4,)
+
+
+def test_rainbow_q(key):
+    net = RainbowQNetwork(BOX, DISC, num_atoms=21, v_min=-5, v_max=5, key=key)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 4))
+    q = net(obs)
+    assert q.shape == (4, 3)
+    logp = net(obs, q_values=False, key=jax.random.PRNGKey(0))
+    assert logp.shape == (4, 3, 21)
+    np.testing.assert_allclose(jnp.exp(logp).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_rainbow_mutation(key):
+    net = RainbowQNetwork(BOX, DISC, key=key)
+    net.apply_mutation("add_latent_node")
+    obs = preprocess_observation(BOX, sample_obs(BOX, 2))
+    assert net(obs).shape == (2, 3)
+
+
+def test_clone(key):
+    actor = StochasticActor(BOX, DISC, key=key)
+    clone = actor.clone()
+    obs = preprocess_observation(BOX, sample_obs(BOX, 3))
+    a1 = actor(obs, deterministic=True)[0]
+    a2 = clone(obs, deterministic=True)[0]
+    np.testing.assert_array_equal(a1, a2)
