@@ -1,0 +1,23 @@
+from agilerl_tpu.components.data import ReplayDataset, Transition
+from agilerl_tpu.components.multi_agent_replay_buffer import MultiAgentReplayBuffer
+from agilerl_tpu.components.replay_buffer import (
+    MultiStepReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from agilerl_tpu.components.rollout_buffer import RolloutBuffer
+from agilerl_tpu.components.sampler import Sampler
+from agilerl_tpu.components.segment_tree import MinSegmentTree, SumSegmentTree
+
+__all__ = [
+    "ReplayBuffer",
+    "MultiStepReplayBuffer",
+    "PrioritizedReplayBuffer",
+    "MultiAgentReplayBuffer",
+    "RolloutBuffer",
+    "Sampler",
+    "SumSegmentTree",
+    "MinSegmentTree",
+    "Transition",
+    "ReplayDataset",
+]

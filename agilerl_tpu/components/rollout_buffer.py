@@ -1,0 +1,199 @@
+"""On-policy rollout storage with GAE (parity: agilerl/components/rollout_buffer.py
+— RolloutBuffer:26, compute_returns_and_advantages:413 (GAE), flat tensor batches
+get_tensor_batch:525, BPTT sequence batches prepare_sequence_tensors:722 /
+get_minibatch_sequences:845, incl. recurrent hidden-state storage).
+
+TPU-first: storage is a [T, N, ...] pytree pre-allocated on device; per-step
+writes are jitted index updates; GAE is one lax.scan over reversed time; flat
+and BPTT-sequence minibatching are jitted gathers over permuted indices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Iterator, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+class RolloutState(NamedTuple):
+    data: Dict[str, PyTree]  # each leaf [T, N, ...]
+    t: jax.Array  # int32 step cursor
+    advantages: jax.Array  # [T, N]
+    returns: jax.Array  # [T, N]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_step(state: RolloutState, step: Dict[str, PyTree]) -> RolloutState:
+    def write(buf, x):
+        return buf.at[state.t].set(jnp.asarray(x).astype(buf.dtype))
+
+    data = dict(state.data)
+    for k, v in step.items():
+        data[k] = jax.tree_util.tree_map(write, data[k], v)
+    return state._replace(data=data, t=state.t + 1)
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "gae_lambda"))
+def _compute_gae(
+    rewards: jax.Array,  # [T, N]
+    values: jax.Array,  # [T, N]
+    dones: jax.Array,  # [T, N] done AFTER step t
+    last_value: jax.Array,  # [N]
+    last_done: jax.Array,  # [N]
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """GAE via reverse lax.scan (parity: rollout_buffer.py:413)."""
+
+    def step(carry, xs):
+        gae, next_value, next_nonterminal = carry
+        reward, value, done = xs
+        delta = reward + gamma * next_value * next_nonterminal - value
+        gae = delta + gamma * gae_lambda * next_nonterminal * gae
+        return (gae, value, 1.0 - done), gae
+
+    init = (jnp.zeros_like(last_value), last_value, 1.0 - last_done)
+    _, adv_rev = jax.lax.scan(
+        step, init, (rewards[::-1], values[::-1], dones[::-1])
+    )
+    advantages = adv_rev[::-1]
+    returns = advantages + values
+    return advantages, returns
+
+
+@jax.jit
+def _flat_gather(data: PyTree, idx: jax.Array) -> PyTree:
+    """Gather flattened [T*N, ...] minibatch by flat indices."""
+
+    def g(buf):
+        flat = buf.reshape((-1,) + buf.shape[2:])
+        return flat[idx]
+
+    return jax.tree_util.tree_map(g, data)
+
+
+class RolloutBuffer:
+    """Fixed-horizon rollout buffer over N vectorised envs."""
+
+    def __init__(
+        self,
+        capacity: int,
+        num_envs: int,
+        gamma: float = 0.99,
+        gae_lambda: float = 0.95,
+        recurrent: bool = False,
+    ):
+        self.capacity = int(capacity)
+        self.num_envs = int(num_envs)
+        self.gamma = float(gamma)
+        self.gae_lambda = float(gae_lambda)
+        self.recurrent = recurrent
+        self.state: Optional[RolloutState] = None
+        self._key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+
+    @property
+    def full(self) -> bool:
+        return self.state is not None and int(self.state.t) >= self.capacity
+
+    def reset(self) -> None:
+        if self.state is not None:
+            self.state = self.state._replace(t=jnp.zeros((), jnp.int32))
+
+    def add(self, **step: PyTree) -> None:
+        """step keys: obs, action, reward, done, value, log_prob
+        (+ hidden_state pytree when recurrent)."""
+        if self.state is None:
+            def alloc(x):
+                x = jnp.asarray(x)
+                return jnp.zeros((self.capacity,) + x.shape, x.dtype)
+
+            data = {k: jax.tree_util.tree_map(alloc, v) for k, v in step.items()}
+            self.state = RolloutState(
+                data=data,
+                t=jnp.zeros((), jnp.int32),
+                advantages=jnp.zeros((self.capacity, self.num_envs)),
+                returns=jnp.zeros((self.capacity, self.num_envs)),
+            )
+        self.state = _write_step(self.state, step)
+
+    def compute_returns_and_advantages(
+        self, last_value: jax.Array, last_done: jax.Array
+    ) -> None:
+        s = self.state
+        adv, ret = _compute_gae(
+            s.data["reward"].astype(jnp.float32),
+            s.data["value"].astype(jnp.float32),
+            s.data["done"].astype(jnp.float32),
+            jnp.asarray(last_value, jnp.float32),
+            jnp.asarray(last_done, jnp.float32),
+            self.gamma,
+            self.gae_lambda,
+        )
+        self.state = s._replace(advantages=adv, returns=ret)
+
+    # -- flat minibatches (parity: get_tensor_batch:525) ----------------- #
+    def minibatch_indices(
+        self, batch_size: int, key: Optional[jax.Array] = None
+    ) -> np.ndarray:
+        total = self.capacity * self.num_envs
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        perm = jax.random.permutation(key, total)
+        n_batches = max(total // batch_size, 1)
+        return np.asarray(perm[: n_batches * batch_size]).reshape(n_batches, batch_size)
+
+    def get_batch(self, idx: jax.Array) -> Dict[str, PyTree]:
+        s = self.state
+        data = dict(s.data)
+        data["advantages"] = s.advantages
+        data["returns"] = s.returns
+        return _flat_gather(data, jnp.asarray(idx))
+
+    def get_all_flat(self) -> Dict[str, PyTree]:
+        s = self.state
+        data = dict(s.data)
+        data["advantages"] = s.advantages
+        data["returns"] = s.returns
+        return jax.tree_util.tree_map(
+            lambda buf: buf.reshape((-1,) + buf.shape[2:]), data
+        )
+
+    # -- BPTT sequence minibatches (parity: get_minibatch_sequences:845) -- #
+    def get_sequences(
+        self, seq_len: int, key: Optional[jax.Array] = None
+    ) -> Dict[str, PyTree]:
+        """Chop [T, N] into [num_seqs, seq_len, ...] sequences (time-major
+        within each sequence) including the hidden state at each sequence
+        start, for truncated-BPTT recurrent PPO."""
+        assert self.capacity % seq_len == 0, "capacity must divide by seq_len"
+        s = self.state
+        n_chunks = self.capacity // seq_len
+
+        def chop(buf):
+            # [T, N, ...] -> [n_chunks, seq_len, N, ...] -> [n_chunks*N, seq_len, ...]
+            x = buf.reshape((n_chunks, seq_len) + buf.shape[1:])
+            x = jnp.moveaxis(x, 2, 1)  # [n_chunks, N, seq_len, ...]
+            return x.reshape((n_chunks * self.num_envs, seq_len) + buf.shape[2:])
+
+        data = dict(s.data)
+        data["advantages"] = s.advantages
+        data["returns"] = s.returns
+        seqs = {}
+        for k, v in data.items():
+            if k == "hidden_state":
+                # keep only the hidden state at each sequence start:
+                # leaf [T, L, N, H] -> [n_chunks, N, L, H] -> [n_chunks*N, L, H]
+                def chop_hidden(buf):
+                    x = buf[::seq_len]
+                    x = jnp.moveaxis(x, 2, 1)
+                    return x.reshape((n_chunks * self.num_envs,) + x.shape[2:])
+
+                seqs[k] = jax.tree_util.tree_map(chop_hidden, v)
+            else:
+                seqs[k] = jax.tree_util.tree_map(chop, v)
+        return seqs

@@ -1,0 +1,37 @@
+"""Sampler: uniform / PER / n-step dispatch
+(parity: agilerl/components/sampler.py — Sampler:25, dispatch :149,182,194,
+distributed DataLoader path :165).
+
+The distributed path becomes per-host key-folded sampling (see data.ReplayDataset)
+— no DataLoader needed on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from agilerl_tpu.components.replay_buffer import (
+    MultiStepReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+
+class Sampler:
+    def __init__(self, memory=None, dataset=None, per: bool = False, n_step: bool = False):
+        self.memory = memory
+        self.dataset = dataset
+        self.per = per or isinstance(memory, PrioritizedReplayBuffer)
+        self.n_step = n_step or isinstance(memory, MultiStepReplayBuffer)
+        self._iter = iter(dataset) if dataset is not None else None
+
+    def sample(self, batch_size: int, beta: Optional[float] = None, idxs=None, **kw):
+        if self._iter is not None:
+            return next(self._iter)
+        if self.per:
+            return self.memory.sample(batch_size, beta=beta if beta is not None else 0.4)
+        if idxs is not None:
+            return self.memory.sample_from_indices(idxs)
+        return self.memory.sample(batch_size)

@@ -1,0 +1,211 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.components import (
+    MinSegmentTree,
+    MultiAgentReplayBuffer,
+    MultiStepReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    RolloutBuffer,
+    SumSegmentTree,
+)
+
+
+def tr(i, n_envs=None):
+    if n_envs is None:
+        return {
+            "obs": np.full(4, i, np.float32),
+            "action": np.int32(i % 2),
+            "reward": np.float32(i),
+            "next_obs": np.full(4, i + 1, np.float32),
+            "done": np.float32(0),
+        }
+    return {
+        "obs": np.full((n_envs, 4), i, np.float32),
+        "action": np.full(n_envs, i % 2, np.int32),
+        "reward": np.full(n_envs, i, np.float32),
+        "next_obs": np.full((n_envs, 4), i + 1, np.float32),
+        "done": np.zeros(n_envs, np.float32),
+    }
+
+
+class TestReplayBuffer:
+    def test_add_sample(self):
+        buf = ReplayBuffer(max_size=16)
+        for i in range(5):
+            buf.add(tr(i))
+        assert len(buf) == 5
+        batch = buf.sample(8, key=jax.random.PRNGKey(0))
+        assert batch["obs"].shape == (8, 4)
+        assert set(np.asarray(batch["reward"]).tolist()) <= {0.0, 1.0, 2.0, 3.0, 4.0}
+
+    def test_vectorised_add(self):
+        buf = ReplayBuffer(max_size=16)
+        buf.add(tr(0, n_envs=4), batched=True)
+        assert len(buf) == 4
+
+    def test_ring_wraparound(self):
+        buf = ReplayBuffer(max_size=4)
+        for i in range(10):
+            buf.add(tr(i))
+        assert len(buf) == 4
+        batch = buf.sample(16, key=jax.random.PRNGKey(0))
+        assert np.asarray(batch["reward"]).min() >= 6.0
+
+
+class TestNStep:
+    def test_fold(self):
+        buf = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.5)
+        fused = None
+        for i in range(4):
+            t = tr(i, n_envs=2)
+            fused = buf.add(t, batched=True)
+        assert fused is not None
+        # first fused transition: rewards 1 + .5*2 + .25*3 for the second add
+        np.testing.assert_allclose(fused["reward"], 1 + 0.5 * 2 + 0.25 * 3)
+        np.testing.assert_allclose(fused["next_obs"][0], np.full(4, 4.0))
+
+    def test_done_truncates(self):
+        buf = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.5)
+        t0 = tr(0, n_envs=1)
+        t0["done"] = np.ones(1, np.float32)
+        buf.add(t0, batched=True)
+        buf.add(tr(1, n_envs=1), batched=True)
+        fused = buf.add(tr(2, n_envs=1), batched=True)
+        # env died at step 0 -> only reward 0 counts, next_obs from step 0
+        np.testing.assert_allclose(fused["reward"], 0.0)
+        np.testing.assert_allclose(fused["done"], 1.0)
+        np.testing.assert_allclose(fused["next_obs"][0], np.full(4, 1.0))
+
+
+class TestPER:
+    def test_priorities_bias_sampling(self):
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=1.0)
+        for i in range(8):
+            buf.add(tr(i))
+        # set huge priority on index 3
+        buf.update_priorities(jnp.array([3]), jnp.array([1000.0]))
+        batch, idx, w = buf.sample(64, beta=1.0, key=jax.random.PRNGKey(0))
+        counts = np.bincount(np.asarray(idx), minlength=8)
+        assert counts[3] > 50
+        assert w.shape == (64,)
+        assert np.asarray(w).max() <= 1.0 + 1e-6
+
+    def test_weights_uniform_when_equal(self):
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=0.6)
+        for i in range(8):
+            buf.add(tr(i))
+        _, _, w = buf.sample(16, beta=0.4, key=jax.random.PRNGKey(1))
+        np.testing.assert_allclose(np.asarray(w), 1.0, rtol=1e-5)
+
+
+class TestRollout:
+    def test_gae_matches_numpy(self):
+        T, N = 8, 2
+        buf = RolloutBuffer(capacity=T, num_envs=N, gamma=0.9, gae_lambda=0.8)
+        rng = np.random.default_rng(0)
+        rewards = rng.normal(size=(T, N)).astype(np.float32)
+        values = rng.normal(size=(T, N)).astype(np.float32)
+        dones = (rng.random((T, N)) < 0.2).astype(np.float32)
+        for t in range(T):
+            buf.add(
+                obs=np.zeros((N, 3), np.float32),
+                action=np.zeros(N, np.int32),
+                reward=rewards[t],
+                done=dones[t],
+                value=values[t],
+                log_prob=np.zeros(N, np.float32),
+            )
+        last_value = rng.normal(size=N).astype(np.float32)
+        last_done = np.zeros(N, np.float32)
+        buf.compute_returns_and_advantages(last_value, last_done)
+
+        # reference numpy GAE
+        adv = np.zeros((T, N), np.float32)
+        gae = np.zeros(N, np.float32)
+        next_v, next_nt = last_value, 1.0 - last_done
+        for t in reversed(range(T)):
+            delta = rewards[t] + 0.9 * next_v * next_nt - values[t]
+            gae = delta + 0.9 * 0.8 * next_nt * gae
+            adv[t] = gae
+            next_v, next_nt = values[t], 1.0 - dones[t]
+        np.testing.assert_allclose(np.asarray(buf.state.advantages), adv, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(buf.state.returns), adv + values, rtol=1e-4
+        )
+
+    def test_minibatches_cover_all(self):
+        T, N = 4, 2
+        buf = RolloutBuffer(capacity=T, num_envs=N)
+        for t in range(T):
+            buf.add(
+                obs=np.full((N, 3), t, np.float32),
+                action=np.zeros(N, np.int32),
+                reward=np.zeros(N, np.float32),
+                done=np.zeros(N, np.float32),
+                value=np.zeros(N, np.float32),
+                log_prob=np.zeros(N, np.float32),
+            )
+        buf.compute_returns_and_advantages(np.zeros(N), np.zeros(N))
+        idx = buf.minibatch_indices(batch_size=4, key=jax.random.PRNGKey(0))
+        assert idx.shape == (2, 4)
+        assert sorted(idx.flatten().tolist()) == list(range(8))
+        batch = buf.get_batch(idx[0])
+        assert batch["obs"].shape == (4, 3)
+        assert "advantages" in batch and "returns" in batch
+
+    def test_sequences(self):
+        T, N, L, H = 8, 2, 1, 5
+        buf = RolloutBuffer(capacity=T, num_envs=N, recurrent=True)
+        for t in range(T):
+            buf.add(
+                obs=np.full((N, 3), t, np.float32),
+                action=np.zeros(N, np.int32),
+                reward=np.zeros(N, np.float32),
+                done=np.zeros(N, np.float32),
+                value=np.zeros(N, np.float32),
+                log_prob=np.zeros(N, np.float32),
+                hidden_state={"h": np.full((L, N, H), t, np.float32)},
+            )
+        seqs = buf.get_sequences(seq_len=4)
+        assert seqs["obs"].shape == (4, 4, 3)  # 2 chunks * 2 envs, seq_len 4
+        assert seqs["hidden_state"]["h"].shape == (4, L, H)
+        # hidden at sequence starts: t=0 and t=4
+        got = sorted(set(np.asarray(seqs["hidden_state"]["h"]).flatten().tolist()))
+        assert got == [0.0, 4.0]
+
+
+class TestMultiAgent:
+    def test_save_and_sample(self):
+        agents = ["a0", "a1"]
+        buf = MultiAgentReplayBuffer(max_size=8, agent_ids=agents)
+        for i in range(4):
+            buf.save_to_memory(
+                obs={a: np.full(3, i, np.float32) for a in agents},
+                action={a: np.int32(0) for a in agents},
+                reward={a: np.float32(i) for a in agents},
+                next_obs={a: np.full(3, i + 1, np.float32) for a in agents},
+                done={a: np.float32(0) for a in agents},
+            )
+        assert len(buf) == 4
+        batch = buf.sample(6, key=jax.random.PRNGKey(0))
+        assert batch["obs"]["a0"].shape == (6, 3)
+
+
+class TestSegmentTree:
+    def test_sum_and_retrieve(self):
+        st = SumSegmentTree(8)
+        st[np.arange(8)] = np.arange(8, dtype=np.float64)
+        assert st.sum() == pytest.approx(28.0)
+        assert st.sum(2, 5) == pytest.approx(2 + 3 + 4)
+        assert st.retrieve(0.5) == 1  # idx0 has mass 0
+        assert st.retrieve(27.9) == 7
+
+    def test_min(self):
+        mt = MinSegmentTree(8)
+        mt[np.arange(8)] = [5, 3, 9, 1, 7, 2, 8, 4]
+        assert mt.min() == 1
+        assert mt.min(4, 8) == 2
