@@ -1,0 +1,280 @@
+"""Algorithm base classes (parity: agilerl/algorithms/core/base.py —
+EvolvableAlgorithm:237, RLAlgorithm:1243; registry validation _registry_init:550,
+evolvable_attributes:790, clone:855, save/load_checkpoint:919-1051,
+get_checkpoint_dict:159).
+
+TPU-first: an algorithm is a thin stateful shell around pure jitted train-step
+functions. Mutable state = network (config, params) pairs, optax states, scalar
+HPs, PRNG key. Jitted functions are cached per static-config signature and
+dropped on any architecture mutation (``_clear_jit_cache``) so XLA recompiles
+exactly when the architecture changed — never on HP/weight changes (HPs are
+traced arguments; lr lives inside the optax state).
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    MutationRegistry,
+    NetworkGroup,
+    OptimizerConfig,
+)
+from agilerl_tpu.utils.spaces import preprocess_observation
+
+
+class EvolvableAlgorithm:
+    """Base for all evolvable agents."""
+
+    def __init__(
+        self,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        device: Optional[str] = None,
+        accelerator: Optional[Any] = None,
+        name: Optional[str] = None,
+        seed: Optional[int] = None,
+    ):
+        self.index = index
+        self.device = device
+        self.accelerator = accelerator
+        self.algo = name or type(self).__name__
+        self.registry = MutationRegistry(hp_config)
+        self.fitness: List[float] = []
+        self.scores: List[float] = []
+        self.steps: List[int] = [0]
+        self.mut = "None"  # last mutation applied, for logging (parity)
+        seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
+        self._key = jax.random.PRNGKey(seed)
+        self.rng = np.random.default_rng(seed)
+        self._jit_cache: Dict[str, Callable] = {}
+
+    # -- rng ------------------------------------------------------------- #
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- registry -------------------------------------------------------- #
+    def register_network_group(self, group: NetworkGroup) -> None:
+        self.registry.register_group(group)
+
+    def register_optimizer(self, cfg: OptimizerConfig) -> None:
+        self.registry.register_optimizer(cfg)
+
+    def register_mutation_hook(self, method_name: str) -> None:
+        self.registry.register_hook(method_name)
+
+    def finalize_registry(self) -> None:
+        """Call at the end of __init__ (replaces the reference's RegistryMeta
+        post-init hook, core/base.py:155)."""
+        self.registry.validate()
+        for cfg in self.registry.optimizer_configs:
+            opt: OptimizerWrapper = getattr(self, cfg.name)
+            if opt.opt_state is None:
+                opt.init(self._optimizer_params(cfg))
+
+    def _optimizer_params(self, cfg: OptimizerConfig) -> Any:
+        nets = {n: getattr(self, n) for n in cfg.networks}
+        if len(nets) == 1:
+            return next(iter(nets.values())).params
+        return {n: net.params for n, net in nets.items()}
+
+    # -- reflection ------------------------------------------------------ #
+    def evolvable_attributes(self) -> Dict[str, Any]:
+        """name -> network object for every registered net (parity: base.py:790)."""
+        return {n: getattr(self, n) for n in self.registry.all_network_names()}
+
+    @property
+    def hp_config(self) -> HyperparameterConfig:
+        return self.registry.hp_config
+
+    # -- jit cache ------------------------------------------------------- #
+    def jit_fn(self, name: str, factory: Callable[[], Callable]) -> Callable:
+        """Get-or-build a jitted function; dropped on architecture mutation."""
+        fn = self._jit_cache.get(name)
+        if fn is None:
+            fn = factory()
+            self._jit_cache[name] = fn
+        return fn
+
+    def _clear_jit_cache(self) -> None:
+        self._jit_cache = {}
+
+    # -- mutation plumbing ---------------------------------------------- #
+    def reinit_optimizers(self) -> None:
+        """Re-init all optax states for current param shapes (parity: base.py:744)."""
+        for cfg in self.registry.optimizer_configs:
+            getattr(self, cfg.name).reinit(self._optimizer_params(cfg))
+
+    def mutation_hook(self) -> None:
+        """Called by the HPO engine after any mutation (parity: base.py:728)."""
+        self._clear_jit_cache()
+        for hook in self.registry.hooks:
+            getattr(self, hook)()
+
+    # -- cloning --------------------------------------------------------- #
+    @property
+    def init_dict(self) -> Dict[str, Any]:  # pragma: no cover
+        raise NotImplementedError
+
+    def clone(self, index: Optional[int] = None, wrap: bool = True):
+        """Deep-copy-free clone: rebuild from init_dict, then copy configs,
+        params, optimizer states and training attrs (parity: base.py:855)."""
+        clone = type(self)(**self.init_dict)
+        # networks: copy mutated configs + weights
+        for name, net in self.evolvable_attributes().items():
+            cnet = getattr(clone, name)
+            cnet.config = net.config
+            cnet.params = jax.tree_util.tree_map(jnp.copy, net.params)
+        # optimizers
+        for cfg in self.registry.optimizer_configs:
+            mine: OptimizerWrapper = getattr(self, cfg.name)
+            theirs: OptimizerWrapper = getattr(clone, cfg.name)
+            theirs.lr = mine.lr
+            theirs.tx = theirs._build()
+            theirs.opt_state = jax.tree_util.tree_map(jnp.copy, mine.opt_state)
+        # scalar HPs
+        for hp in self.hp_config.names():
+            setattr(clone, hp, getattr(self, hp))
+        clone.fitness = list(self.fitness)
+        clone.scores = list(self.scores)
+        clone.steps = list(self.steps)
+        clone.mut = self.mut
+        clone.index = self.index if index is None else index
+        clone._on_clone(self)
+        return clone
+
+    def _on_clone(self, parent: "EvolvableAlgorithm") -> None:
+        """Subclass hook for extra copied state."""
+
+    # -- checkpointing ---------------------------------------------------- #
+    def checkpoint_dict(self) -> Dict[str, Any]:
+        nets = {
+            name: {"config": net.config, "params": jax.device_get(net.params)}
+            for name, net in self.evolvable_attributes().items()
+        }
+        opts = {
+            cfg.name: {
+                "lr": getattr(self, cfg.name).lr,
+                "state": jax.device_get(getattr(self, cfg.name).opt_state),
+            }
+            for cfg in self.registry.optimizer_configs
+        }
+        attrs = {
+            "index": self.index,
+            "fitness": self.fitness,
+            "scores": self.scores,
+            "steps": self.steps,
+            "mut": self.mut,
+        }
+        for hp in self.hp_config.names():
+            attrs[hp] = getattr(self, hp)
+        return {
+            "agilerl_tpu_class": type(self).__name__,
+            "init_dict": self.init_dict,
+            "networks": nets,
+            "optimizers": opts,
+            "attrs": attrs,
+        }
+
+    def save_checkpoint(self, path: Union[str, Path]) -> None:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(self.checkpoint_dict(), f)
+
+    def load_checkpoint(self, path: Union[str, Path]) -> None:
+        with open(path, "rb") as f:
+            ckpt = pickle.load(f)
+        self._restore(ckpt)
+
+    def _restore(self, ckpt: Dict[str, Any]) -> None:
+        for name, blob in ckpt["networks"].items():
+            net = getattr(self, name)
+            net.config = blob["config"]
+            net.params = jax.tree_util.tree_map(jnp.asarray, blob["params"])
+        for cname, blob in ckpt["optimizers"].items():
+            opt: OptimizerWrapper = getattr(self, cname)
+            opt.lr = blob["lr"]
+            opt.tx = opt._build()
+            opt.opt_state = jax.tree_util.tree_map(jnp.asarray, blob["state"])
+        for k, v in ckpt["attrs"].items():
+            setattr(self, k, v)
+        self._clear_jit_cache()
+
+    @classmethod
+    def load(cls, path: Union[str, Path], device=None):
+        """Reconstruct an agent from a checkpoint file (parity: base.py:1052)."""
+        with open(path, "rb") as f:
+            ckpt = pickle.load(f)
+        agent = cls(**ckpt["init_dict"])
+        agent._restore(ckpt)
+        return agent
+
+    # -- distributed shims ------------------------------------------------ #
+    def wrap_models(self) -> None:
+        """No-op: GSPMD sharding replaces Accelerate DDP wrapping (base.py:821)."""
+
+    def unwrap_models(self) -> None:
+        """No-op (parity: base.py:837)."""
+
+    def recompile(self) -> None:
+        """Drop jit caches; XLA recompiles lazily (parity: base.py:761)."""
+        self._clear_jit_cache()
+
+
+class RLAlgorithm(EvolvableAlgorithm):
+    """Single-agent RL base (parity: base.py:1243)."""
+
+    def __init__(self, observation_space, action_space, **kwargs):
+        super().__init__(**kwargs)
+        self.observation_space = observation_space
+        self.action_space = action_space
+
+    def preprocess_observation(self, obs: Any) -> Any:
+        return preprocess_observation(self.observation_space, obs)
+
+    # -- generic evaluation (parity: per-algo .test methods) -------------- #
+    def test(
+        self,
+        env,
+        swap_channels: bool = False,
+        max_steps: Optional[int] = None,
+        loop: int = 3,
+        sum_scores: bool = True,
+    ) -> float:
+        """Run `loop` evaluation episodes, return mean return
+        (parity: e.g. dqn.py test; deterministic/greedy actions)."""
+        rewards = []
+        num_envs = getattr(env, "num_envs", 1)
+        for _ in range(loop):
+            obs, _ = env.reset()
+            done = np.zeros(num_envs, dtype=bool)
+            total = np.zeros(num_envs, dtype=np.float64)
+            steps = 0
+            while not done.all():
+                action = self.get_action(obs, training=False)
+                action = np.asarray(action)
+                if num_envs == 1 and action.ndim > 0 and not hasattr(env, "num_envs"):
+                    action = action[0]
+                obs, reward, terminated, truncated, _ = env.step(action)
+                step_done = np.logical_or(
+                    np.asarray(terminated, dtype=bool), np.asarray(truncated, dtype=bool)
+                )
+                total += np.asarray(reward, dtype=np.float64) * (~done)
+                done = np.logical_or(done, step_done)
+                steps += 1
+                if max_steps is not None and steps >= max_steps:
+                    break
+            rewards.append(np.mean(total) if sum_scores else total)
+        fitness = float(np.mean(rewards))
+        self.fitness.append(fitness)
+        return fitness

@@ -1,0 +1,93 @@
+"""Optimizer wrapper over optax (parity: agilerl/algorithms/core/optimizer_wrapper.py
+— OptimizerWrapper:63; single, multi-net and per-agent-dict shapes; re-created
+wholesale after any architecture mutation, core/base.py:643-694).
+
+TPU-first: learning rate lives INSIDE the optax state via inject_hyperparams, so
+an lr hyperparameter mutation is a pure state edit — no optimizer re-creation
+and no XLA recompile. Architecture mutations call ``reinit`` which rebuilds the
+state for the new param tree shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import optax
+
+OPTIMIZERS: Dict[str, Callable] = {
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "sgd": optax.sgd,
+    "rmsprop": optax.rmsprop,
+}
+
+
+class OptimizerWrapper:
+    """Holds an optax transform + its state over one params pytree.
+
+    ``params`` is a dict {network_attr_name: net.params} so one optimizer can
+    span several networks (PPO actor+critic) or per-agent dicts (MADDPG).
+    """
+
+    def __init__(
+        self,
+        optimizer: str = "adam",
+        lr: float = 1e-3,
+        max_grad_norm: Optional[float] = None,
+        **kwargs,
+    ):
+        self.optimizer_name = optimizer
+        self.lr = float(lr)
+        self.max_grad_norm = max_grad_norm
+        self.kwargs = kwargs
+        self.tx = self._build()
+        self.opt_state = None
+
+    def _build(self) -> optax.GradientTransformation:
+        base = optax.inject_hyperparams(OPTIMIZERS[self.optimizer_name])(
+            learning_rate=self.lr, **self.kwargs
+        )
+        if self.max_grad_norm is not None:
+            return optax.chain(optax.clip_by_global_norm(self.max_grad_norm), base)
+        return base
+
+    def init(self, params: Any) -> None:
+        self.opt_state = self.tx.init(params)
+
+    def reinit(self, params: Any) -> None:
+        """Rebuild state after an architecture mutation (parity: base.py:744)."""
+        self.opt_state = self.tx.init(params)
+
+    def set_lr(self, lr: float) -> None:
+        """Edit lr in-place in the optax state (no recompile, no reinit)."""
+        self.lr = float(lr)
+        if self.opt_state is not None:
+            self.opt_state = _set_injected_lr(self.opt_state, self.lr)
+        self.tx = self._build()
+
+    def update(self, grads: Any, params: Any):
+        updates, self.opt_state = self.tx.update(grads, self.opt_state, params)
+        return optax.apply_updates(params, updates)
+
+    def state_dict(self) -> Any:
+        return self.opt_state
+
+    def load_state_dict(self, state: Any) -> None:
+        self.opt_state = state
+
+
+def _set_injected_lr(opt_state: Any, lr: float) -> Any:
+    """Find the InjectHyperparamsState and overwrite learning_rate."""
+    import jax.numpy as jnp
+
+    def visit(state):
+        if isinstance(state, optax.InjectHyperparamsState):
+            hp = dict(state.hyperparams)
+            hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+            return state._replace(hyperparams=hp)
+        if isinstance(state, tuple) and not hasattr(state, "_fields"):
+            return tuple(visit(s) for s in state)
+        return state
+
+    return visit(opt_state)

@@ -1,0 +1,131 @@
+"""Mutation registry: network groups, optimizer configs, HP mutation spaces.
+
+Parity: agilerl/algorithms/core/registry.py — MutationRegistry:372,
+NetworkGroup:245, OptimizerConfig:44, HyperparameterConfig:189, RLParameter:109.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class NetworkGroup:
+    """One evolvable network role in an algorithm: an eval net plus any nets
+    that must share its architecture (targets, twin critics)
+    (parity: registry.py:245)."""
+
+    eval: str  # attribute name of the evaluated/trained network
+    shared: Union[str, List[str], None] = None  # attrs rebuilt from eval after mutation
+    policy: bool = False  # is this the acting policy?
+    multiagent: bool = False
+
+    def shared_names(self) -> List[str]:
+        if self.shared is None:
+            return []
+        return [self.shared] if isinstance(self.shared, str) else list(self.shared)
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Metadata binding an optimizer attribute to its networks + lr HP
+    (parity: registry.py:44)."""
+
+    name: str  # attribute name of the OptimizerWrapper
+    networks: List[str]  # attribute names of the nets it optimises
+    lr: str = "lr"  # attribute name of the learning-rate HP
+    optimizer: str = "adam"
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RLParameter:
+    """Mutation space for one scalar hyperparameter (parity: registry.py:109)."""
+
+    min: float
+    max: float
+    shrink_factor: float = 0.8
+    grow_factor: float = 1.2
+    dtype: type = float
+
+    def mutate(self, value, rng: Optional[np.random.Generator] = None):
+        """Randomly grow or shrink within [min, max] (parity: registry.py:135)."""
+        rng = rng or np.random.default_rng()
+        factor = self.grow_factor if rng.random() < 0.5 else self.shrink_factor
+        new = value * factor
+        new = float(np.clip(new, self.min, self.max))
+        if self.dtype is int:
+            new = int(round(new))
+            new = int(np.clip(new, int(self.min), int(self.max)))
+        return self.dtype(new)
+
+
+@dataclasses.dataclass
+class HyperparameterConfig:
+    """Named collection of RLParameters (parity: registry.py:189)."""
+
+    params: Dict[str, RLParameter] = dataclasses.field(default_factory=dict)
+
+    def __init__(self, **kwargs: RLParameter):
+        self.params = dict(kwargs)
+
+    def names(self) -> List[str]:
+        return list(self.params.keys())
+
+    def sample(self, rng: Optional[np.random.Generator] = None) -> Optional[str]:
+        rng = rng or np.random.default_rng()
+        if not self.params:
+            return None
+        return str(rng.choice(self.names()))
+
+    def __getitem__(self, k: str) -> RLParameter:
+        return self.params[k]
+
+    def __contains__(self, k: str) -> bool:
+        return k in self.params
+
+    def __bool__(self) -> bool:
+        return bool(self.params)
+
+
+class MutationRegistry:
+    """Per-agent registry of network groups, optimizers and hooks
+    (parity: registry.py:372)."""
+
+    def __init__(self, hp_config: Optional[HyperparameterConfig] = None):
+        self.groups: List[NetworkGroup] = []
+        self.optimizer_configs: List[OptimizerConfig] = []
+        self.hooks: List[str] = []  # method names called after mutations
+        self.hp_config = hp_config or HyperparameterConfig()
+
+    def register_group(self, group: NetworkGroup) -> None:
+        self.groups.append(group)
+
+    def register_optimizer(self, cfg: OptimizerConfig) -> None:
+        self.optimizer_configs.append(cfg)
+
+    def register_hook(self, method_name: str) -> None:
+        self.hooks.append(method_name)
+
+    @property
+    def policy_group(self) -> Optional[NetworkGroup]:
+        for g in self.groups:
+            if g.policy:
+                return g
+        return None
+
+    def all_network_names(self) -> List[str]:
+        names: List[str] = []
+        for g in self.groups:
+            names.append(g.eval)
+            names.extend(g.shared_names())
+        return names
+
+    def validate(self) -> None:
+        """One group must be the policy (parity: core/base.py:582)."""
+        assert self.policy_group is not None, (
+            "An algorithm must register exactly one NetworkGroup with policy=True"
+        )

@@ -1,0 +1,224 @@
+"""DQN (parity: agilerl/algorithms/dqn.py — DQN:?, epsilon-greedy get_action:188,
+double-DQN option, soft target update :349; the reference's optional
+CUDA-graphs/torch.compile path is subsumed by the always-jitted train step).
+
+TPU-first: one fused jitted train step (loss + grads + optax update + soft
+target update) over device-resident batches; epsilon-greedy runs on device with
+PRNG keys so action selection never syncs to host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.base import RLAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.networks.q_networks import QNetwork
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr=RLParameter(min=1e-5, max=1e-2, dtype=float),
+        batch_size=RLParameter(min=8, max=512, dtype=int),
+        learn_step=RLParameter(min=1, max=16, dtype=int),
+    )
+
+
+class DQN(RLAlgorithm):
+    def __init__(
+        self,
+        observation_space,
+        action_space,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        net_config: Optional[Dict[str, Any]] = None,
+        batch_size: int = 64,
+        lr: float = 1e-4,
+        learn_step: int = 5,
+        gamma: float = 0.99,
+        tau: float = 1e-3,
+        double: bool = False,
+        normalize_images: bool = True,
+        **kwargs,
+    ):
+        super().__init__(
+            observation_space,
+            action_space,
+            index=index,
+            hp_config=hp_config or default_hp_config(),
+            **kwargs,
+        )
+        self.batch_size = int(batch_size)
+        self.lr = float(lr)
+        self.learn_step = int(learn_step)
+        self.gamma = float(gamma)
+        self.tau = float(tau)
+        self.double = bool(double)
+        self.net_config = dict(net_config or {})
+
+        self.actor = QNetwork(observation_space, action_space, key=self.next_key(),
+                              **self.net_config)
+        self.actor_target = self.actor.clone()
+
+        self.optimizer = OptimizerWrapper(optimizer="adam", lr=self.lr)
+        self.register_network_group(
+            NetworkGroup(eval="actor", shared="actor_target", policy=True)
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="optimizer", networks=["actor"], lr="lr")
+        )
+        self.finalize_registry()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "observation_space": self.observation_space,
+            "action_space": self.action_space,
+            "index": self.index,
+            "net_config": self.net_config,
+            "batch_size": self.batch_size,
+            "lr": self.lr,
+            "learn_step": self.learn_step,
+            "gamma": self.gamma,
+            "tau": self.tau,
+            "double": self.double,
+        }
+
+    # ------------------------------------------------------------------ #
+    def _act_fn(self):
+        config = self.actor.config
+
+        @jax.jit
+        def act(params, obs, key, epsilon, action_mask):
+            q = QNetwork.apply(config, params, obs)  # [B, A]
+            if action_mask is not None:
+                q = jnp.where(action_mask.astype(bool), q, -1e8)
+            greedy = jnp.argmax(q, axis=-1)
+            kx, ku = jax.random.split(key)
+            if action_mask is not None:
+                logits = jnp.where(action_mask.astype(bool), 0.0, -1e8)
+                rand = jax.random.categorical(ku, logits, axis=-1)
+            else:
+                rand = jax.random.randint(ku, greedy.shape, 0, q.shape[-1])
+            explore = jax.random.uniform(kx, greedy.shape) < epsilon
+            return jnp.where(explore, rand, greedy)
+
+        return act
+
+    def get_action(
+        self,
+        obs: Any,
+        epsilon: float = 0.0,
+        action_mask: Optional[np.ndarray] = None,
+        training: bool = True,
+    ) -> np.ndarray:
+        obs = self.preprocess_observation(obs)
+        single = _is_single(obs, self.observation_space)
+        if single:
+            obs = jax.tree_util.tree_map(lambda x: x[None], obs)
+        eps = epsilon if training else 0.0
+        mask = None if action_mask is None else jnp.asarray(action_mask)
+        act = self.jit_fn("act" if mask is None else "act_masked", self._act_fn)
+        actions = act(self.actor.params, obs, self.next_key(), jnp.float32(eps), mask)
+        actions = np.asarray(actions)
+        return actions[0] if single else actions
+
+    # ------------------------------------------------------------------ #
+    def _train_fn(self):
+        config = self.actor.config
+        tx = self.optimizer.tx
+        double = self.double
+
+        @jax.jit
+        def train_step(params, target_params, opt_state, batch, gamma, tau):
+            obs, action = batch["obs"], batch["action"].astype(jnp.int32)
+            reward = batch["reward"].astype(jnp.float32)
+            done = batch["done"].astype(jnp.float32)
+            next_obs = batch["next_obs"]
+
+            q_next_t = QNetwork.apply(config, target_params, next_obs)
+            if double:
+                next_a = jnp.argmax(QNetwork.apply(config, params, next_obs), axis=-1)
+                q_next = jnp.take_along_axis(q_next_t, next_a[..., None], axis=-1)[..., 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=-1)
+            target = reward + gamma * (1.0 - done) * q_next
+
+            def loss_fn(p):
+                q = QNetwork.apply(config, p, obs)
+                q_sel = jnp.take_along_axis(q, action[..., None], axis=-1)[..., 0]
+                return jnp.mean(jnp.square(q_sel - jax.lax.stop_gradient(target)))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree_util.tree_map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target_params, params
+            )
+            return params, target_params, opt_state, loss
+
+        return train_step
+
+    def learn(self, experiences: Dict[str, jax.Array]) -> float:
+        """One TD update from a sampled batch (parity: dqn.py learn/update)."""
+        batch = dict(experiences)
+        batch["obs"] = self.preprocess_observation(batch["obs"])
+        batch["next_obs"] = self.preprocess_observation(batch["next_obs"])
+        train_step = self.jit_fn("train", self._train_fn)
+        params, tparams, opt_state, loss = train_step(
+            self.actor.params,
+            self.actor_target.params,
+            self.optimizer.opt_state,
+            batch,
+            jnp.float32(self.gamma),
+            jnp.float32(self.tau),
+        )
+        self.actor.params = params
+        self.actor_target.params = tparams
+        self.optimizer.opt_state = opt_state
+        return float(loss)
+
+    def soft_update(self) -> None:
+        """Explicit soft target sync (parity: dqn.py:349); normally fused into
+        the train step."""
+        self.actor_target.params = jax.tree_util.tree_map(
+            lambda t, p: (1.0 - self.tau) * t + self.tau * p,
+            self.actor_target.params,
+            self.actor.params,
+        )
+
+
+def _is_single(obs: Any, space) -> bool:
+    """Heuristic: is this an unbatched observation?"""
+    import gymnasium.spaces as gspaces
+
+    leaf = jax.tree_util.tree_leaves(obs)[0]
+    if isinstance(space, gspaces.Dict):
+        sub = next(iter(space.spaces.values()))
+    elif isinstance(space, gspaces.Tuple):
+        sub = space.spaces[0]
+    else:
+        sub = space
+    if isinstance(sub, gspaces.Discrete):
+        return leaf.ndim == 1
+    if isinstance(sub, gspaces.MultiDiscrete):
+        return leaf.ndim == 1
+    if isinstance(sub, gspaces.Box):
+        base = len(sub.shape) if len(sub.shape) != 3 else 3
+        if len(sub.shape) == 0:
+            base = 1
+        return leaf.ndim == base
+    return leaf.ndim == 1

@@ -1,0 +1,4 @@
+from agilerl_tpu.envs.classic import CartPole, MountainCar, Pendulum, make
+from agilerl_tpu.envs.core import JaxEnv, JaxVecEnv, rollout_scan
+
+__all__ = ["JaxEnv", "JaxVecEnv", "rollout_scan", "CartPole", "Pendulum", "MountainCar", "make"]

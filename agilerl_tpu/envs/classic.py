@@ -1,0 +1,150 @@
+"""Pure-JAX classic-control environments (CartPole, Pendulum, MountainCar,
+Acrobot-lite) matching gymnasium dynamics, for zero-host-sync rollouts.
+
+These give the framework its own fast env backend (the reference depends on
+gymnasium subprocess workers for everything, agilerl/utils/utils.py:47); the
+gymnasium path remains available via utils.make_vect_envs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from agilerl_tpu.envs.core import JaxEnv
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array
+    x_dot: jax.Array
+    theta: jax.Array
+    theta_dot: jax.Array
+
+
+class CartPole(JaxEnv):
+    """CartPole-v1 dynamics (Euler integration, same constants as gymnasium)."""
+
+    max_episode_steps = 500
+
+    def __init__(self):
+        high = np.array([4.8, np.inf, 0.418, np.inf], dtype=np.float32)
+        self.observation_space = spaces.Box(-high, high, dtype=np.float32)
+        self.action_space = spaces.Discrete(2)
+
+    def reset_fn(self, key):
+        vals = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = CartPoleState(vals[0], vals[1], vals[2], vals[3])
+        return state, jnp.stack(vals)
+
+    def step_fn(self, state, action, key):
+        gravity, masscart, masspole = 9.8, 1.0, 0.1
+        total_mass = masscart + masspole
+        length = 0.5
+        polemass_length = masspole * length
+        force_mag, dt = 10.0, 0.02
+
+        force = jnp.where(action == 1, force_mag, -force_mag)
+        costh, sinth = jnp.cos(state.theta), jnp.sin(state.theta)
+        temp = (force + polemass_length * state.theta_dot**2 * sinth) / total_mass
+        theta_acc = (gravity * sinth - costh * temp) / (
+            length * (4.0 / 3.0 - masspole * costh**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+
+        x = state.x + dt * state.x_dot
+        x_dot = state.x_dot + dt * x_acc
+        theta = state.theta + dt * state.theta_dot
+        theta_dot = state.theta_dot + dt * theta_acc
+        new = CartPoleState(x, x_dot, theta, theta_dot)
+        obs = jnp.stack([x, x_dot, theta, theta_dot])
+        terminated = jnp.logical_or(
+            jnp.abs(x) > 2.4, jnp.abs(theta) > 12 * jnp.pi / 180
+        )
+        reward = jnp.float32(1.0)
+        return new, obs, reward, terminated, jnp.bool_(False)
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+
+
+class Pendulum(JaxEnv):
+    """Pendulum-v1 dynamics."""
+
+    max_episode_steps = 200
+
+    def __init__(self):
+        high = np.array([1.0, 1.0, 8.0], dtype=np.float32)
+        self.observation_space = spaces.Box(-high, high, dtype=np.float32)
+        self.action_space = spaces.Box(-2.0, 2.0, (1,), dtype=np.float32)
+
+    def _obs(self, s: PendulumState) -> jax.Array:
+        return jnp.stack([jnp.cos(s.theta), jnp.sin(s.theta), s.theta_dot])
+
+    def reset_fn(self, key):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, minval=-jnp.pi, maxval=jnp.pi)
+        theta_dot = jax.random.uniform(k2, minval=-1.0, maxval=1.0)
+        state = PendulumState(theta, theta_dot)
+        return state, self._obs(state)
+
+    def step_fn(self, state, action, key):
+        g, m, l, dt = 10.0, 1.0, 1.0, 0.05
+        u = jnp.clip(action[0] if action.ndim > 0 else action, -2.0, 2.0)
+        th, thdot = state.theta, state.theta_dot
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (3 * g / (2 * l) * jnp.sin(th) + 3.0 / (m * l**2) * u) * dt
+        newthdot = jnp.clip(newthdot, -8.0, 8.0)
+        newth = th + newthdot * dt
+        new = PendulumState(newth, newthdot)
+        return new, self._obs(new), -cost, jnp.bool_(False), jnp.bool_(False)
+
+
+class MountainCarState(NamedTuple):
+    position: jax.Array
+    velocity: jax.Array
+
+
+class MountainCar(JaxEnv):
+    """MountainCar-v0 dynamics."""
+
+    max_episode_steps = 200
+
+    def __init__(self):
+        self.observation_space = spaces.Box(
+            np.array([-1.2, -0.07], np.float32), np.array([0.6, 0.07], np.float32)
+        )
+        self.action_space = spaces.Discrete(3)
+
+    def reset_fn(self, key):
+        pos = jax.random.uniform(key, minval=-0.6, maxval=-0.4)
+        state = MountainCarState(pos, jnp.float32(0.0))
+        return state, jnp.stack([pos, jnp.float32(0.0)])
+
+    def step_fn(self, state, action, key):
+        velocity = state.velocity + (action - 1) * 0.001 + jnp.cos(3 * state.position) * (-0.0025)
+        velocity = jnp.clip(velocity, -0.07, 0.07)
+        position = jnp.clip(state.position + velocity, -1.2, 0.6)
+        velocity = jnp.where((position <= -1.2) & (velocity < 0), 0.0, velocity)
+        terminated = (position >= 0.5) & (velocity >= 0)
+        new = MountainCarState(position, velocity)
+        return new, jnp.stack([position, velocity]), jnp.float32(-1.0), terminated, jnp.bool_(False)
+
+
+REGISTRY = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+    "MountainCar-v0": MountainCar,
+}
+
+
+def make(env_id: str) -> JaxEnv:
+    if env_id not in REGISTRY:
+        raise KeyError(f"Unknown JAX env {env_id!r}; available: {sorted(REGISTRY)}")
+    return REGISTRY[env_id]()

@@ -1,0 +1,125 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms.dqn import DQN
+from agilerl_tpu.components import ReplayBuffer
+from agilerl_tpu.envs.probe import (
+    ConstantRewardEnv,
+    DiscountedRewardEnv,
+    ObsDependentRewardEnv,
+    check_q_learning_with_probe_env,
+    fill_buffer_random,
+)
+
+BOX = spaces.Box(-1, 1, (4,))
+DISC = spaces.Discrete(2)
+
+
+def make_agent(**kw):
+    defaults = dict(observation_space=BOX, action_space=DISC, lr=1e-3, seed=0)
+    defaults.update(kw)
+    return DQN(**defaults)
+
+
+def test_get_action_shapes():
+    agent = make_agent()
+    a = agent.get_action(np.zeros((5, 4), np.float32))
+    assert a.shape == (5,)
+    a1 = agent.get_action(np.zeros(4, np.float32))
+    assert a1.shape == ()
+
+
+def test_epsilon_explores():
+    agent = make_agent()
+    acts = agent.get_action(np.zeros((500, 4), np.float32), epsilon=1.0)
+    assert set(np.unique(acts)) == {0, 1}
+
+
+def test_action_mask():
+    agent = make_agent()
+    mask = np.tile([1, 0], (10, 1))
+    acts = agent.get_action(np.zeros((10, 4), np.float32), epsilon=1.0, action_mask=mask)
+    assert (acts == 0).all()
+
+
+def test_learn_reduces_loss():
+    agent = make_agent()
+    buf = ReplayBuffer(max_size=512)
+    rng = np.random.default_rng(0)
+    for i in range(128):
+        buf.add(
+            {
+                "obs": rng.normal(size=4).astype(np.float32),
+                "action": np.int32(i % 2),
+                "reward": np.float32(1.0),
+                "next_obs": rng.normal(size=4).astype(np.float32),
+                "done": np.float32(1.0),
+            }
+        )
+    losses = [agent.learn(buf.sample(64, key=jax.random.PRNGKey(i))) for i in range(200)]
+    assert losses[-1] < losses[0]
+    assert losses[-1] < 0.05
+
+
+def test_clone_and_checkpoint(tmp_path):
+    agent = make_agent()
+    agent.fitness = [1.0, 2.0]
+    clone = agent.clone(index=7)
+    assert clone.index == 7
+    obs = np.zeros((3, 4), np.float32)
+    np.testing.assert_array_equal(agent.get_action(obs, training=False),
+                                  clone.get_action(obs, training=False))
+
+    path = tmp_path / "dqn.ckpt"
+    agent.save_checkpoint(path)
+    loaded = DQN.load(path)
+    np.testing.assert_array_equal(
+        np.asarray(agent.actor.params["encoder"]["layer_0"]["kernel"]),
+        np.asarray(loaded.actor.params["encoder"]["layer_0"]["kernel"]),
+    )
+    assert loaded.fitness == [1.0, 2.0]
+
+
+def test_mutation_then_learn():
+    """Architecture mutation must keep the agent trainable (recompile path)."""
+    env = ConstantRewardEnv()
+    agent = make_agent(
+        observation_space=env.observation_space, action_space=env.action_space
+    )
+    buf = ReplayBuffer(max_size=256)
+    fill_buffer_random(env, buf, steps=16, num_envs=8)
+    agent.learn(buf.sample(32))
+    agent.actor.apply_mutation("encoder.add_node")
+    agent.actor_target.apply_mutation("encoder.add_node")
+    # mirror mutation: re-sync target arch from actor (what the HPO engine does)
+    agent.actor_target.config = agent.actor.config
+    agent.actor_target.params = jax.tree_util.tree_map(jnp.copy, agent.actor.params)
+    agent.reinit_optimizers()
+    agent.mutation_hook()
+    loss = agent.learn(buf.sample(32))
+    assert np.isfinite(loss)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "env_cls", [ConstantRewardEnv, ObsDependentRewardEnv, DiscountedRewardEnv]
+)
+def test_probe_envs(env_cls):
+    env = env_cls()
+    check_q_learning_with_probe_env(
+        env,
+        DQN,
+        dict(
+            observation_space=env.observation_space,
+            action_space=env.action_space,
+            lr=5e-3,
+            gamma=0.9,
+            tau=0.5,
+            seed=1,
+            net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}},
+        ),
+        learn_steps=400,
+    )
