@@ -219,7 +219,13 @@ class EvolvableNetwork:
         head_cfg = config_replace(cfg.head, num_inputs=new_latent)
         new_cfg = config_replace(cfg, encoder=enc_cfg, head=head_cfg, latent_dim=new_latent)
         new_params = self.init_params(self._next_key(), new_cfg)
-        self.params = preserve_params(self.params, new_params)
+        preserved = preserve_params(self.params, new_params)
+        # keep extra top-level param groups (e.g. StochasticActor's "dist")
+        # that init_params doesn't produce
+        for k, v in self.params.items():
+            if k not in preserved:
+                preserved[k] = v
+        self.params = preserved
         self.config = new_cfg
         self.last_mutation = {"numb_new_nodes": abs(delta)}
         return self.last_mutation

@@ -1,0 +1,56 @@
+"""Rollout collection into the RolloutBuffer (parity: agilerl/rollouts/on_policy.py
+— collect_rollouts:199, collect_rollouts_recurrent:220, shared core _collect:16
+with per-env done resets and hidden-state carry).
+
+Works against any gymnasium.vector-style env (JaxVecEnv or gym.vector).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def collect_rollouts(agent, env, n_steps: Optional[int] = None) -> float:
+    """Step the env `n_steps` times, storing transitions in agent.rollout_buffer.
+    Returns the mean reward collected."""
+    n_steps = n_steps or agent.learn_step
+    buf = agent.rollout_buffer
+    if agent._last_obs is None:
+        obs, _ = env.reset()
+        agent._last_obs = obs
+        agent._last_done = np.zeros(agent.num_envs, np.float32)
+        if agent.recurrent:
+            agent._hidden = agent.get_initial_hidden_state()
+    obs = agent._last_obs
+    total_reward = 0.0
+    for _ in range(n_steps):
+        hidden_before = agent._hidden if agent.recurrent else None
+        action, logp, value, _ = agent.get_action_and_value(obs)
+        next_obs, reward, terminated, truncated, _ = env.step(np.asarray(action))
+        done = np.logical_or(terminated, truncated).astype(np.float32)
+        step = dict(
+            obs=obs,
+            action=action,
+            reward=np.asarray(reward, np.float32),
+            done=done,
+            value=value,
+            log_prob=logp,
+        )
+        if agent.recurrent:
+            step["hidden_state"] = hidden_before
+            # reset hidden for envs that finished
+            agent._hidden = jax.tree_util.tree_map(
+                lambda h: np.asarray(h) * (1.0 - done)[None, :, None], agent._hidden
+            )
+        buf.add(**step)
+        total_reward += float(np.mean(reward))
+        obs = next_obs
+    agent._last_obs = obs
+    agent._last_done = done
+    return total_reward / n_steps
+
+
+collect_rollouts_recurrent = collect_rollouts  # same core (parity alias :220)

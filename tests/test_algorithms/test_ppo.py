@@ -1,0 +1,126 @@
+import jax
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms.ppo import PPO
+from agilerl_tpu.envs import CartPole, JaxVecEnv
+from agilerl_tpu.envs.probe import (
+    FixedObsPolicyEnv,
+    PolicyEnv,
+    check_policy_on_policy_with_probe_env,
+)
+from agilerl_tpu.rollouts.on_policy import collect_rollouts
+
+BOX = spaces.Box(-1, 1, (4,))
+DISC = spaces.Discrete(2)
+
+
+def make_agent(**kw):
+    defaults = dict(
+        observation_space=BOX,
+        action_space=DISC,
+        num_envs=4,
+        learn_step=32,
+        batch_size=32,
+        update_epochs=2,
+        seed=0,
+    )
+    defaults.update(kw)
+    return PPO(**defaults)
+
+
+def test_collect_and_learn():
+    env_vec = JaxVecEnv(CartPole(), num_envs=4, seed=0)
+    agent = make_agent(
+        observation_space=env_vec.single_observation_space,
+        action_space=env_vec.single_action_space,
+    )
+    collect_rollouts(agent, env_vec)
+    assert agent.rollout_buffer.full
+    loss = agent.learn()
+    assert np.isfinite(loss)
+    # buffer reset for next iteration
+    assert int(agent.rollout_buffer.state.t) == 0
+    collect_rollouts(agent, env_vec)
+    loss2 = agent.learn()
+    assert np.isfinite(loss2)
+
+
+def test_continuous_action():
+    box_act = spaces.Box(-1, 1, (2,))
+    agent = make_agent(action_space=box_act)
+    a, logp, v, _ = agent.get_action_and_value(np.zeros((4, 4), np.float32))
+    assert a.shape == (4, 2)
+    assert logp.shape == (4,)
+    assert v.shape == (4,)
+
+
+def test_clone_preserves_weights():
+    agent = make_agent()
+    clone = agent.clone(index=3)
+    obs = np.zeros((2, 4), np.float32)
+    a1 = agent.get_action(obs, training=False)
+    a2 = clone.get_action(obs, training=False)
+    np.testing.assert_array_equal(a1, a2)
+    assert clone.index == 3
+
+
+def test_mutation_then_learn():
+    env_vec = JaxVecEnv(CartPole(), num_envs=4, seed=0)
+    agent = make_agent(
+        observation_space=env_vec.single_observation_space,
+        action_space=env_vec.single_action_space,
+    )
+    collect_rollouts(agent, env_vec)
+    agent.learn()
+    agent.actor.apply_mutation("add_latent_node")
+    agent.critic.apply_mutation("add_latent_node")
+    agent.reinit_optimizers()
+    agent.mutation_hook()
+    collect_rollouts(agent, env_vec)
+    loss = agent.learn()
+    assert np.isfinite(loss)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("env_cls", [FixedObsPolicyEnv, PolicyEnv])
+def test_probe_policy(env_cls):
+    env = env_cls()
+    check_policy_on_policy_with_probe_env(
+        env,
+        PPO,
+        dict(
+            observation_space=env.observation_space,
+            action_space=env.action_space,
+            num_envs=8,
+            learn_step=16,
+            batch_size=64,
+            update_epochs=4,
+            lr=3e-3,
+            gamma=0.5,
+            ent_coef=0.05,
+            seed=3,
+            net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}},
+        ),
+        train_iters=80,
+    )
+
+
+@pytest.mark.slow
+def test_recurrent_ppo_runs():
+    env_vec = JaxVecEnv(CartPole(), num_envs=4, seed=0)
+    agent = PPO(
+        observation_space=env_vec.single_observation_space,
+        action_space=env_vec.single_action_space,
+        num_envs=4,
+        learn_step=32,
+        batch_size=32,
+        update_epochs=1,
+        recurrent=True,
+        seq_len=8,
+        seed=0,
+    )
+    collect_rollouts(agent, env_vec)
+    loss = agent.learn()
+    assert np.isfinite(loss)
