@@ -46,6 +46,9 @@ def default_hp_config() -> HyperparameterConfig:
 
 
 class PPO(RLAlgorithm):
+    # activation mutation is blocked for policy-gradient algos (parity: hpo/mutation.py:473)
+    supports_activation_mutation = False
+
     def __init__(
         self,
         observation_space,

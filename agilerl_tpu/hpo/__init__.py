@@ -1,0 +1,4 @@
+from agilerl_tpu.hpo.mutation import Mutations
+from agilerl_tpu.hpo.tournament import TournamentSelection
+
+__all__ = ["Mutations", "TournamentSelection"]

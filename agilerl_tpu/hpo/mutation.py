@@ -1,0 +1,201 @@
+"""Mutations engine (parity: agilerl/hpo/mutation.py — Mutations:167, dispatch
+mutation:311, no_mutation:364, architecture_mutate:374 (single :829 — sample a
+method on the policy then apply the same to other eval nets), activation
+mutation:457 (blocked for policy-gradient algos :473), parameter mutation
+(Gaussian weight noise _gaussian_parameter_mutation:733), RL-HP mutation:413,
+shared-net rebuild @reinit_shared_networks:104).
+
+TPU-first: parameter noise is a jitted pytree op; architecture changes are
+config transitions whose weight transfer happened inside the module mutation;
+after any mutation the engine re-syncs shared (target) networks from their eval
+nets, re-inits optax states to the new param shapes, and drops the agent's jit
+cache so XLA recompiles only the mutated member.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.networks.base import EvolvableNetwork
+
+
+class Mutations:
+    def __init__(
+        self,
+        no_mutation: float = 0.2,
+        architecture: float = 0.2,
+        new_layer_prob: float = 0.2,
+        parameters: float = 0.2,
+        activation: float = 0.2,
+        rl_hp: float = 0.2,
+        mutation_sd: float = 0.1,
+        activation_selection: Optional[List[str]] = None,
+        mutate_elite: bool = True,
+        rand_seed: Optional[int] = None,
+    ):
+        self.no_mut = float(no_mutation)
+        self.architecture_mut = float(architecture)
+        self.new_layer_prob = float(new_layer_prob)
+        self.parameters_mut = float(parameters)
+        self.activation_mut = float(activation)
+        self.rl_hp_mut = float(rl_hp)
+        self.mutation_sd = float(mutation_sd)
+        self.activation_selection = activation_selection or ["ReLU", "ELU", "GELU"]
+        self.mutate_elite = bool(mutate_elite)
+        self.rng = np.random.default_rng(rand_seed)
+        self._key = jax.random.PRNGKey(rand_seed if rand_seed is not None else 0)
+
+    # ------------------------------------------------------------------ #
+    def mutation(self, population: List, pre_training_mut: bool = False) -> List:
+        """Apply one sampled mutation per agent (parity: mutation.py:311)."""
+        options = [
+            (self.no_mutation, self.no_mut),
+            (self.architecture_mutate, self.architecture_mut),
+            (self.parameter_mutation, self.parameters_mut),
+            (self.activation_mutation, self.activation_mut),
+            (self.rl_hyperparam_mutation, self.rl_hp_mut),
+        ]
+        if pre_training_mut:
+            # before training starts only HP/no mutations (parity: pre_training_mut)
+            options = [
+                (self.no_mutation, self.no_mut),
+                (self.rl_hyperparam_mutation, self.rl_hp_mut),
+            ]
+        fns = [f for f, _ in options]
+        probs = np.array([p for _, p in options], np.float64)
+        if probs.sum() == 0:
+            probs = np.ones_like(probs)
+        probs = probs / probs.sum()
+
+        mutated = []
+        for i, agent in enumerate(population):
+            if i == 0 and not self.mutate_elite and not pre_training_mut:
+                agent.mut = "None"
+                mutated.append(agent)
+                continue
+            fn = fns[int(self.rng.choice(len(fns), p=probs))]
+            mutated.append(fn(agent))
+        return mutated
+
+    # ------------------------------------------------------------------ #
+    def no_mutation(self, agent):
+        agent.mut = "None"
+        return agent
+
+    # ------------------------------------------------------------------ #
+    def architecture_mutate(self, agent):
+        """Sample one mutation method on the policy net; replay the same method
+        on every other eval net so architectures stay aligned
+        (parity: mutation.py:829)."""
+        policy_group = agent.registry.policy_group
+        policy: EvolvableNetwork = getattr(agent, policy_group.eval)
+        method = policy.sample_mutation_method(self.new_layer_prob, self.rng)
+        # apply with a shared numpy state so magnitudes align across nets
+        seed = int(self.rng.integers(0, 2**31 - 1))
+        policy.apply_mutation(method, rng=np.random.default_rng(seed))
+        for group in agent.registry.groups:
+            if group is policy_group:
+                continue
+            net = getattr(agent, group.eval)
+            if hasattr(net, "apply_mutation") and _has_method(net, method):
+                try:
+                    net.apply_mutation(method, rng=np.random.default_rng(seed))
+                except Exception:
+                    pass
+        self._reinit_shared(agent)
+        agent.reinit_optimizers()
+        agent.mutation_hook()
+        agent.mut = method
+        return agent
+
+    # ------------------------------------------------------------------ #
+    def parameter_mutation(self, agent):
+        """Gaussian weight noise on the policy net
+        (parity: _gaussian_parameter_mutation:733 — noise applied to a random
+        ~10% subset of each weight tensor)."""
+        policy_group = agent.registry.policy_group
+        policy = getattr(agent, policy_group.eval)
+        self._key, sub = jax.random.split(self._key)
+        policy.params = _gaussian_mutate(policy.params, sub, self.mutation_sd)
+        self._reinit_shared(agent)
+        agent.mutation_hook()
+        agent.mut = "param"
+        return agent
+
+    # ------------------------------------------------------------------ #
+    def activation_mutation(self, agent):
+        """Swap the activation in every eval net (parity: mutation.py:457;
+        blocked for policy-gradient algos :473)."""
+        if not getattr(agent, "supports_activation_mutation", True):
+            agent.mut = "None"
+            return agent
+        new_act = str(self.rng.choice(self.activation_selection))
+        for group in agent.registry.groups:
+            net = getattr(agent, group.eval)
+            if hasattr(net, "change_activation"):
+                net.change_activation(new_act)
+        self._reinit_shared(agent)
+        agent.reinit_optimizers()
+        agent.mutation_hook()
+        agent.mut = "act"
+        return agent
+
+    # ------------------------------------------------------------------ #
+    def rl_hyperparam_mutation(self, agent):
+        """Resample one scalar HP within its RLParameter space
+        (parity: mutation.py:413)."""
+        hp_config = agent.hp_config
+        name = hp_config.sample(self.rng)
+        if name is None:
+            agent.mut = "None"
+            return agent
+        new_value = hp_config[name].mutate(getattr(agent, name), self.rng)
+        setattr(agent, name, new_value)
+        if name == "lr":
+            for cfg in agent.registry.optimizer_configs:
+                if cfg.lr == name:
+                    getattr(agent, cfg.name).set_lr(new_value)
+        if name == "learn_step" and hasattr(agent, "rollout_buffer"):
+            agent.rollout_buffer.capacity = int(new_value)
+            agent.rollout_buffer.state = None
+        agent.mut = name
+        return agent
+
+    # ------------------------------------------------------------------ #
+    def _reinit_shared(self, agent) -> None:
+        """Rebuild target/shared nets from their eval nets
+        (parity: @reinit_shared_networks:104)."""
+        for group in agent.registry.groups:
+            eval_net = getattr(agent, group.eval)
+            for shared_name in group.shared_names():
+                shared = getattr(agent, shared_name)
+                shared.config = eval_net.config
+                shared.params = jax.tree_util.tree_map(jnp.copy, eval_net.params)
+
+
+def _has_method(net, method: str) -> bool:
+    if "." in method:
+        return hasattr(net, "apply_mutation")
+    return hasattr(net, method) or hasattr(net, "apply_mutation")
+
+
+def _gaussian_mutate(params: Any, key: jax.Array, sd: float, frac: float = 0.1) -> Any:
+    """Add N(0, sd) noise to a random ~frac subset of every weight tensor."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(key, len(leaves))
+
+    def mutate_leaf(leaf, k):
+        if leaf.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return leaf
+        k1, k2 = jax.random.split(k)
+        mask = jax.random.uniform(k1, leaf.shape) < frac
+        noise = jax.random.normal(k2, leaf.shape) * sd
+        return leaf + jnp.where(mask, noise, 0.0).astype(leaf.dtype)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [mutate_leaf(l, k) for l, k in zip(leaves, keys)]
+    )

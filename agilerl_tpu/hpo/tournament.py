@@ -1,0 +1,61 @@
+"""Tournament selection (parity: agilerl/hpo/tournament.py —
+TournamentSelection:9, fitness = mean of last eval_loop scores, elitism,
+k-way tournament _tournament:41).
+
+The reference's LLM path (_select_llm_agents:121: rank-0 decides then
+broadcast_object_list) is replaced TPU-style by deterministic replicated RNG:
+every host holds the same numpy Generator seed, so every host computes the same
+tournament outcome with no object broadcast (see parallel/population.py for the
+pod-sharded variant).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class TournamentSelection:
+    def __init__(
+        self,
+        tournament_size: int = 2,
+        elitism: bool = True,
+        population_size: int = 6,
+        eval_loop: int = 1,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        self.tournament_size = int(tournament_size)
+        self.elitism = bool(elitism)
+        self.population_size = int(population_size)
+        self.eval_loop = int(eval_loop)
+        self.rng = rng or np.random.default_rng()
+
+    def _fitness(self, agent) -> float:
+        window = agent.fitness[-self.eval_loop:]
+        return float(np.mean(window)) if window else -np.inf
+
+    def _tournament(self, fitnesses: np.ndarray) -> int:
+        """k-way tournament: sample k entrants, return the fittest's index
+        (parity: tournament.py:41)."""
+        entrants = self.rng.choice(
+            len(fitnesses), size=min(self.tournament_size, len(fitnesses)), replace=False
+        )
+        return int(entrants[np.argmax(fitnesses[entrants])])
+
+    def select(self, population: List) -> Tuple[object, List]:
+        """Return (elite, next_generation). The elite is always cloned into the
+        next generation when elitism is on (parity: tournament.py:71)."""
+        fitnesses = np.array([self._fitness(a) for a in population])
+        elite_idx = int(np.argmax(fitnesses))
+        elite = population[elite_idx]
+
+        max_id = max(a.index for a in population)
+        new_population = []
+        if self.elitism:
+            new_population.append(elite.clone(index=elite.index))
+        while len(new_population) < self.population_size:
+            winner = population[self._tournament(fitnesses)]
+            max_id += 1
+            new_population.append(winner.clone(index=max_id))
+        return elite, new_population

@@ -230,6 +230,24 @@ class EvolvableNetwork:
         self.last_mutation = {"numb_new_nodes": abs(delta)}
         return self.last_mutation
 
+    def change_activation(self, activation: str, output: bool = False) -> None:
+        """Swap activation functions across encoder/head configs (activation
+        changes never alter param shapes, so no morph is needed)."""
+
+        def maybe(cfg):
+            changes = {}
+            if hasattr(cfg, "activation"):
+                changes["activation"] = activation
+            if hasattr(cfg, "sub_configs"):
+                changes["sub_configs"] = tuple(
+                    (n, k, maybe(sc)) for n, k, sc in cfg.sub_configs
+                )
+            return config_replace(cfg, **changes) if changes else cfg
+
+        self.config = config_replace(
+            self.config, encoder=maybe(self.config.encoder), head=maybe(self.config.head)
+        )
+
     # -- cloning / state ------------------------------------------------ #
     def clone(self) -> "EvolvableNetwork":
         new = object.__new__(type(self))

@@ -1,0 +1,153 @@
+"""Evolutionary off-policy training loop (parity: agilerl/training/train_off_policy.py
+— train_off_policy:41: per-agent env stepping, n-step/PER buffer variants
+:340-429, learn cadence, fitness eval, tournament+mutation, fps tracking :439,
+wandb + checkpointing; the Accelerate DataLoader path :213 is replaced by
+device-resident buffers).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agilerl_tpu.utils.utils import (
+    init_wandb,
+    print_hyperparams,
+    save_population_checkpoint,
+    tournament_selection_and_mutation,
+)
+
+
+def train_off_policy(
+    env,
+    env_name: str,
+    algo: str,
+    pop: List,
+    memory,
+    INIT_HP: Optional[Dict] = None,
+    MUT_P: Optional[Dict] = None,
+    swap_channels: bool = False,
+    max_steps: int = 50_000,
+    evo_steps: int = 5_000,
+    eval_steps: Optional[int] = None,
+    eval_loop: int = 1,
+    learning_delay: int = 0,
+    eps_start: float = 1.0,
+    eps_end: float = 0.1,
+    eps_decay: float = 0.995,
+    target: Optional[float] = None,
+    n_step: bool = False,
+    per: bool = False,
+    n_step_memory=None,
+    tournament=None,
+    mutation=None,
+    checkpoint: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    overwrite_checkpoints: bool = False,
+    save_elite: bool = False,
+    elite_path: Optional[str] = None,
+    wb: bool = False,
+    verbose: bool = True,
+    accelerator=None,
+    wandb_api_key: Optional[str] = None,
+) -> Tuple[List, List[List[float]]]:
+    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    num_envs = getattr(env, "num_envs", 1)
+    epsilon = eps_start
+    pop_fitnesses: List[List[float]] = [[] for _ in pop]
+    total_steps = 0
+    checkpoint_count = 0
+    start = time.time()
+
+    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+        for agent in pop:
+            obs, _ = env.reset()
+            scores = np.zeros(num_envs)
+            completed_scores: List[float] = []
+            steps = 0
+            for _ in range(max(evo_steps // num_envs, 1)):
+                action = agent.get_action(obs, epsilon=epsilon)
+                next_obs, reward, terminated, truncated, _ = env.step(np.asarray(action))
+                done = np.logical_or(terminated, truncated)
+                scores += np.asarray(reward)
+                for i, d in enumerate(np.atleast_1d(done)):
+                    if d:
+                        completed_scores.append(float(np.atleast_1d(scores)[i]))
+                        scores[i] = 0.0
+
+                transition = {
+                    "obs": obs,
+                    "action": action,
+                    "reward": np.asarray(reward, np.float32),
+                    "next_obs": next_obs,
+                    "done": np.asarray(terminated, np.float32),
+                }
+                if n_step and n_step_memory is not None:
+                    fused = n_step_memory.add(transition, batched=num_envs > 1)
+                    if fused is not None:
+                        memory.add(fused, batched=num_envs > 1)
+                else:
+                    memory.add(transition, batched=num_envs > 1)
+
+                obs = next_obs
+                steps += num_envs
+                total_steps += num_envs
+                epsilon = max(eps_end, epsilon * eps_decay)
+
+                if (
+                    len(memory) >= agent.batch_size
+                    and len(memory) >= learning_delay
+                    and steps % max(agent.learn_step, 1) < num_envs
+                ):
+                    if per:
+                        batch, idxs, weights = memory.sample(agent.batch_size)
+                        new_priorities = agent.learn((batch, idxs, weights))
+                        if new_priorities is not None:
+                            memory.update_priorities(idxs, new_priorities)
+                    else:
+                        agent.learn(memory.sample(agent.batch_size))
+
+            agent.steps[-1] += steps
+            mean_score = float(np.mean(completed_scores)) if completed_scores else float(np.mean(scores))
+            agent.scores.append(mean_score)
+
+        # evaluation + evolution
+        fitnesses = [
+            agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
+            for agent in pop
+        ]
+        for i, f in enumerate(fitnesses):
+            pop_fitnesses[i].append(f)
+        if wandb_run is not None:
+            wandb_run.log(
+                {"global_step": total_steps, "fps": total_steps / (time.time() - start),
+                 "eval/mean_fitness": float(np.mean(fitnesses))}
+            )
+        if verbose:
+            fps = total_steps / (time.time() - start)
+            print(
+                f"--- steps {total_steps} fps {fps:.0f} eps {epsilon:.3f} "
+                f"fitness {[f'{f:.1f}' for f in fitnesses]}"
+            )
+            print_hyperparams(pop)
+
+        if tournament is not None and mutation is not None:
+            pop = tournament_selection_and_mutation(
+                pop, tournament, mutation, env_name=env_name, algo=algo,
+                elite_path=elite_path, save_elite=save_elite,
+            )
+
+        for agent in pop:
+            agent.steps.append(agent.steps[-1])
+
+        if checkpoint is not None and checkpoint_path is not None:
+            if total_steps // checkpoint > checkpoint_count:
+                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                checkpoint_count = total_steps // checkpoint
+
+        if target is not None and np.min(fitnesses) >= target:
+            break
+
+    return pop, pop_fitnesses

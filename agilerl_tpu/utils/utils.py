@@ -1,0 +1,276 @@
+"""Population factory, env makers, evolution glue, logging helpers
+(parity: agilerl/utils/utils.py — create_population:218, make_vect_envs:47,
+tournament_selection_and_mutation:706, save_population_checkpoint:656,
+print_hyperparams:924, aggregate_metrics_across_gpus:1004).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+# Algo name -> class, populated lazily to avoid import cycles
+_ALGO_CLASSES: Dict[str, Any] = {}
+
+
+def get_algo_class(algo: str):
+    if not _ALGO_CLASSES:
+        from agilerl_tpu.algorithms.dqn import DQN
+        from agilerl_tpu.algorithms.ppo import PPO
+
+        _ALGO_CLASSES.update({"DQN": DQN, "PPO": PPO})
+        try:
+            from agilerl_tpu.algorithms.dqn_rainbow import RainbowDQN
+
+            _ALGO_CLASSES["Rainbow DQN"] = RainbowDQN
+            _ALGO_CLASSES["RainbowDQN"] = RainbowDQN
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.ddpg import DDPG
+            from agilerl_tpu.algorithms.td3 import TD3
+
+            _ALGO_CLASSES.update({"DDPG": DDPG, "TD3": TD3})
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.cqn import CQN
+
+            _ALGO_CLASSES["CQN"] = CQN
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.neural_ucb_bandit import NeuralUCB
+            from agilerl_tpu.algorithms.neural_ts_bandit import NeuralTS
+
+            _ALGO_CLASSES.update({"NeuralUCB": NeuralUCB, "NeuralTS": NeuralTS})
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.maddpg import MADDPG
+            from agilerl_tpu.algorithms.matd3 import MATD3
+
+            _ALGO_CLASSES.update({"MADDPG": MADDPG, "MATD3": MATD3})
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.ippo import IPPO
+
+            _ALGO_CLASSES["IPPO"] = IPPO
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.grpo import GRPO
+
+            _ALGO_CLASSES["GRPO"] = GRPO
+        except ImportError:
+            pass
+        try:
+            from agilerl_tpu.algorithms.dpo import DPO
+
+            _ALGO_CLASSES["DPO"] = DPO
+        except ImportError:
+            pass
+    if algo not in _ALGO_CLASSES:
+        raise KeyError(f"Unknown algorithm {algo!r}; known: {sorted(_ALGO_CLASSES)}")
+    return _ALGO_CLASSES[algo]
+
+
+# INIT_HP upper-case key -> constructor kwarg (parity with the reference's
+# INIT_HP dict convention)
+_INIT_HP_MAP = {
+    "BATCH_SIZE": "batch_size",
+    "LR": "lr",
+    "LR_ACTOR": "lr_actor",
+    "LR_CRITIC": "lr_critic",
+    "GAMMA": "gamma",
+    "TAU": "tau",
+    "LEARN_STEP": "learn_step",
+    "DOUBLE": "double",
+    "N_STEP": "n_step",
+    "PER": "per",
+    "NUM_ATOMS": "num_atoms",
+    "V_MIN": "v_min",
+    "V_MAX": "v_max",
+    "CLIP_COEF": "clip_coef",
+    "ENT_COEF": "ent_coef",
+    "VF_COEF": "vf_coef",
+    "MAX_GRAD_NORM": "max_grad_norm",
+    "UPDATE_EPOCHS": "update_epochs",
+    "GAE_LAMBDA": "gae_lambda",
+    "TARGET_KL": "target_kl",
+    "POLICY_FREQ": "policy_freq",
+    "O_U_NOISE": "O_U_noise",
+    "EXPL_NOISE": "expl_noise",
+    "MEAN_NOISE": "mean_noise",
+    "THETA": "theta",
+    "DT": "dt",
+    "NUM_ENVS": "num_envs",
+    "AGENT_IDS": "agent_ids",
+    "LAMBDA": "reg_lambda",
+    "REG": "reg_lambda",
+}
+
+
+def create_population(
+    algo: str,
+    observation_space,
+    action_space,
+    net_config: Optional[Dict[str, Any]] = None,
+    INIT_HP: Optional[Dict[str, Any]] = None,
+    hp_config=None,
+    population_size: Optional[int] = None,
+    num_envs: int = 1,
+    device=None,
+    accelerator=None,
+    seed: Optional[int] = None,
+    **kwargs,
+) -> List:
+    """Build a population of agents (parity: utils/utils.py:218)."""
+    INIT_HP = dict(INIT_HP or {})
+    pop_size = population_size or INIT_HP.get("POP_SIZE", INIT_HP.get("POPULATION_SIZE", 4))
+    algo_cls = get_algo_class(algo)
+
+    ctor_kwargs: Dict[str, Any] = {}
+    for k, v in INIT_HP.items():
+        key = _INIT_HP_MAP.get(k)
+        if key is not None:
+            ctor_kwargs[key] = v
+    ctor_kwargs.update(kwargs)
+    if "num_envs" in algo_cls.__init__.__code__.co_varnames:
+        ctor_kwargs.setdefault("num_envs", num_envs)
+
+    population = []
+    rng = np.random.default_rng(seed)
+    for idx in range(pop_size):
+        population.append(
+            algo_cls(
+                observation_space,
+                action_space,
+                index=idx,
+                net_config=net_config,
+                hp_config=hp_config,
+                seed=int(rng.integers(0, 2**31 - 1)),
+                **ctor_kwargs,
+            )
+        )
+    return population
+
+
+def make_vect_envs(
+    env_name: Optional[str] = None,
+    num_envs: int = 1,
+    *,
+    make_env: Optional[Any] = None,
+    should_async_vector: bool = True,
+    prefer_jax: bool = True,
+    **env_kwargs,
+):
+    """Vectorised env factory (parity: utils/utils.py:47).
+
+    Prefers the in-tree pure-JAX env (zero-host-boundary) when the id is known;
+    falls back to gymnasium vectorisation otherwise."""
+    if make_env is None and prefer_jax and env_name is not None:
+        from agilerl_tpu.envs import classic
+
+        if env_name in classic.REGISTRY:
+            from agilerl_tpu.envs.core import JaxVecEnv
+
+            return JaxVecEnv(classic.make(env_name), num_envs=num_envs)
+    import gymnasium as gym
+
+    if make_env is not None:
+        fns = [make_env for _ in range(num_envs)]
+    else:
+        fns = [lambda: gym.make(env_name, **env_kwargs) for _ in range(num_envs)]
+    cls = gym.vector.AsyncVectorEnv if should_async_vector else gym.vector.SyncVectorEnv
+    return cls(fns)
+
+
+def tournament_selection_and_mutation(
+    population: List,
+    tournament,
+    mutation,
+    env_name: Optional[str] = None,
+    algo: Optional[str] = None,
+    elite_path: Optional[str] = None,
+    save_elite: bool = False,
+    accelerator=None,
+    language_model: bool = False,
+) -> List:
+    """select -> mutate -> optionally save elite (parity: utils/utils.py:706)."""
+    elite, population = tournament.select(population)
+    population = mutation.mutation(population)
+    if save_elite and elite_path is not None:
+        path = Path(elite_path)
+        if path.suffix == "":
+            path = path / f"{algo or elite.algo}_elite.ckpt"
+        elite.save_checkpoint(path)
+    return population
+
+
+def save_population_checkpoint(
+    population: List, save_path: str, overwrite_checkpoints: bool = True, accelerator=None
+) -> None:
+    """Checkpoint every member (parity: utils/utils.py:656)."""
+    for agent in population:
+        p = Path(save_path)
+        path = p.parent / f"{p.stem}_{agent.index}{p.suffix or '.ckpt'}"
+        agent.save_checkpoint(path)
+
+
+def load_population_checkpoint(algo: str, save_path: str, indices: List[int], **kwargs) -> List:
+    cls = get_algo_class(algo)
+    pop = []
+    for idx in indices:
+        p = Path(save_path)
+        path = p.parent / f"{p.stem}_{idx}{p.suffix or '.ckpt'}"
+        pop.append(cls.load(path))
+    return pop
+
+
+def print_hyperparams(population: List) -> None:
+    """Log per-agent HPs + fitness (parity: utils/utils.py:924)."""
+    for agent in population:
+        hps = {name: getattr(agent, name) for name in agent.hp_config.names()}
+        fit = np.mean(agent.fitness[-5:]) if agent.fitness else float("nan")
+        print(
+            f"Agent {agent.index}: fitness(5)={fit:.2f} mut={agent.mut} "
+            f"steps={agent.steps[-1]} {hps}"
+        )
+
+
+def aggregate_metrics_across_hosts(value: float) -> float:
+    """Mean-reduce a host scalar across processes (parity: utils/utils.py:1004
+    aggregate_metrics_across_gpus — torch.distributed gather becomes a psum over
+    the pod when running multi-host)."""
+    if jax.process_count() == 1:
+        return float(value)
+    from jax.experimental import multihost_utils
+
+    arr = multihost_utils.process_allgather(np.asarray([value]))
+    return float(np.mean(arr))
+
+
+def default_progress_bar(total: int, desc: str = ""):
+    try:
+        from tqdm import trange
+
+        return trange(total, desc=desc)
+    except ImportError:  # pragma: no cover
+        return range(total)
+
+
+def init_wandb(project: str = "agilerl-tpu", config: Optional[dict] = None, **kwargs):
+    """W&B is optional in this image; no-op fallback (parity: utils.py:799)."""
+    try:
+        import wandb
+
+        wandb.init(project=project, config=config, **kwargs)
+        return wandb
+    except Exception:
+        return None

@@ -1,0 +1,132 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms.dqn import DQN
+from agilerl_tpu.algorithms.ppo import PPO
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+from agilerl_tpu.utils.utils import create_population
+
+BOX = spaces.Box(-1, 1, (4,))
+DISC = spaces.Discrete(2)
+
+
+def make_pop(algo="DQN", size=4):
+    return create_population(
+        algo, BOX, DISC, population_size=size, seed=0,
+        net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}},
+        **({"learn_step": 16, "num_envs": 2} if algo == "PPO" else {}),
+    )
+
+
+class TestTournament:
+    def test_elitism_keeps_best(self):
+        pop = make_pop()
+        for i, agent in enumerate(pop):
+            agent.fitness = [float(i)]
+        ts = TournamentSelection(tournament_size=2, elitism=True, population_size=4,
+                                 eval_loop=1, rng=np.random.default_rng(0))
+        elite, new_pop = ts.select(pop)
+        assert elite is pop[-1]
+        assert len(new_pop) == 4
+        assert new_pop[0].index == pop[-1].index
+        obs = np.zeros((2, 4), np.float32)
+        np.testing.assert_array_equal(
+            elite.get_action(obs, training=False), new_pop[0].get_action(obs, training=False)
+        )
+
+    def test_fitness_window(self):
+        pop = make_pop(size=2)
+        pop[0].fitness = [100.0, 0.0, 0.0]
+        pop[1].fitness = [0.0, 10.0, 10.0]
+        ts = TournamentSelection(2, True, 2, eval_loop=2, rng=np.random.default_rng(0))
+        elite, _ = ts.select(pop)
+        assert elite is pop[1]
+
+
+class TestMutations:
+    def test_architecture_mutation_keeps_agent_working(self):
+        pop = make_pop()
+        mut = Mutations(no_mutation=0, architecture=1, parameters=0, activation=0,
+                        rl_hp=0, rand_seed=0)
+        new_pop = mut.mutation(pop)
+        obs = np.zeros((2, 4), np.float32)
+        for agent in new_pop:
+            assert agent.mut not in ("None",)
+            a = agent.get_action(obs, training=False)
+            assert a.shape == (2,)
+            # target must mirror actor architecture
+            assert agent.actor_target.config == agent.actor.config
+
+    def test_parameter_mutation_changes_weights(self):
+        pop = make_pop(size=2)
+        before = np.asarray(pop[0].actor.params["encoder"]["layer_0"]["kernel"]).copy()
+        mut = Mutations(no_mutation=0, architecture=0, parameters=1, activation=0,
+                        rl_hp=0, rand_seed=0)
+        new_pop = mut.mutation(pop)
+        after = np.asarray(new_pop[0].actor.params["encoder"]["layer_0"]["kernel"])
+        assert not np.array_equal(before, after)
+        assert new_pop[0].mut == "param"
+
+    def test_rl_hp_mutation(self):
+        pop = make_pop(size=2)
+        lr0, bs0, ls0 = pop[0].lr, pop[0].batch_size, pop[0].learn_step
+        mut = Mutations(no_mutation=0, architecture=0, parameters=0, activation=0,
+                        rl_hp=1, rand_seed=3)
+        new_pop = mut.mutation(pop)
+        changed = (
+            new_pop[0].lr != lr0
+            or new_pop[0].batch_size != bs0
+            or new_pop[0].learn_step != ls0
+        )
+        assert changed
+        assert new_pop[0].mut in ("lr", "batch_size", "learn_step")
+
+    def test_activation_mutation_dqn(self):
+        pop = make_pop(size=2)
+        mut = Mutations(no_mutation=0, architecture=0, parameters=0, activation=1,
+                        rl_hp=0, activation_selection=["Tanh"], rand_seed=0)
+        new_pop = mut.mutation(pop)
+        assert new_pop[0].actor.config.encoder.activation == "Tanh"
+        obs = np.zeros((2, 4), np.float32)
+        assert new_pop[0].get_action(obs, training=False).shape == (2,)
+
+    def test_activation_mutation_blocked_for_ppo(self):
+        pop = make_pop(algo="PPO", size=2)
+        act0 = pop[0].actor.config.encoder.activation
+        mut = Mutations(no_mutation=0, architecture=0, parameters=0, activation=1,
+                        rl_hp=0, activation_selection=["Tanh"], rand_seed=0)
+        new_pop = mut.mutation(pop)
+        assert new_pop[0].actor.config.encoder.activation == act0
+        assert new_pop[0].mut == "None"
+
+    def test_ppo_architecture_mutation(self):
+        pop = make_pop(algo="PPO", size=2)
+        mut = Mutations(no_mutation=0, architecture=1, parameters=0, activation=0,
+                        rl_hp=0, rand_seed=1)
+        new_pop = mut.mutation(pop)
+        obs = np.zeros((2, 4), np.float32)
+        for agent in new_pop:
+            assert agent.get_action(obs, training=False).shape == (2,)
+
+    def test_learn_after_every_mutation_class(self):
+        from agilerl_tpu.components import ReplayBuffer
+
+        pop = make_pop(size=5)
+        buf = ReplayBuffer(max_size=256)
+        rng = np.random.default_rng(0)
+        for i in range(64):
+            buf.add({
+                "obs": rng.normal(size=4).astype(np.float32),
+                "action": np.int32(i % 2),
+                "reward": np.float32(1.0),
+                "next_obs": rng.normal(size=4).astype(np.float32),
+                "done": np.float32(1.0),
+            })
+        mut = Mutations(no_mutation=0.2, architecture=0.2, parameters=0.2,
+                        activation=0.2, rl_hp=0.2, rand_seed=7)
+        new_pop = mut.mutation(pop)
+        for agent in new_pop:
+            loss = agent.learn(buf.sample(int(agent.batch_size)))
+            assert np.isfinite(loss)
