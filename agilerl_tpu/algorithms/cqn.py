@@ -1,0 +1,66 @@
+"""CQN — conservative Q-learning for offline RL on discrete actions
+(parity: agilerl/algorithms/cqn.py — CQN:?, learn:216; DQN-style TD backup plus
+the CQL regulariser logsumexp(Q(s,·)) - Q(s,a) that penalises OOD actions).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from agilerl_tpu.algorithms.dqn import DQN
+from agilerl_tpu.networks.q_networks import QNetwork
+
+
+class CQN(DQN):
+    def __init__(self, observation_space, action_space, cql_alpha: float = 1.0, **kwargs):
+        self.cql_alpha = float(cql_alpha)
+        super().__init__(observation_space, action_space, **kwargs)
+
+    @property
+    def init_dict(self) -> Dict:
+        d = super().init_dict
+        d["cql_alpha"] = self.cql_alpha
+        return d
+
+    def _train_fn(self):
+        config = self.actor.config
+        tx = self.optimizer.tx
+        double = self.double
+        cql_alpha = self.cql_alpha
+
+        @jax.jit
+        def train_step(params, target_params, opt_state, batch, gamma, tau):
+            obs, action = batch["obs"], batch["action"].astype(jnp.int32)
+            reward = batch["reward"].astype(jnp.float32)
+            done = batch["done"].astype(jnp.float32)
+            next_obs = batch["next_obs"]
+
+            q_next_t = QNetwork.apply(config, target_params, next_obs)
+            if double:
+                next_a = jnp.argmax(QNetwork.apply(config, params, next_obs), axis=-1)
+                q_next = jnp.take_along_axis(q_next_t, next_a[..., None], axis=-1)[..., 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=-1)
+            target = reward + gamma * (1.0 - done) * q_next
+
+            def loss_fn(p):
+                q = QNetwork.apply(config, p, obs)
+                q_sel = jnp.take_along_axis(q, action[..., None], axis=-1)[..., 0]
+                td = jnp.mean(jnp.square(q_sel - jax.lax.stop_gradient(target)))
+                # conservative penalty: push down logsumexp, push up data actions
+                cql = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1) - q_sel)
+                return td + cql_alpha * cql
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree_util.tree_map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target_params, params
+            )
+            return params, target_params, opt_state, loss
+
+        return train_step

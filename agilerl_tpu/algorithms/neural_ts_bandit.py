@@ -1,0 +1,53 @@
+"""NeuralTS contextual bandit (parity: agilerl/algorithms/neural_ts_bandit.py —
+NeuralTS:?, learn:258; Thompson sampling: per-arm reward sampled from
+N(f(x_a), nu * g^T U^-1 g) with the diagonal design-matrix approximation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.algorithms.neural_ucb_bandit import NeuralUCB
+from agilerl_tpu.networks.base import EvolvableNetwork
+
+
+class NeuralTS(NeuralUCB):
+    def _score_fn(self):
+        config = self.actor.config
+        lamb = self.lamb
+
+        def f(params, x):
+            return EvolvableNetwork.apply(config, params, x[None])[0, 0]
+
+        @jax.jit
+        def score(params, U, context, nu, key):
+            values = jax.vmap(lambda x: f(params, x))(context)
+            grads = jax.vmap(lambda x: jax.grad(f)(params, x))(context)
+            var = jax.vmap(
+                lambda g: lamb * sum(
+                    jnp.sum(gl * gl / ul)
+                    for gl, ul in zip(
+                        jax.tree_util.tree_leaves(g), jax.tree_util.tree_leaves(U)
+                    )
+                ),
+                in_axes=0,
+            )(grads)
+            sigma = jnp.sqrt(jnp.maximum(var, 1e-12))
+            sampled = values + nu * sigma * jax.random.normal(key, values.shape)
+            arm = jnp.argmax(sampled)
+            chosen_g = jax.tree_util.tree_map(lambda g: g[arm], grads)
+            new_U = jax.tree_util.tree_map(lambda u, g: u + g * g, U, chosen_g)
+            return arm, new_U
+
+        return score
+
+    def get_action(self, context, training: bool = True, **kw) -> np.ndarray:
+        context = self.preprocess_observation(np.asarray(context))
+        score = self.jit_fn("score", self._score_fn)
+        nu = jnp.float32(self.gamma if training else 0.0)
+        arm, new_U = score(self.actor.params, self.U, context, nu, self.next_key())
+        if training:
+            self.U = new_U
+        return np.asarray(arm)

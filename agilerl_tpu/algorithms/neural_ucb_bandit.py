@@ -1,0 +1,202 @@
+"""NeuralUCB contextual bandit (parity: agilerl/algorithms/neural_ucb_bandit.py
+— NeuralUCB:?, learn:261; gradient-based confidence with the diagonal
+approximation of the design matrix; regularised toward the init params;
+_reinit_bandit_grads after mutations, hpo/mutation.py:1064).
+
+TPU-first: the per-arm confidence width sqrt(lambda*nu * sum(g^2 / U)) needs
+per-arm parameter gradients — computed with a vmapped jax.grad over arms, fully
+on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.base import RLAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.networks.base import EvolvableNetwork
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr=RLParameter(min=1e-4, max=1e-2, dtype=float),
+        batch_size=RLParameter(min=8, max=512, dtype=int),
+        learn_step=RLParameter(min=1, max=16, dtype=int),
+    )
+
+
+class NeuralUCB(RLAlgorithm):
+    def __init__(
+        self,
+        observation_space,
+        action_space,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        net_config: Optional[Dict[str, Any]] = None,
+        gamma: float = 1.0,
+        lamb: float = 1.0,
+        reg: float = 0.000625,
+        batch_size: int = 64,
+        lr: float = 1e-3,
+        learn_step: int = 2,
+        **kwargs,
+    ):
+        super().__init__(
+            observation_space, action_space, index=index,
+            hp_config=hp_config or default_hp_config(), **kwargs,
+        )
+        self.gamma = float(gamma)
+        self.lamb = float(lamb)
+        self.reg = float(reg)
+        self.batch_size = int(batch_size)
+        self.lr = float(lr)
+        self.learn_step = int(learn_step)
+        self.net_config = dict(net_config or {})
+
+        self.actor = EvolvableNetwork(
+            observation_space, num_outputs=1, key=self.next_key(), **self.net_config
+        )
+        self.optimizer = OptimizerWrapper(optimizer="adam", lr=self.lr)
+        self.register_network_group(NetworkGroup(eval="actor", policy=True))
+        self.register_optimizer(
+            OptimizerConfig(name="optimizer", networks=["actor"], lr="lr")
+        )
+        self.finalize_registry()
+        self._reinit_bandit_grads()
+        self.register_mutation_hook("_reinit_bandit_grads")
+
+    def _reinit_bandit_grads(self) -> None:
+        """Reset the diagonal design matrix U and the anchor params theta_0
+        (parity: hpo/mutation.py:1064 after any architecture change)."""
+        self.theta_0 = jax.tree_util.tree_map(jnp.copy, self.actor.params)
+        self.U = jax.tree_util.tree_map(
+            lambda p: jnp.full_like(p, self.lamb), self.actor.params
+        )
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "observation_space": self.observation_space,
+            "action_space": self.action_space,
+            "index": self.index,
+            "net_config": self.net_config,
+            "gamma": self.gamma,
+            "lamb": self.lamb,
+            "reg": self.reg,
+            "batch_size": self.batch_size,
+            "lr": self.lr,
+            "learn_step": self.learn_step,
+        }
+
+    def _on_clone(self, parent) -> None:
+        self.theta_0 = jax.tree_util.tree_map(jnp.copy, parent.theta_0)
+        self.U = jax.tree_util.tree_map(jnp.copy, parent.U)
+
+    # ------------------------------------------------------------------ #
+    def _score_fn(self):
+        config = self.actor.config
+        lamb = self.lamb
+
+        def f(params, x):
+            return EvolvableNetwork.apply(config, params, x[None])[0, 0]
+
+        @jax.jit
+        def score(params, U, context, nu):
+            # context: [num_arms, dim]
+            values = jax.vmap(lambda x: f(params, x))(context)  # [arms]
+            grads = jax.vmap(lambda x: jax.grad(f)(params, x))(context)
+            width = jax.vmap(
+                lambda g: jnp.sqrt(
+                    lamb * nu * sum(
+                        jnp.sum(gl * gl / ul)
+                        for gl, ul in zip(
+                            jax.tree_util.tree_leaves(g), jax.tree_util.tree_leaves(U)
+                        )
+                    )
+                ),
+                in_axes=0,
+            )(grads)
+            scores = values + width
+            arm = jnp.argmax(scores)
+            # update U with the chosen arm's squared gradient
+            chosen_g = jax.tree_util.tree_map(lambda g: g[arm], grads)
+            new_U = jax.tree_util.tree_map(lambda u, g: u + g * g, U, chosen_g)
+            return arm, new_U
+
+        return score
+
+    def get_action(self, context: Any, training: bool = True, **kw) -> np.ndarray:
+        """context: [num_arms, context_dim] features; returns chosen arm."""
+        context = self.preprocess_observation(np.asarray(context))
+        score = self.jit_fn("score", self._score_fn)
+        nu = jnp.float32(self.gamma if training else 0.0)
+        arm, new_U = score(self.actor.params, self.U, context, nu)
+        if training:
+            self.U = new_U
+        return np.asarray(arm)
+
+    # ------------------------------------------------------------------ #
+    def _train_fn(self):
+        config = self.actor.config
+        tx = self.optimizer.tx
+        reg = self.reg
+
+        @jax.jit
+        def train_step(params, theta_0, opt_state, batch):
+            obs = batch["obs"]
+            reward = batch["reward"].astype(jnp.float32)
+
+            def loss_fn(p):
+                pred = EvolvableNetwork.apply(config, p, obs)[..., 0]
+                mse = jnp.mean(jnp.square(pred - reward))
+                l2 = sum(
+                    jnp.sum(jnp.square(a - b))
+                    for a, b in zip(
+                        jax.tree_util.tree_leaves(p), jax.tree_util.tree_leaves(theta_0)
+                    )
+                )
+                return mse + reg * l2
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return train_step
+
+    def learn(self, experiences: Dict[str, jax.Array]) -> float:
+        batch = dict(experiences)
+        batch["obs"] = self.preprocess_observation(batch["obs"])
+        train_step = self.jit_fn("train", self._train_fn)
+        params, opt_state, loss = train_step(
+            self.actor.params, self.theta_0, self.optimizer.opt_state, batch
+        )
+        self.actor.params = params
+        self.optimizer.opt_state = opt_state
+        return float(loss)
+
+    def test(self, env, swap_channels=False, max_steps: Optional[int] = 100, loop: int = 1):
+        """Evaluate mean regret-free reward over bandit steps (parity: bandit test)."""
+        rewards = []
+        for _ in range(loop):
+            context = env.reset()
+            total = 0.0
+            for _ in range(max_steps or 100):
+                arm = self.get_action(context, training=False)
+                context, reward = env.step(arm)
+                total += float(np.asarray(reward).squeeze())
+            rewards.append(total / (max_steps or 100))
+        fitness = float(np.mean(rewards))
+        self.fitness.append(fitness)
+        return fitness

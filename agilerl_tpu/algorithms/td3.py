@@ -1,0 +1,147 @@
+"""TD3 (parity: agilerl/algorithms/td3.py — twin centralized critics, delayed
+policy updates, target-policy smoothing in learn:462).
+
+Structure mirrors DDPG but with clipped double-Q targets and smoothing noise
+inside the jitted critic step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+)
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.ddpg import DDPG, default_hp_config
+from agilerl_tpu.networks.actors import DeterministicActor
+from agilerl_tpu.networks.q_networks import ContinuousQNetwork
+
+
+class TD3(DDPG):
+    def __init__(
+        self,
+        observation_space,
+        action_space,
+        policy_noise: float = 0.2,
+        noise_clip: float = 0.5,
+        **kwargs,
+    ):
+        self.policy_noise = float(policy_noise)
+        self.noise_clip = float(noise_clip)
+        super().__init__(observation_space, action_space, **kwargs)
+        # add the twin critic on top of DDPG's single critic
+        self.critic_2 = ContinuousQNetwork(
+            observation_space, action_space, key=self.next_key(), **self.net_config
+        )
+        self.critic_2_target = self.critic_2.clone()
+        self.critic_2_optimizer = OptimizerWrapper(optimizer="adam", lr=self.lr_critic)
+        self.register_network_group(
+            NetworkGroup(eval="critic_2", shared="critic_2_target")
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="critic_2_optimizer", networks=["critic_2"], lr="lr_critic")
+        )
+        self.critic_2_optimizer.init(self.critic_2.params)
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        d = super().init_dict
+        d["policy_noise"] = self.policy_noise
+        d["noise_clip"] = self.noise_clip
+        return d
+
+    # ------------------------------------------------------------------ #
+    def _twin_critic_fn(self):
+        a_cfg = self.actor.config
+        c1_cfg = self.critic.config
+        c2_cfg = self.critic_2.config
+        low, high = self.actor.action_low, self.actor.action_high
+        tx1 = self.critic_optimizer.tx
+        tx2 = self.critic_2_optimizer.tx
+        policy_noise, noise_clip = self.policy_noise, self.noise_clip
+
+        @jax.jit
+        def critic_step(
+            c1, c1t, c2, c2t, at_params, opt1, opt2, batch, gamma, tau, key
+        ):
+            obs = batch["obs"]
+            action = batch["action"].astype(jnp.float32)
+            reward = batch["reward"].astype(jnp.float32)
+            done = batch["done"].astype(jnp.float32)
+            next_obs = batch["next_obs"]
+
+            next_action = DeterministicActor.rescale(
+                DeterministicActor.apply(a_cfg, at_params, next_obs), low, high
+            )
+            # target-policy smoothing (parity: learn:462)
+            noise = jnp.clip(
+                policy_noise * jax.random.normal(key, next_action.shape),
+                -noise_clip, noise_clip,
+            )
+            next_action = jnp.clip(next_action + noise, low, high)
+            q1_next = ContinuousQNetwork.apply(c1_cfg, c1t, next_obs, action=next_action)
+            q2_next = ContinuousQNetwork.apply(c2_cfg, c2t, next_obs, action=next_action)
+            q_next = jnp.minimum(q1_next, q2_next)
+            target = jax.lax.stop_gradient(reward + gamma * (1.0 - done) * q_next)
+
+            def loss1(p):
+                return jnp.mean(jnp.square(
+                    ContinuousQNetwork.apply(c1_cfg, p, obs, action=action) - target
+                ))
+
+            def loss2(p):
+                return jnp.mean(jnp.square(
+                    ContinuousQNetwork.apply(c2_cfg, p, obs, action=action) - target
+                ))
+
+            l1, g1 = jax.value_and_grad(loss1)(c1)
+            l2, g2 = jax.value_and_grad(loss2)(c2)
+            u1, opt1 = tx1.update(g1, opt1, c1)
+            c1 = optax.apply_updates(c1, u1)
+            u2, opt2 = tx2.update(g2, opt2, c2)
+            c2 = optax.apply_updates(c2, u2)
+            c1t = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c1t, c1)
+            c2t = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c2t, c2)
+            return c1, c1t, c2, c2t, opt1, opt2, l1 + l2
+
+        return critic_step
+
+    def learn(self, experiences: Dict[str, jax.Array]) -> float:
+        batch = dict(experiences)
+        batch["obs"] = self.preprocess_observation(batch["obs"])
+        batch["next_obs"] = self.preprocess_observation(batch["next_obs"])
+
+        critic_step = self.jit_fn("twin_critic", self._twin_critic_fn)
+        (c1, c1t, c2, c2t, opt1, opt2, closs) = critic_step(
+            self.critic.params, self.critic_target.params,
+            self.critic_2.params, self.critic_2_target.params,
+            self.actor_target.params,
+            self.critic_optimizer.opt_state, self.critic_2_optimizer.opt_state,
+            batch, jnp.float32(self.gamma), jnp.float32(self.tau), self.next_key(),
+        )
+        self.critic.params = c1
+        self.critic_target.params = c1t
+        self.critic_2.params = c2
+        self.critic_2_target.params = c2t
+        self.critic_optimizer.opt_state = opt1
+        self.critic_2_optimizer.opt_state = opt2
+
+        self._learn_counter += 1
+        if self._learn_counter % self.policy_freq == 0:
+            actor_step = self.jit_fn("actor", self._actor_fn)
+            aparams, at_params, a_opt, _ = actor_step(
+                self.actor.params, self.actor_target.params, self.critic.params,
+                self.actor_optimizer.opt_state, batch, jnp.float32(self.tau),
+            )
+            self.actor.params = aparams
+            self.actor_target.params = at_params
+            self.actor_optimizer.opt_state = a_opt
+        return float(closs)

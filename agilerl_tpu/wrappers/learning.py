@@ -1,0 +1,71 @@
+"""Learning wrappers (parity: agilerl/wrappers/learning.py — Skill:9 curriculum
+wrapper, BanditEnv:40 labelled-dataset -> contextual bandit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class BanditEnv:
+    """Turn a labelled dataset into a contextual bandit (parity: learning.py:40).
+
+    Each step presents one sample encoded as arm-wise contexts via the
+    disjoint-model trick: context for arm a is the feature vector placed in the
+    a-th block of a (num_arms * dim) vector. Reward 1 for the correct label."""
+
+    def __init__(self, features: np.ndarray, targets: np.ndarray):
+        self.features = np.asarray(features, np.float32)
+        self.targets = np.asarray(targets).astype(np.int64)
+        if self.features.ndim > 2:
+            self.features = self.features.reshape(len(self.features), -1)
+        self.num_samples, self.dim = self.features.shape
+        self.arms = int(self.targets.max()) + 1
+        self.context_dim = self.arms * self.dim
+        self._rng = np.random.default_rng(0)
+        self._idx = 0
+
+    def _context(self, i: int) -> np.ndarray:
+        x = self.features[i]
+        ctx = np.zeros((self.arms, self.context_dim), np.float32)
+        for a in range(self.arms):
+            ctx[a, a * self.dim : (a + 1) * self.dim] = x
+        return ctx
+
+    def reset(self) -> np.ndarray:
+        self._idx = int(self._rng.integers(0, self.num_samples))
+        return self._context(self._idx)
+
+    def step(self, action) -> Tuple[np.ndarray, np.ndarray]:
+        reward = np.float32(1.0 if int(action) == int(self.targets[self._idx]) else 0.0)
+        self._idx = int(self._rng.integers(0, self.num_samples))
+        return self._context(self._idx), reward
+
+
+class Skill:
+    """Curriculum skill wrapper (parity: learning.py:9): overrides the reward
+    with a skill-specific shaping while delegating everything else."""
+
+    def __init__(self, env):
+        self.env = env
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+
+    def reset(self, **kwargs):
+        return self.env.reset(**kwargs)
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        obs, reward, terminated, truncated, info = self.skill_reward(
+            obs, reward, terminated, truncated, info
+        )
+        return obs, reward, terminated, truncated, info
+
+    def skill_reward(self, obs, reward, terminated, truncated, info):
+        """Override in subclasses to shape rewards for this skill."""
+        return obs, reward, terminated, truncated, info
+
+    def __getattr__(self, item):
+        return getattr(self.env, item)
