@@ -2,9 +2,15 @@ from agilerl_tpu.algorithms.cqn import CQN
 from agilerl_tpu.algorithms.ddpg import DDPG
 from agilerl_tpu.algorithms.dqn import DQN
 from agilerl_tpu.algorithms.dqn_rainbow import RainbowDQN
+from agilerl_tpu.algorithms.ippo import IPPO
+from agilerl_tpu.algorithms.maddpg import MADDPG
+from agilerl_tpu.algorithms.matd3 import MATD3
 from agilerl_tpu.algorithms.neural_ts_bandit import NeuralTS
 from agilerl_tpu.algorithms.neural_ucb_bandit import NeuralUCB
 from agilerl_tpu.algorithms.ppo import PPO
 from agilerl_tpu.algorithms.td3 import TD3
 
-__all__ = ["DQN", "RainbowDQN", "CQN", "DDPG", "TD3", "PPO", "NeuralUCB", "NeuralTS"]
+__all__ = [
+    "DQN", "RainbowDQN", "CQN", "DDPG", "TD3", "PPO",
+    "MADDPG", "MATD3", "IPPO", "NeuralUCB", "NeuralTS",
+]

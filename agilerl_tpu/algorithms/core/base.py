@@ -84,8 +84,8 @@ class EvolvableAlgorithm:
     def _optimizer_params(self, cfg: OptimizerConfig) -> Any:
         nets = {n: getattr(self, n) for n in cfg.networks}
         if len(nets) == 1:
-            return next(iter(nets.values())).params
-        return {n: net.params for n, net in nets.items()}
+            return _params_of(next(iter(nets.values())))
+        return {n: _params_of(net) for n, net in nets.items()}
 
     # -- reflection ------------------------------------------------------ #
     def evolvable_attributes(self) -> Dict[str, Any]:
@@ -129,11 +129,13 @@ class EvolvableAlgorithm:
         """Deep-copy-free clone: rebuild from init_dict, then copy configs,
         params, optimizer states and training attrs (parity: base.py:855)."""
         clone = type(self)(**self.init_dict)
-        # networks: copy mutated configs + weights
+        # networks: copy mutated configs + weights (handles dict-of-nets for
+        # multi-agent ModuleDict-equivalents)
         for name, net in self.evolvable_attributes().items():
             cnet = getattr(clone, name)
-            cnet.config = net.config
-            cnet.params = jax.tree_util.tree_map(jnp.copy, net.params)
+            for sub, csub in _net_pairs(net, cnet):
+                csub.config = sub.config
+                csub.params = jax.tree_util.tree_map(jnp.copy, sub.params)
         # optimizers
         for cfg in self.registry.optimizer_configs:
             mine: OptimizerWrapper = getattr(self, cfg.name)
@@ -157,10 +159,12 @@ class EvolvableAlgorithm:
 
     # -- checkpointing ---------------------------------------------------- #
     def checkpoint_dict(self) -> Dict[str, Any]:
-        nets = {
-            name: {"config": net.config, "params": jax.device_get(net.params)}
-            for name, net in self.evolvable_attributes().items()
-        }
+        def blob(net):
+            if isinstance(net, dict):
+                return {k: blob(v) for k, v in net.items()}
+            return {"config": net.config, "params": jax.device_get(net.params)}
+
+        nets = {name: blob(net) for name, net in self.evolvable_attributes().items()}
         opts = {
             cfg.name: {
                 "lr": getattr(self, cfg.name).lr,
@@ -197,10 +201,16 @@ class EvolvableAlgorithm:
         self._restore(ckpt)
 
     def _restore(self, ckpt: Dict[str, Any]) -> None:
-        for name, blob in ckpt["networks"].items():
-            net = getattr(self, name)
+        def load(net, blob):
+            if isinstance(net, dict):
+                for k in net:
+                    load(net[k], blob[k])
+                return
             net.config = blob["config"]
             net.params = jax.tree_util.tree_map(jnp.asarray, blob["params"])
+
+        for name, blob in ckpt["networks"].items():
+            load(getattr(self, name), blob)
         for cname, blob in ckpt["optimizers"].items():
             opt: OptimizerWrapper = getattr(self, cname)
             opt.lr = blob["lr"]
@@ -229,6 +239,112 @@ class EvolvableAlgorithm:
     def recompile(self) -> None:
         """Drop jit caches; XLA recompiles lazily (parity: base.py:761)."""
         self._clear_jit_cache()
+
+
+def _params_of(net) -> Any:
+    if isinstance(net, dict):
+        return {k: _params_of(v) for k, v in net.items()}
+    return net.params
+
+
+def _net_pairs(a, b):
+    """Yield matching (net, clone_net) leaf pairs across dict-of-nets."""
+    if isinstance(a, dict):
+        for k in a:
+            yield from _net_pairs(a[k], b[k])
+    else:
+        yield a, b
+
+
+class MultiAgentRLAlgorithm(EvolvableAlgorithm):
+    """Multi-agent RL base (parity: base.py:1304 — agent-id grouping by prefix
+    get_group_id:1767, homogeneous-group assertion :1416, MultiAgentSetup
+    classification get_setup:1482, shared-reward helpers :1776,1838)."""
+
+    def __init__(self, observation_spaces, action_spaces, agent_ids=None, **kwargs):
+        super().__init__(**kwargs)
+        if agent_ids is None:
+            agent_ids = list(observation_spaces.keys())
+        self.agent_ids = list(agent_ids)
+        self.n_agents = len(self.agent_ids)
+        self.observation_spaces = dict(observation_spaces)
+        self.action_spaces = dict(action_spaces)
+        self.grouped_agents = self._group_agents()
+
+    @staticmethod
+    def get_group_id(agent_id: str) -> str:
+        """speaker_0 -> speaker (parity: base.py:1767)."""
+        parts = str(agent_id).rsplit("_", 1)
+        if len(parts) == 2 and parts[1].isdigit():
+            return parts[0]
+        return str(agent_id)
+
+    def _group_agents(self) -> Dict[str, List[str]]:
+        groups: Dict[str, List[str]] = {}
+        for aid in self.agent_ids:
+            groups.setdefault(self.get_group_id(aid), []).append(aid)
+        # homogeneity check within groups (parity: base.py:1416)
+        for gid, members in groups.items():
+            spaces_ = {str(self.observation_spaces[m]) for m in members}
+            act_ = {str(self.action_spaces[m]) for m in members}
+            assert len(spaces_) == 1 and len(act_) == 1, (
+                f"Agents in group {gid!r} must share observation/action spaces"
+            )
+        return groups
+
+    def preprocess_observation(self, obs: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            aid: preprocess_observation(self.observation_spaces[aid], obs[aid])
+            for aid in self.agent_ids
+        }
+
+    def sum_shared_rewards(self, rewards: Dict[str, Any]) -> Dict[str, Any]:
+        """Sum rewards across agents for fully-shared-reward games
+        (parity: base.py:1838)."""
+        total = None
+        for v in rewards.values():
+            v = np.asarray(v, np.float64)
+            total = v if total is None else total + v
+        return {aid: total for aid in self.agent_ids}
+
+    def test(
+        self,
+        env,
+        swap_channels: bool = False,
+        max_steps: Optional[int] = None,
+        loop: int = 3,
+        sum_scores: bool = True,
+    ) -> float:
+        """Evaluate over parallel-env episodes; fitness = summed agent scores."""
+        rewards = []
+        num_envs = getattr(env, "num_envs", 1)
+        for _ in range(loop):
+            obs, _ = env.reset()
+            done = np.zeros(num_envs, dtype=bool)
+            total = np.zeros(num_envs, dtype=np.float64)
+            steps = 0
+            while not done.all():
+                action = self.get_action(obs, training=False)
+                obs, reward, terminated, truncated, _ = env.step(action)
+                agg = np.zeros(num_envs, dtype=np.float64)
+                for aid in self.agent_ids:
+                    agg += np.asarray(reward[aid], np.float64)
+                if not sum_scores:
+                    agg /= self.n_agents
+                total += agg * (~done)
+                step_done = np.zeros(num_envs, dtype=bool)
+                for aid in self.agent_ids:
+                    step_done |= np.logical_or(
+                        np.asarray(terminated[aid], bool), np.asarray(truncated[aid], bool)
+                    )
+                done = np.logical_or(done, step_done)
+                steps += 1
+                if max_steps is not None and steps >= max_steps:
+                    break
+            rewards.append(np.mean(total))
+        fitness = float(np.mean(rewards))
+        self.fitness.append(fitness)
+        return fitness
 
 
 class RLAlgorithm(EvolvableAlgorithm):

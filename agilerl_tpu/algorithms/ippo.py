@@ -1,0 +1,293 @@
+"""IPPO — independent PPO with per-GROUP shared networks (parity:
+agilerl/algorithms/ippo.py — homogeneous agents share one actor/critic per
+group; grouped rollout learn _learn_individual:687).
+
+TPU-first: each group's minibatch update is one jitted function; experiences
+from all agents of a group are stacked into one batch so homogeneous agents
+train as extra batch rows (free MXU utilisation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.base import MultiAgentRLAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.components.rollout_buffer import RolloutBuffer
+from agilerl_tpu.networks import distributions as D
+from agilerl_tpu.networks.actors import StochasticActor
+from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.networks.value_networks import ValueNetwork
+from agilerl_tpu.utils.spaces import preprocess_observation
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr=RLParameter(min=1e-5, max=1e-2, dtype=float),
+        batch_size=RLParameter(min=32, max=1024, dtype=int),
+        learn_step=RLParameter(min=64, max=4096, dtype=int),
+    )
+
+
+class IPPO(MultiAgentRLAlgorithm):
+    supports_activation_mutation = False
+
+    def __init__(
+        self,
+        observation_spaces,
+        action_spaces,
+        agent_ids: Optional[List[str]] = None,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        net_config: Optional[Dict[str, Any]] = None,
+        batch_size: int = 64,
+        lr: float = 3e-4,
+        learn_step: int = 128,
+        gamma: float = 0.99,
+        gae_lambda: float = 0.95,
+        clip_coef: float = 0.2,
+        ent_coef: float = 0.01,
+        vf_coef: float = 0.5,
+        max_grad_norm: float = 0.5,
+        update_epochs: int = 4,
+        num_envs: int = 1,
+        **kwargs,
+    ):
+        super().__init__(
+            observation_spaces, action_spaces, agent_ids=agent_ids, index=index,
+            hp_config=hp_config or default_hp_config(), **kwargs,
+        )
+        self.batch_size = int(batch_size)
+        self.lr = float(lr)
+        self.learn_step = int(learn_step)
+        self.gamma = float(gamma)
+        self.gae_lambda = float(gae_lambda)
+        self.clip_coef = float(clip_coef)
+        self.ent_coef = float(ent_coef)
+        self.vf_coef = float(vf_coef)
+        self.max_grad_norm = float(max_grad_norm)
+        self.update_epochs = int(update_epochs)
+        self.num_envs = int(num_envs)
+        self.net_config = dict(net_config or {})
+
+        # one actor/critic per GROUP (homogeneous agents share; parity: ippo.py)
+        self.actors: Dict[str, StochasticActor] = {}
+        self.critics: Dict[str, ValueNetwork] = {}
+        self.rollout_buffers: Dict[str, RolloutBuffer] = {}
+        for gid, members in self.grouped_agents.items():
+            rep = members[0]
+            self.actors[gid] = StochasticActor(
+                self.observation_spaces[rep], self.action_spaces[rep],
+                key=self.next_key(), **self.net_config,
+            )
+            self.critics[gid] = ValueNetwork(
+                self.observation_spaces[rep], key=self.next_key(), **self.net_config
+            )
+            # one buffer per agent-slot: stacked as extra env rows
+            self.rollout_buffers[gid] = RolloutBuffer(
+                capacity=self.learn_step,
+                num_envs=self.num_envs * len(members),
+                gamma=self.gamma,
+                gae_lambda=self.gae_lambda,
+            )
+
+        self.optimizer = OptimizerWrapper(
+            optimizer="adam", lr=self.lr, max_grad_norm=self.max_grad_norm
+        )
+        self.register_network_group(NetworkGroup(eval="actors", policy=True, multiagent=True))
+        self.register_network_group(NetworkGroup(eval="critics", multiagent=True))
+        self.register_optimizer(
+            OptimizerConfig(name="optimizer", networks=["actors", "critics"], lr="lr")
+        )
+        self.finalize_registry()
+        self._last_obs = None
+        self._last_done = None
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "observation_spaces": self.observation_spaces,
+            "action_spaces": self.action_spaces,
+            "agent_ids": self.agent_ids,
+            "index": self.index,
+            "net_config": self.net_config,
+            "batch_size": self.batch_size,
+            "lr": self.lr,
+            "learn_step": self.learn_step,
+            "gamma": self.gamma,
+            "gae_lambda": self.gae_lambda,
+            "clip_coef": self.clip_coef,
+            "ent_coef": self.ent_coef,
+            "vf_coef": self.vf_coef,
+            "update_epochs": self.update_epochs,
+            "num_envs": self.num_envs,
+        }
+
+    def evolvable_attributes(self) -> Dict[str, Any]:
+        return {"actors": self.actors, "critics": self.critics}
+
+    # ------------------------------------------------------------------ #
+    def _group_of(self, aid: str) -> str:
+        return self.get_group_id(aid)
+
+    def _act_fn(self):
+        groups = {g: ms for g, ms in self.grouped_agents.items()}
+        actor_cfgs = {g: self.actors[g].config for g in groups}
+        critic_cfgs = {g: self.critics[g].config for g in groups}
+        dist_cfgs = {g: self.actors[g].dist_config for g in groups}
+        obs_spaces = self.observation_spaces
+
+        @jax.jit
+        def act(actor_params, critic_params, obs, key):
+            actions, logps, values = {}, {}, {}
+            i = 0
+            for gid, members in groups.items():
+                for aid in members:
+                    o = preprocess_observation(obs_spaces[aid], obs[aid])
+                    logits = EvolvableNetwork.apply(actor_cfgs[gid], actor_params[gid], o)
+                    dist_extra = actor_params[gid].get("dist")
+                    k = jax.random.fold_in(key, i)
+                    a = D.sample(dist_cfgs[gid], logits, k, dist_extra)
+                    actions[aid] = a
+                    logps[aid] = D.log_prob(dist_cfgs[gid], logits, a, dist_extra)
+                    values[aid] = EvolvableNetwork.apply(
+                        critic_cfgs[gid], critic_params[gid], o
+                    )[..., 0]
+                    i += 1
+            return actions, logps, values
+
+        return act
+
+    def get_action(self, obs: Dict[str, Any], training: bool = True, **kw):
+        first = np.asarray(obs[self.agent_ids[0]])
+        own_space = self.observation_spaces[self.agent_ids[0]]
+        base_ndim = len(own_space.shape) if own_space.shape else 0
+        single = first.ndim == base_ndim
+        if single:
+            obs = {a: np.asarray(o)[None] for a, o in obs.items()}
+        act = self.jit_fn("act", self._act_fn)
+        actor_params = {g: self.actors[g].params for g in self.actors}
+        critic_params = {g: self.critics[g].params for g in self.critics}
+        actions, logps, values = act(actor_params, critic_params, obs, self.next_key())
+        self._cached_logps = {a: np.asarray(v) for a, v in logps.items()}
+        self._cached_values = {a: np.asarray(v) for a, v in values.items()}
+        out = {a: np.asarray(v) for a, v in actions.items()}
+        if single:
+            out = {a: v[0] for a, v in out.items()}
+        return out
+
+    # ------------------------------------------------------------------ #
+    def collect_rollouts(self, env, n_steps: Optional[int] = None) -> float:
+        """Step the parallel env, stacking each group's agents as extra env
+        rows in that group's rollout buffer."""
+        n_steps = n_steps or self.learn_step
+        if self._last_obs is None:
+            obs, _ = env.reset()
+            self._last_obs = obs
+        obs = self._last_obs
+        total_r = 0.0
+        for _ in range(n_steps):
+            actions = self.get_action(obs)
+            next_obs, rew, term, trunc, _ = env.step(actions)
+            for gid, members in self.grouped_agents.items():
+                g_obs = np.concatenate([np.asarray(obs[a]) for a in members], axis=0)
+                g_act = np.concatenate([np.asarray(actions[a]) for a in members], axis=0)
+                g_rew = np.concatenate([np.asarray(rew[a], np.float32) for a in members], axis=0)
+                g_done = np.concatenate(
+                    [np.logical_or(term[a], trunc[a]).astype(np.float32) for a in members],
+                    axis=0,
+                )
+                g_logp = np.concatenate([self._cached_logps[a] for a in members], axis=0)
+                g_val = np.concatenate([self._cached_values[a] for a in members], axis=0)
+                self.rollout_buffers[gid].add(
+                    obs=g_obs, action=g_act, reward=g_rew, done=g_done,
+                    value=g_val, log_prob=g_logp,
+                )
+            total_r += float(np.mean([np.mean(np.asarray(rew[a])) for a in self.agent_ids]))
+            obs = next_obs
+        self._last_obs = obs
+        self._last_done = {
+            a: np.logical_or(term[a], trunc[a]).astype(np.float32) for a in self.agent_ids
+        }
+        return total_r / n_steps
+
+    def _update_fn_for(self, gid: str):
+        actor_cfg = self.actors[gid].config
+        critic_cfg = self.critics[gid].config
+        dist_cfg = self.actors[gid].dist_config
+        space = self.observation_spaces[self.grouped_agents[gid][0]]
+        tx = self.optimizer.tx
+
+        @jax.jit
+        def update(params, opt_state, batch, clip, ent_coef, vf_coef):
+            def loss_fn(p):
+                obs = preprocess_observation(space, batch["obs"])
+                logits = EvolvableNetwork.apply(actor_cfg, p["actors"][gid], obs)
+                dist_extra = p["actors"][gid].get("dist")
+                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra)
+                entropy = D.entropy(dist_cfg, logits, dist_extra).mean()
+                value = EvolvableNetwork.apply(critic_cfg, p["critics"][gid], obs)[..., 0]
+                adv = batch["advantages"]
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                ratio = jnp.exp(new_logp - batch["log_prob"])
+                pg = jnp.maximum(
+                    -adv * ratio, -adv * jnp.clip(ratio, 1 - clip, 1 + clip)
+                ).mean()
+                v_loss = 0.5 * jnp.square(value - batch["returns"]).mean()
+                return pg - ent_coef * entropy + vf_coef * v_loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return update
+
+    def learn(self, experiences=None) -> float:
+        params = {
+            "actors": {g: self.actors[g].params for g in self.actors},
+            "critics": {g: self.critics[g].params for g in self.critics},
+        }
+        opt_state = self.optimizer.opt_state
+        total, n = 0.0, 0
+        for gid, members in self.grouped_agents.items():
+            buf = self.rollout_buffers[gid]
+            if buf.state is None:
+                continue
+            last_obs = np.concatenate(
+                [np.asarray(self._last_obs[a]) for a in members], axis=0
+            )
+            last_done = np.concatenate([self._last_done[a] for a in members], axis=0)
+            o = preprocess_observation(self.observation_spaces[members[0]], last_obs)
+            last_value = EvolvableNetwork.apply(
+                self.critics[gid].config, self.critics[gid].params, o
+            )[..., 0]
+            buf.compute_returns_and_advantages(last_value, jnp.asarray(last_done))
+            update = self.jit_fn(f"update_{gid}", lambda gid=gid: self._update_fn_for(gid))
+            for _ in range(self.update_epochs):
+                for idx in buf.minibatch_indices(self.batch_size, key=self.next_key()):
+                    batch = buf.get_batch(idx)
+                    params, opt_state, loss = update(
+                        params, opt_state, batch,
+                        jnp.float32(self.clip_coef), jnp.float32(self.ent_coef),
+                        jnp.float32(self.vf_coef),
+                    )
+                    total += float(loss)
+                    n += 1
+            buf.reset()
+        for g in self.actors:
+            self.actors[g].params = params["actors"][g]
+            self.critics[g].params = params["critics"][g]
+        self.optimizer.opt_state = opt_state
+        return total / max(n, 1)

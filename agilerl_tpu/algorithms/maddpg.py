@@ -1,0 +1,330 @@
+"""MADDPG (parity: agilerl/algorithms/maddpg.py — per-agent actors + centralized
+critics over all obs+actions, Gumbel-softmax for discrete actions, per-agent
+learn loop learn:571/_learn_individual:630, OU exploration; sub-agent
+architecture-mutation sync handled by the HPO engine, hpo/mutation.py:887).
+
+TPU-first: ALL agents' critic and actor updates are fused into ONE jitted
+function (a static python loop over agent ids inside the trace), so a learn call
+is a single XLA program regardless of agent count.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms.core.base import MultiAgentRLAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.utils.spaces import action_dim, obs_dim, preprocess_observation
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr_actor=RLParameter(min=1e-5, max=1e-2, dtype=float),
+        lr_critic=RLParameter(min=1e-5, max=1e-2, dtype=float),
+        batch_size=RLParameter(min=8, max=512, dtype=int),
+        learn_step=RLParameter(min=1, max=16, dtype=int),
+    )
+
+
+def gumbel_softmax(logits: jax.Array, key: jax.Array, tau: float = 1.0, hard: bool = True):
+    """Gumbel-softmax sampling (parity: modules/custom_components.py:10)."""
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, logits.shape, minval=1e-10) + 1e-10))
+    y = jax.nn.softmax((logits + g) / tau, axis=-1)
+    if hard:
+        y_hard = jax.nn.one_hot(jnp.argmax(y, axis=-1), logits.shape[-1])
+        y = y_hard + y - jax.lax.stop_gradient(y)
+    return y
+
+
+class MADDPG(MultiAgentRLAlgorithm):
+    supports_activation_mutation = False
+
+    def __init__(
+        self,
+        observation_spaces,
+        action_spaces,
+        agent_ids: Optional[List[str]] = None,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        net_config: Optional[Dict[str, Any]] = None,
+        batch_size: int = 64,
+        lr_actor: float = 1e-4,
+        lr_critic: float = 1e-3,
+        learn_step: int = 5,
+        gamma: float = 0.95,
+        tau: float = 1e-2,
+        expl_noise: float = 0.1,
+        **kwargs,
+    ):
+        super().__init__(
+            observation_spaces, action_spaces, agent_ids=agent_ids, index=index,
+            hp_config=hp_config or default_hp_config(), **kwargs,
+        )
+        self.batch_size = int(batch_size)
+        self.lr_actor = float(lr_actor)
+        self.lr_critic = float(lr_critic)
+        self.learn_step = int(learn_step)
+        self.gamma = float(gamma)
+        self.tau = float(tau)
+        self.expl_noise = float(expl_noise)
+        self.net_config = dict(net_config or {})
+
+        self.discrete = {
+            aid: isinstance(self.action_spaces[aid], spaces.Discrete)
+            for aid in self.agent_ids
+        }
+        self.action_dims = {aid: action_dim(self.action_spaces[aid]) for aid in self.agent_ids}
+        total_obs = sum(obs_dim(self.observation_spaces[a]) for a in self.agent_ids)
+        total_act = sum(self.action_dims.values())
+        critic_space = spaces.Box(-np.inf, np.inf, (total_obs + total_act,), np.float32)
+
+        self.actors: Dict[str, EvolvableNetwork] = {}
+        self.critics: Dict[str, EvolvableNetwork] = {}
+        for aid in self.agent_ids:
+            head_cfg = dict(self.net_config.get("head_config", {}))
+            if not self.discrete[aid]:
+                head_cfg["output_activation"] = "Tanh"
+            actor_kwargs = {**self.net_config, "head_config": head_cfg}
+            self.actors[aid] = EvolvableNetwork(
+                self.observation_spaces[aid], num_outputs=self.action_dims[aid],
+                key=self.next_key(), **actor_kwargs,
+            )
+            self.critics[aid] = EvolvableNetwork(
+                critic_space, num_outputs=1, key=self.next_key(), **self.net_config
+            )
+        self.actor_targets = {aid: self.actors[aid].clone() for aid in self.agent_ids}
+        self.critic_targets = {aid: self.critics[aid].clone() for aid in self.agent_ids}
+
+        self.actor_optimizers = OptimizerWrapper(optimizer="adam", lr=self.lr_actor)
+        self.critic_optimizers = OptimizerWrapper(optimizer="adam", lr=self.lr_critic)
+        self.register_network_group(
+            NetworkGroup(eval="actors", shared="actor_targets", policy=True, multiagent=True)
+        )
+        self.register_network_group(
+            NetworkGroup(eval="critics", shared="critic_targets", multiagent=True)
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="actor_optimizers", networks=["actors"], lr="lr_actor")
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="critic_optimizers", networks=["critics"], lr="lr_critic")
+        )
+        self.finalize_registry()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "observation_spaces": self.observation_spaces,
+            "action_spaces": self.action_spaces,
+            "agent_ids": self.agent_ids,
+            "index": self.index,
+            "net_config": self.net_config,
+            "batch_size": self.batch_size,
+            "lr_actor": self.lr_actor,
+            "lr_critic": self.lr_critic,
+            "learn_step": self.learn_step,
+            "gamma": self.gamma,
+            "tau": self.tau,
+            "expl_noise": self.expl_noise,
+        }
+
+    def evolvable_attributes(self) -> Dict[str, Any]:
+        return {
+            "actors": self.actors,
+            "actor_targets": self.actor_targets,
+            "critics": self.critics,
+            "critic_targets": self.critic_targets,
+        }
+
+    # -- acting ---------------------------------------------------------- #
+    def _act_fn(self):
+        actor_cfgs = {aid: self.actors[aid].config for aid in self.agent_ids}
+        obs_spaces = self.observation_spaces
+        discrete = self.discrete
+        act_spaces = self.action_spaces
+        agent_ids = tuple(self.agent_ids)
+
+        @jax.jit
+        def act(actor_params, obs, key, noise_scale):
+            out = {}
+            for i, aid in enumerate(agent_ids):
+                o = preprocess_observation(obs_spaces[aid], obs[aid])
+                raw = EvolvableNetwork.apply(actor_cfgs[aid], actor_params[aid], o)
+                k = jax.random.fold_in(key, i)
+                if discrete[aid]:
+                    sampled = jnp.argmax(gumbel_softmax(raw, k), axis=-1)
+                    greedy = jnp.argmax(raw, axis=-1)
+                    out[aid] = jnp.where(noise_scale > 0, sampled, greedy)
+                else:
+                    low = jnp.asarray(act_spaces[aid].low, jnp.float32)
+                    high = jnp.asarray(act_spaces[aid].high, jnp.float32)
+                    a = low + (raw + 1.0) * 0.5 * (high - low)
+                    a = a + noise_scale * jax.random.normal(k, a.shape) * (high - low) * 0.5
+                    out[aid] = jnp.clip(a, low, high)
+            return out
+
+        return act
+
+    def get_action(self, obs: Dict[str, Any], training: bool = True, **kw) -> Dict[str, np.ndarray]:
+        first = np.asarray(obs[self.agent_ids[0]])
+        own_space = self.observation_spaces[self.agent_ids[0]]
+        base_ndim = len(own_space.shape) if hasattr(own_space, "shape") and own_space.shape else 0
+        single = first.ndim == base_ndim
+        if single:
+            obs = {a: np.asarray(o)[None] for a, o in obs.items()}
+        act = self.jit_fn("act", self._act_fn)
+        noise = jnp.float32(self.expl_noise if training else 0.0)
+        actor_params = {a: self.actors[a].params for a in self.agent_ids}
+        actions = act(actor_params, obs, self.next_key(), noise)
+        out = {a: np.asarray(v) for a, v in actions.items()}
+        if single:
+            out = {a: v[0] for a, v in out.items()}
+        return out
+
+    # -- learning --------------------------------------------------------- #
+    def _train_fn(self):
+        agent_ids = tuple(self.agent_ids)
+        actor_cfgs = {a: self.actors[a].config for a in agent_ids}
+        critic_cfgs = {a: self.critics[a].config for a in agent_ids}
+        obs_spaces = self.observation_spaces
+        act_spaces = self.action_spaces
+        discrete = self.discrete
+        action_dims = self.action_dims
+        a_tx = self.actor_optimizers.tx
+        c_tx = self.critic_optimizers.tx
+
+        def flat_obs(obs):
+            outs = []
+            for aid in agent_ids:
+                o = preprocess_observation(obs_spaces[aid], obs[aid])
+                outs.append(o.reshape(o.shape[0], -1))
+            return jnp.concatenate(outs, axis=-1)
+
+        def encode_action(aid, a):
+            if discrete[aid]:
+                return jax.nn.one_hot(a.astype(jnp.int32), action_dims[aid])
+            return a.astype(jnp.float32).reshape(a.shape[0], -1)
+
+        def actor_out(aid, params, obs, key=None, differentiable=False):
+            o = preprocess_observation(obs_spaces[aid], obs[aid])
+            raw = EvolvableNetwork.apply(actor_cfgs[aid], params, o)
+            if discrete[aid]:
+                if differentiable:
+                    return gumbel_softmax(raw, key)
+                return jax.nn.one_hot(jnp.argmax(raw, axis=-1), action_dims[aid])
+            low = jnp.asarray(act_spaces[aid].low, jnp.float32)
+            high = jnp.asarray(act_spaces[aid].high, jnp.float32)
+            return low + (raw + 1.0) * 0.5 * (high - low)
+
+        @jax.jit
+        def train_step(actors, actor_ts, critics, critic_ts, a_opt, c_opt, batch, gamma, tau, key):
+            obs, actions = batch["obs"], batch["action"]
+            rewards, dones, next_obs = batch["reward"], batch["done"], batch["next_obs"]
+
+            all_obs = flat_obs(obs)
+            all_next_obs = flat_obs(next_obs)
+            all_actions = jnp.concatenate(
+                [encode_action(a, actions[a]) for a in agent_ids], axis=-1
+            )
+            next_target_actions = jnp.concatenate(
+                [actor_out(a, actor_ts[a], next_obs) for a in agent_ids], axis=-1
+            )
+            critic_next_in = jnp.concatenate([all_next_obs, next_target_actions], axis=-1)
+            critic_in = jnp.concatenate([all_obs, all_actions], axis=-1)
+
+            losses = {}
+            # --- critic updates (per agent, single trace) ---------------- #
+            c_grads = {}
+            for aid in agent_ids:
+                q_next = EvolvableNetwork.apply(
+                    critic_cfgs[aid], critic_ts[aid], critic_next_in
+                )[..., 0]
+                r = rewards[aid].astype(jnp.float32)
+                d = dones[aid].astype(jnp.float32)
+                target = jax.lax.stop_gradient(r + gamma * (1.0 - d) * q_next)
+
+                def c_loss(p, target=target, aid=aid):
+                    q = EvolvableNetwork.apply(critic_cfgs[aid], p, critic_in)[..., 0]
+                    return jnp.mean(jnp.square(q - target))
+
+                loss, grads = jax.value_and_grad(c_loss)(critics[aid])
+                losses[f"critic_{aid}"] = loss
+                c_grads[aid] = grads
+
+            updates, c_opt = c_tx.update(c_grads, c_opt, critics)
+            critics = optax.apply_updates(critics, updates)
+
+            # --- actor updates ------------------------------------------- #
+            a_grads = {}
+            for i, aid in enumerate(agent_ids):
+                k = jax.random.fold_in(key, i)
+
+                def a_loss(p, aid=aid, k=k):
+                    my_action = actor_out(aid, p, obs, key=k, differentiable=True)
+                    parts = []
+                    for other in agent_ids:
+                        if other == aid:
+                            parts.append(my_action)
+                        else:
+                            parts.append(encode_action(other, actions[other]))
+                    joint = jnp.concatenate(parts, axis=-1)
+                    q_in = jnp.concatenate([all_obs, joint], axis=-1)
+                    q = EvolvableNetwork.apply(critic_cfgs[aid], critics[aid], q_in)[..., 0]
+                    return -jnp.mean(q)
+
+                loss, grads = jax.value_and_grad(a_loss)(actors[aid])
+                losses[f"actor_{aid}"] = loss
+                a_grads[aid] = grads
+
+            updates, a_opt = a_tx.update(a_grads, a_opt, actors)
+            actors = optax.apply_updates(actors, updates)
+
+            # --- soft target updates ------------------------------------- #
+            actor_ts = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, actor_ts, actors
+            )
+            critic_ts = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, critic_ts, critics
+            )
+            mean_loss = sum(
+                losses[f"critic_{a}"] for a in agent_ids
+            ) / len(agent_ids)
+            return actors, actor_ts, critics, critic_ts, a_opt, c_opt, mean_loss
+
+        return train_step
+
+    def learn(self, experiences: Dict[str, Dict[str, jax.Array]]) -> float:
+        """experiences: dict with obs/action/reward/next_obs/done, each a dict
+        keyed by agent id with [B, ...] leaves (parity: learn:571)."""
+        train_step = self.jit_fn("train", self._train_fn)
+        actors = {a: self.actors[a].params for a in self.agent_ids}
+        actor_ts = {a: self.actor_targets[a].params for a in self.agent_ids}
+        critics = {a: self.critics[a].params for a in self.agent_ids}
+        critic_ts = {a: self.critic_targets[a].params for a in self.agent_ids}
+        (actors, actor_ts, critics, critic_ts, a_opt, c_opt, loss) = train_step(
+            actors, actor_ts, critics, critic_ts,
+            self.actor_optimizers.opt_state, self.critic_optimizers.opt_state,
+            experiences, jnp.float32(self.gamma), jnp.float32(self.tau), self.next_key(),
+        )
+        for a in self.agent_ids:
+            self.actors[a].params = actors[a]
+            self.actor_targets[a].params = actor_ts[a]
+            self.critics[a].params = critics[a]
+            self.critic_targets[a].params = critic_ts[a]
+        self.actor_optimizers.opt_state = a_opt
+        self.critic_optimizers.opt_state = c_opt
+        return float(loss)

@@ -1,0 +1,212 @@
+"""MATD3 (parity: agilerl/algorithms/matd3.py — MADDPG + twin centralized
+critics with clipped double-Q targets and delayed policy updates,
+learn_individual:696).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import NetworkGroup, OptimizerConfig
+from agilerl_tpu.algorithms.maddpg import MADDPG, gumbel_softmax
+from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.utils.spaces import obs_dim, preprocess_observation
+
+
+class MATD3(MADDPG):
+    def __init__(self, observation_spaces, action_spaces,
+                 policy_noise: float = 0.2, noise_clip: float = 0.5,
+                 policy_freq: int = 2, **kwargs):
+        self.policy_noise = float(policy_noise)
+        self.noise_clip = float(noise_clip)
+        self.policy_freq = int(policy_freq)
+        self._learn_counter = 0
+        super().__init__(observation_spaces, action_spaces, **kwargs)
+        total_obs = sum(obs_dim(self.observation_spaces[a]) for a in self.agent_ids)
+        total_act = sum(self.action_dims.values())
+        critic_space = spaces.Box(-np.inf, np.inf, (total_obs + total_act,), np.float32)
+        self.critic_2s = {
+            aid: EvolvableNetwork(critic_space, num_outputs=1, key=self.next_key(),
+                                  **self.net_config)
+            for aid in self.agent_ids
+        }
+        self.critic_2_targets = {a: self.critic_2s[a].clone() for a in self.agent_ids}
+        self.critic_2_optimizers = OptimizerWrapper(optimizer="adam", lr=self.lr_critic)
+        self.register_network_group(
+            NetworkGroup(eval="critic_2s", shared="critic_2_targets", multiagent=True)
+        )
+        self.register_optimizer(
+            OptimizerConfig(name="critic_2_optimizers", networks=["critic_2s"], lr="lr_critic")
+        )
+        self.critic_2_optimizers.init({a: self.critic_2s[a].params for a in self.agent_ids})
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        d = super().init_dict
+        d.update(policy_noise=self.policy_noise, noise_clip=self.noise_clip,
+                 policy_freq=self.policy_freq)
+        return d
+
+    def evolvable_attributes(self) -> Dict[str, Any]:
+        d = super().evolvable_attributes()
+        d["critic_2s"] = self.critic_2s
+        d["critic_2_targets"] = self.critic_2_targets
+        return d
+
+    def _train_fn(self):
+        agent_ids = tuple(self.agent_ids)
+        actor_cfgs = {a: self.actors[a].config for a in agent_ids}
+        c1_cfgs = {a: self.critics[a].config for a in agent_ids}
+        c2_cfgs = {a: self.critic_2s[a].config for a in agent_ids}
+        obs_spaces = self.observation_spaces
+        act_spaces = self.action_spaces
+        discrete = self.discrete
+        action_dims = self.action_dims
+        a_tx = self.actor_optimizers.tx
+        c1_tx = self.critic_optimizers.tx
+        c2_tx = self.critic_2_optimizers.tx
+        policy_noise, noise_clip = self.policy_noise, self.noise_clip
+
+        def flat_obs(obs):
+            outs = []
+            for aid in agent_ids:
+                o = preprocess_observation(obs_spaces[aid], obs[aid])
+                outs.append(o.reshape(o.shape[0], -1))
+            return jnp.concatenate(outs, axis=-1)
+
+        def encode_action(aid, a):
+            if discrete[aid]:
+                return jax.nn.one_hot(a.astype(jnp.int32), action_dims[aid])
+            return a.astype(jnp.float32).reshape(a.shape[0], -1)
+
+        def actor_out(aid, params, obs, key=None, differentiable=False, smooth_key=None):
+            o = preprocess_observation(obs_spaces[aid], obs[aid])
+            raw = EvolvableNetwork.apply(actor_cfgs[aid], params, o)
+            if discrete[aid]:
+                if differentiable:
+                    return gumbel_softmax(raw, key)
+                return jax.nn.one_hot(jnp.argmax(raw, axis=-1), action_dims[aid])
+            low = jnp.asarray(act_spaces[aid].low, jnp.float32)
+            high = jnp.asarray(act_spaces[aid].high, jnp.float32)
+            a = low + (raw + 1.0) * 0.5 * (high - low)
+            if smooth_key is not None:
+                noise = jnp.clip(
+                    policy_noise * jax.random.normal(smooth_key, a.shape),
+                    -noise_clip, noise_clip,
+                )
+                a = jnp.clip(a + noise, low, high)
+            return a
+
+        @jax.jit
+        def train_step(actors, actor_ts, c1s, c1ts, c2s, c2ts,
+                       a_opt, c1_opt, c2_opt, batch, gamma, tau, key, update_actor):
+            obs, actions = batch["obs"], batch["action"]
+            rewards, dones, next_obs = batch["reward"], batch["done"], batch["next_obs"]
+            all_obs = flat_obs(obs)
+            all_next_obs = flat_obs(next_obs)
+            all_actions = jnp.concatenate(
+                [encode_action(a, actions[a]) for a in agent_ids], axis=-1
+            )
+            smooth_keys = jax.random.split(key, len(agent_ids) + 1)
+            next_target_actions = jnp.concatenate(
+                [actor_out(a, actor_ts[a], next_obs, smooth_key=smooth_keys[i])
+                 for i, a in enumerate(agent_ids)], axis=-1,
+            )
+            next_in = jnp.concatenate([all_next_obs, next_target_actions], axis=-1)
+            now_in = jnp.concatenate([all_obs, all_actions], axis=-1)
+
+            c1_grads, c2_grads, closs = {}, {}, 0.0
+            for aid in agent_ids:
+                q1n = EvolvableNetwork.apply(c1_cfgs[aid], c1ts[aid], next_in)[..., 0]
+                q2n = EvolvableNetwork.apply(c2_cfgs[aid], c2ts[aid], next_in)[..., 0]
+                qn = jnp.minimum(q1n, q2n)
+                r = rewards[aid].astype(jnp.float32)
+                d = dones[aid].astype(jnp.float32)
+                target = jax.lax.stop_gradient(r + gamma * (1 - d) * qn)
+
+                def l1(p, target=target, aid=aid):
+                    q = EvolvableNetwork.apply(c1_cfgs[aid], p, now_in)[..., 0]
+                    return jnp.mean(jnp.square(q - target))
+
+                def l2(p, target=target, aid=aid):
+                    q = EvolvableNetwork.apply(c2_cfgs[aid], p, now_in)[..., 0]
+                    return jnp.mean(jnp.square(q - target))
+
+                v1, g1 = jax.value_and_grad(l1)(c1s[aid])
+                v2, g2 = jax.value_and_grad(l2)(c2s[aid])
+                c1_grads[aid], c2_grads[aid] = g1, g2
+                closs = closs + v1 + v2
+
+            u1, c1_opt = c1_tx.update(c1_grads, c1_opt, c1s)
+            c1s = optax.apply_updates(c1s, u1)
+            u2, c2_opt = c2_tx.update(c2_grads, c2_opt, c2s)
+            c2s = optax.apply_updates(c2s, u2)
+
+            def do_actor(args):
+                actors, a_opt = args
+                a_grads = {}
+                for i, aid in enumerate(agent_ids):
+                    k = jax.random.fold_in(smooth_keys[-1], i)
+
+                    def a_loss(p, aid=aid, k=k):
+                        my = actor_out(aid, p, obs, key=k, differentiable=True)
+                        parts = [
+                            my if other == aid else encode_action(other, actions[other])
+                            for other in agent_ids
+                        ]
+                        q_in = jnp.concatenate(
+                            [all_obs, jnp.concatenate(parts, axis=-1)], axis=-1
+                        )
+                        q = EvolvableNetwork.apply(c1_cfgs[aid], c1s[aid], q_in)[..., 0]
+                        return -jnp.mean(q)
+
+                    _, g = jax.value_and_grad(a_loss)(actors[aid])
+                    a_grads[aid] = g
+                ua, a_opt = a_tx.update(a_grads, a_opt, actors)
+                return optax.apply_updates(actors, ua), a_opt
+
+            actors, a_opt = jax.lax.cond(
+                update_actor, do_actor, lambda args: args, (actors, a_opt)
+            )
+            actor_ts = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, actor_ts, actors)
+            c1ts = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c1ts, c1s)
+            c2ts = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c2ts, c2s)
+            return actors, actor_ts, c1s, c1ts, c2s, c2ts, a_opt, c1_opt, c2_opt, closs
+
+        return train_step
+
+    def learn(self, experiences) -> float:
+        self._learn_counter += 1
+        train_step = self.jit_fn("train", self._train_fn)
+        A = {a: self.actors[a].params for a in self.agent_ids}
+        AT = {a: self.actor_targets[a].params for a in self.agent_ids}
+        C1 = {a: self.critics[a].params for a in self.agent_ids}
+        C1T = {a: self.critic_targets[a].params for a in self.agent_ids}
+        C2 = {a: self.critic_2s[a].params for a in self.agent_ids}
+        C2T = {a: self.critic_2_targets[a].params for a in self.agent_ids}
+        (A, AT, C1, C1T, C2, C2T, a_opt, c1_opt, c2_opt, loss) = train_step(
+            A, AT, C1, C1T, C2, C2T,
+            self.actor_optimizers.opt_state, self.critic_optimizers.opt_state,
+            self.critic_2_optimizers.opt_state, experiences,
+            jnp.float32(self.gamma), jnp.float32(self.tau), self.next_key(),
+            jnp.bool_(self._learn_counter % self.policy_freq == 0),
+        )
+        for a in self.agent_ids:
+            self.actors[a].params = A[a]
+            self.actor_targets[a].params = AT[a]
+            self.critics[a].params = C1[a]
+            self.critic_targets[a].params = C1T[a]
+            self.critic_2s[a].params = C2[a]
+            self.critic_2_targets[a].params = C2T[a]
+        self.actor_optimizers.opt_state = a_opt
+        self.critic_optimizers.opt_state = c1_opt
+        self.critic_2_optimizers.opt_state = c2_opt
+        return float(loss)

@@ -1,0 +1,139 @@
+"""Pure-JAX multi-agent test environments with the PettingZoo parallel-env dict
+API, vectorised (complements the host-side PettingZoo wrappers in
+agilerl_tpu/vector/ — parity target: the simple_speaker_listener / simple_spread
+workloads in BASELINE.md).
+
+SimpleSpreadJax: N agents on a 2D plane must cover N landmarks; shared reward
+= -sum(min distances). Discrete(5) or Box(2) actions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+
+class MAState(NamedTuple):
+    pos: jax.Array  # [n_agents, 2]
+    landmarks: jax.Array  # [n_agents, 2]
+    t: jax.Array
+
+
+class SimpleSpreadJax:
+    """Cooperative navigation: agents observe own pos + all landmark offsets."""
+
+    def __init__(self, n_agents: int = 2, continuous: bool = False, max_steps: int = 25):
+        self.n_agents = n_agents
+        self.continuous = continuous
+        self.max_episode_steps = max_steps
+        self.agent_ids = [f"agent_{i}" for i in range(n_agents)]
+        obs_dim = 2 + 2 * n_agents
+        self.observation_spaces = {
+            a: spaces.Box(-np.inf, np.inf, (obs_dim,), np.float32) for a in self.agent_ids
+        }
+        if continuous:
+            self.action_spaces = {
+                a: spaces.Box(-1.0, 1.0, (2,), np.float32) for a in self.agent_ids
+            }
+        else:
+            self.action_spaces = {a: spaces.Discrete(5) for a in self.agent_ids}
+
+    def _obs(self, state: MAState) -> Dict[str, jax.Array]:
+        out = {}
+        for i, aid in enumerate(self.agent_ids):
+            rel = (state.landmarks - state.pos[i]).reshape(-1)
+            out[aid] = jnp.concatenate([state.pos[i], rel])
+        return out
+
+    def reset_fn(self, key) -> Tuple[MAState, Dict[str, jax.Array]]:
+        k1, k2 = jax.random.split(key)
+        pos = jax.random.uniform(k1, (self.n_agents, 2), minval=-1, maxval=1)
+        lm = jax.random.uniform(k2, (self.n_agents, 2), minval=-1, maxval=1)
+        state = MAState(pos, lm, jnp.int32(0))
+        return state, self._obs(state)
+
+    def step_fn(self, state: MAState, actions: Dict[str, jax.Array], key):
+        moves = []
+        for aid in self.agent_ids:
+            a = actions[aid]
+            if self.continuous:
+                moves.append(jnp.clip(a, -1, 1) * 0.1)
+            else:
+                # 0 stay, 1 left, 2 right, 3 down, 4 up
+                dx = jnp.where(a == 1, -0.1, jnp.where(a == 2, 0.1, 0.0))
+                dy = jnp.where(a == 3, -0.1, jnp.where(a == 4, 0.1, 0.0))
+                moves.append(jnp.stack([dx, dy]))
+        pos = jnp.clip(state.pos + jnp.stack(moves), -1.5, 1.5)
+        t = state.t + 1
+        new = MAState(pos, state.landmarks, t)
+        # shared reward: -sum over landmarks of min agent distance
+        d = jnp.linalg.norm(pos[:, None, :] - state.landmarks[None, :, :], axis=-1)
+        reward = -jnp.sum(jnp.min(d, axis=0))
+        truncated = t >= self.max_episode_steps
+        obs = self._obs(new)
+        rewards = {a: reward for a in self.agent_ids}
+        terms = {a: jnp.bool_(False) for a in self.agent_ids}
+        truncs = {a: truncated for a in self.agent_ids}
+        return new, obs, rewards, terms, truncs
+
+
+class MultiAgentJaxVecEnv:
+    """Vectorised dict-API wrapper (PettingZoo-parallel-like, batched)."""
+
+    def __init__(self, env: SimpleSpreadJax, num_envs: int = 1, seed: int = 0):
+        self.env = env
+        self.num_envs = num_envs
+        self.agents = env.agent_ids
+        self.agent_ids = env.agent_ids
+        self.observation_spaces = env.observation_spaces
+        self.action_spaces = env.action_spaces
+        self._key = jax.random.PRNGKey(seed)
+        self._reset_v = jax.jit(jax.vmap(env.reset_fn))
+        self._step_v = jax.jit(self._make_step())
+        self._state = None
+        self._t = None
+
+    def _make_step(self):
+        env = self.env
+
+        def single(state, actions, key):
+            k1, k2 = jax.random.split(key)
+            new, obs, rew, term, trunc = env.step_fn(state, actions, k1)
+            done = jnp.any(
+                jnp.stack([jnp.logical_or(term[a], trunc[a]) for a in env.agent_ids])
+            )
+            reset_state, reset_obs = env.reset_fn(k2)
+            out_state = jax.tree_util.tree_map(
+                lambda r, n: jnp.where(done, r, n), reset_state, new
+            )
+            out_obs = {
+                a: jnp.where(done, reset_obs[a], obs[a]) for a in env.agent_ids
+            }
+            return out_state, out_obs, rew, term, trunc
+
+        def vec_step(state, actions, key):
+            keys = jax.random.split(key, self.num_envs)
+            return jax.vmap(single)(state, actions, keys)
+
+        return vec_step
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        self._key, sub = jax.random.split(self._key)
+        self._state, obs = self._reset_v(jax.random.split(sub, self.num_envs))
+        return {a: np.asarray(o) for a, o in obs.items()}, {}
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        self._key, sub = jax.random.split(self._key)
+        actions = {a: jnp.asarray(v) for a, v in actions.items()}
+        self._state, obs, rew, term, trunc = self._step_v(self._state, actions, sub)
+        to_np = lambda d: {a: np.asarray(v) for a, v in d.items()}  # noqa: E731
+        return to_np(obs), to_np(rew), to_np(term), to_np(trunc), {}
+
+    def close(self):
+        pass

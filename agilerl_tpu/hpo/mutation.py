@@ -90,22 +90,26 @@ class Mutations:
     def architecture_mutate(self, agent):
         """Sample one mutation method on the policy net; replay the same method
         on every other eval net so architectures stay aligned
-        (parity: mutation.py:829)."""
+        (parity: mutation.py:829 single-agent; :887 multi-agent — the reference
+        searches for an 'analogous mutation' per sub-agent, here the identical
+        method+seed is replayed across every member which keeps groups exactly
+        homogeneous)."""
         policy_group = agent.registry.policy_group
-        policy: EvolvableNetwork = getattr(agent, policy_group.eval)
-        method = policy.sample_mutation_method(self.new_layer_prob, self.rng)
-        # apply with a shared numpy state so magnitudes align across nets
+        policy = getattr(agent, policy_group.eval)
+        sample_net = (
+            next(iter(policy.values())) if isinstance(policy, dict) else policy
+        )
+        method = sample_net.sample_mutation_method(self.new_layer_prob, self.rng)
+        # apply with a shared numpy seed so magnitudes align across nets
         seed = int(self.rng.integers(0, 2**31 - 1))
-        policy.apply_mutation(method, rng=np.random.default_rng(seed))
         for group in agent.registry.groups:
-            if group is policy_group:
-                continue
             net = getattr(agent, group.eval)
-            if hasattr(net, "apply_mutation") and _has_method(net, method):
-                try:
-                    net.apply_mutation(method, rng=np.random.default_rng(seed))
-                except Exception:
-                    pass
+            for sub in (net.values() if isinstance(net, dict) else [net]):
+                if hasattr(sub, "apply_mutation") and _has_method(sub, method):
+                    try:
+                        sub.apply_mutation(method, rng=np.random.default_rng(seed))
+                    except Exception:
+                        pass
         self._reinit_shared(agent)
         agent.reinit_optimizers()
         agent.mutation_hook()
@@ -119,8 +123,9 @@ class Mutations:
         ~10% subset of each weight tensor)."""
         policy_group = agent.registry.policy_group
         policy = getattr(agent, policy_group.eval)
-        self._key, sub = jax.random.split(self._key)
-        policy.params = _gaussian_mutate(policy.params, sub, self.mutation_sd)
+        for net in (policy.values() if isinstance(policy, dict) else [policy]):
+            self._key, sub = jax.random.split(self._key)
+            net.params = _gaussian_mutate(net.params, sub, self.mutation_sd)
         self._reinit_shared(agent)
         agent.mutation_hook()
         agent.mut = "param"
@@ -136,8 +141,9 @@ class Mutations:
         new_act = str(self.rng.choice(self.activation_selection))
         for group in agent.registry.groups:
             net = getattr(agent, group.eval)
-            if hasattr(net, "change_activation"):
-                net.change_activation(new_act)
+            for sub in (net.values() if isinstance(net, dict) else [net]):
+                if hasattr(sub, "change_activation"):
+                    sub.change_activation(new_act)
         self._reinit_shared(agent)
         agent.reinit_optimizers()
         agent.mutation_hook()
@@ -169,12 +175,18 @@ class Mutations:
     def _reinit_shared(self, agent) -> None:
         """Rebuild target/shared nets from their eval nets
         (parity: @reinit_shared_networks:104)."""
+        from agilerl_tpu.algorithms.core.base import _net_pairs
+
         for group in agent.registry.groups:
             eval_net = getattr(agent, group.eval)
             for shared_name in group.shared_names():
                 shared = getattr(agent, shared_name)
-                shared.config = eval_net.config
-                shared.params = jax.tree_util.tree_map(jnp.copy, eval_net.params)
+                for e, s in _net_pairs(
+                    eval_net if isinstance(eval_net, dict) else {"_": eval_net},
+                    shared if isinstance(shared, dict) else {"_": shared},
+                ):
+                    s.config = e.config
+                    s.params = jax.tree_util.tree_map(jnp.copy, e.params)
 
 
 def _has_method(net, method: str) -> bool:

@@ -1,0 +1,92 @@
+"""Multi-agent on-policy (IPPO) evolutionary training
+(parity: agilerl/training/train_multi_agent_on_policy.py — grouped rollouts then
+per-group PPO updates).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agilerl_tpu.utils.utils import (
+    init_wandb,
+    print_hyperparams,
+    save_population_checkpoint,
+    tournament_selection_and_mutation,
+)
+
+
+def train_multi_agent_on_policy(
+    env,
+    env_name: str,
+    algo: str,
+    pop: List,
+    INIT_HP: Optional[Dict] = None,
+    MUT_P: Optional[Dict] = None,
+    sum_scores: bool = True,
+    max_steps: int = 50_000,
+    evo_steps: int = 5_000,
+    eval_steps: Optional[int] = None,
+    eval_loop: int = 1,
+    target: Optional[float] = None,
+    tournament=None,
+    mutation=None,
+    checkpoint: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    overwrite_checkpoints: bool = False,
+    save_elite: bool = False,
+    elite_path: Optional[str] = None,
+    wb: bool = False,
+    verbose: bool = True,
+    accelerator=None,
+    wandb_api_key: Optional[str] = None,
+) -> Tuple[List, List[List[float]]]:
+    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    num_envs = getattr(env, "num_envs", 1)
+    pop_fitnesses: List[List[float]] = [[] for _ in pop]
+    total_steps = 0
+    checkpoint_count = 0
+    start = time.time()
+
+    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+        for agent in pop:
+            steps = 0
+            agent._last_obs = None
+            for _ in range(max(evo_steps // (agent.learn_step * num_envs), 1)):
+                agent.collect_rollouts(env, n_steps=agent.learn_step)
+                agent.learn()
+                steps += agent.learn_step * num_envs
+                total_steps += agent.learn_step * num_envs
+            agent.steps[-1] += steps
+
+        fitnesses = [
+            agent.test(env, max_steps=eval_steps, loop=eval_loop, sum_scores=sum_scores)
+            for agent in pop
+        ]
+        for i, f in enumerate(fitnesses):
+            pop_fitnesses[i].append(f)
+        if wandb_run is not None:
+            wandb_run.log({"global_step": total_steps,
+                           "eval/mean_fitness": float(np.mean(fitnesses))})
+        if verbose:
+            fps = total_steps / (time.time() - start)
+            print(f"--- steps {total_steps} fps {fps:.0f} fitness {[f'{f:.1f}' for f in fitnesses]}")
+            print_hyperparams(pop)
+
+        if tournament is not None and mutation is not None:
+            pop = tournament_selection_and_mutation(
+                pop, tournament, mutation, env_name=env_name, algo=algo,
+                elite_path=elite_path, save_elite=save_elite,
+            )
+        for agent in pop:
+            agent.steps.append(agent.steps[-1])
+        if checkpoint is not None and checkpoint_path is not None:
+            if total_steps // checkpoint > checkpoint_count:
+                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                checkpoint_count = total_steps // checkpoint
+        if target is not None and np.min(fitnesses) >= target:
+            break
+
+    return pop, pop_fitnesses

@@ -1,0 +1,87 @@
+import jax
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms.maddpg import MADDPG
+from agilerl_tpu.components import MultiAgentReplayBuffer
+from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+
+def make_env(continuous=False, num_envs=2):
+    return MultiAgentJaxVecEnv(
+        SimpleSpreadJax(n_agents=2, continuous=continuous), num_envs=num_envs, seed=0
+    )
+
+
+def make_agent(env, **kw):
+    defaults = dict(
+        observation_spaces=env.observation_spaces,
+        action_spaces=env.action_spaces,
+        agent_ids=env.agent_ids,
+        net_config=NET,
+        seed=0,
+    )
+    defaults.update(kw)
+    return MADDPG(**defaults)
+
+
+@pytest.mark.parametrize("continuous", [False, True])
+def test_get_action(continuous):
+    env = make_env(continuous)
+    agent = make_agent(env)
+    obs, _ = env.reset()
+    actions = agent.get_action(obs)
+    assert set(actions) == set(env.agent_ids)
+    for aid in env.agent_ids:
+        if continuous:
+            assert actions[aid].shape == (2, 2)
+        else:
+            assert actions[aid].shape == (2,)
+            assert actions[aid].max() < 5
+
+
+@pytest.mark.parametrize("continuous", [False, True])
+def test_step_and_learn(continuous):
+    env = make_env(continuous)
+    agent = make_agent(env)
+    buf = MultiAgentReplayBuffer(max_size=512, agent_ids=env.agent_ids)
+    obs, _ = env.reset()
+    for _ in range(40):
+        actions = agent.get_action(obs)
+        next_obs, rew, term, trunc, _ = env.step(actions)
+        done = {a: np.asarray(term[a], np.float32) for a in env.agent_ids}
+        buf.save_to_memory(obs, actions, rew, next_obs, done, is_vectorised=True)
+        obs = next_obs
+    losses = [agent.learn(buf.sample(32)) for _ in range(10)]
+    assert np.isfinite(losses).all()
+
+
+def test_grouping():
+    env = make_env()
+    agent = make_agent(env)
+    assert agent.grouped_agents == {"agent": ["agent_0", "agent_1"]}
+
+
+def test_clone_and_checkpoint(tmp_path):
+    env = make_env()
+    agent = make_agent(env)
+    clone = agent.clone(index=9)
+    obs, _ = env.reset()
+    a1 = agent.get_action(obs, training=False)
+    a2 = clone.get_action(obs, training=False)
+    for aid in env.agent_ids:
+        np.testing.assert_array_equal(a1[aid], a2[aid])
+    agent.save_checkpoint(tmp_path / "ma.ckpt")
+    loaded = MADDPG.load(tmp_path / "ma.ckpt")
+    a3 = loaded.get_action(obs, training=False)
+    for aid in env.agent_ids:
+        np.testing.assert_array_equal(a1[aid], a3[aid])
+
+
+def test_test_loop():
+    env = make_env()
+    agent = make_agent(env)
+    fitness = agent.test(env, max_steps=10, loop=2)
+    assert np.isfinite(fitness)

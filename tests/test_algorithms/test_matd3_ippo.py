@@ -1,0 +1,104 @@
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms import IPPO, MATD3
+from agilerl_tpu.components import MultiAgentReplayBuffer
+from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+
+def make_env(continuous=False, num_envs=2):
+    return MultiAgentJaxVecEnv(
+        SimpleSpreadJax(n_agents=2, continuous=continuous), num_envs=num_envs, seed=0
+    )
+
+
+class TestMATD3:
+    def test_learn(self):
+        env = make_env(continuous=True)
+        agent = MATD3(
+            observation_spaces=env.observation_spaces,
+            action_spaces=env.action_spaces,
+            agent_ids=env.agent_ids,
+            net_config=NET, seed=0, policy_freq=2,
+        )
+        buf = MultiAgentReplayBuffer(max_size=256, agent_ids=env.agent_ids)
+        obs, _ = env.reset()
+        for _ in range(40):
+            actions = agent.get_action(obs)
+            next_obs, rew, term, trunc, _ = env.step(actions)
+            done = {a: np.asarray(term[a], np.float32) for a in env.agent_ids}
+            buf.save_to_memory(obs, actions, rew, next_obs, done, is_vectorised=True)
+            obs = next_obs
+        losses = [agent.learn(buf.sample(32)) for _ in range(6)]
+        assert np.isfinite(losses).all()
+
+    def test_clone(self):
+        env = make_env(continuous=True)
+        agent = MATD3(
+            observation_spaces=env.observation_spaces,
+            action_spaces=env.action_spaces,
+            agent_ids=env.agent_ids, net_config=NET, seed=0,
+        )
+        clone = agent.clone(index=2)
+        obs, _ = env.reset()
+        a1, a2 = agent.get_action(obs, training=False), clone.get_action(obs, training=False)
+        for aid in env.agent_ids:
+            np.testing.assert_array_equal(a1[aid], a2[aid])
+
+
+class TestIPPO:
+    @pytest.mark.parametrize("continuous", [False, True])
+    def test_collect_and_learn(self, continuous):
+        env = make_env(continuous)
+        agent = IPPO(
+            observation_spaces=env.observation_spaces,
+            action_spaces=env.action_spaces,
+            agent_ids=env.agent_ids,
+            net_config=NET, num_envs=2, learn_step=16, batch_size=32,
+            update_epochs=2, seed=0,
+        )
+        agent.collect_rollouts(env)
+        loss = agent.learn()
+        assert np.isfinite(loss)
+        # groups share nets: only one actor for the homogeneous group
+        assert list(agent.actors.keys()) == ["agent"]
+
+    def test_test_loop(self):
+        env = make_env()
+        agent = IPPO(
+            observation_spaces=env.observation_spaces,
+            action_spaces=env.action_spaces,
+            agent_ids=env.agent_ids, net_config=NET, num_envs=2,
+            learn_step=8, seed=0,
+        )
+        assert np.isfinite(agent.test(env, max_steps=10, loop=1))
+
+
+class TestMultiAgentEvolution:
+    def test_tournament_and_mutation(self):
+        env = make_env()
+        pop = [
+            MATD3(
+                observation_spaces=env.observation_spaces,
+                action_spaces=env.action_spaces,
+                agent_ids=env.agent_ids, net_config=NET, seed=i, index=i,
+            )
+            for i in range(3)
+        ]
+        for i, a in enumerate(pop):
+            a.fitness = [float(i)]
+        ts = TournamentSelection(2, True, 3, 1, rng=np.random.default_rng(0))
+        mut = Mutations(no_mutation=0.25, architecture=0.5, parameters=0.25,
+                        activation=0, rl_hp=0, rand_seed=0)
+        elite, new_pop = ts.select(pop)
+        new_pop = mut.mutation(new_pop)
+        obs, _ = env.reset()
+        for agent in new_pop:
+            actions = agent.get_action(obs, training=False)
+            assert set(actions) == set(env.agent_ids)
+            # homogeneous architecture maintained across sub-agents
+            cfgs = {str(agent.actors[a].config) for a in agent.agent_ids}
+            assert len(cfgs) == 1
