@@ -1,0 +1,15 @@
+from agilerl_tpu.training.train_bandits import train_bandits
+from agilerl_tpu.training.train_multi_agent_off_policy import train_multi_agent_off_policy
+from agilerl_tpu.training.train_multi_agent_on_policy import train_multi_agent_on_policy
+from agilerl_tpu.training.train_off_policy import train_off_policy
+from agilerl_tpu.training.train_offline import train_offline
+from agilerl_tpu.training.train_on_policy import train_on_policy
+
+__all__ = [
+    "train_off_policy",
+    "train_on_policy",
+    "train_offline",
+    "train_bandits",
+    "train_multi_agent_off_policy",
+    "train_multi_agent_on_policy",
+]

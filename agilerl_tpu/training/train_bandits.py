@@ -1,0 +1,101 @@
+"""Contextual-bandit training loop (parity: agilerl/training/train_bandits.py —
+BanditEnv loop with regret tracking, fitness eval, evolution).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agilerl_tpu.utils.utils import (
+    init_wandb,
+    print_hyperparams,
+    save_population_checkpoint,
+    tournament_selection_and_mutation,
+)
+
+
+def train_bandits(
+    env,
+    env_name: str,
+    algo: str,
+    pop: List,
+    memory,
+    INIT_HP: Optional[Dict] = None,
+    MUT_P: Optional[Dict] = None,
+    swap_channels: bool = False,
+    max_steps: int = 10_000,
+    episode_steps: int = 100,
+    evo_steps: int = 500,
+    eval_steps: Optional[int] = None,
+    eval_loop: int = 1,
+    target: Optional[float] = None,
+    tournament=None,
+    mutation=None,
+    checkpoint: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    overwrite_checkpoints: bool = False,
+    save_elite: bool = False,
+    elite_path: Optional[str] = None,
+    wb: bool = False,
+    verbose: bool = True,
+    accelerator=None,
+    wandb_api_key: Optional[str] = None,
+) -> Tuple[List, List[List[float]]]:
+    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    pop_fitnesses: List[List[float]] = [[] for _ in pop]
+    total_steps = 0
+    checkpoint_count = 0
+    start = time.time()
+
+    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+        for agent in pop:
+            context = env.reset()
+            regret_free = 0.0
+            for step in range(max(evo_steps, 1)):
+                arm = agent.get_action(context)
+                next_context, reward = env.step(arm)
+                regret_free += float(np.asarray(reward).squeeze())
+                memory.add({
+                    "obs": np.asarray(context)[int(arm)],
+                    "action": np.int32(arm),
+                    "reward": np.float32(np.asarray(reward).squeeze()),
+                    "next_obs": np.asarray(next_context)[int(arm)],
+                    "done": np.float32(1.0),
+                })
+                context = next_context
+                total_steps += 1
+                agent.steps[-1] += 1
+                if len(memory) >= agent.batch_size and step % max(agent.learn_step, 1) == 0:
+                    agent.learn(memory.sample(agent.batch_size))
+            agent.scores.append(regret_free / max(evo_steps, 1))
+
+        fitnesses = [
+            agent.test(env, max_steps=eval_steps or 100, loop=eval_loop) for agent in pop
+        ]
+        for i, f in enumerate(fitnesses):
+            pop_fitnesses[i].append(f)
+        if wandb_run is not None:
+            wandb_run.log({"global_step": total_steps,
+                           "eval/mean_fitness": float(np.mean(fitnesses))})
+        if verbose:
+            print(f"--- steps {total_steps} fitness {[f'{f:.2f}' for f in fitnesses]}")
+            print_hyperparams(pop)
+
+        if tournament is not None and mutation is not None:
+            pop = tournament_selection_and_mutation(
+                pop, tournament, mutation, env_name=env_name, algo=algo,
+                elite_path=elite_path, save_elite=save_elite,
+            )
+        for agent in pop:
+            agent.steps.append(agent.steps[-1])
+        if checkpoint is not None and checkpoint_path is not None:
+            if total_steps // checkpoint > checkpoint_count:
+                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                checkpoint_count = total_steps // checkpoint
+        if target is not None and np.min(fitnesses) >= target:
+            break
+
+    return pop, pop_fitnesses

@@ -1,0 +1,102 @@
+"""Offline RL training from a fixed dataset (parity: agilerl/training/train_offline.py
+— h5 dataset -> buffer -> CQN/CQL learn loop, fitness eval, evolution).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agilerl_tpu.utils.utils import (
+    init_wandb,
+    print_hyperparams,
+    save_population_checkpoint,
+    tournament_selection_and_mutation,
+)
+
+
+def train_offline(
+    env,
+    env_name: str,
+    dataset,
+    algo: str,
+    pop: List,
+    memory,
+    INIT_HP: Optional[Dict] = None,
+    MUT_P: Optional[Dict] = None,
+    swap_channels: bool = False,
+    max_steps: int = 50_000,
+    evo_steps: int = 5_000,
+    eval_steps: Optional[int] = None,
+    eval_loop: int = 1,
+    target: Optional[float] = None,
+    tournament=None,
+    mutation=None,
+    checkpoint: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    overwrite_checkpoints: bool = False,
+    save_elite: bool = False,
+    elite_path: Optional[str] = None,
+    wb: bool = False,
+    verbose: bool = True,
+    accelerator=None,
+    wandb_api_key: Optional[str] = None,
+) -> Tuple[List, List[List[float]]]:
+    """dataset: dict-like with observations/actions/rewards/next_observations/
+    terminals arrays (h5py.File or numpy dict; parity with the reference's
+    h5 format in data/cartpole)."""
+    wandb_run = init_wandb(config=INIT_HP) if wb else None
+
+    if len(memory) == 0:
+        obs = np.asarray(dataset["observations"])
+        transition = {
+            "obs": obs,
+            "action": np.asarray(dataset["actions"]).squeeze(),
+            "reward": np.asarray(dataset["rewards"], np.float32).squeeze(),
+            "next_obs": np.asarray(dataset["next_observations"]),
+            "done": np.asarray(dataset["terminals"], np.float32).squeeze(),
+        }
+        memory.add(transition, batched=True)
+
+    pop_fitnesses: List[List[float]] = [[] for _ in pop]
+    total_steps = 0
+    checkpoint_count = 0
+    start = time.time()
+
+    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+        for agent in pop:
+            for _ in range(max(evo_steps // max(agent.learn_step, 1), 1)):
+                agent.learn(memory.sample(agent.batch_size))
+                agent.steps[-1] += agent.learn_step
+                total_steps += agent.learn_step
+
+        fitnesses = [
+            agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
+            for agent in pop
+        ]
+        for i, f in enumerate(fitnesses):
+            pop_fitnesses[i].append(f)
+        if wandb_run is not None:
+            wandb_run.log({"global_step": total_steps,
+                           "eval/mean_fitness": float(np.mean(fitnesses))})
+        if verbose:
+            print(f"--- steps {total_steps} fitness {[f'{f:.1f}' for f in fitnesses]}")
+            print_hyperparams(pop)
+
+        if tournament is not None and mutation is not None:
+            pop = tournament_selection_and_mutation(
+                pop, tournament, mutation, env_name=env_name, algo=algo,
+                elite_path=elite_path, save_elite=save_elite,
+            )
+        for agent in pop:
+            agent.steps.append(agent.steps[-1])
+        if checkpoint is not None and checkpoint_path is not None:
+            if total_steps // checkpoint > checkpoint_count:
+                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                checkpoint_count = total_steps // checkpoint
+        if target is not None and np.min(fitnesses) >= target:
+            break
+
+    return pop, pop_fitnesses

@@ -1,0 +1,4 @@
+from agilerl_tpu.vector.pz_async_vec_env import AsyncPettingZooVecEnv
+from agilerl_tpu.vector.pz_vec_env import PettingZooVecEnv
+
+__all__ = ["PettingZooVecEnv", "AsyncPettingZooVecEnv"]

@@ -1,0 +1,170 @@
+"""Async (multiprocessing) PettingZoo vectorisation
+(parity: agilerl/vector/pz_async_vec_env.py — AsyncPettingZooVecEnv:79, worker
+loop _async_worker:906, pipe control, shared-memory observation buffers
+create_shared_memory:733, autoreset, error propagation _raise_if_errors:541).
+
+Workers write observations into a shared multiprocessing.Array per agent (the
+reference's shared-memory design), commands travel over pipes. On TPU hosts the
+env processes overlap with device compute exactly like the reference overlaps
+with CUDA streams.
+"""
+
+from __future__ import annotations
+
+import enum
+import multiprocessing as mp
+import traceback
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class AsyncState(enum.Enum):
+    DEFAULT = "default"
+    WAITING_RESET = "reset"
+    WAITING_STEP = "step"
+
+
+def _flatdim(space) -> int:
+    from gymnasium import spaces as S
+
+    if isinstance(space, S.Discrete):
+        return 1
+    return int(np.prod(space.shape)) if space.shape else 1
+
+
+def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, obs_dims):
+    """Worker loop (parity: pz_async_vec_env.py:906)."""
+    parent_pipe.close()
+    env = env_fn()
+
+    def write_obs(obs):
+        for a in agents:
+            arr = np.frombuffer(shm[a].get_obj(), dtype=np.float32)
+            dim = obs_dims[a]
+            flat = np.asarray(obs.get(a, np.zeros(dim)), np.float32).reshape(-1)
+            arr[index * dim : (index + 1) * dim] = flat[:dim]
+
+    try:
+        while True:
+            cmd, data = pipe.recv()
+            if cmd == "reset":
+                obs, info = env.reset(seed=data)
+                write_obs(obs)
+                pipe.send(((), True))
+            elif cmd == "step":
+                action = {a: data[a] for a in env.agents} if env.agents else data
+                obs, rew, term, trunc, _ = env.step(action)
+                if not env.agents:  # autoreset
+                    obs, _ = env.reset()
+                write_obs(obs)
+                out = (
+                    {a: float(rew.get(a, 0.0)) for a in agents},
+                    {a: bool(term.get(a, False)) for a in agents},
+                    {a: bool(trunc.get(a, False)) for a in agents},
+                )
+                pipe.send((out, True))
+            elif cmd == "close":
+                env.close()
+                pipe.send(((), True))
+                break
+    except Exception:  # pragma: no cover - error path
+        pipe.send((traceback.format_exc(), False))
+
+
+class AsyncPettingZooVecEnv:
+    def __init__(self, env_fns: List[Callable], context: str = "spawn"):
+        ctx = mp.get_context(context)
+        self.num_envs = len(env_fns)
+        probe = env_fns[0]()
+        self.agents = list(probe.possible_agents)
+        self.possible_agents = list(probe.possible_agents)
+        self.observation_spaces = {a: probe.observation_space(a) for a in self.agents}
+        self.action_spaces = {a: probe.action_space(a) for a in self.agents}
+        self.agent_ids = self.agents
+        probe.close()
+        self._obs_dims = {a: _flatdim(self.observation_spaces[a]) for a in self.agents}
+        # shared-memory observation buffers (parity: create_shared_memory:733)
+        self._shm = {
+            a: ctx.Array("f", self.num_envs * self._obs_dims[a]) for a in self.agents
+        }
+        self._pipes, self._procs = [], []
+        for i, fn in enumerate(env_fns):
+            parent, child = ctx.Pipe()
+            proc = ctx.Process(
+                target=_async_worker,
+                args=(i, fn, child, parent, self._shm, self.agents, self._obs_dims),
+                daemon=True,
+            )
+            proc.start()
+            child.close()
+            self._pipes.append(parent)
+            self._procs.append(proc)
+        self._state = AsyncState.DEFAULT
+
+    def observation_space(self, agent: str):
+        return self.observation_spaces[agent]
+
+    def action_space(self, agent: str):
+        return self.action_spaces[agent]
+
+    def _assert_is_running(self):
+        assert all(p.is_alive() for p in self._procs), "worker died"
+
+    def _raise_if_errors(self, results):
+        for out, ok in results:
+            if not ok:
+                raise RuntimeError(f"env worker error:\n{out}")
+
+    def _read_obs(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for a in self.agents:
+            arr = np.frombuffer(self._shm[a].get_obj(), dtype=np.float32).copy()
+            shape = self.observation_spaces[a].shape
+            if shape and int(np.prod(shape)) == self._obs_dims[a]:
+                out[a] = arr.reshape(self.num_envs, *shape)
+            else:
+                out[a] = arr.reshape(self.num_envs, self._obs_dims[a])
+        return out
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        self._assert_is_running()
+        for i, pipe in enumerate(self._pipes):
+            pipe.send(("reset", None if seed is None else seed + i))
+        results = [pipe.recv() for pipe in self._pipes]
+        self._raise_if_errors(results)
+        return self._read_obs(), {}
+
+    def step_async(self, actions: Dict[str, np.ndarray]) -> None:
+        self._assert_is_running()
+        for i, pipe in enumerate(self._pipes):
+            act_i = {a: np.asarray(actions[a])[i] for a in self.agents}
+            act_i = {
+                a: int(v) if hasattr(self.action_spaces[a], "n") else v
+                for a, v in act_i.items()
+            }
+            pipe.send(("step", act_i))
+        self._state = AsyncState.WAITING_STEP
+
+    def step_wait(self):
+        results = [pipe.recv() for pipe in self._pipes]
+        self._raise_if_errors(results)
+        self._state = AsyncState.DEFAULT
+        rews, terms, truncs = zip(*[r for r, ok in results])
+        stack = lambda ds: {a: np.array([d[a] for d in ds]) for a in self.agents}  # noqa: E731
+        return self._read_obs(), stack(rews), stack(terms), stack(truncs), {}
+
+    def step(self, actions):
+        self.step_async(actions)
+        return self.step_wait()
+
+    def close(self):
+        try:
+            for pipe in self._pipes:
+                pipe.send(("close", None))
+            for pipe in self._pipes:
+                pipe.recv()
+        except (BrokenPipeError, EOFError):
+            pass
+        for p in self._procs:
+            p.join(timeout=2)

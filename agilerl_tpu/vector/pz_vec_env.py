@@ -1,0 +1,83 @@
+"""PettingZoo parallel-env vectorisation base API
+(parity: agilerl/vector/pz_vec_env.py — PettingZooVecEnv: reset/step_async/
+step_wait with per-agent dict obs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class PettingZooVecEnv:
+    """Synchronous vectorisation of PettingZoo parallel envs: the baseline
+    implementation of the vec API (async shared-memory variant in
+    pz_async_vec_env.py)."""
+
+    def __init__(self, env_fns: List[Callable]):
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        e0 = self.envs[0]
+        self.agents = list(e0.possible_agents)
+        self.possible_agents = list(e0.possible_agents)
+        self.observation_spaces = {a: e0.observation_space(a) for a in self.agents}
+        self.action_spaces = {a: e0.action_space(a) for a in self.agents}
+        self.agent_ids = self.agents
+        self._actions = None
+
+    def observation_space(self, agent: str):
+        return self.observation_spaces[agent]
+
+    def action_space(self, agent: str):
+        return self.action_spaces[agent]
+
+    def reset(self, seed: Optional[int] = None, options=None):
+        obs_list, info_list = [], []
+        for i, e in enumerate(self.envs):
+            obs, info = e.reset(seed=None if seed is None else seed + i, options=options)
+            obs_list.append(obs)
+            info_list.append(info)
+        stacked = {
+            a: np.stack([o.get(a) for o in obs_list]) for a in self.agents
+        }
+        return stacked, {}
+
+    def step_async(self, actions: Dict[str, np.ndarray]) -> None:
+        self._actions = actions
+
+    def step_wait(self):
+        actions = self._actions
+        obs_l, rew_l, term_l, trunc_l = [], [], [], []
+        for i, e in enumerate(self.envs):
+            act_i = {a: np.asarray(actions[a])[i] for a in self.agents}
+            # gymnasium-style scalars for Discrete
+            act_i = {
+                a: (int(v) if np.ndim(v) == 0 and not isinstance(
+                    self.action_spaces[a], type(None)
+                ) and hasattr(self.action_spaces[a], "n") else v)
+                for a, v in act_i.items()
+            }
+            obs, rew, term, trunc, _ = e.step(act_i)
+            if not e.agents:  # episode over -> autoreset
+                obs, _ = e.reset()
+            obs_l.append(obs)
+            rew_l.append(rew)
+            term_l.append(term)
+            trunc_l.append(trunc)
+
+        def stack(dicts, default=0.0):
+            return {
+                a: np.stack([np.asarray(d.get(a, default)) for d in dicts])
+                for a in self.agents
+            }
+
+        return stack(obs_l), stack(rew_l), stack(term_l, False), stack(trunc_l, False), {}
+
+    def step(self, actions):
+        self.step_async(actions)
+        return self.step_wait()
+
+    def close(self):
+        for e in self.envs:
+            e.close()

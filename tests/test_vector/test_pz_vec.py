@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+
+class TinyParallelEnv:
+    """Minimal PettingZoo-parallel-API env for vectorisation tests."""
+
+    def __init__(self, n_agents=2, episode_len=5):
+        self.possible_agents = [f"a_{i}" for i in range(n_agents)]
+        self.agents = []
+        self.episode_len = episode_len
+        self._t = 0
+
+    def observation_space(self, agent):
+        return spaces.Box(-1, 1, (3,), np.float32)
+
+    def action_space(self, agent):
+        return spaces.Discrete(2)
+
+    def reset(self, seed=None, options=None):
+        self.agents = list(self.possible_agents)
+        self._t = 0
+        obs = {a: np.full(3, self._t, np.float32) for a in self.agents}
+        return obs, {}
+
+    def step(self, actions):
+        self._t += 1
+        done = self._t >= self.episode_len
+        obs = {a: np.full(3, self._t, np.float32) for a in self.agents}
+        rew = {a: float(actions[a]) for a in self.agents}
+        term = {a: False for a in self.agents}
+        trunc = {a: done for a in self.agents}
+        if done:
+            self.agents = []
+        return obs, rew, term, trunc, {}
+
+    def close(self):
+        pass
+
+
+def test_sync_vec_env():
+    from agilerl_tpu.vector import PettingZooVecEnv
+
+    env = PettingZooVecEnv([TinyParallelEnv for _ in range(3)])
+    obs, _ = env.reset(seed=0)
+    assert obs["a_0"].shape == (3, 3)
+    for t in range(7):  # across the autoreset boundary
+        actions = {a: np.ones(3, np.int64) for a in env.agents}
+        obs, rew, term, trunc, _ = env.step(actions)
+        assert rew["a_0"].shape == (3,)
+    env.close()
+
+
+def test_async_vec_env():
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    env = AsyncPettingZooVecEnv([TinyParallelEnv for _ in range(2)])
+    obs, _ = env.reset(seed=0)
+    assert obs["a_0"].shape == (2, 3)
+    for _ in range(6):
+        actions = {a: np.zeros(2, np.int64) for a in env.agents}
+        obs, rew, term, trunc, _ = env.step(actions)
+        assert obs["a_1"].shape == (2, 3)
+        assert rew["a_0"].shape == (2,)
+    env.close()

@@ -1,0 +1,41 @@
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms import DQN
+from agilerl_tpu.wrappers import BanditEnv, RSNorm, RunningMeanStd
+
+BOX = spaces.Box(-1, 1, (4,))
+DISC = spaces.Discrete(2)
+
+
+def test_running_mean_std_matches_numpy():
+    rms = RunningMeanStd((3,))
+    rng = np.random.default_rng(0)
+    data = rng.normal(5.0, 2.0, size=(500, 3))
+    for chunk in np.split(data, 10):
+        rms.update(chunk)
+    np.testing.assert_allclose(rms.mean, data.mean(0), rtol=1e-2)
+    np.testing.assert_allclose(rms.var, data.var(0), rtol=5e-2)
+
+
+def test_rsnorm_wraps_agent():
+    agent = DQN(BOX, DISC, seed=0,
+                net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}})
+    wrapped = RSNorm(agent)
+    obs = np.random.default_rng(0).normal(10.0, 3.0, size=(8, 4)).astype(np.float32)
+    a = wrapped.get_action(obs)
+    assert a.shape == (8,)
+    # running stats were updated
+    assert wrapped.rms.count > 1
+    # transparent attribute passthrough
+    assert wrapped.batch_size == agent.batch_size
+
+
+def test_bandit_env():
+    rng = np.random.default_rng(0)
+    env = BanditEnv(rng.normal(size=(16, 3)), rng.integers(0, 2, 16))
+    ctx = env.reset()
+    assert ctx.shape == (2, 6)
+    next_ctx, r = env.step(0)
+    assert r in (0.0, 1.0)
