@@ -1,0 +1,128 @@
+"""DPO — direct preference optimisation (parity: agilerl/algorithms/dpo.py —
+preference learn:180 over chosen/rejected pairs with prompt masks
+(create_prompt_masks core/base.py:3087), sigmoid DPO loss _dpo_loss_standard:361
+(+ the Liger fused path :409 — replaced by ops/fused_loss.py), implicit reward
+_compute_implicit_reward:530). Same LoRA actor/reference adapter layout as GRPO.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    RLParameter,
+)
+from agilerl_tpu.algorithms.grpo import GRPO
+from agilerl_tpu.llm import model as M
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr=RLParameter(min=1e-8, max=1e-4, dtype=float),
+        beta=RLParameter(min=0.01, max=1.0, dtype=float),
+    )
+
+
+class DPO(GRPO):
+    def __init__(self, *args, beta: float = 0.1, label_smoothing: float = 0.0, **kwargs):
+        kwargs.setdefault("hp_config", default_hp_config())
+        super().__init__(*args, beta=beta, **kwargs)
+        self.label_smoothing = float(label_smoothing)
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        d = super().init_dict
+        d["label_smoothing"] = self.label_smoothing
+        return d
+
+    # ------------------------------------------------------------------ #
+    def _dpo_update_fn(self):
+        config = self.model_config
+        base = self.base_params
+        tx = self.optimizer.tx
+        smooth = self.label_smoothing
+
+        def seq_logprob(lora, ids, mask, loss_mask):
+            lp = M.token_logprobs(config, base, ids, attention_mask=mask, lora=lora)
+            return (lp * loss_mask).sum(axis=-1)
+
+        @jax.jit
+        def update(lora, ref_lora, opt_state, batch, beta):
+            ref_c = seq_logprob(
+                ref_lora, batch["chosen_ids"], batch["chosen_mask"],
+                batch["chosen_loss_mask"],
+            )
+            ref_r = seq_logprob(
+                ref_lora, batch["rejected_ids"], batch["rejected_mask"],
+                batch["rejected_loss_mask"],
+            )
+
+            def loss_fn(lo):
+                pol_c = seq_logprob(
+                    lo, batch["chosen_ids"], batch["chosen_mask"],
+                    batch["chosen_loss_mask"],
+                )
+                pol_r = seq_logprob(
+                    lo, batch["rejected_ids"], batch["rejected_mask"],
+                    batch["rejected_loss_mask"],
+                )
+                logits = beta * ((pol_c - ref_c) - (pol_r - ref_r))
+                # sigmoid DPO loss with optional label smoothing (parity :361)
+                loss = (
+                    -jax.nn.log_sigmoid(logits) * (1 - smooth)
+                    - jax.nn.log_sigmoid(-logits) * smooth
+                ).mean()
+                # implicit rewards (parity: _compute_implicit_reward:530)
+                reward_margin = beta * ((pol_c - ref_c) - (pol_r - ref_r))
+                acc = (reward_margin > 0).astype(jnp.float32).mean()
+                return loss, (acc, reward_margin.mean())
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(lora)
+            updates, opt_state = tx.update(grads, opt_state, lora)
+            lora = optax.apply_updates(lora, updates)
+            return lora, opt_state, loss, aux
+
+        return update
+
+    def learn(self, experiences: Dict[str, np.ndarray]) -> Tuple[float, float]:
+        """experiences: the PreferenceGym.reset() batch dict
+        (parity: dpo.py:180). Returns (loss, preference accuracy)."""
+        batch = {k: jnp.asarray(v) for k, v in experiences.items()}
+        update = self.jit_fn("dpo_update", self._dpo_update_fn)
+        lora, opt_state, loss, (acc, margin) = update(
+            self.actor.params, self.reference.params, self.optimizer.opt_state,
+            batch, jnp.float32(self.beta),
+        )
+        if not np.isfinite(float(loss)):
+            raise RuntimeError(f"Non-finite DPO loss {float(loss)}")
+        self.actor.params = lora
+        self.optimizer.opt_state = opt_state
+        return float(loss), float(acc)
+
+    def test(self, env) -> float:
+        """Preference accuracy on the eval split (parity: dpo.py test)."""
+        batch = {k: jnp.asarray(v) for k, v in env.reset(eval_mode=True).items()}
+        config, base = self.model_config, self.base_params
+
+        def seq_lp(lora, ids, mask, loss_mask):
+            lp = M.token_logprobs(config, base, ids, attention_mask=mask, lora=lora)
+            return (lp * loss_mask).sum(axis=-1)
+
+        pol_c = seq_lp(self.actor.params, batch["chosen_ids"], batch["chosen_mask"],
+                       batch["chosen_loss_mask"])
+        pol_r = seq_lp(self.actor.params, batch["rejected_ids"], batch["rejected_mask"],
+                       batch["rejected_loss_mask"])
+        ref_c = seq_lp(self.reference.params, batch["chosen_ids"], batch["chosen_mask"],
+                       batch["chosen_loss_mask"])
+        ref_r = seq_lp(self.reference.params, batch["rejected_ids"], batch["rejected_mask"],
+                       batch["rejected_loss_mask"])
+        margin = (pol_c - ref_c) - (pol_r - ref_r)
+        fitness = float((margin > 0).mean())
+        self.fitness.append(fitness)
+        return fitness

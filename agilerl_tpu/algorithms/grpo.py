@@ -1,0 +1,287 @@
+"""GRPO — group-relative policy optimisation for LLM finetuning
+(parity: agilerl/algorithms/grpo.py — group sampling get_action:259,
+group-relative advantage _calculate_advantage:409, clipped-ratio + k3-KL loss
+_grpo_loss_standard:517, learn:321 recomputes old/ref logprobs then runs
+update_epochs minibatch epochs, test:380; and the LLMAlgorithm adapter design
+core/base.py:1894 — actor/reference as two LoRA subtrees over one frozen base).
+
+TPU-first deltas vs the reference:
+- no vLLM: generation is the in-tree jitted decode loop (llm/generate.py)
+  sharing the training param tree — no weight hot-swap, no engine sleep/wake;
+- no DeepSpeed: the base params + LoRA live in one pytree that parallel/mesh.py
+  shards GSPMD-style (fsdp/tp axes);
+- the fused chunked loss (ops/fused_loss.py) replaces Liger's Triton kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.base import EvolvableAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.generate import generate
+
+
+def default_hp_config() -> HyperparameterConfig:
+    return HyperparameterConfig(
+        lr=RLParameter(min=1e-8, max=1e-4, dtype=float),
+        beta=RLParameter(min=1e-4, max=0.1, dtype=float),
+        group_size=RLParameter(min=2, max=16, dtype=int),
+    )
+
+
+class _LoraNet:
+    """Minimal network-shaped holder so the registry/clone machinery sees the
+    adapter as an evolvable attribute (configs never mutate for LLMs — the
+    reference blocks arch mutations too, training/train_llm.py:97-109)."""
+
+    def __init__(self, config, params):
+        self.config = config
+        self.params = params
+
+
+class GRPO(EvolvableAlgorithm):
+    supports_activation_mutation = False
+
+    def __init__(
+        self,
+        config: M.GPTConfig,
+        base_params: Any = None,
+        pad_token_id: int = 0,
+        eos_token_id: Optional[int] = None,
+        index: int = 0,
+        hp_config: Optional[HyperparameterConfig] = None,
+        batch_size: int = 8,
+        beta: float = 0.04,
+        lr: float = 5e-6,
+        clip_coef: float = 0.2,
+        max_grad_norm: float = 0.1,
+        update_epochs: int = 1,
+        group_size: int = 8,
+        temperature: float = 0.9,
+        max_output_tokens: int = 64,
+        lora_rank: int = 8,
+        lora_targets: Tuple[str, ...] = ("wq", "wv"),
+        lora_scale: float = 2.0,
+        **kwargs,
+    ):
+        super().__init__(index=index, hp_config=hp_config or default_hp_config(), **kwargs)
+        self.model_config = config
+        self.pad_token_id = int(pad_token_id)
+        self.eos_token_id = eos_token_id
+        self.batch_size = int(batch_size)
+        self.beta = float(beta)
+        self.lr = float(lr)
+        self.clip_coef = float(clip_coef)
+        self.max_grad_norm = float(max_grad_norm)
+        self.update_epochs = int(update_epochs)
+        self.group_size = int(group_size)
+        self.temperature = float(temperature)
+        self.max_output_tokens = int(max_output_tokens)
+        self.lora_rank = int(lora_rank)
+        self.lora_targets = tuple(lora_targets)
+        self.lora_scale = float(lora_scale)
+
+        if base_params is None:
+            base_params = M.init_params(self.next_key(), config)
+        self.base_params = base_params  # frozen
+        # actor adapter (trainable) + reference adapter (frozen snapshot)
+        self.actor = _LoraNet(
+            config, M.init_lora(self.next_key(), config, lora_rank, self.lora_targets)
+        )
+        self.reference = _LoraNet(
+            config, jax.tree_util.tree_map(jnp.copy, self.actor.params)
+        )
+        self.optimizer = OptimizerWrapper(
+            optimizer="adamw", lr=self.lr, max_grad_norm=self.max_grad_norm
+        )
+        self.register_network_group(NetworkGroup(eval="actor", policy=True))
+        self.register_optimizer(
+            OptimizerConfig(name="optimizer", networks=["actor"], lr="lr")
+        )
+        self.finalize_registry()
+        self._reference_epoch = -1
+
+    # ------------------------------------------------------------------ #
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "config": self.model_config,
+            "base_params": self.base_params,  # shared reference, not copied
+            "pad_token_id": self.pad_token_id,
+            "eos_token_id": self.eos_token_id,
+            "index": self.index,
+            "batch_size": self.batch_size,
+            "beta": self.beta,
+            "lr": self.lr,
+            "clip_coef": self.clip_coef,
+            "max_grad_norm": self.max_grad_norm,
+            "update_epochs": self.update_epochs,
+            "group_size": self.group_size,
+            "temperature": self.temperature,
+            "max_output_tokens": self.max_output_tokens,
+            "lora_rank": self.lora_rank,
+            "lora_targets": self.lora_targets,
+            "lora_scale": self.lora_scale,
+        }
+
+    def _on_clone(self, parent) -> None:
+        self.reference.params = jax.tree_util.tree_map(jnp.copy, parent.reference.params)
+        self._reference_epoch = parent._reference_epoch
+
+    def set_reference_policy(self, epoch: int) -> None:
+        """Refresh the reference adapter from the actor once per dataset epoch
+        (parity: core/base.py:2544 — the adapter-copy replaces the reference's
+        enable/disable-adapter trick)."""
+        if epoch != self._reference_epoch:
+            self.reference.params = jax.tree_util.tree_map(jnp.copy, self.actor.params)
+            self._reference_epoch = epoch
+
+    # ------------------------------------------------------------------ #
+    def get_action(self, prompts: Dict[str, np.ndarray], training: bool = True):
+        """Generate group_size completions per prompt
+        (parity: grpo.py:259; the vLLM wake/swap/gather dance collapses into one
+        jitted generate call). prompts: {"input_ids": [B, P], "attention_mask"}.
+        Returns (completion_ids [B*G, N], completion_mask [B*G, N])."""
+        ids = jnp.asarray(prompts["input_ids"])
+        mask = jnp.asarray(prompts["attention_mask"])
+        g = self.group_size if training else 1
+        ids = jnp.repeat(ids, g, axis=0)
+        mask = jnp.repeat(mask, g, axis=0)
+        comp, cmask = generate(
+            self.model_config, self.base_params, ids, mask, self.next_key(),
+            max_new_tokens=self.max_output_tokens, lora=self.actor.params,
+            temperature=self.temperature if training else 0.0,
+            eos_id=self.eos_token_id, pad_id=self.pad_token_id,
+        )
+        return np.asarray(comp), np.asarray(cmask)
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _calculate_advantage(rewards: jax.Array, eps: float = 1e-4) -> jax.Array:
+        """Group z-score (parity: grpo.py:409). rewards [B, G] -> [B*G]."""
+        mean = rewards.mean(axis=1, keepdims=True)
+        std = rewards.std(axis=1, keepdims=True)
+        return ((rewards - mean) / (std + eps)).reshape(-1)
+
+    def _logprob_fn(self):
+        config = self.model_config
+        base = self.base_params
+        scale = self.lora_scale
+
+        @jax.jit
+        def logprobs(lora, tokens, mask):
+            return M.token_logprobs(config, base, tokens, attention_mask=mask, lora=lora)
+
+        return logprobs
+
+    def _update_fn(self):
+        config = self.model_config
+        base = self.base_params
+        scale = self.lora_scale
+        tx = self.optimizer.tx
+
+        @jax.jit
+        def update(lora, opt_state, batch, clip, beta):
+            def loss_fn(lo):
+                lp = M.token_logprobs(
+                    config, base, batch["tokens"], attention_mask=batch["mask"], lora=lo
+                )
+                lp = lp * batch["loss_mask"]
+                ratio = jnp.exp(lp - batch["old_lp"])
+                adv = batch["advantage"][:, None]
+                s1 = ratio * adv
+                s2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+                pg = -jnp.minimum(s1, s2)
+                # k3 KL estimator vs the reference adapter (parity: grpo.py:517)
+                log_ratio_ref = batch["ref_lp"] - lp
+                kl = jnp.exp(log_ratio_ref) - log_ratio_ref - 1.0
+                per_tok = (pg + beta * kl) * batch["loss_mask"]
+                denom = jnp.maximum(batch["loss_mask"].sum(), 1.0)
+                return per_tok.sum() / denom
+
+            loss, grads = jax.value_and_grad(loss_fn)(lora)
+            updates, opt_state = tx.update(grads, opt_state, lora)
+            lora = optax.apply_updates(lora, updates)
+            return lora, opt_state, loss
+
+        return update
+
+    def learn(self, experiences: Tuple) -> Tuple[float, float]:
+        """experiences = (ids, action_masks, rewards):
+        ids [B*G, P+N] full prompt+completion sequences, action_masks [B*G, P+N-1]
+        marking completion-token predictions, rewards [B, G]
+        (parity: grpo.py:321). Returns (mean loss, mean |kl| proxy)."""
+        ids, action_masks, rewards = experiences
+        ids = jnp.asarray(ids)
+        mask = (ids != self.pad_token_id).astype(jnp.int32)
+        # attention mask must also cover pads inside prompt (left-pad) — caller
+        # supplies full attention separately when pad==real token id
+        loss_mask = jnp.asarray(action_masks, jnp.float32)
+        rewards = jnp.asarray(rewards, jnp.float32)
+        advantage = self._calculate_advantage(rewards)
+
+        logprobs = self.jit_fn("logprobs", self._logprob_fn)
+        old_lp = logprobs(self.actor.params, ids, mask) * loss_mask
+        ref_lp = logprobs(self.reference.params, ids, mask) * loss_mask
+
+        update = self.jit_fn("update", self._update_fn)
+        lora, opt_state = self.actor.params, self.optimizer.opt_state
+        n_rows = ids.shape[0]
+        total, n_updates = 0.0, 0
+        for _ in range(self.update_epochs):
+            perm = np.asarray(jax.random.permutation(self.next_key(), n_rows))
+            for s in range(0, n_rows, self.batch_size):
+                idx = perm[s : s + self.batch_size]
+                batch = {
+                    "tokens": ids[idx],
+                    "mask": mask[idx],
+                    "loss_mask": loss_mask[idx],
+                    "old_lp": old_lp[idx],
+                    "ref_lp": ref_lp[idx],
+                    "advantage": advantage[idx],
+                }
+                lora, opt_state, loss = update(
+                    lora, opt_state, batch, jnp.float32(self.clip_coef),
+                    jnp.float32(self.beta),
+                )
+                if not np.isfinite(float(loss)):
+                    raise RuntimeError(
+                        f"Non-finite GRPO loss {float(loss)} — aborting "
+                        "(parity: grpo.py:370 NaN guard)"
+                    )
+                total += float(loss)
+                n_updates += 1
+        self.actor.params = lora
+        self.optimizer.opt_state = opt_state
+        return total / max(n_updates, 1), 0.0
+
+    # ------------------------------------------------------------------ #
+    def test(self, env) -> float:
+        """Greedy-decode the eval split and average the reward
+        (parity: grpo.py:380)."""
+        prompts = env.reset(eval_mode=True)
+        comp, cmask = self.get_action(prompts, training=False)
+        _, rewards = env.step_eval(comp, cmask)
+        fitness = float(np.mean(rewards))
+        self.fitness.append(fitness)
+        return fitness
+
+    def clean_up(self) -> None:
+        """Free cached jit executables (parity: core/base.py:2335 clean_up —
+        the DeepSpeed-engine teardown has no analogue; XLA buffers free with
+        the params)."""
+        self._clear_jit_cache()

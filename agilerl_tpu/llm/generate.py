@@ -1,0 +1,100 @@
+"""In-tree jitted generation loop — the vLLM replacement
+(parity target: agilerl/algorithms/core/base.py:3101 _configure_vllm +
+_generate_with_vllm_colocate:2799 + weight hot-swap _move_model_to_vllm:2772.
+None of that machinery exists here: training and sampling share one sharded
+param tree, the KV cache is a device pytree, and decode is a lax.scan).
+
+Left-padded ragged prompts; per-row RoPE positions; EOS early-stop via done
+masking (shapes stay static so XLA compiles once per (B, P, max_new_tokens)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.llm import model as M
+
+
+def left_pad(
+    sequences, pad_id: int, max_len: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host helper: list of 1D token arrays -> (tokens [B, P], mask [B, P])."""
+    max_len = max_len or max(len(s) for s in sequences)
+    B = len(sequences)
+    toks = np.full((B, max_len), pad_id, np.int32)
+    mask = np.zeros((B, max_len), np.int32)
+    for i, s in enumerate(sequences):
+        s = np.asarray(s, np.int32)[-max_len:]
+        toks[i, max_len - len(s):] = s
+        mask[i, max_len - len(s):] = 1
+    return toks, mask
+
+
+def _sample_token(logits, key, temperature, top_k):
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(key, logits / temperature, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k", "eos_id", "pad_id"),
+)
+def generate(
+    config: M.GPTConfig,
+    params,
+    prompt: jax.Array,  # [B, P] left-padded
+    prompt_mask: jax.Array,  # [B, P]
+    key: jax.Array,
+    max_new_tokens: int = 64,
+    lora=None,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (completions [B, max_new_tokens], completion_mask [B, max_new_tokens]).
+
+    completion_mask covers tokens up to and including the first EOS."""
+    B, P = prompt.shape
+    caches = M.init_caches(config, B, P + max_new_tokens)
+    positions = jnp.maximum(jnp.cumsum(prompt_mask, axis=-1) - 1, 0)
+    hidden, caches = M.forward(
+        config, params, prompt, attention_mask=prompt_mask, positions=positions,
+        cache=caches, lora=lora,
+    )
+    last_logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]  # [B, V]
+    pos = prompt_mask.sum(axis=-1)  # next position per row
+
+    def step(carry, _):
+        caches, logits, pos, done, key = carry
+        key, k_s = jax.random.split(key)
+        tok = _sample_token(logits, k_s, temperature, top_k)
+        if eos_id is not None:
+            tok = jnp.where(done, pad_id, tok)
+        emit = tok
+        emit_mask = jnp.logical_not(done)
+        if eos_id is not None:
+            done = jnp.logical_or(done, tok == eos_id)
+        hidden, caches = M.forward(
+            config, params, tok[:, None],
+            attention_mask=emit_mask.astype(jnp.int32)[:, None],
+            positions=pos[:, None], cache=caches, lora=lora,
+        )
+        logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
+        pos = pos + emit_mask.astype(pos.dtype)
+        return (caches, logits, pos, done, key), (emit, emit_mask)
+
+    done0 = jnp.zeros((B,), bool)
+    (_, _, _, _, _), (tokens, masks) = jax.lax.scan(
+        step, (caches, last_logits, pos, done0, key), None, length=max_new_tokens
+    )
+    return tokens.T, masks.T.astype(jnp.int32)  # [B, N]

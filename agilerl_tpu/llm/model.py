@@ -1,0 +1,354 @@
+"""Decoder-only transformer (Llama-class: RMSNorm, RoPE, SwiGLU, GQA) as pure
+init/apply over dict params, with LoRA adapter subtrees and a fixed-size KV
+cache for jitted decoding.
+
+This is the in-tree replacement for the reference's HF-model + PEFT + vLLM stack
+(agilerl/algorithms/core/base.py:1894 LLMAlgorithm — LoRA adapters :2041,
+adapter-swap reference policy :2755, vLLM colocate generation :3101): training
+and sampling share ONE sharded param tree, so there is no weight hot-swap and no
+external engine. bfloat16 compute on the MXU; float32 params/logits.
+
+Sharding contract (see parallel/mesh.py): attention/MLP kernels are annotated
+with logical axes ("embed", "heads"/"mlp") so GSPMD shards them over ("fsdp",
+"tp") mesh axes with no code change here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int
+    n_layer: int = 4
+    n_head: int = 4
+    n_kv_head: Optional[int] = None  # grouped-query attention; None -> n_head
+    d_model: int = 256
+    d_ff: Optional[int] = None  # None -> 4 * d_model (SwiGLU sized 2/3)
+    max_seq_len: int = 1024
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or int(8 * self.d_model / 3 + 127) // 128 * 128
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [B, S, KV, hd]
+    v: jax.Array  # [B, S, KV, hd]
+    length: jax.Array  # [] int32 — filled slots
+    mask: jax.Array  # [B, S] int32 — 1 where the slot holds a REAL token
+    # (left-padded prompts leave dead slots that must stay masked forever)
+
+
+def init_kv_cache(config: GPTConfig, batch: int, max_len: Optional[int] = None) -> KVCache:
+    s = max_len or config.max_seq_len
+    shape = (batch, s, config.kv_heads, config.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, config.dtype),
+        v=jnp.zeros(shape, config.dtype),
+        length=jnp.zeros((), jnp.int32),
+        mask=jnp.zeros((batch, s), jnp.int32),
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Init
+# --------------------------------------------------------------------------- #
+
+
+def _normal(key, shape, std):
+    return (std * jax.random.normal(key, shape, jnp.float32)).astype(jnp.float32)
+
+
+def init_params(key: jax.Array, config: GPTConfig) -> Params:
+    d, hd = config.d_model, config.head_dim
+    nh, nkv, f = config.n_head, config.kv_heads, config.ff_dim
+    std = 0.02
+    out_std = std / math.sqrt(2 * config.n_layer)
+    keys = jax.random.split(key, config.n_layer + 3)
+    params: Dict = {
+        "tok_emb": _normal(keys[0], (config.vocab_size, d), std),
+        "blocks": {},
+        "ln_f": jnp.ones((d,), jnp.float32),
+    }
+    for i in range(config.n_layer):
+        ks = jax.random.split(keys[i + 1], 7)
+        params["blocks"][str(i)] = {
+            "ln1": jnp.ones((d,), jnp.float32),
+            "wq": _normal(ks[0], (d, nh * hd), std),
+            "wk": _normal(ks[1], (d, nkv * hd), std),
+            "wv": _normal(ks[2], (d, nkv * hd), std),
+            "wo": _normal(ks[3], (nh * hd, d), out_std),
+            "ln2": jnp.ones((d,), jnp.float32),
+            "w_gate": _normal(ks[4], (d, f), std),
+            "w_up": _normal(ks[5], (d, f), std),
+            "w_down": _normal(ks[6], (f, d), out_std),
+        }
+    if not config.tie_embeddings:
+        params["lm_head"] = _normal(keys[-1], (d, config.vocab_size), std)
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# LoRA
+# --------------------------------------------------------------------------- #
+
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(
+    key: jax.Array, config: GPTConfig, rank: int = 8, targets: Tuple[str, ...] = ("wq", "wv")
+) -> Params:
+    """LoRA adapter subtree mirroring blocks (parity: the reference's auto LoRA
+    config, core/base.py:2041). B is zero-init so the adapter starts as a no-op."""
+    d, hd = config.d_model, config.head_dim
+    dims = {
+        "wq": (d, config.n_head * hd),
+        "wk": (d, config.kv_heads * hd),
+        "wv": (d, config.kv_heads * hd),
+        "wo": (config.n_head * hd, d),
+        "w_gate": (d, config.ff_dim),
+        "w_up": (d, config.ff_dim),
+        "w_down": (config.ff_dim, d),
+    }
+    lora: Dict = {"blocks": {}}
+    for i in range(config.n_layer):
+        k = jax.random.fold_in(key, i)
+        layer = {}
+        for t in targets:
+            ka = jax.random.fold_in(k, hash(t) % (2**31))
+            din, dout = dims[t]
+            layer[t] = {
+                "A": _normal(ka, (din, rank), 0.02),
+                "B": jnp.zeros((rank, dout), jnp.float32),
+            }
+        lora["blocks"][str(i)] = layer
+    return lora
+
+
+def _maybe_lora(x, w, lora_layer, name, scale, dtype):
+    y = x @ w.astype(dtype)
+    if lora_layer is not None and name in lora_layer:
+        a = lora_layer[name]["A"].astype(dtype)
+        b = lora_layer[name]["B"].astype(dtype)
+        y = y + ((x @ a) @ b) * scale
+    return y
+
+
+def merge_lora(params: Params, lora: Params, scale: float = 2.0) -> Params:
+    """Fold the adapter into the base weights (used for export; training never
+    needs it — parity contrast: the reference must merge before every vLLM
+    weight swap, core/base.py:2772)."""
+    out = jax.tree_util.tree_map(lambda x: x, params)
+    for i, layer in lora["blocks"].items():
+        for t, ab in layer.items():
+            out["blocks"][i][t] = params["blocks"][i][t] + (ab["A"] @ ab["B"]) * scale
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Apply
+# --------------------------------------------------------------------------- #
+
+
+def _rms(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, T, H, hd]; positions: [B, T]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def forward(
+    config: GPTConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T]
+    attention_mask: Optional[jax.Array] = None,  # [B, T] 1=valid
+    positions: Optional[jax.Array] = None,  # [B, T]
+    cache: Optional[KVCache] = None,  # per-layer caches stacked: dict of layer->KVCache
+    lora: Optional[Params] = None,
+    lora_scale: float = 2.0,
+) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
+    """Returns (hidden [B, T, D] float32, new caches). With a cache, tokens are
+    appended at cache.length (all rows share a length — use left-padding for
+    ragged prompts so positions/masks do the aligning)."""
+    B, T = tokens.shape
+    dtype = config.dtype
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, T), jnp.int32)
+    if positions is None:
+        positions = jnp.cumsum(attention_mask, axis=-1) - 1
+        positions = jnp.maximum(positions, 0)
+
+    h = jnp.take(params["tok_emb"], tokens, axis=0).astype(dtype)
+
+    new_caches: Optional[Dict[str, KVCache]] = {} if cache is not None else None
+
+    def block_fn(h, blk, layer_cache, lora_layer):
+        x = _rms(h, blk["ln1"])
+        q = _maybe_lora(x, blk["wq"], lora_layer, "wq", lora_scale, dtype)
+        k = _maybe_lora(x, blk["wk"], lora_layer, "wk", lora_scale, dtype)
+        v = _maybe_lora(x, blk["wv"], lora_layer, "wv", lora_scale, dtype)
+        q = q.reshape(B, T, config.n_head, config.head_dim)
+        k = k.reshape(B, T, config.kv_heads, config.head_dim)
+        v = v.reshape(B, T, config.kv_heads, config.head_dim)
+        q = _rope(q, positions, config.rope_theta)
+        k = _rope(k, positions, config.rope_theta)
+
+        if layer_cache is not None:
+            start = layer_cache.length
+            ck = jax.lax.dynamic_update_slice(layer_cache.k, k, (0, start, 0, 0))
+            cv = jax.lax.dynamic_update_slice(layer_cache.v, v, (0, start, 0, 0))
+            cm = jax.lax.dynamic_update_slice(
+                layer_cache.mask, attention_mask.astype(jnp.int32), (0, start)
+            )
+            new_cache = KVCache(ck, cv, start + T, cm)
+            S = ck.shape[1]
+            k_all, v_all = ck, cv
+            kv_slot = jnp.arange(S)
+            # slot j visible to query t iff j <= start+t AND the slot is real
+            causal = kv_slot[None, None, :] <= (start + jnp.arange(T))[None, :, None]
+            mask = jnp.logical_and(causal, cm[:, None, :].astype(bool))
+        else:
+            new_cache = None
+            k_all, v_all = k, v
+            # causal within the block + padding mask
+            t_ids = jnp.arange(T)
+            mask = (t_ids[None, None, :] <= t_ids[None, :, None])  # [1, T, S=T]
+            mask = jnp.logical_and(mask, attention_mask[:, None, :].astype(bool))
+
+        # GQA: repeat kv heads
+        rep = config.n_head // config.kv_heads
+        if rep > 1:
+            k_all = jnp.repeat(k_all, rep, axis=2)
+            v_all = jnp.repeat(v_all, rep, axis=2)
+
+        # attention: [B, H, T, S]
+        qh = jnp.moveaxis(q, 2, 1)
+        kh = jnp.moveaxis(k_all, 2, 1)
+        vh = jnp.moveaxis(v_all, 2, 1)
+        scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
+        scores = scores / math.sqrt(config.head_dim)
+        scores = jnp.where(mask[:, None, :, :], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+        attn = jnp.moveaxis(attn, 1, 2).reshape(B, T, config.n_head * config.head_dim)
+        attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
+        h = h + attn
+
+        x = _rms(h, blk["ln2"])
+        gate = _maybe_lora(x, blk["w_gate"], lora_layer, "w_gate", lora_scale, dtype)
+        up = _maybe_lora(x, blk["w_up"], lora_layer, "w_up", lora_scale, dtype)
+        down = _maybe_lora(
+            jax.nn.silu(gate) * up, blk["w_down"], lora_layer, "w_down", lora_scale, dtype
+        )
+        return h + down, new_cache
+
+    for i in range(config.n_layer):
+        blk = params["blocks"][str(i)]
+        lora_layer = lora["blocks"].get(str(i)) if lora is not None else None
+        layer_cache = cache[str(i)] if cache is not None else None
+        fn = jax.checkpoint(block_fn, static_argnums=()) if config.remat else block_fn
+        h, new_cache = fn(h, blk, layer_cache, lora_layer)
+        if new_caches is not None:
+            new_caches[str(i)] = new_cache
+
+    h = _rms(h, params["ln_f"]).astype(jnp.float32)
+    return h, new_caches
+
+
+def logits_fn(config: GPTConfig, params: Params, hidden: jax.Array) -> jax.Array:
+    """hidden [B, T, D] -> logits [B, T, V] (float32)."""
+    head = params["tok_emb"].T if config.tie_embeddings else params["lm_head"]
+    return hidden @ head.astype(jnp.float32)
+
+
+def apply(
+    config: GPTConfig,
+    params: Params,
+    tokens: jax.Array,
+    **kw,
+) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
+    """Full forward to logits."""
+    hidden, caches = forward(config, params, tokens, **kw)
+    return logits_fn(config, params, hidden), caches
+
+
+def init_caches(config: GPTConfig, batch: int, max_len: Optional[int] = None) -> Dict[str, KVCache]:
+    return {str(i): init_kv_cache(config, batch, max_len) for i in range(config.n_layer)}
+
+
+# --------------------------------------------------------------------------- #
+# Chunked log-probs (parity: _get_logprobs / _memory_efficient_logits,
+# core/base.py:2670,2937 — row-chunked log-softmax to avoid materialising
+# [B, T, V] float32 logits; the Pallas fused kernel in ops/fused_loss.py goes
+# further and never materialises the chunk either)
+# --------------------------------------------------------------------------- #
+
+
+def token_logprobs(
+    config: GPTConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, T]
+    attention_mask: Optional[jax.Array] = None,
+    lora: Optional[Params] = None,
+    temperature: float = 1.0,
+    chunk_size: int = 128,
+) -> jax.Array:
+    """log p(tokens[:, t] | tokens[:, <t]) for t>=1, shape [B, T-1]."""
+    hidden, _ = forward(config, params, tokens, attention_mask=attention_mask, lora=lora)
+    hidden = hidden[:, :-1]  # predict next token
+    targets = tokens[:, 1:]
+    head = (params["tok_emb"].T if config.tie_embeddings else params["lm_head"]).astype(
+        jnp.float32
+    )
+
+    B, Tm1, D = hidden.shape
+    flat_h = hidden.reshape(-1, D)
+    flat_t = targets.reshape(-1)
+    n = flat_h.shape[0]
+    pad = (-n) % chunk_size
+    flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+    flat_t = jnp.pad(flat_t, (0, pad))
+    chunks_h = flat_h.reshape(-1, chunk_size, D)
+    chunks_t = flat_t.reshape(-1, chunk_size)
+
+    def one_chunk(carry, xs):
+        h, t = xs
+        logits = (h @ head) / temperature  # [chunk, V]
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        chosen = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0]
+        return carry, chosen - logz
+
+    _, lp = jax.lax.scan(one_chunk, None, (chunks_h, chunks_t))
+    return lp.reshape(-1)[:n].reshape(B, Tm1)

@@ -1,0 +1,153 @@
+"""LLM finetuning loops (parity: agilerl/training/train_llm.py —
+finetune_llm_reasoning:25 (GRPO over ReasoningGym; asserts arch/param/act
+mutation probs are 0 for LLMs :97-109), finetune_llm_preference:417 (DPO over
+PreferenceGym); per-epoch reference refresh; rank-0-decides evolution becomes
+replicated deterministic RNG — every host seeds the same tournament so no
+object broadcast is needed).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from agilerl_tpu.utils.utils import (
+    init_wandb,
+    print_hyperparams,
+    tournament_selection_and_mutation,
+)
+
+
+def _assert_llm_mutations(mutation) -> None:
+    """LLMs only mutate RL hyperparameters (parity: train_llm.py:97-109)."""
+    if mutation is None:
+        return
+    assert mutation.architecture_mut == 0, "architecture mutation must be 0 for LLMs"
+    assert mutation.parameters_mut == 0, "parameter mutation must be 0 for LLMs"
+    assert mutation.activation_mut == 0, "activation mutation must be 0 for LLMs"
+
+
+def finetune_llm_reasoning(
+    pop: List,
+    env,
+    INIT_HP: Optional[Dict] = None,
+    max_reward: Optional[float] = None,
+    wb: bool = False,
+    evaluation_interval: int = 10,
+    verbose: bool = True,
+    accelerator=None,
+    checkpoint_interval: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    max_steps: int = 200,
+    evo_steps: Optional[int] = None,
+    tournament=None,
+    mutation=None,
+    wandb_api_key: Optional[str] = None,
+    save_elite: bool = False,
+    elite_path: Optional[str] = None,
+) -> Tuple[List, List[List[float]]]:
+    """GRPO reasoning finetune (parity: train_llm.py:25)."""
+    _assert_llm_mutations(mutation)
+    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    pop_fitnesses: List[List[float]] = [[] for _ in pop]
+    start = time.time()
+
+    prompts = env.reset()
+    for step in range(1, max_steps + 1):
+        for agent in pop:
+            agent.set_reference_policy(env.num_epochs)
+            completions, completion_mask = agent.get_action(prompts)
+            ids, action_masks = env.assemble_learn_batch(completions, completion_mask)
+            next_prompts, rewards = env.step(completions, completion_mask)
+            loss, kl = agent.learn((ids, action_masks, rewards))
+            agent.steps[-1] += int(np.asarray(rewards).size)
+            if verbose:
+                print(
+                    f"[{step}] agent {agent.index} loss {loss:.4f} "
+                    f"reward {np.mean(rewards):.3f}"
+                )
+            if wandb_run is not None:
+                wandb_run.log({
+                    "train/loss": loss, "train/mean_reward": float(np.mean(rewards)),
+                    "agent": agent.index,
+                })
+            prompts = next_prompts
+
+        if step % evaluation_interval == 0:
+            fitnesses = [agent.test(env) for agent in pop]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            if verbose:
+                print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
+                print_hyperparams(pop)
+            if wandb_run is not None:
+                wandb_run.log({"eval/mean_fitness": float(np.mean(fitnesses))})
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, language_model=True,
+                    elite_path=elite_path, save_elite=save_elite,
+                )
+            if max_reward is not None and np.max(fitnesses) >= max_reward:
+                break
+        if checkpoint_interval is not None and checkpoint_path is not None:
+            if step % checkpoint_interval == 0:
+                for agent in pop:
+                    agent.save_checkpoint(f"{checkpoint_path}_{agent.index}.ckpt")
+
+    return pop, pop_fitnesses
+
+
+def finetune_llm_preference(
+    pop: List,
+    env,
+    INIT_HP: Optional[Dict] = None,
+    wb: bool = False,
+    evaluation_interval: int = 10,
+    verbose: bool = True,
+    accelerator=None,
+    checkpoint_interval: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    max_steps: int = 200,
+    tournament=None,
+    mutation=None,
+    wandb_api_key: Optional[str] = None,
+    save_elite: bool = False,
+    elite_path: Optional[str] = None,
+) -> Tuple[List, List[List[float]]]:
+    """DPO preference finetune (parity: train_llm.py:417)."""
+    _assert_llm_mutations(mutation)
+    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    pop_fitnesses: List[List[float]] = [[] for _ in pop]
+
+    for step in range(1, max_steps + 1):
+        batch = env.reset()
+        for agent in pop:
+            agent.set_reference_policy(env.num_epochs)
+            loss, acc = agent.learn(batch)
+            agent.steps[-1] += len(batch["chosen_ids"])
+            if verbose:
+                print(f"[{step}] agent {agent.index} dpo loss {loss:.4f} acc {acc:.3f}")
+            if wandb_run is not None:
+                wandb_run.log({"train/loss": loss, "train/acc": acc, "agent": agent.index})
+
+        if step % evaluation_interval == 0:
+            fitnesses = [agent.test(env) for agent in pop]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            if verbose:
+                print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
+            if wandb_run is not None:
+                wandb_run.log({"eval/mean_fitness": float(np.mean(fitnesses))})
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, language_model=True,
+                    elite_path=elite_path, save_elite=save_elite,
+                )
+        if checkpoint_interval is not None and checkpoint_path is not None:
+            if step % checkpoint_interval == 0:
+                for agent in pop:
+                    agent.save_checkpoint(f"{checkpoint_path}_{agent.index}.ckpt")
+
+    return pop, pop_fitnesses

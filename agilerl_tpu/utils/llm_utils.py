@@ -1,0 +1,213 @@
+"""HF-dataset-as-gym for LLM RL finetuning
+(parity: agilerl/utils/llm_utils.py — HuggingFaceGym:74, ReasoningGym:265,
+PreferenceGym:464, context-length filtering :227, distributed-aware batching).
+
+Tokenizer protocol: ``encode(str) -> List[int]``, ``decode(List[int]) -> str``,
+``pad_token_id``, ``eos_token_id`` — satisfied by HF tokenizers and by the
+in-tree CharTokenizer used in tests.
+
+Multi-host note: the reference uses torch DistributedSampler; here each host
+slices the dataset by ``jax.process_index()`` stride (same effect, no sampler
+object).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from agilerl_tpu.llm.generate import left_pad
+
+
+class CharTokenizer:
+    """Tiny char-level tokenizer for tests/demos. id 0 = pad, 1 = eos."""
+
+    def __init__(self, alphabet: str = "0123456789+-*=() abcdefghijklmnopqrstuvwxyz"):
+        self.pad_token_id = 0
+        self.eos_token_id = 1
+        self._c2i = {c: i + 2 for i, c in enumerate(alphabet)}
+        self._i2c = {i + 2: c for i, c in enumerate(alphabet)}
+        self.vocab_size = len(alphabet) + 2
+
+    def encode(self, text: str) -> List[int]:
+        return [self._c2i[c] for c in text if c in self._c2i]
+
+    def decode(self, ids) -> str:
+        return "".join(self._i2c.get(int(i), "") for i in ids)
+
+
+class HuggingFaceGym:
+    """Dataset -> gym base (parity: llm_utils.py:74)."""
+
+    def __init__(
+        self,
+        train_dataset,
+        test_dataset,
+        tokenizer,
+        data_batch_size: int = 8,
+        max_context_length: Optional[int] = None,
+        question_key: str = "question",
+        answer_key: str = "answer",
+        seed: int = 0,
+    ):
+        self.tokenizer = tokenizer
+        self.data_batch_size = int(data_batch_size)
+        self.max_context_length = max_context_length
+        self.question_key = question_key
+        self.answer_key = answer_key
+        self._rng = np.random.default_rng(seed + jax.process_index())
+        self.train_rows = self._filter(list(train_dataset))
+        self.test_rows = self._filter(list(test_dataset))
+        # multi-host sharding: each host sees a strided slice
+        if jax.process_count() > 1:
+            self.train_rows = self.train_rows[jax.process_index():: jax.process_count()]
+        self._epoch = 0
+        self._cursor = 0
+        self.num_epochs = 0
+
+    def _filter(self, rows: List[Dict]) -> List[Dict]:
+        """Context-length filtering (parity: llm_utils.py:227)."""
+        if self.max_context_length is None:
+            return rows
+        out = []
+        for r in rows:
+            if len(self.tokenizer.encode(str(r[self.question_key]))) <= self.max_context_length:
+                out.append(r)
+        return out
+
+    def _next_batch(self, eval_mode: bool = False) -> List[Dict]:
+        rows = self.test_rows if eval_mode else self.train_rows
+        if eval_mode:
+            return rows[: self.data_batch_size]
+        if self._cursor + self.data_batch_size > len(rows):
+            self._cursor = 0
+            self._epoch += 1
+            self.num_epochs = self._epoch
+            order = self._rng.permutation(len(rows))
+            self.train_rows = [rows[i] for i in order]
+            rows = self.train_rows
+        batch = rows[self._cursor : self._cursor + self.data_batch_size]
+        self._cursor += self.data_batch_size
+        return batch
+
+    def _tokenize_prompts(self, rows: List[Dict]) -> Dict[str, np.ndarray]:
+        seqs = [self.tokenizer.encode(str(r[self.question_key])) for r in rows]
+        ids, mask = left_pad(seqs, pad_id=self.tokenizer.pad_token_id,
+                             max_len=self.max_context_length)
+        return {"input_ids": ids, "attention_mask": mask}
+
+    def __len__(self):
+        return len(self.train_rows)
+
+
+class ReasoningGym(HuggingFaceGym):
+    """reset() -> tokenized prompt batch; step(completions) -> rewards
+    (parity: llm_utils.py:265)."""
+
+    def __init__(self, *args, reward_fn: Callable[[str, Any, str], float], **kwargs):
+        super().__init__(*args, **kwargs)
+        self.reward_fn = reward_fn
+        self._current: Optional[List[Dict]] = None
+        self._current_prompts = None
+
+    def reset(self, eval_mode: bool = False) -> Dict[str, np.ndarray]:
+        self._current = self._next_batch(eval_mode)
+        self._current_prompts = self._tokenize_prompts(self._current)
+        return self._current_prompts
+
+    def _rewards(self, completion_ids, completion_mask, group_size: int) -> np.ndarray:
+        rewards = []
+        for i, row in enumerate(self._current):
+            group = []
+            for g in range(group_size):
+                r = i * group_size + g
+                ids = np.asarray(completion_ids[r])
+                m = np.asarray(completion_mask[r]).astype(bool)
+                text = self.tokenizer.decode(ids[m])
+                group.append(
+                    float(self.reward_fn(text, row[self.answer_key], str(row[self.question_key])))
+                )
+            rewards.append(group)
+        return np.asarray(rewards, np.float32)
+
+    def step(
+        self, completion_ids, completion_mask
+    ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """completion_ids: [B*G, N]. Returns (next prompt batch, rewards [B, G])."""
+        group_size = completion_ids.shape[0] // len(self._current)
+        rewards = self._rewards(completion_ids, completion_mask, group_size)
+        next_prompts = self.reset()
+        return next_prompts, rewards
+
+    def step_eval(self, completion_ids, completion_mask):
+        rewards = self._rewards(completion_ids, completion_mask, 1)
+        return None, rewards.reshape(-1)
+
+    def assemble_learn_batch(self, completion_ids, completion_mask):
+        """Concatenate the last prompt batch with completions into full
+        sequences + action masks for GRPO.learn.
+
+        Returns (ids [B*G, P+N], action_masks [B*G, P+N-1])."""
+        prompts = self._current_prompts
+        B, P = prompts["input_ids"].shape
+        G = completion_ids.shape[0] // B
+        prompt_ids = np.repeat(prompts["input_ids"], G, axis=0)
+        ids = np.concatenate([prompt_ids, np.asarray(completion_ids)], axis=1)
+        N = completion_ids.shape[1]
+        action_mask = np.zeros((B * G, P + N - 1), np.float32)
+        action_mask[:, P - 1:] = np.asarray(completion_mask, np.float32)
+        return ids, action_mask
+
+
+class PreferenceGym(HuggingFaceGym):
+    """Preference-pair batches for DPO (parity: llm_utils.py:464). Dataset rows
+    need prompt/chosen/rejected keys."""
+
+    def __init__(
+        self,
+        *args,
+        prompt_key: str = "prompt",
+        chosen_key: str = "chosen",
+        rejected_key: str = "rejected",
+        max_completion_length: Optional[int] = None,
+        **kwargs,
+    ):
+        kwargs.setdefault("question_key", prompt_key)
+        super().__init__(*args, **kwargs)
+        self.prompt_key = prompt_key
+        self.chosen_key = chosen_key
+        self.rejected_key = rejected_key
+        self.max_completion_length = max_completion_length
+
+    def reset(self, eval_mode: bool = False) -> Dict[str, np.ndarray]:
+        rows = self._next_batch(eval_mode)
+        tok = self.tokenizer
+
+        def build(key):
+            seqs, masks = [], []
+            for r in rows:
+                p = tok.encode(str(r[self.prompt_key]))
+                c = tok.encode(str(r[key])) + [tok.eos_token_id]
+                if self.max_completion_length:
+                    c = c[: self.max_completion_length]
+                seqs.append(p + c)
+                masks.append(len(p))
+            ids, attn = left_pad(seqs, pad_id=tok.pad_token_id)
+            # prompt mask: 1 where token is part of the COMPLETION prediction
+            # targets (parity: create_prompt_masks, core/base.py:3087)
+            P = ids.shape[1]
+            loss_mask = np.zeros((len(rows), P - 1), np.float32)
+            for i, (seq, plen) in enumerate(zip(seqs, masks)):
+                total = len(seq)
+                start = P - total + plen  # left-pad offset + prompt length
+                loss_mask[i, max(start - 1, 0):] = 1.0
+            return ids, attn, loss_mask
+
+        c_ids, c_attn, c_lm = build(self.chosen_key)
+        r_ids, r_attn, r_lm = build(self.rejected_key)
+        return {
+            "chosen_ids": c_ids, "chosen_mask": c_attn, "chosen_loss_mask": c_lm,
+            "rejected_ids": r_ids, "rejected_mask": r_attn, "rejected_loss_mask": r_lm,
+        }

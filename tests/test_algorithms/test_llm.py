@@ -1,0 +1,157 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms.dpo import DPO
+from agilerl_tpu.algorithms.grpo import GRPO
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.utils.llm_utils import CharTokenizer, PreferenceGym, ReasoningGym
+
+TOK = CharTokenizer()
+CFG = M.GPTConfig(
+    vocab_size=TOK.vocab_size, n_layer=2, n_head=4, n_kv_head=2, d_model=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+
+
+def make_reasoning_dataset(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        a, b = rng.integers(0, 5, 2)
+        rows.append({"question": f"{a}+{b}=", "answer": str(a + b)})
+    return rows
+
+
+def reward_fn(completion: str, answer: str, prompt: str) -> float:
+    return 1.0 if completion.strip().startswith(answer) else 0.0
+
+
+def make_grpo(**kw):
+    defaults = dict(
+        config=CFG, pad_token_id=TOK.pad_token_id, eos_token_id=TOK.eos_token_id,
+        group_size=4, batch_size=8, max_output_tokens=4, lr=1e-3, seed=0,
+    )
+    defaults.update(kw)
+    return GRPO(**defaults)
+
+
+def make_gym(batch=4):
+    return ReasoningGym(
+        make_reasoning_dataset(24), make_reasoning_dataset(8, seed=1), TOK,
+        reward_fn=reward_fn, data_batch_size=batch,
+    )
+
+
+class TestGRPO:
+    def test_get_action_shapes(self):
+        agent = make_grpo()
+        env = make_gym()
+        prompts = env.reset()
+        comp, cmask = agent.get_action(prompts)
+        assert comp.shape == (4 * 4, 4)
+        assert cmask.shape == comp.shape
+
+    def test_advantage_zscore(self):
+        rewards = jnp.array([[1.0, 0.0, 1.0, 0.0], [2.0, 2.0, 2.0, 2.0]])
+        adv = GRPO._calculate_advantage(rewards)
+        assert adv.shape == (8,)
+        np.testing.assert_allclose(np.asarray(adv[4:]), 0.0, atol=1e-2)
+        assert adv[0] > 0 and adv[1] < 0
+
+    def test_learn_updates_only_lora(self):
+        agent = make_grpo()
+        env = make_gym()
+        prompts = env.reset()
+        comp, cmask = agent.get_action(prompts)
+        ids, action_masks = env.assemble_learn_batch(comp, cmask)
+        _, rewards = env.step(comp, cmask)
+        base_before = np.asarray(agent.base_params["blocks"]["0"]["wq"]).copy()
+        lora_before = np.asarray(agent.actor.params["blocks"]["0"]["wq"]["B"]).copy()
+        loss, _ = agent.learn((ids, action_masks, rewards))
+        assert np.isfinite(loss)
+        np.testing.assert_array_equal(
+            base_before, np.asarray(agent.base_params["blocks"]["0"]["wq"])
+        )
+        assert not np.array_equal(
+            lora_before, np.asarray(agent.actor.params["blocks"]["0"]["wq"]["B"])
+        )
+
+    def test_reference_refresh(self):
+        agent = make_grpo()
+        agent.actor.params = jax.tree_util.tree_map(
+            lambda x: x + 1.0, agent.actor.params
+        )
+        agent.set_reference_policy(0)
+        np.testing.assert_array_equal(
+            np.asarray(agent.reference.params["blocks"]["0"]["wq"]["A"]),
+            np.asarray(agent.actor.params["blocks"]["0"]["wq"]["A"]),
+        )
+        # same epoch -> no refresh
+        agent.actor.params = jax.tree_util.tree_map(lambda x: x + 1.0, agent.actor.params)
+        agent.set_reference_policy(0)
+        assert not np.array_equal(
+            np.asarray(agent.reference.params["blocks"]["0"]["wq"]["A"]),
+            np.asarray(agent.actor.params["blocks"]["0"]["wq"]["A"]),
+        )
+
+    def test_clone_shares_base(self):
+        agent = make_grpo()
+        clone = agent.clone(index=3)
+        assert clone.base_params is agent.base_params  # no base copy
+        np.testing.assert_array_equal(
+            np.asarray(clone.actor.params["blocks"]["0"]["wq"]["A"]),
+            np.asarray(agent.actor.params["blocks"]["0"]["wq"]["A"]),
+        )
+
+    def test_test_loop(self):
+        agent = make_grpo()
+        env = make_gym()
+        fitness = agent.test(env)
+        assert 0.0 <= fitness <= 1.0
+
+
+def make_pref_dataset(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        a = int(rng.integers(0, 5))
+        rows.append({
+            "prompt": f"{a}+1=", "chosen": str(a + 1), "rejected": str(a),
+        })
+    return rows
+
+
+class TestDPO:
+    def test_learn_and_accuracy_improves(self):
+        agent = DPO(
+            config=CFG, pad_token_id=TOK.pad_token_id, eos_token_id=TOK.eos_token_id,
+            lr=5e-3, beta=0.5, seed=0,
+        )
+        env = PreferenceGym(
+            make_pref_dataset(16), make_pref_dataset(8, seed=1), TOK, data_batch_size=8,
+        )
+        batch = env.reset()
+        losses = []
+        for _ in range(15):
+            loss, acc = agent.learn(batch)
+            losses.append(loss)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        fitness = agent.test(env)
+        assert fitness >= 0.5  # margin should be positive after training
+
+
+@pytest.mark.slow
+def test_finetune_llm_reasoning_e2e():
+    from agilerl_tpu.training.train_llm import finetune_llm_reasoning
+
+    pop = [make_grpo(seed=i) for i in range(2)]
+    for i, a in enumerate(pop):
+        a.index = i
+    env = make_gym()
+    pop, fitnesses = finetune_llm_reasoning(
+        pop, env, max_steps=4, evaluation_interval=2, verbose=False,
+    )
+    assert all(len(f) >= 1 for f in fitnesses)
