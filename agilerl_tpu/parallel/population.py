@@ -1,0 +1,302 @@
+"""Population parallelism: the whole evolutionary loop (rollout -> PPO update ->
+fitness -> tournament -> mutation) as ONE jitted SPMD program.
+
+This is the north-star redesign of the reference's population handling
+(SURVEY.md §2.8 "Population parallelism"): the reference keeps the full
+population on every rank and trains members sequentially with rank-0 deciding
+evolution + broadcast_object_list (agilerl/hpo/tournament.py:161). Here the
+population is a stacked pytree sharded one-member-per-device over a "pop" mesh
+axis (shard_map); fitnesses all-gather over ICI; every device computes the SAME
+tournament from a shared PRNG key (deterministic => no object broadcast); winner
+params move with one all-gather; parameter mutations apply locally.
+
+Works identically vmapped on one chip (the bench path) and shard_mapped over a
+pod — same member_iteration function.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agilerl_tpu.envs.core import JaxEnv, VecState, make_autoreset_step
+from agilerl_tpu.networks import distributions as D
+from agilerl_tpu.networks.base import EvolvableNetwork
+
+
+class MemberState(NamedTuple):
+    actor: Any
+    critic: Any
+    opt_state: Any
+    env_state: Any  # VecState
+    obs: jax.Array
+    key: jax.Array
+
+
+class EvoPPO:
+    """Fully-on-device evolutionary PPO over a JAX-native env."""
+
+    def __init__(
+        self,
+        env: JaxEnv,
+        actor_config,
+        critic_config,
+        dist_config,
+        tx,
+        num_envs: int = 64,
+        rollout_len: int = 32,
+        update_epochs: int = 2,
+        num_minibatches: int = 4,
+        gamma: float = 0.99,
+        gae_lambda: float = 0.95,
+        clip_coef: float = 0.2,
+        ent_coef: float = 0.01,
+        vf_coef: float = 0.5,
+        elitism: bool = True,
+        tournament_size: int = 2,
+        mutation_sd: float = 0.02,
+        mutation_prob: float = 0.5,
+    ):
+        self.env = env
+        self.actor_config = actor_config
+        self.critic_config = critic_config
+        self.dist_config = dist_config
+        self.tx = tx
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.update_epochs = update_epochs
+        self.num_minibatches = num_minibatches
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self.clip_coef = clip_coef
+        self.ent_coef = ent_coef
+        self.vf_coef = vf_coef
+        self.elitism = elitism
+        self.tournament_size = tournament_size
+        self.mutation_sd = mutation_sd
+        self.mutation_prob = mutation_prob
+        self._vec_step = make_autoreset_step(env)
+        self._reset = jax.vmap(env.reset_fn)
+
+    # ------------------------------------------------------------------ #
+    def init_member(self, key: jax.Array) -> MemberState:
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        actor = EvolvableNetwork.init_params(k1, self.actor_config)
+        extra = D.extra_params(self.dist_config)
+        if extra:
+            actor["dist"] = extra
+        critic = EvolvableNetwork.init_params(k2, self.critic_config)
+        opt_state = self.tx.init({"actor": actor, "critic": critic})
+        env_state, obs = self._reset(jax.random.split(k3, self.num_envs))
+        vstate = VecState(env_state, jnp.zeros(self.num_envs, jnp.int32), k4)
+        return MemberState(actor, critic, opt_state, vstate, obs, key)
+
+    def init_population(self, key: jax.Array, pop_size: int) -> MemberState:
+        return jax.vmap(self.init_member)(jax.random.split(key, pop_size))
+
+    # ------------------------------------------------------------------ #
+    def _rollout(self, state: MemberState):
+        """lax.scan rollout; returns trajectory + episode-return fitness."""
+
+        def body(carry, _):
+            vstate, obs, ep_ret, fitness_sum, fitness_n, key = carry
+            key, k_act = jax.random.split(key)
+            logits = EvolvableNetwork.apply(self.actor_config, state.actor, obs)
+            action = D.sample(self.dist_config, logits, k_act, state.actor.get("dist"))
+            logp = D.log_prob(self.dist_config, logits, action, state.actor.get("dist"))
+            value = EvolvableNetwork.apply(self.critic_config, state.critic, obs)[..., 0]
+            vstate, next_obs, reward, term, trunc = self._vec_step(vstate, action)
+            done = jnp.logical_or(term, trunc).astype(jnp.float32)
+            ep_ret = ep_ret + reward
+            fitness_sum = fitness_sum + jnp.sum(ep_ret * done)
+            fitness_n = fitness_n + jnp.sum(done)
+            ep_ret = ep_ret * (1.0 - done)
+            out = dict(obs=obs, action=action, logp=logp, value=value,
+                       reward=reward, done=done)
+            return (vstate, next_obs, ep_ret, fitness_sum, fitness_n, key), out
+
+        key, sub = jax.random.split(state.key)
+        init = (state.env_state, state.obs,
+                jnp.zeros(self.num_envs), jnp.float32(0.0), jnp.float32(0.0), sub)
+        (vstate, obs, _, fsum, fn, _), traj = jax.lax.scan(
+            body, init, None, length=self.rollout_len
+        )
+        fitness = jnp.where(fn > 0, fsum / jnp.maximum(fn, 1.0),
+                            jnp.mean(traj["reward"]) * self.env.max_episode_steps
+                            if self.env.max_episode_steps else jnp.mean(traj["reward"]))
+        return traj, vstate, obs, fitness, key
+
+    def _gae(self, traj, last_value):
+        def step(carry, xs):
+            gae, next_v, next_nt = carry
+            r, v, d = xs
+            delta = r + self.gamma * next_v * next_nt - v
+            gae = delta + self.gamma * self.gae_lambda * next_nt * gae
+            return (gae, v, 1.0 - d), gae
+
+        init = (jnp.zeros_like(last_value), last_value, jnp.ones_like(last_value))
+        _, adv = jax.lax.scan(
+            step, init,
+            (traj["reward"][::-1], traj["value"][::-1], traj["done"][::-1]),
+        )
+        adv = adv[::-1]
+        return adv, adv + traj["value"]
+
+    def _ppo_update(self, actor, critic, opt_state, traj, adv, ret, key):
+        T, N = traj["reward"].shape
+        total = T * N
+        mb = total // self.num_minibatches
+        flat = {
+            "obs": traj["obs"].reshape((total,) + traj["obs"].shape[2:]),
+            "action": traj["action"].reshape((total,) + traj["action"].shape[2:]),
+            "logp": traj["logp"].reshape(total),
+            "adv": adv.reshape(total),
+            "ret": ret.reshape(total),
+        }
+
+        def epoch(carry, k):
+            params, opt_state = carry
+            perm = jax.random.permutation(k, total)[: mb * self.num_minibatches]
+            batches = jax.tree_util.tree_map(
+                lambda x: x[perm].reshape((self.num_minibatches, mb) + x.shape[1:]), flat
+            )
+
+            def minibatch(carry, b):
+                params, opt_state = carry
+
+                def loss_fn(p):
+                    logits = EvolvableNetwork.apply(self.actor_config, p["actor"], b["obs"])
+                    extra = p["actor"].get("dist")
+                    new_logp = D.log_prob(self.dist_config, logits, b["action"], extra)
+                    ent = D.entropy(self.dist_config, logits, extra).mean()
+                    value = EvolvableNetwork.apply(
+                        self.critic_config, p["critic"], b["obs"]
+                    )[..., 0]
+                    a = (b["adv"] - b["adv"].mean()) / (b["adv"].std() + 1e-8)
+                    ratio = jnp.exp(new_logp - b["logp"])
+                    pg = jnp.maximum(
+                        -a * ratio,
+                        -a * jnp.clip(ratio, 1 - self.clip_coef, 1 + self.clip_coef),
+                    ).mean()
+                    v_loss = 0.5 * jnp.square(value - b["ret"]).mean()
+                    return pg - self.ent_coef * ent + self.vf_coef * v_loss
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state = self.tx.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(minibatch, (params, opt_state), batches)
+            return (params, opt_state), losses.mean()
+
+        params = {"actor": actor, "critic": critic}
+        keys = jax.random.split(key, self.update_epochs)
+        (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), keys)
+        return params["actor"], params["critic"], opt_state, losses.mean()
+
+    # ------------------------------------------------------------------ #
+    def member_iteration(self, state: MemberState) -> Tuple[MemberState, jax.Array]:
+        """One generation for one member: rollout -> GAE -> PPO epochs."""
+        traj, vstate, obs, fitness, key = self._rollout(state)
+        last_value = EvolvableNetwork.apply(self.critic_config, state.critic, obs)[..., 0]
+        adv, ret = self._gae(traj, last_value)
+        key, k_up = jax.random.split(key)
+        actor, critic, opt_state, _loss = self._ppo_update(
+            state.actor, state.critic, state.opt_state, traj, adv, ret, k_up
+        )
+        return MemberState(actor, critic, opt_state, vstate, obs, key), fitness
+
+    # ------------------------------------------------------------------ #
+    def evolve(self, pop: MemberState, fitness: jax.Array, key: jax.Array) -> MemberState:
+        """Deterministic tournament + parameter mutation as pure array ops.
+        pop leaves have leading pop axis; fitness [P]. Same key on every host
+        => same winners everywhere (replaces rank-0 + broadcast)."""
+        P_ = fitness.shape[0]
+        k_t, k_m = jax.random.split(key)
+        entrants = jax.random.randint(
+            k_t, (P_, self.tournament_size), 0, P_
+        )  # [P, k]
+        winners = entrants[jnp.arange(P_), jnp.argmax(fitness[entrants], axis=1)]
+        if self.elitism:
+            winners = winners.at[0].set(jnp.argmax(fitness))
+
+        def gather(x):
+            return x[winners]
+
+        new_actor = jax.tree_util.tree_map(gather, pop.actor)
+        new_critic = jax.tree_util.tree_map(gather, pop.critic)
+        new_opt = jax.tree_util.tree_map(gather, pop.opt_state)
+
+        # parameter mutation on a random subset of members (never the elite)
+        mutate_keys = jax.random.split(k_m, P_)
+
+        def mutate_member(params, k, do):
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            ks = jax.random.split(k, len(leaves))
+            out = [
+                l + do * self.mutation_sd * jax.random.normal(kk, l.shape)
+                for l, kk in zip(leaves, ks)
+            ]
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        do_mut = (
+            jax.random.uniform(k_m, (P_,)) < self.mutation_prob
+        ).astype(jnp.float32)
+        if self.elitism:
+            do_mut = do_mut.at[0].set(0.0)
+        new_actor = jax.vmap(mutate_member)(new_actor, mutate_keys, do_mut)
+        return MemberState(
+            new_actor, new_critic, new_opt, pop.env_state, pop.obs, pop.key
+        )
+
+    # ------------------------------------------------------------------ #
+    def make_vmap_generation(self) -> Callable:
+        """Single-device: vmapped members + on-device evolution, one jit."""
+
+        @jax.jit
+        def generation(pop: MemberState, key: jax.Array):
+            pop, fitness = jax.vmap(self.member_iteration)(pop)
+            pop = self.evolve(pop, fitness, key)
+            return pop, fitness
+
+        return generation
+
+    def make_pod_generation(self, mesh: Mesh) -> Callable:
+        """Pod-sharded: one member per device over the 'pop' axis; fitness and
+        winner-params all-gather over ICI inside shard_map."""
+        assert "pop" in mesh.axis_names
+
+        def gen(pop: MemberState, key: jax.Array):
+            # pop leaves sharded [P, ...] over "pop"
+            def per_device(pop_local, key):
+                state = jax.tree_util.tree_map(lambda x: x[0], pop_local)
+                state, fitness = self.member_iteration(state)
+                pop_local = jax.tree_util.tree_map(
+                    lambda x: x[None], state
+                )
+                fit_all = jax.lax.all_gather(fitness, "pop")  # [P]
+                # all-gather member params over ICI, evolve deterministically
+                gathered = jax.tree_util.tree_map(
+                    lambda x: jax.lax.all_gather(x[0], "pop"), pop_local
+                )
+                new_pop = self.evolve(gathered, fit_all, key)
+                my = jax.lax.axis_index("pop")
+                mine = jax.tree_util.tree_map(lambda x: x[my][None], new_pop)
+                return mine, fit_all
+
+            specs = P("pop")
+            return shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
+                out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
+                check_rep=False,
+            )(pop, key)
+
+        return jax.jit(gen)
