@@ -1,0 +1,132 @@
+"""Pallas flash attention for TPU (parity goal: replace vLLM paged/flash CUDA
+attention, SURVEY.md §2.9, for the in-tree generate/prefill path; long-sequence
+scaling across chips is ops/ring_attention.py).
+
+Blocked online-softmax attention: grid = (batch*heads, q blocks, kv blocks),
+kv innermost so the (m, l, acc) accumulators live in VMEM scratch across kv
+iterations. Causal masking by block index; [BQ, d] x [d, BK] matmuls on the MXU.
+On CPU the kernel runs in pallas interpret mode (tests); TPU compiles natively.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _make_kernel(scale: float, causal: bool, block_q: int, block_k: int, seq_len: int):
+    def kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref):
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def body():
+            q = q_ref[0]  # [BQ, d]
+            k = k_ref[0]  # [BK, d]
+            v = v_ref[0]
+            scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0
+            )
+            k_ids = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1
+            )
+            mask = k_ids < seq_len
+            if causal:
+                mask = jnp.logical_and(mask, k_ids <= q_ids)
+            scores = jnp.where(mask, scores, -1e30)
+
+            m_old = m_ref[:]
+            m_new = jnp.maximum(m_old, jnp.max(scores, axis=1, keepdims=True))
+            p = jnp.exp(scores - m_new)
+            alpha = jnp.exp(m_old - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
+            m_ref[:] = m_new
+
+        if causal:
+            # skip kv blocks entirely in the future of this q block
+            @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(kj == nk - 1)
+        def _finish():
+            out_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(out_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,  # [B, H, T, d]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, T, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    pad_t = (-T) % max(block_q, block_k)
+    if pad_t:
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+    else:
+        qp, kp, vp = q, k, v
+    Tp = T + pad_t
+    bh = B * H
+    qf = qp.reshape(bh, Tp, d)
+    kf = kp.reshape(bh, Tp, d)
+    vf = vp.reshape(bh, Tp, d)
+
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas tpu module unavailable")
+    grid = (bh, Tp // block_q, Tp // block_k)
+    out = pl.pallas_call(
+        _make_kernel(scale, causal, block_q, block_k, T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, Tp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tp, d)[:, :, :T, :]

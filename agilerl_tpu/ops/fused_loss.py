@@ -1,0 +1,123 @@
+"""Pallas fused chunked lm-head + log-softmax kernel — the Liger-kernel
+replacement (parity: liger Triton fused GRPO/DPO/CE losses used at
+agilerl/algorithms/grpo.py:558, dpo.py:409, and the chunked logprob path
+_memory_efficient_logits, core/base.py:2937).
+
+Computes per-token log p(target) WITHOUT materialising the [N, V] logits: the
+grid walks vocab chunks innermost, keeping an online (max, sum-exp,
+chosen-logit) accumulator in VMEM scratch; each chunk is one [BN, D] x [D, BV]
+matmul on the MXU.
+
+Forward-only by design: it accelerates the no-grad logprob passes (GRPO's
+old/reference logprobs are half the learn-step FLOPs); the differentiable path
+stays on the XLA-chunked implementation (llm/model.token_logprobs). On CPU the
+kernel runs in pallas interpret mode (how the tests exercise it); on TPU it
+compiles natively.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _make_kernel(vocab_size: int, inv_temp: float):
+    def kernel(hidden_ref, head_ref, target_ref, out_ref, m_ref, s_ref, c_ref):
+        j = pl.program_id(1)
+        nv = pl.num_programs(1)
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            s_ref[:] = jnp.zeros_like(s_ref)
+            c_ref[:] = jnp.zeros_like(c_ref)
+
+        h = hidden_ref[:]  # [BN, D]
+        w = head_ref[:]  # [D, BV]
+        logits = jnp.dot(h, w, preferred_element_type=jnp.float32) * inv_temp
+
+        bn, bv = logits.shape
+        cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+        valid = cols < vocab_size  # mask padded vocab columns
+        logits = jnp.where(valid, logits, -1e30)
+
+        targets = target_ref[:]  # [BN, 1]
+        hit = cols == targets
+        c_ref[:] = c_ref[:] + jnp.sum(
+            jnp.where(hit, logits, 0.0), axis=1, keepdims=True
+        )
+
+        m_old = m_ref[:]
+        m_new = jnp.maximum(m_old, jnp.max(logits, axis=1, keepdims=True))
+        s_ref[:] = s_ref[:] * jnp.exp(m_old - m_new) + jnp.sum(
+            jnp.exp(logits - m_new), axis=1, keepdims=True
+        )
+        m_ref[:] = m_new
+
+        @pl.when(j == nv - 1)
+        def _finish():
+            out_ref[:] = c_ref[:] - m_ref[:] - jnp.log(s_ref[:])
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("temperature", "block_n", "block_v", "interpret")
+)
+def fused_token_logprob(
+    hidden: jax.Array,  # [N, D]
+    head: jax.Array,  # [D, V]
+    targets: jax.Array,  # [N] int
+    temperature: float = 1.0,
+    block_n: int = 256,
+    block_v: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Per-row log softmax(hidden @ head / T)[target]. Returns [N] float32."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    N, D = hidden.shape
+    V = head.shape[1]
+    block_n = min(block_n, max(8, N))
+    block_v = min(block_v, V + (-V) % 128)
+    pad_n = (-N) % block_n
+    pad_v = (-V) % block_v
+    h = jnp.pad(hidden.astype(jnp.float32), ((0, pad_n), (0, 0)))
+    w = jnp.pad(head.astype(jnp.float32), ((0, 0), (0, pad_v)))
+    t = jnp.pad(targets.astype(jnp.int32), (0, pad_n))[:, None]
+
+    grid = (h.shape[0] // block_n, w.shape[1] // block_v)
+    if pltpu is None:  # pragma: no cover - CPU wheels without pltpu
+        raise RuntimeError("pallas tpu module unavailable")
+    scratch = [pltpu.VMEM((block_n, 1), jnp.float32) for _ in range(3)]
+
+    out = pl.pallas_call(
+        _make_kernel(V, 1.0 / temperature),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((h.shape[0], 1), jnp.float32),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(h, w, t)
+    return out[:N, 0]
+
+
+def reference_token_logprob(hidden, head, targets, temperature: float = 1.0):
+    """Dense reference for tests."""
+    logits = (hidden.astype(jnp.float32) @ head.astype(jnp.float32)) / temperature
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, targets[:, None].astype(jnp.int32), axis=1)[:, 0]

@@ -1,0 +1,119 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7: absent; long context
+is handled only by chunking + vLLM paged attention). This module goes beyond
+parity: sequences shard over a "sp" mesh axis, K/V blocks rotate around the ring
+via ppermute over ICI, and softmax is accumulated online (flash-style running
+max/denominator), so attention memory per chip is O(T/P * T/P) and sequence
+length scales linearly with ring size. (Liu et al., Ring Attention, 2023.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One q-block x kv-block partial attention.
+
+    q [B, Tq, H, d]; k/v [B, Tk, H, d]; mask [Tq, Tk] or None.
+    Returns (numerator [B, Tq, H, d], row max m [B, Tq, H], denom l [B, Tq, H])."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Tq, Tk]
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    m = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [B, H, Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, jnp.moveaxis(m, 1, 2), jnp.moveaxis(l, 1, 2)  # m,l -> [B, Tq, H]
+
+
+def ring_attention(
+    q: jax.Array,  # [B, T_local, H, d] — local sequence shard
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Call INSIDE shard_map with q/k/v sharded on the sequence axis."""
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+
+    t_ids = jnp.arange(T)
+    intra_mask = t_ids[:, None] >= t_ids[None, :]  # causal within a block
+
+    def step(carry, i):
+        k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        src_idx = (my_idx - i) % p_size  # which block this k/v shard came from
+
+        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, None, scale)
+        if causal:
+            # block-level causality: src block strictly after mine contributes
+            # nothing; same block uses the intra-block causal mask
+            o_diag, m_diag, l_diag = _block_attn(q, k_blk, v_blk, intra_mask, scale)
+            same = src_idx == my_idx
+            after = src_idx > my_idx
+            o_b = jnp.where(same, o_diag, o_b)
+            m_b = jnp.where(same, m_diag, m_b)
+            l_b = jnp.where(same, l_diag, l_b)
+            # mask out blocks from the future entirely
+            m_b = jnp.where(after, -1e30, m_b)
+            l_b = jnp.where(after, 0.0, l_b)
+            o_b = jnp.where(after, 0.0, o_b)
+
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = l_acc * alpha + l_b * beta
+        o_new = o_acc * alpha[..., None] + o_b * beta[..., None]
+
+        # rotate k/v around the ring
+        k_next = lax.ppermute(k_blk, axis_name,
+                              [(j, (j + 1) % p_size) for j in range(p_size)])
+        v_next = lax.ppermute(v_blk, axis_name,
+                              [(j, (j + 1) % p_size) for j in range(p_size)])
+        return (k_next, v_next, o_new, m_new, l_new), None
+
+    # derive accumulators from q so they carry the same varying-axis ("vma")
+    # type as the per-device loop outputs (new shard_map type system)
+    o0 = q * 0.0
+    m0 = jnp.sum(o0, axis=-1) - 1e30
+    l0 = jnp.sum(o0, axis=-1)
+    (k_f, v_f, o, m, l), _ = lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(p_size)
+    )
+    return o / jnp.maximum(l[..., None], 1e-30)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = True):
+    """Wrap ring_attention in shard_map: takes [B, T, H, d] arrays sharded on T."""
+
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    spec = P(None, axis_name, None, None)
+    return jax.jit(
+        shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+           
+        )
+    )
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    """Dense attention for correctness checks."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -296,7 +296,7 @@ class EvoPPO:
                 mesh=mesh,
                 in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
                 out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-                check_rep=False,
+               
             )(pop, key)
 
         return jax.jit(gen)

@@ -1,0 +1,75 @@
+"""Benchmark: evolutionary PPO population, fully on-device (BASELINE.md target:
+evo-PPO pop=64 at >=1M env-steps/sec aggregate).
+
+Runs the EvoPPO population program (rollout -> GAE -> PPO epochs -> tournament
+-> mutation, one jitted SPMD program) on JAX CartPole and reports aggregate
+env-steps/sec. Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import optax
+
+    from agilerl_tpu.envs import CartPole
+    from agilerl_tpu.modules.mlp import MLPConfig
+    from agilerl_tpu.networks import distributions as D
+    from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+    from agilerl_tpu.parallel.population import EvoPPO
+
+    pop_size = int(os.environ.get("BENCH_POP", 64))
+    num_envs = int(os.environ.get("BENCH_ENVS", 128))
+    rollout_len = int(os.environ.get("BENCH_ROLLOUT", 64))
+    generations = int(os.environ.get("BENCH_GENS", 5))
+
+    env = CartPole()
+    kind, enc = default_encoder_config(
+        env.observation_space, latent_dim=64, encoder_config={"hidden_size": (64,)}
+    )
+    actor_cfg = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=64, num_outputs=2, hidden_size=(64,)), latent_dim=64,
+    )
+    critic_cfg = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=64, num_outputs=1, hidden_size=(64,)), latent_dim=64,
+    )
+    dist_cfg = D.dist_config_from_space(env.action_space)
+    evo = EvoPPO(
+        env, actor_cfg, critic_cfg, dist_cfg, optax.adam(3e-4),
+        num_envs=num_envs, rollout_len=rollout_len, update_epochs=1, num_minibatches=4,
+    )
+    pop = evo.init_population(jax.random.PRNGKey(0), pop_size)
+    gen = evo.make_vmap_generation()
+
+    # compile + warmup
+    pop, fitness = gen(pop, jax.random.PRNGKey(1))
+    jax.block_until_ready(fitness)
+
+    t0 = time.perf_counter()
+    for i in range(generations):
+        pop, fitness = gen(pop, jax.random.PRNGKey(2 + i))
+    jax.block_until_ready(fitness)
+    dt = time.perf_counter() - t0
+
+    env_steps = pop_size * num_envs * rollout_len * generations
+    sps = env_steps / dt
+    baseline = 1_000_000.0  # BASELINE.md: >=1M env-steps/sec aggregate
+    print(json.dumps({
+        "metric": f"evo-PPO pop={pop_size} aggregate env-steps/sec (single chip)",
+        "value": round(sps),
+        "unit": "env-steps/sec",
+        "vs_baseline": round(sps / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.ops.flash_attention import flash_attention
+from agilerl_tpu.ops.fused_loss import fused_token_logprob, reference_token_logprob
+
+
+class TestFusedLoss:
+    def test_matches_dense(self):
+        key = jax.random.PRNGKey(0)
+        N, D, V = 64, 32, 500
+        hidden = jax.random.normal(key, (N, D))
+        head = 0.1 * jax.random.normal(jax.random.fold_in(key, 1), (D, V))
+        targets = jax.random.randint(jax.random.fold_in(key, 2), (N,), 0, V)
+        got = fused_token_logprob(hidden, head, targets, block_n=16, block_v=128)
+        want = reference_token_logprob(hidden, head, targets)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+    def test_temperature_and_padding(self):
+        key = jax.random.PRNGKey(3)
+        N, D, V = 33, 16, 130  # deliberately non-divisible
+        hidden = jax.random.normal(key, (N, D))
+        head = 0.2 * jax.random.normal(jax.random.fold_in(key, 1), (D, V))
+        targets = jax.random.randint(jax.random.fold_in(key, 2), (N,), 0, V)
+        got = fused_token_logprob(hidden, head, targets, temperature=1.7,
+                                  block_n=16, block_v=64)
+        want = reference_token_logprob(hidden, head, targets, temperature=1.7)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+class TestFlashAttention:
+    def _dense(self, q, k, v, causal):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            T = q.shape[2]
+            mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        key = jax.random.PRNGKey(0)
+        B, H, T, d = 2, 2, 64, 16
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+            for i in range(3)
+        )
+        got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        want = self._dense(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_ragged_length(self):
+        key = jax.random.PRNGKey(1)
+        B, H, T, d = 1, 2, 48, 16  # T not divisible by block
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+            for i in range(3)
+        )
+        got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        want = self._dense(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
