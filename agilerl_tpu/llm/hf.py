@@ -1,0 +1,138 @@
+"""HF checkpoint import: Llama/Qwen2-class torch weights -> (GPTConfig, params)
+(replaces the reference's HF AutoModel + PEFT loading path,
+agilerl/algorithms/core/base.py:2605 _initialize_actors; the GRPO benchmark
+workload Qwen2.5-0.5B-Instruct, benchmarking/benchmarking_grpo.py:25, loads
+through here).
+
+torch stays CPU-only and is touched exactly once at load time; everything after
+is jax. Gated import: environments without transformers still run everything
+else.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.llm.model import GPTConfig
+
+
+def config_from_hf(hf_config) -> GPTConfig:
+    """Map an HF LlamaConfig/Qwen2Config to GPTConfig."""
+    tie = bool(getattr(hf_config, "tie_word_embeddings", False))
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        n_kv_head=getattr(hf_config, "num_key_value_heads", None),
+        d_model=hf_config.hidden_size,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=min(getattr(hf_config, "max_position_embeddings", 4096), 8192),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        tie_embeddings=tie,
+        qkv_bias=bool(getattr(hf_config, "attention_bias", False))
+        or hf_config.model_type in ("qwen2",),
+        rms_eps=float(getattr(hf_config, "rms_norm_eps", 1e-6)),
+    )
+
+
+def _rotate_half_to_interleaved(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """HF RoPE uses the rotate-half layout (pairs (i, i+hd/2)); the in-tree
+    kernel uses interleaved pairs (2i, 2i+1). Permute projection output columns
+    so identical inputs produce identical attention. w: [..., n_heads*head_dim]
+    on the LAST axis."""
+    half = head_dim // 2
+    perm = np.empty(head_dim, np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    full = np.concatenate([perm + h * head_dim for h in range(n_heads)])
+    return w[..., full]
+
+
+def convert_hf_model(model, hf_cfg=None) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """Convert an in-memory HF Llama/Qwen2-class causal LM to (config, params)."""
+    import torch
+
+    hf_cfg = hf_cfg or model.config
+    config = config_from_hf(hf_cfg)
+    sd = model.state_dict()
+    hd = config.head_dim
+
+    def t2j(t) -> jnp.ndarray:
+        return jnp.asarray(t.detach().to(torch.float32).numpy())
+
+    def q_perm(arr, heads):
+        return jnp.asarray(_rotate_half_to_interleaved(np.asarray(arr), heads, hd))
+
+    params: Dict[str, Any] = {
+        "tok_emb": t2j(sd["model.embed_tokens.weight"]),
+        "blocks": {},
+        "ln_f": t2j(sd["model.norm.weight"]),
+    }
+    for i in range(config.n_layer):
+        p = f"model.layers.{i}."
+        blk = {
+            "ln1": t2j(sd[p + "input_layernorm.weight"]),
+            # torch Linear stores [out, in]; our kernels are [in, out]
+            "wq": q_perm(t2j(sd[p + "self_attn.q_proj.weight"]).T, config.n_head),
+            "wk": q_perm(t2j(sd[p + "self_attn.k_proj.weight"]).T, config.kv_heads),
+            "wv": t2j(sd[p + "self_attn.v_proj.weight"]).T,
+            "wo": t2j(sd[p + "self_attn.o_proj.weight"]).T,
+            "ln2": t2j(sd[p + "post_attention_layernorm.weight"]),
+            "w_gate": t2j(sd[p + "mlp.gate_proj.weight"]).T,
+            "w_up": t2j(sd[p + "mlp.up_proj.weight"]).T,
+            "w_down": t2j(sd[p + "mlp.down_proj.weight"]).T,
+        }
+        if config.qkv_bias:
+            blk["bq"] = q_perm(t2j(sd[p + "self_attn.q_proj.bias"]), config.n_head)
+            blk["bk"] = q_perm(t2j(sd[p + "self_attn.k_proj.bias"]), config.kv_heads)
+            blk["bv"] = t2j(sd[p + "self_attn.v_proj.bias"])
+        params["blocks"][str(i)] = blk
+    if not config.tie_embeddings:
+        params["lm_head"] = t2j(sd["lm_head.weight"]).T
+    del sd
+    return config, params
+
+
+def load_hf_model(
+    name_or_path: str, dtype=jnp.bfloat16
+) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """Load a pretrained Llama/Qwen2-class causal LM into the in-tree format."""
+    import torch
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(name_or_path)
+    model = AutoModelForCausalLM.from_pretrained(
+        name_or_path, torch_dtype=torch.float32, low_cpu_mem_usage=True
+    )
+    out = convert_hf_model(model, hf_cfg)
+    del model
+    return out
+
+
+def load_hf_tokenizer(name_or_path: str):
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name_or_path)
+    if tok.pad_token_id is None:
+        tok.pad_token = tok.eos_token
+    return tok
+
+
+def verify_against_hf(model, config, params, n_tokens: int = 8) -> float:
+    """Max |logit| deviation between the HF torch forward and the jax port — a
+    load-time sanity check for converted models."""
+    import dataclasses
+
+    import torch
+
+    from agilerl_tpu.llm.model import apply
+
+    ids = np.arange(1, n_tokens + 1)[None, :]
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits.to(torch.float32).numpy()
+    cfg32 = dataclasses.replace(config, dtype=jnp.float32)
+    got, _ = apply(cfg32, params, jnp.asarray(ids))
+    return float(np.max(np.abs(np.asarray(got) - ref)))

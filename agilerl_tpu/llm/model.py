@@ -37,6 +37,8 @@ class GPTConfig:
     max_seq_len: int = 1024
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
+    qkv_bias: bool = False  # Qwen2-style attention biases
+    rms_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
 
@@ -94,7 +96,7 @@ def init_params(key: jax.Array, config: GPTConfig) -> Params:
     }
     for i in range(config.n_layer):
         ks = jax.random.split(keys[i + 1], 7)
-        params["blocks"][str(i)] = {
+        blk = {
             "ln1": jnp.ones((d,), jnp.float32),
             "wq": _normal(ks[0], (d, nh * hd), std),
             "wk": _normal(ks[1], (d, nkv * hd), std),
@@ -105,6 +107,11 @@ def init_params(key: jax.Array, config: GPTConfig) -> Params:
             "w_up": _normal(ks[5], (d, f), std),
             "w_down": _normal(ks[6], (f, d), out_std),
         }
+        if config.qkv_bias:
+            blk["bq"] = jnp.zeros((nh * hd,), jnp.float32)
+            blk["bk"] = jnp.zeros((nkv * hd,), jnp.float32)
+            blk["bv"] = jnp.zeros((nkv * hd,), jnp.float32)
+        params["blocks"][str(i)] = blk
     if not config.tie_embeddings:
         params["lm_head"] = _normal(keys[-1], (d, config.vocab_size), std)
     return params
@@ -215,10 +222,14 @@ def forward(
     new_caches: Optional[Dict[str, KVCache]] = {} if cache is not None else None
 
     def block_fn(h, blk, layer_cache, lora_layer):
-        x = _rms(h, blk["ln1"])
+        x = _rms(h, blk["ln1"], config.rms_eps)
         q = _maybe_lora(x, blk["wq"], lora_layer, "wq", lora_scale, dtype)
         k = _maybe_lora(x, blk["wk"], lora_layer, "wk", lora_scale, dtype)
         v = _maybe_lora(x, blk["wv"], lora_layer, "wv", lora_scale, dtype)
+        if config.qkv_bias:
+            q = q + blk["bq"].astype(dtype)
+            k = k + blk["bk"].astype(dtype)
+            v = v + blk["bv"].astype(dtype)
         q = q.reshape(B, T, config.n_head, config.head_dim)
         k = k.reshape(B, T, config.kv_heads, config.head_dim)
         v = v.reshape(B, T, config.kv_heads, config.head_dim)
@@ -266,7 +277,7 @@ def forward(
         attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
         h = h + attn
 
-        x = _rms(h, blk["ln2"])
+        x = _rms(h, blk["ln2"], config.rms_eps)
         gate = _maybe_lora(x, blk["w_gate"], lora_layer, "w_gate", lora_scale, dtype)
         up = _maybe_lora(x, blk["w_up"], lora_layer, "w_up", lora_scale, dtype)
         down = _maybe_lora(
@@ -283,7 +294,7 @@ def forward(
         if new_caches is not None:
             new_caches[str(i)] = new_cache
 
-    h = _rms(h, params["ln_f"]).astype(jnp.float32)
+    h = _rms(h, params["ln_f"], config.rms_eps).astype(jnp.float32)
     return h, new_caches
 
 

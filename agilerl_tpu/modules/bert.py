@@ -1,0 +1,227 @@
+"""EvolvableBERT — evolvable encoder-decoder transformer
+(parity: agilerl/modules/bert.py — EvolvableBERT:12 with layer/node mutations
+:512-530,536,582).
+
+Compact pre-norm encoder-decoder: bidirectional encoder self-attention, causal
+decoder self-attention + cross-attention, GELU MLPs. Blocks are name-keyed so
+layer mutations preserve weights; node mutations morph d_model slab-wise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.modules import layers as L
+from agilerl_tpu.modules.base import EvolvableModule, mutation
+from agilerl_tpu.typing import MutationType
+
+
+@dataclasses.dataclass(frozen=True)
+class BERTConfig:
+    vocab_size: int
+    n_encoder_layers: int = 2
+    n_decoder_layers: int = 2
+    n_head: int = 4
+    d_model: int = 128
+    d_ff: Optional[int] = None
+    max_seq_len: int = 256
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+
+def _attn_init(key, d, n_head):
+    ks = jax.random.split(key, 4)
+    std = 0.02
+    return {
+        "wq": std * jax.random.normal(ks[0], (d, d)),
+        "wk": std * jax.random.normal(ks[1], (d, d)),
+        "wv": std * jax.random.normal(ks[2], (d, d)),
+        "wo": std * jax.random.normal(ks[3], (d, d)),
+    }
+
+
+def _attn(params, q_in, kv_in, n_head, mask=None):
+    B, Tq, D = q_in.shape
+    Tk = kv_in.shape[1]
+    hd = D // n_head
+    q = (q_in @ params["wq"]).reshape(B, Tq, n_head, hd)
+    k = (kv_in @ params["wk"]).reshape(B, Tk, n_head, hd)
+    v = (kv_in @ params["wv"]).reshape(B, Tk, n_head, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, Tq, D)
+    return out @ params["wo"]
+
+
+def _mlp_init(key, d, ff):
+    k1, k2 = jax.random.split(key)
+    return {"fc1": L.dense_init(k1, d, ff), "fc2": L.dense_init(k2, ff, d)}
+
+
+def _mlp(params, x):
+    return L.dense_apply(params["fc2"], jax.nn.gelu(L.dense_apply(params["fc1"], x)))
+
+
+class EvolvableBERT(EvolvableModule):
+    Config = BERTConfig
+
+    def __init__(
+        self,
+        vocab_size: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[BERTConfig] = None,
+        min_layers: int = 1,
+        max_layers: int = 8,
+        **kwargs,
+    ):
+        if config is None:
+            config = BERTConfig(vocab_size=vocab_size, **kwargs)
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self.min_layers = min_layers
+        self.max_layers = max_layers
+        super().__init__(config, key)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: BERTConfig) -> Dict:
+        d, ff = config.d_model, config.ff_dim
+        keys = jax.random.split(key, 4 + 2 * (config.n_encoder_layers + config.n_decoder_layers))
+        params: Dict = {
+            "tok_emb": 0.02 * jax.random.normal(keys[0], (config.vocab_size, d)),
+            "pos_emb": 0.02 * jax.random.normal(keys[1], (config.max_seq_len, d)),
+            "encoder": {},
+            "decoder": {},
+            "ln_f": L.layer_norm_init(d),
+            "lm_head": 0.02 * jax.random.normal(keys[2], (d, config.vocab_size)),
+        }
+        ki = 3
+        for i in range(config.n_encoder_layers):
+            params["encoder"][str(i)] = {
+                "ln1": L.layer_norm_init(d),
+                "attn": _attn_init(keys[ki], d, config.n_head),
+                "ln2": L.layer_norm_init(d),
+                "mlp": _mlp_init(keys[ki + 1], d, ff),
+            }
+            ki += 2
+        for i in range(config.n_decoder_layers):
+            k_extra = jax.random.fold_in(keys[ki], 7)
+            params["decoder"][str(i)] = {
+                "ln1": L.layer_norm_init(d),
+                "self_attn": _attn_init(keys[ki], d, config.n_head),
+                "ln_x": L.layer_norm_init(d),
+                "cross_attn": _attn_init(k_extra, d, config.n_head),
+                "ln2": L.layer_norm_init(d),
+                "mlp": _mlp_init(keys[ki + 1], d, ff),
+            }
+            ki += 2
+        return params
+
+    @staticmethod
+    def encode(config: BERTConfig, params: Dict, src: jax.Array,
+               src_mask: Optional[jax.Array] = None) -> jax.Array:
+        B, T = src.shape
+        h = jnp.take(params["tok_emb"], src, axis=0) + params["pos_emb"][None, :T]
+        mask = None
+        if src_mask is not None:
+            mask = src_mask[:, None, None, :].astype(bool)
+        for i in range(config.n_encoder_layers):
+            blk = params["encoder"][str(i)]
+            x = L.layer_norm_apply(blk["ln1"], h)
+            h = h + _attn(blk["attn"], x, x, config.n_head, mask)
+            h = h + _mlp(blk["mlp"], L.layer_norm_apply(blk["ln2"], h))
+        return h
+
+    @staticmethod
+    def apply(
+        config: BERTConfig,
+        params: Dict,
+        src: jax.Array,
+        tgt: Optional[jax.Array] = None,
+        src_mask: Optional[jax.Array] = None,
+        **_,
+    ) -> jax.Array:
+        """Encoder-decoder forward: returns decoder logits [B, Tt, V]
+        (tgt=None -> encode only, returns encoder states)."""
+        enc = EvolvableBERT.encode(config, params, src, src_mask)
+        if tgt is None:
+            return enc
+        B, Tt = tgt.shape
+        h = jnp.take(params["tok_emb"], tgt, axis=0) + params["pos_emb"][None, :Tt]
+        causal = (jnp.arange(Tt)[:, None] >= jnp.arange(Tt)[None, :])[None, None]
+        cross_mask = None
+        if src_mask is not None:
+            cross_mask = src_mask[:, None, None, :].astype(bool)
+        for i in range(config.n_decoder_layers):
+            blk = params["decoder"][str(i)]
+            x = L.layer_norm_apply(blk["ln1"], h)
+            h = h + _attn(blk["self_attn"], x, x, config.n_head, causal)
+            x = L.layer_norm_apply(blk["ln_x"], h)
+            h = h + _attn(blk["cross_attn"], x, enc, config.n_head, cross_mask)
+            h = h + _mlp(blk["mlp"], L.layer_norm_apply(blk["ln2"], h))
+        h = L.layer_norm_apply(params["ln_f"], h)
+        return h @ params["lm_head"]
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if bool(rng.integers(0, 2)) and cfg.n_encoder_layers < self.max_layers:
+            self._morph(dataclasses.replace(cfg, n_encoder_layers=cfg.n_encoder_layers + 1))
+            return {"stack": "encoder"}
+        if cfg.n_decoder_layers < self.max_layers:
+            self._morph(dataclasses.replace(cfg, n_decoder_layers=cfg.n_decoder_layers + 1))
+            return {"stack": "decoder"}
+        return self.add_node(rng=rng)
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if bool(rng.integers(0, 2)) and cfg.n_encoder_layers > self.min_layers:
+            self._morph(dataclasses.replace(cfg, n_encoder_layers=cfg.n_encoder_layers - 1))
+            return {"stack": "encoder"}
+        if cfg.n_decoder_layers > self.min_layers:
+            self._morph(dataclasses.replace(cfg, n_decoder_layers=cfg.n_decoder_layers - 1))
+            return {"stack": "decoder"}
+        return self.add_node(rng=rng)
+
+    @mutation(MutationType.NODE)
+    def add_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if numb_new_nodes is None:
+            numb_new_nodes = cfg.n_head * int(rng.choice([4, 8]))
+        new_d = min(cfg.d_model + numb_new_nodes, 1024)
+        new_d -= new_d % cfg.n_head
+        self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
+        return {"numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if numb_new_nodes is None:
+            numb_new_nodes = cfg.n_head * int(rng.choice([4, 8]))
+        new_d = max(cfg.d_model - numb_new_nodes, 64)
+        new_d -= new_d % cfg.n_head
+        self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
+        return {"numb_new_nodes": numb_new_nodes}

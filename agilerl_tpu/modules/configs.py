@@ -1,0 +1,65 @@
+"""Net-config dataclasses with dict/YAML loading (parity: agilerl/modules/configs.py
+— MlpNetConfig:56, SimBaNetConfig:87, CnnNetConfig:114, LstmNetConfig:131,
+MultiInputNetConfig:143).
+
+In this framework the per-module architecture configs live next to their modules
+(MLPConfig in modules/mlp.py, etc.). This module provides the reference-style
+*user-facing* net-config layer: named dataclass aliases plus YAML/dict loaders
+that produce the ``net_config`` kwargs accepted by every algorithm
+(latent_dim / encoder_config / head_config / simba / recurrent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+from agilerl_tpu.modules.cnn import CNNConfig as CnnNetConfig  # noqa: F401
+from agilerl_tpu.modules.lstm import LSTMConfig as LstmNetConfig  # noqa: F401
+from agilerl_tpu.modules.mlp import MLPConfig as MlpNetConfig  # noqa: F401
+from agilerl_tpu.modules.multi_input import (  # noqa: F401
+    MultiInputConfig as MultiInputNetConfig,
+)
+from agilerl_tpu.modules.simba import SimBaConfig as SimBaNetConfig  # noqa: F401
+
+_KNOWN_KEYS = {
+    "latent_dim", "encoder_config", "head_config", "simba", "recurrent",
+    "min_latent_dim", "max_latent_dim",
+}
+
+
+def load_net_config(source: Union[str, Path, Dict[str, Any], None]) -> Dict[str, Any]:
+    """Load a net_config dict from YAML path or dict, normalising keys.
+
+    Accepts the reference's YAML shape (e.g. {"latent_dim": 64,
+    "encoder_config": {"hidden_size": [64, 64]}}) and converts lists to the
+    tuples the frozen config dataclasses require."""
+    if source is None:
+        return {}
+    if isinstance(source, (str, Path)):
+        import yaml
+
+        with open(source) as f:
+            source = yaml.safe_load(f) or {}
+    out: Dict[str, Any] = {}
+    for k, v in source.items():
+        key = k.lower()
+        if key not in _KNOWN_KEYS:
+            continue
+        if isinstance(v, dict):
+            v = {
+                sk: tuple(sv) if isinstance(sv, list) else sv for sk, sv in v.items()
+            }
+        out[key] = v
+    return out
+
+
+def load_yaml_config(path: Union[str, Path]) -> Dict[str, Any]:
+    """Load a full training YAML (INIT_HP / MUTATION_PARAMS / NET_CONFIG
+    sections, parity with configs/training/*.yaml in the reference)."""
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    return cfg

@@ -1,0 +1,66 @@
+"""Custom layer components (parity: agilerl/modules/custom_components.py —
+GumbelSoftmax:10, NoisyLinear:38, NewGELU:134, ResidualBlock:152,
+SimbaResidualBlock:224).
+
+All are pure functions over dict params (the framework's layer idiom); the
+torch-module forms of the reference map to init/apply pairs here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.algorithms.maddpg import gumbel_softmax as GumbelSoftmax  # noqa: F401
+from agilerl_tpu.modules.layers import (  # noqa: F401
+    conv2d_apply,
+    conv2d_init,
+    dense_apply,
+    dense_init,
+    layer_norm_apply,
+    layer_norm_init,
+    noisy_dense_apply as NoisyLinear_apply,
+    noisy_dense_init as NoisyLinear_init,
+)
+
+
+def NewGELU(x: jax.Array) -> jax.Array:
+    """tanh-approx GELU (parity: custom_components.py:134)."""
+    return (
+        0.5 * x * (1.0 + jnp.tanh(jnp.sqrt(2.0 / jnp.pi) * (x + 0.044715 * x**3)))
+    )
+
+
+def residual_block_init(key: jax.Array, channels: int, kernel: int = 3) -> Dict:
+    """Image residual block params (parity: ResidualBlock:152)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "conv1": conv2d_init(k1, kernel, kernel, channels, channels),
+        "norm1": layer_norm_init(channels),
+        "conv2": conv2d_init(k2, kernel, kernel, channels, channels),
+        "norm2": layer_norm_init(channels),
+    }
+
+
+def residual_block_apply(params: Dict, x: jax.Array) -> jax.Array:
+    h = jax.nn.relu(layer_norm_apply(params["norm1"], conv2d_apply(params["conv1"], x, 1, "SAME")))
+    h = layer_norm_apply(params["norm2"], conv2d_apply(params["conv2"], h, 1, "SAME"))
+    return jax.nn.relu(x + h)
+
+
+def simba_residual_block_init(key: jax.Array, hidden: int, scale: int = 4) -> Dict:
+    """SimBa residual MLP block (parity: SimbaResidualBlock:224)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "norm": layer_norm_init(hidden),
+        "fc1": dense_init(k1, hidden, hidden * scale),
+        "fc2": dense_init(k2, hidden * scale, hidden),
+    }
+
+
+def simba_residual_block_apply(params: Dict, x: jax.Array) -> jax.Array:
+    h = layer_norm_apply(params["norm"], x)
+    h = jax.nn.relu(dense_apply(params["fc1"], h))
+    return x + dense_apply(params["fc2"], h)

@@ -1,0 +1,45 @@
+"""DummyEvolvable (parity: agilerl/modules/dummy.py — DummyEvolvable:19): wraps
+an arbitrary (config, init_fn, apply_fn) triple into the EvolvableModule
+interface with NO mutation methods, so non-evolvable nets (e.g. frozen
+pretrained encoders) slot into algorithms unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from agilerl_tpu.modules.base import EvolvableModule
+
+
+class DummyEvolvable(EvolvableModule):
+    def __init__(
+        self,
+        init_fn: Callable[[jax.Array], Any],
+        apply_fn: Callable[..., Any],
+        config: Any = None,
+        key: Optional[jax.Array] = None,
+    ):
+        self._init_fn = init_fn
+        self._apply_fn = apply_fn
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        super().__init__(config, key)
+
+    def init_params(self, key, config):  # type: ignore[override]
+        return self._init_fn(key)
+
+    def apply(self, config, params, x, **kw):  # type: ignore[override]
+        return self._apply_fn(params, x, **kw)
+
+    def __call__(self, x, **kw):
+        return self._apply_fn(self.params, x, **kw)
+
+    @classmethod
+    def get_mutation_methods(cls):
+        return {}
+
+    def sample_mutation_method(self, new_layer_prob=0.2, rng=None):
+        raise ValueError("DummyEvolvable has no mutation methods")

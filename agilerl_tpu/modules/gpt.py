@@ -1,0 +1,105 @@
+"""EvolvableGPT (parity: agilerl/modules/gpt.py — EvolvableGPT:16 with
+layer/node mutations :592-617, KV-cache generation, estimate_mfu:516;
+CausalSelfAttention:679/Block:814 live in llm/model.py as pure functions).
+
+The evolvable wrapper over the Llama-class transformer in llm/model.py: a layer
+mutation adds/removes a block (blocks are name-keyed so weight preservation is
+pytree surgery); a node mutation grows/shrinks d_model in head-divisible chunks
+with slab-wise weight transfer. The reference's from_pretrained GPT-2 import is
+replaced by llm/hf.py's HF weight conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.modules.base import EvolvableModule, mutation
+from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.profiling import estimate_mfu as _estimate_mfu
+
+
+class EvolvableGPT(EvolvableModule):
+    Config = M.GPTConfig
+
+    def __init__(
+        self,
+        vocab_size: Optional[int] = None,
+        key: Optional[jax.Array] = None,
+        config: Optional[M.GPTConfig] = None,
+        min_layers: int = 1,
+        max_layers: int = 12,
+        min_d_model: int = 64,
+        max_d_model: int = 1024,
+        **kwargs,
+    ):
+        if config is None:
+            config = M.GPTConfig(vocab_size=vocab_size, **kwargs)
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self.min_layers = min_layers
+        self.max_layers = max_layers
+        self.min_d_model = min_d_model
+        self.max_d_model = max_d_model
+        super().__init__(config, key)
+
+    @staticmethod
+    def init_params(key: jax.Array, config: M.GPTConfig) -> Dict:
+        return M.init_params(key, config)
+
+    @staticmethod
+    def apply(config: M.GPTConfig, params: Dict, tokens: jax.Array, **kw):
+        logits, caches = M.apply(config, params, tokens, **kw)
+        return logits if caches is None else (logits, caches)
+
+    def estimate_mfu(self, tokens_per_step: int, dt: float) -> float:
+        """Model FLOPs utilisation (parity: gpt.py:516)."""
+        return _estimate_mfu(self.config, tokens_per_step, dt)
+
+    # -- mutations ------------------------------------------------------ #
+    @mutation(MutationType.LAYER)
+    def add_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.n_layer >= self.max_layers:
+            return self.add_node(rng=rng)
+        self._morph(dataclasses.replace(cfg, n_layer=cfg.n_layer + 1))
+        return {}
+
+    @mutation(MutationType.LAYER, shrink_params=True)
+    def remove_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.n_layer <= self.min_layers:
+            return self.add_node(rng=rng)
+        self._morph(dataclasses.replace(cfg, n_layer=cfg.n_layer - 1))
+        return {}
+
+    @mutation(MutationType.NODE)
+    def add_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if numb_new_nodes is None:
+            numb_new_nodes = cfg.n_head * int(rng.choice([4, 8, 16]))
+        new_d = min(cfg.d_model + numb_new_nodes, self.max_d_model)
+        new_d -= new_d % cfg.n_head  # head_dim stays integral
+        self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
+        return {"numb_new_nodes": numb_new_nodes}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_node(
+        self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
+    ) -> Dict:
+        rng = rng or np.random.default_rng()
+        cfg = self.config
+        if numb_new_nodes is None:
+            numb_new_nodes = cfg.n_head * int(rng.choice([4, 8, 16]))
+        new_d = max(cfg.d_model - numb_new_nodes, self.min_d_model)
+        new_d -= new_d % cfg.n_head
+        self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
+        return {"numb_new_nodes": numb_new_nodes}

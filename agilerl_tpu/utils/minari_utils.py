@@ -1,0 +1,102 @@
+"""Offline-dataset ingestion (parity: agilerl/utils/minari_utils.py —
+Minari dataset -> buffer/h5 :74,111; bundled h5 sets in data/cartpole,
+data/pendulum).
+
+Minari is not in this image, so the loaders gate on import; the h5 path (the
+format the reference ships its offline data in) is fully supported via h5py,
+plus a generator to produce offline datasets from any trained agent.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+
+
+def load_h5_dataset(path: Union[str, Path]) -> Dict[str, np.ndarray]:
+    """Load an offline dataset with observations/actions/rewards/
+    next_observations/terminals arrays (the reference's h5 schema)."""
+    import h5py
+
+    out: Dict[str, np.ndarray] = {}
+    with h5py.File(path, "r") as f:
+        for key in ("observations", "actions", "rewards", "next_observations", "terminals"):
+            if key in f:
+                out[key] = np.asarray(f[key])
+    if "next_observations" not in out and "observations" in out:
+        obs = out["observations"]
+        out["next_observations"] = np.concatenate([obs[1:], obs[-1:]], axis=0)
+    return out
+
+
+def save_h5_dataset(path: Union[str, Path], dataset: Dict[str, np.ndarray]) -> None:
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        for k, v in dataset.items():
+            f.create_dataset(k, data=np.asarray(v))
+
+
+def minari_to_agile_dataset(dataset_id: str, **kwargs) -> Dict[str, np.ndarray]:
+    """Convert a Minari dataset (parity: minari_utils.py:74). Gated: raises a
+    clear error when minari isn't installed."""
+    try:
+        import minari  # type: ignore
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "minari is not installed in this environment; load offline data "
+            "with load_h5_dataset or generate it with collect_offline_dataset"
+        ) from e
+    ds = minari.load_dataset(dataset_id)
+    obs, act, rew, next_obs, term = [], [], [], [], []
+    for ep in ds.iterate_episodes():
+        obs.append(ep.observations[:-1])
+        next_obs.append(ep.observations[1:])
+        act.append(ep.actions)
+        rew.append(ep.rewards)
+        term.append(ep.terminations)
+    return {
+        "observations": np.concatenate(obs),
+        "actions": np.concatenate(act),
+        "rewards": np.concatenate(rew),
+        "next_observations": np.concatenate(next_obs),
+        "terminals": np.concatenate(term).astype(np.float32),
+    }
+
+
+def collect_offline_dataset(
+    env, agent=None, steps: int = 10_000, epsilon: float = 0.3, seed: int = 0
+) -> Dict[str, np.ndarray]:
+    """Roll a (possibly epsilon-random) policy to build an offline dataset —
+    replaces the reference's bundled h5 files with on-demand generation."""
+    rng = np.random.default_rng(seed)
+    num_envs = getattr(env, "num_envs", 1)
+    obs_l, act_l, rew_l, next_l, term_l = [], [], [], [], []
+    obs, _ = env.reset(seed=seed)
+    for _ in range(steps // num_envs):
+        if agent is not None and rng.random() > epsilon:
+            action = np.asarray(agent.get_action(obs, training=False))
+        else:
+            sp = getattr(env, "single_action_space", env.action_space)
+            if hasattr(sp, "n"):
+                action = rng.integers(0, sp.n, size=num_envs)
+            else:
+                action = rng.uniform(sp.low, sp.high, size=(num_envs,) + sp.shape).astype(
+                    np.float32
+                )
+        next_obs, reward, terminated, truncated, _ = env.step(action)
+        obs_l.append(obs)
+        act_l.append(action)
+        rew_l.append(reward)
+        next_l.append(next_obs)
+        term_l.append(np.asarray(terminated, np.float32))
+        obs = next_obs
+    return {
+        "observations": np.concatenate(obs_l),
+        "actions": np.concatenate(act_l),
+        "rewards": np.concatenate(rew_l).astype(np.float32),
+        "next_observations": np.concatenate(next_l),
+        "terminals": np.concatenate(term_l),
+    }

@@ -1,0 +1,89 @@
+"""Tracing / profiling / MFU accounting — first-class on TPU
+(parity+: the reference has NO in-library tracer, SURVEY.md §5.1 — profiling is
+demonstrated via external cProfile/torch.profiler scripts and the only MFU
+accounting is EvolvableGPT.estimate_mfu, agilerl/modules/gpt.py:516. Here
+jax.profiler traces and per-step MFU/step-time metrics are built in.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str = "/tmp/agilerl_tpu_trace") -> Iterator[None]:
+    """Capture a jax.profiler trace viewable in TensorBoard/Perfetto."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace span for host-side phases."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def transformer_flops_per_token(config) -> float:
+    """Approximate fwd+bwd FLOPs per token for the GPT config (6N + attention),
+    PaLM-style accounting."""
+    d, L = config.d_model, config.n_layer
+    ff = config.ff_dim
+    # parameter count (mirrors llm/model.init_params)
+    attn = d * config.n_head * config.head_dim * 2 + d * config.kv_heads * config.head_dim * 2
+    mlp = 3 * d * ff
+    n_params = config.vocab_size * d + L * (attn + mlp)
+    return 6.0 * n_params + 12.0 * L * config.max_seq_len * d
+
+
+def estimate_mfu(
+    config,
+    tokens_per_step: int,
+    step_time_s: float,
+    peak_flops: Optional[float] = None,
+) -> float:
+    """Model FLOPs utilisation (parity: modules/gpt.py:516, generalised).
+
+    peak_flops defaults per detected TPU generation (bf16)."""
+    if peak_flops is None:
+        kind = jax.devices()[0].device_kind.lower()
+        peak_flops = {
+            "tpu v4": 275e12, "tpu v5": 197e12, "tpu v5 lite": 197e12,
+            "tpu v5p": 459e12, "tpu v6e": 918e12, "tpu v6 lite": 918e12,
+        }.get(kind, 197e12)
+    flops = transformer_flops_per_token(config) * tokens_per_step
+    return flops / (step_time_s * peak_flops)
+
+
+class StepTimer:
+    """Rolling fps / step-time / MFU tracker for training loops
+    (parity: fps tracking in training/train_off_policy.py:439)."""
+
+    def __init__(self, window: int = 20):
+        self.window = window
+        self._times = []
+        self._last = None
+
+    def tick(self) -> Optional[float]:
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            self._times.append(dt)
+            if len(self._times) > self.window:
+                self._times.pop(0)
+        self._last = now
+        return dt
+
+    @property
+    def mean_step_time(self) -> float:
+        return sum(self._times) / len(self._times) if self._times else float("nan")
+
+    def throughput(self, units_per_step: float) -> float:
+        st = self.mean_step_time
+        return units_per_step / st if st == st and st > 0 else float("nan")

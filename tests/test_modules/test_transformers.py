@@ -1,0 +1,65 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.llm.model import GPTConfig
+from agilerl_tpu.modules.bert import EvolvableBERT
+from agilerl_tpu.modules.gpt import EvolvableGPT
+
+
+def make_gpt(key):
+    cfg = GPTConfig(vocab_size=50, n_layer=2, n_head=4, d_model=64,
+                    max_seq_len=32, dtype=jnp.float32)
+    return EvolvableGPT(config=cfg, key=key)
+
+
+class TestEvolvableGPT:
+    def test_forward(self, key):
+        gpt = make_gpt(key)
+        logits = gpt(jnp.zeros((2, 8), jnp.int32))
+        assert logits.shape == (2, 8, 50)
+
+    def test_layer_mutation_preserves(self, key):
+        gpt = make_gpt(key)
+        w0 = np.asarray(gpt.params["blocks"]["0"]["wq"]).copy()
+        gpt.add_layer()
+        assert gpt.config.n_layer == 3
+        np.testing.assert_array_equal(w0, np.asarray(gpt.params["blocks"]["0"]["wq"]))
+        assert gpt(jnp.zeros((1, 4), jnp.int32)).shape == (1, 4, 50)
+        gpt.remove_layer()
+        assert gpt.config.n_layer == 2
+
+    def test_node_mutation(self, key):
+        gpt = make_gpt(key)
+        old = np.asarray(gpt.params["blocks"]["0"]["wq"]).copy()
+        gpt.add_node(numb_new_nodes=16)
+        assert gpt.config.d_model == 80
+        assert gpt.config.d_model % gpt.config.n_head == 0
+        new = np.asarray(gpt.params["blocks"]["0"]["wq"])
+        np.testing.assert_array_equal(new[:64, :64], old[:, :64])
+        assert gpt(jnp.zeros((1, 4), jnp.int32)).shape == (1, 4, 50)
+
+    def test_estimate_mfu(self, key):
+        gpt = make_gpt(key)
+        mfu = gpt.estimate_mfu(tokens_per_step=1024, dt=0.1)
+        assert 0 <= mfu < 1
+
+
+class TestEvolvableBERT:
+    def test_encode_decode(self, key):
+        bert = EvolvableBERT(vocab_size=40, key=key, d_model=64, n_head=4)
+        src = jnp.zeros((2, 6), jnp.int32)
+        tgt = jnp.zeros((2, 5), jnp.int32)
+        logits = bert(src, tgt=tgt)
+        assert logits.shape == (2, 5, 40)
+        enc = bert(src)
+        assert enc.shape == (2, 6, 64)
+
+    def test_mutations(self, key, rng):
+        bert = EvolvableBERT(vocab_size=40, key=key, d_model=64, n_head=4)
+        bert.add_layer(rng=rng)
+        bert.add_node(numb_new_nodes=16)
+        src = jnp.zeros((1, 4), jnp.int32)
+        tgt = jnp.zeros((1, 3), jnp.int32)
+        assert bert(src, tgt=tgt).shape == (1, 3, 40)
