@@ -1,20 +1,52 @@
 """agilerl_tpu — a TPU-native evolutionary reinforcement-learning framework.
 
 Brand-new JAX/XLA/Pallas implementation with the capability surface of AgileRL
-(evolutionary HPO over populations of agents; on-/off-policy, offline, multi-agent,
-bandit and LLM-finetuning RL) designed TPU-first:
+(evolutionary HPO over populations of agents; on-/off-policy, offline,
+multi-agent, bandit and LLM-finetuning RL) designed TPU-first:
 
-- agents are pytrees of arrays + static configs; architecture mutations change the
-  static config and trigger XLA recompilation with weight-preserving pytree surgery
-  (vs. the reference's torch module re-instantiation, agilerl/modules/base.py:260)
+- agents are pytrees of arrays + static configs; architecture mutations change
+  the static config and trigger XLA recompilation with weight-preserving pytree
+  surgery (vs. the reference's torch module re-instantiation,
+  agilerl/modules/base.py:260)
 - populations shard across a device mesh with ICI collectives for tournament
   selection (vs. rank-0 + broadcast_object_list, agilerl/hpo/tournament.py:161)
-- the LLM stack is GSPMD-sharded pjit (vs. DeepSpeed ZeRO) with an in-tree jitted
-  generate loop (vs. vLLM colocate, agilerl/algorithms/core/base.py:3101)
+- the LLM stack is GSPMD-sharded pjit (vs. DeepSpeed ZeRO) with an in-tree
+  jitted generate loop (vs. vLLM colocate, agilerl/algorithms/core/base.py:3101)
+- sequence parallelism via ring attention over ICI (absent in the reference)
 """
 
 __version__ = "0.1.0"
 
-from agilerl_tpu import modules, networks, components, algorithms, hpo, utils
+from agilerl_tpu import (
+    algorithms,
+    components,
+    envs,
+    hpo,
+    llm,
+    modules,
+    networks,
+    ops,
+    parallel,
+    rollouts,
+    training,
+    utils,
+    vector,
+    wrappers,
+)
 
-__all__ = ["modules", "networks", "components", "algorithms", "hpo", "utils"]
+__all__ = [
+    "algorithms",
+    "components",
+    "envs",
+    "hpo",
+    "llm",
+    "modules",
+    "networks",
+    "ops",
+    "parallel",
+    "rollouts",
+    "training",
+    "utils",
+    "vector",
+    "wrappers",
+]

@@ -1,4 +1,8 @@
 from agilerl_tpu.envs.classic import CartPole, MountainCar, Pendulum, make
 from agilerl_tpu.envs.core import JaxEnv, JaxVecEnv, rollout_scan
+from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
 
-__all__ = ["JaxEnv", "JaxVecEnv", "rollout_scan", "CartPole", "Pendulum", "MountainCar", "make"]
+__all__ = [
+    "JaxEnv", "JaxVecEnv", "rollout_scan", "CartPole", "Pendulum", "MountainCar",
+    "make", "SimpleSpreadJax", "MultiAgentJaxVecEnv",
+]

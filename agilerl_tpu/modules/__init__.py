@@ -4,13 +4,20 @@ from agilerl_tpu.modules.base import (
     mutation,
     preserve_params,
 )
+from agilerl_tpu.modules.bert import BERTConfig, EvolvableBERT
+from agilerl_tpu.modules.cnn import CNNConfig, EvolvableCNN
+from agilerl_tpu.modules.dummy import DummyEvolvable
+from agilerl_tpu.modules.gpt import EvolvableGPT
+from agilerl_tpu.modules.lstm import EvolvableLSTM, LSTMConfig
 from agilerl_tpu.modules.mlp import EvolvableMLP, MLPConfig
+from agilerl_tpu.modules.multi_input import EvolvableMultiInput, MultiInputConfig
+from agilerl_tpu.modules.resnet import EvolvableResNet, ResNetConfig
+from agilerl_tpu.modules.simba import EvolvableSimBa, SimBaConfig
 
 __all__ = [
-    "EvolvableModule",
-    "ModuleDict",
-    "mutation",
-    "preserve_params",
-    "EvolvableMLP",
-    "MLPConfig",
+    "EvolvableModule", "ModuleDict", "mutation", "preserve_params",
+    "EvolvableMLP", "MLPConfig", "EvolvableCNN", "CNNConfig",
+    "EvolvableLSTM", "LSTMConfig", "EvolvableMultiInput", "MultiInputConfig",
+    "EvolvableSimBa", "SimBaConfig", "EvolvableResNet", "ResNetConfig",
+    "EvolvableGPT", "EvolvableBERT", "BERTConfig", "DummyEvolvable",
 ]

@@ -1,0 +1,5 @@
+from agilerl_tpu.ops.flash_attention import flash_attention
+from agilerl_tpu.ops.fused_loss import fused_token_logprob
+from agilerl_tpu.ops.ring_attention import make_ring_attention, ring_attention
+
+__all__ = ["flash_attention", "fused_token_logprob", "ring_attention", "make_ring_attention"]

@@ -1,0 +1,14 @@
+from agilerl_tpu.parallel.mesh import (
+    auto_mesh,
+    batch_sharding,
+    gpt_param_specs,
+    lora_specs,
+    make_mesh,
+    shard_params,
+)
+from agilerl_tpu.parallel.population import EvoPPO, MemberState
+
+__all__ = [
+    "make_mesh", "auto_mesh", "gpt_param_specs", "lora_specs", "shard_params",
+    "batch_sharding", "EvoPPO", "MemberState",
+]

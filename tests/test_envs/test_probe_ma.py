@@ -1,0 +1,26 @@
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms.maddpg import MADDPG
+from agilerl_tpu.envs.probe_ma import (
+    ConstantRewardEnvMA,
+    check_ma_q_learning_with_probe_env,
+)
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+
+@pytest.mark.slow
+def test_maddpg_constant_reward_probe():
+    env = ConstantRewardEnvMA()
+    check_ma_q_learning_with_probe_env(
+        env,
+        MADDPG,
+        dict(
+            observation_spaces=env.observation_spaces,
+            action_spaces=env.action_spaces,
+            agent_ids=env.agent_ids,
+            net_config=NET, lr_critic=5e-3, gamma=0.9, tau=0.5, seed=0,
+        ),
+        learn_steps=200,
+    )

@@ -1,0 +1,82 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.utils.spaces import preprocess_observation, sample_obs
+from agilerl_tpu.utils.utils import create_population, make_vect_envs
+
+
+class TestSpaces:
+    def test_discrete_one_hot(self):
+        sp = spaces.Discrete(4)
+        out = preprocess_observation(sp, np.array([0, 2]))
+        np.testing.assert_array_equal(
+            np.asarray(out), [[1, 0, 0, 0], [0, 0, 1, 0]]
+        )
+
+    def test_multidiscrete(self):
+        sp = spaces.MultiDiscrete([2, 3])
+        out = preprocess_observation(sp, np.array([[1, 2]]))
+        assert out.shape == (1, 5)
+
+    def test_image_chw_to_nhwc(self):
+        sp = spaces.Box(0, 255, (3, 8, 8), dtype=np.uint8)
+        out = preprocess_observation(sp, np.zeros((2, 3, 8, 8), np.uint8))
+        assert out.shape == (2, 8, 8, 3)
+
+    def test_dict_space(self):
+        sp = spaces.Dict({"a": spaces.Discrete(2), "b": spaces.Box(-1, 1, (3,))})
+        out = preprocess_observation(sp, sample_obs(sp, 4))
+        assert out["a"].shape == (4, 2)
+        assert out["b"].shape == (4, 3)
+
+
+class TestFactory:
+    def test_create_population_applies_init_hp(self):
+        pop = create_population(
+            "DQN", spaces.Box(-1, 1, (4,)), spaces.Discrete(2),
+            INIT_HP={"BATCH_SIZE": 17, "LR": 3e-3, "GAMMA": 0.9, "DOUBLE": True},
+            population_size=3, seed=0,
+            net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}},
+        )
+        assert len(pop) == 3
+        assert pop[0].batch_size == 17
+        assert pop[0].lr == 3e-3
+        assert pop[0].double is True
+        assert [a.index for a in pop] == [0, 1, 2]
+
+    def test_make_vect_envs_prefers_jax(self):
+        env = make_vect_envs("CartPole-v1", num_envs=3)
+        from agilerl_tpu.envs.core import JaxVecEnv
+
+        assert isinstance(env, JaxVecEnv)
+        obs, _ = env.reset()
+        assert obs.shape == (3, 4)
+
+
+class TestOrbaxCheckpoint:
+    def test_pytree_roundtrip(self, tmp_path):
+        from agilerl_tpu.utils.checkpoint import load_pytree, save_pytree
+
+        tree = {"a": jnp.arange(5.0), "b": {"c": jnp.ones((2, 2))}}
+        save_pytree(tmp_path / "ck", tree)
+        back = load_pytree(tmp_path / "ck", tree)
+        np.testing.assert_array_equal(np.asarray(back["a"]), np.arange(5.0))
+
+
+class TestNetConfigYaml:
+    def test_load_net_config(self, tmp_path):
+        from agilerl_tpu.modules.configs import load_net_config
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text("latent_dim: 24\nencoder_config:\n  hidden_size: [32, 32]\n")
+        cfg = load_net_config(p)
+        assert cfg["latent_dim"] == 24
+        assert cfg["encoder_config"]["hidden_size"] == (32, 32)
+        # usable to construct an agent
+        from agilerl_tpu.algorithms import DQN
+
+        agent = DQN(spaces.Box(-1, 1, (4,)), spaces.Discrete(2), net_config=cfg, seed=0)
+        assert agent.actor.config.latent_dim == 24
