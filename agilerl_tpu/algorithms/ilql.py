@@ -1,0 +1,279 @@
+"""ILQL — implicit-language Q-learning (legacy stack; parity:
+agilerl/algorithms/ilql.py — EvolvableGPT with pi/V/Q/target-Q heads, AWAC +
+CQL loss terms get_loss:750, beam/sample policies ILQL_Policy:1308. The
+reference's 2.2k-LoC torch implementation reduces to one jitted loss over the
+shared transformer trunk).
+
+Per-token offline RL on language: the LM head is the policy pi; V and Q heads
+ride the same hidden states. Q is trained by TD toward r + gamma * V(s');
+V by expectile regression toward target-Q (the IQL trick); pi by
+advantage-weighted behavioural cloning (AWAC); a CQL term keeps Q conservative.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.algorithms.core.base import EvolvableAlgorithm
+from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.registry import (
+    HyperparameterConfig,
+    NetworkGroup,
+    OptimizerConfig,
+    RLParameter,
+)
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.modules import layers as L
+
+
+class _Net:
+    def __init__(self, config, params):
+        self.config = config
+        self.params = params
+
+
+class ILQL(EvolvableAlgorithm):
+    supports_activation_mutation = False
+
+    def __init__(
+        self,
+        config: M.GPTConfig,
+        index: int = 0,
+        batch_size: int = 16,
+        lr: float = 1e-4,
+        gamma: float = 0.99,
+        tau: float = 0.7,  # expectile
+        alpha: float = 0.005,  # polyak for target Q
+        beta: float = 1.0,  # AWAC temperature
+        cql_weight: float = 0.01,
+        transition_weight: float = 0.0,
+        seed: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(
+            index=index,
+            hp_config=HyperparameterConfig(
+                lr=RLParameter(min=1e-6, max=1e-3, dtype=float),
+                batch_size=RLParameter(min=4, max=128, dtype=int),
+            ),
+            seed=seed,
+            **kwargs,
+        )
+        self.model_config = config
+        self.batch_size = int(batch_size)
+        self.lr = float(lr)
+        self.gamma = float(gamma)
+        self.tau = float(tau)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.cql_weight = float(cql_weight)
+        self.learn_step = 1
+
+        d, v = config.d_model, config.vocab_size
+        k1, k2, k3, k4 = jax.random.split(self.next_key(), 4)
+        params = {
+            "gpt": M.init_params(k1, config),
+            "v_head": L.dense_init(k2, d, 1),
+            "q_head": L.dense_init(k3, d, v),
+        }
+        self.actor = _Net(config, params)
+        self.target_q = _Net(config, {"q_head": jax.tree_util.tree_map(jnp.copy, params["q_head"])})
+        self.optimizer = OptimizerWrapper(optimizer="adamw", lr=self.lr)
+        self.register_network_group(NetworkGroup(eval="actor", policy=True))
+        self.register_optimizer(OptimizerConfig(name="optimizer", networks=["actor"], lr="lr"))
+        self.finalize_registry()
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {
+            "config": self.model_config,
+            "index": self.index,
+            "batch_size": self.batch_size,
+            "lr": self.lr,
+            "gamma": self.gamma,
+            "tau": self.tau,
+            "alpha": self.alpha,
+            "beta": self.beta,
+            "cql_weight": self.cql_weight,
+        }
+
+    # ------------------------------------------------------------------ #
+    def _loss_fn(self):
+        config = self.model_config
+        gamma, tau, beta, cql_w = self.gamma, self.tau, self.beta, self.cql_weight
+        tx = self.optimizer.tx
+
+        def heads(params, tokens, mask):
+            hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
+            logits = M.logits_fn(config, params["gpt"], hidden)
+            vs = L.dense_apply(params["v_head"], hidden)[..., 0]  # [B, T]
+            qs = L.dense_apply(params["q_head"], hidden)  # [B, T, V]
+            return logits, vs, qs, hidden
+
+        @jax.jit
+        def train_step(params, tq_params, opt_state, batch, key):
+            tokens = batch["tokens"]
+            mask = batch["attention_mask"].astype(jnp.float32)
+            rewards = batch["rewards"]
+            terminals = batch["terminals"]
+            # action at step t is token t+1
+            a = tokens[:, 1:]
+            valid = mask[:, 1:] * mask[:, :-1]
+
+            def loss(p):
+                logits, vs, qs, hidden = heads(p, tokens, batch["attention_mask"])
+                q_a = jnp.take_along_axis(
+                    qs[:, :-1], a[..., None].astype(jnp.int32), axis=-1
+                )[..., 0]  # [B, T-1]
+                # target-Q head on the SAME trunk (stop-grad trunk for target)
+                tq = L.dense_apply(tq_params["q_head"], jax.lax.stop_gradient(hidden))
+                tq_a = jnp.take_along_axis(
+                    tq[:, :-1], a[..., None].astype(jnp.int32), axis=-1
+                )[..., 0]
+                v_next = vs[:, 1:]
+                r = rewards[:, :-1]
+                nonterm = 1.0 - terminals[:, :-1]
+                td_target = jax.lax.stop_gradient(r + gamma * nonterm * v_next)
+                q_loss = jnp.sum(jnp.square(q_a - td_target) * valid) / jnp.maximum(
+                    valid.sum(), 1.0
+                )
+                # expectile V toward target-Q (IQL)
+                diff = jax.lax.stop_gradient(tq_a) - vs[:, :-1]
+                w = jnp.where(diff > 0, tau, 1.0 - tau)
+                v_loss = jnp.sum(w * jnp.square(diff) * valid) / jnp.maximum(valid.sum(), 1.0)
+                # CQL conservatism on Q
+                cql = jnp.sum(
+                    (jax.scipy.special.logsumexp(qs[:, :-1], axis=-1) - q_a) * valid
+                ) / jnp.maximum(valid.sum(), 1.0)
+                # AWAC policy loss: advantage-weighted CE
+                adv = jax.lax.stop_gradient(tq_a - vs[:, :-1])
+                wts = jnp.exp(jnp.clip(beta * adv, -5.0, 5.0))
+                logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+                logp_a = jnp.take_along_axis(
+                    logp, a[..., None].astype(jnp.int32), axis=-1
+                )[..., 0]
+                pi_loss = -jnp.sum(wts * logp_a * valid) / jnp.maximum(valid.sum(), 1.0)
+                total = q_loss + v_loss + cql_w * cql + pi_loss
+                return total, (q_loss, v_loss, cql, pi_loss)
+
+            (total, aux), grads = jax.value_and_grad(loss, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # polyak target-Q head
+            tq_params = jax.tree_util.tree_map(
+                lambda t, p: (1 - self.alpha) * t + self.alpha * p,
+                tq_params, {"q_head": params["q_head"]},
+            )
+            return params, tq_params, opt_state, total, aux
+
+        return train_step
+
+    def learn(self, batch: Dict[str, np.ndarray]) -> float:
+        """batch from data/rl_data.RL_Dataset.sample_batch (parity: get_loss:750)."""
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        step = self.jit_fn("train", self._loss_fn)
+        params, tq, opt_state, loss, aux = step(
+            self.actor.params, self.target_q.params, self.optimizer.opt_state,
+            batch, self.next_key(),
+        )
+        self.actor.params = params
+        self.target_q.params = tq
+        self.optimizer.opt_state = opt_state
+        return float(loss)
+
+    # ------------------------------------------------------------------ #
+    def get_action(
+        self, tokens: np.ndarray, mask: np.ndarray, key=None, q_scale: float = 1.0
+    ) -> np.ndarray:
+        """Sample next tokens from pi perturbed by Q-advantage
+        (parity: ILQL_Policy sample path :1308)."""
+        config = self.model_config
+
+        @jax.jit
+        def act(params, tokens, mask, key):
+            hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
+            logits = M.logits_fn(config, params["gpt"], hidden)[:, -1]
+            qs = L.dense_apply(params["q_head"], hidden)[:, -1]
+            vs = L.dense_apply(params["v_head"], hidden)[:, -1]
+            score = jax.nn.log_softmax(logits, axis=-1) + q_scale * (qs - vs)
+            return jax.random.categorical(key, score, axis=-1)
+
+        act_fn = self.jit_fn("act", lambda: act)
+        key = key if key is not None else self.next_key()
+        return np.asarray(act_fn(self.actor.params, jnp.asarray(tokens), jnp.asarray(mask), key))
+
+
+class BC_LM(EvolvableAlgorithm):
+    """Behavioural-cloning language model (legacy; parity:
+    agilerl/algorithms/bc_lm.py — BC_LM:672 LoC — CE on offline text + sampling
+    policy)."""
+
+    supports_activation_mutation = False
+
+    def __init__(self, config: M.GPTConfig, index: int = 0, batch_size: int = 16,
+                 lr: float = 1e-4, seed: Optional[int] = None, **kwargs):
+        super().__init__(
+            index=index,
+            hp_config=HyperparameterConfig(
+                lr=RLParameter(min=1e-6, max=1e-3, dtype=float),
+                batch_size=RLParameter(min=4, max=128, dtype=int),
+            ),
+            seed=seed, **kwargs,
+        )
+        self.model_config = config
+        self.batch_size = int(batch_size)
+        self.lr = float(lr)
+        self.learn_step = 1
+        self.actor = _Net(config, {"gpt": M.init_params(self.next_key(), config)})
+        self.optimizer = OptimizerWrapper(optimizer="adamw", lr=self.lr)
+        self.register_network_group(NetworkGroup(eval="actor", policy=True))
+        self.register_optimizer(OptimizerConfig(name="optimizer", networks=["actor"], lr="lr"))
+        self.finalize_registry()
+
+    @property
+    def init_dict(self) -> Dict[str, Any]:
+        return {"config": self.model_config, "index": self.index,
+                "batch_size": self.batch_size, "lr": self.lr}
+
+    def _train_fn(self):
+        config = self.model_config
+        tx = self.optimizer.tx
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            tokens = batch["tokens"]
+            mask = batch["attention_mask"].astype(jnp.float32)
+
+            def loss(p):
+                lp = M.token_logprobs(config, p["gpt"], tokens,
+                                      attention_mask=batch["attention_mask"])
+                valid = mask[:, 1:] * mask[:, :-1]
+                return -jnp.sum(lp * valid) / jnp.maximum(valid.sum(), 1.0)
+
+            l, grads = jax.value_and_grad(loss)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, l
+
+        return step
+
+    def learn(self, batch: Dict[str, np.ndarray]) -> float:
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        step = self.jit_fn("train", self._train_fn)
+        params, opt_state, loss = step(self.actor.params, self.optimizer.opt_state, batch)
+        self.actor.params = params
+        self.optimizer.opt_state = opt_state
+        return float(loss)
+
+    def generate(self, prompt_tokens, prompt_mask, max_new_tokens: int = 16,
+                 temperature: float = 1.0):
+        from agilerl_tpu.llm.generate import generate as _gen
+
+        return _gen(self.model_config, self.actor.params["gpt"],
+                    jnp.asarray(prompt_tokens), jnp.asarray(prompt_mask),
+                    self.next_key(), max_new_tokens=max_new_tokens,
+                    temperature=temperature)
