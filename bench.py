@@ -15,6 +15,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# persistent compile cache: repeated bench runs skip XLA compilation
+os.makedirs("/tmp/agilerl_tpu_xla_cache", exist_ok=True)
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/agilerl_tpu_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
 
 def main():
     import optax
@@ -47,12 +59,16 @@ def main():
         env, actor_cfg, critic_cfg, dist_cfg, optax.adam(3e-4),
         num_envs=num_envs, rollout_len=rollout_len, update_epochs=1, num_minibatches=4,
     )
+    log(f"bench: devices={jax.devices()} pop={pop_size} envs={num_envs} "
+        f"rollout={rollout_len} gens={generations}")
     pop = evo.init_population(jax.random.PRNGKey(0), pop_size)
     gen = evo.make_vmap_generation()
 
     # compile + warmup
+    t_c = time.perf_counter()
     pop, fitness = gen(pop, jax.random.PRNGKey(1))
     jax.block_until_ready(fitness)
+    log(f"bench: compiled+warmed in {time.perf_counter() - t_c:.1f}s")
 
     t0 = time.perf_counter()
     for i in range(generations):
