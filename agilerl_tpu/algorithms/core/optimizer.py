@@ -23,6 +23,20 @@ OPTIMIZERS: Dict[str, Callable] = {
 }
 
 
+import dataclasses
+
+
+@dataclasses.dataclass
+class CosineLRScheduleConfig:
+    """Cosine schedule with warmup (parity: agilerl/utils/algo_utils.py:1406
+    CosineLRScheduleConfig, consumed by the LLM algorithms)."""
+
+    num_epochs: int = 10
+    warmup_proportion: float = 0.05
+    min_lr_fraction: float = 0.1
+    steps_per_epoch: int = 100
+
+
 class OptimizerWrapper:
     """Holds an optax transform + its state over one params pytree.
 
@@ -35,19 +49,33 @@ class OptimizerWrapper:
         optimizer: str = "adam",
         lr: float = 1e-3,
         max_grad_norm: Optional[float] = None,
+        lr_schedule: Optional[CosineLRScheduleConfig] = None,
         **kwargs,
     ):
         self.optimizer_name = optimizer
         self.lr = float(lr)
         self.max_grad_norm = max_grad_norm
+        self.lr_schedule = lr_schedule
         self.kwargs = kwargs
         self.tx = self._build()
         self.opt_state = None
 
     def _build(self) -> optax.GradientTransformation:
-        base = optax.inject_hyperparams(OPTIMIZERS[self.optimizer_name])(
-            learning_rate=self.lr, **self.kwargs
-        )
+        if self.lr_schedule is not None:
+            total = self.lr_schedule.num_epochs * self.lr_schedule.steps_per_epoch
+            warmup = max(int(total * self.lr_schedule.warmup_proportion), 1)
+            schedule = optax.warmup_cosine_decay_schedule(
+                init_value=0.0,
+                peak_value=self.lr,
+                warmup_steps=warmup,
+                decay_steps=total,
+                end_value=self.lr * self.lr_schedule.min_lr_fraction,
+            )
+            base = OPTIMIZERS[self.optimizer_name](learning_rate=schedule, **self.kwargs)
+        else:
+            base = optax.inject_hyperparams(OPTIMIZERS[self.optimizer_name])(
+                learning_rate=self.lr, **self.kwargs
+            )
         if self.max_grad_norm is not None:
             return optax.chain(optax.clip_by_global_norm(self.max_grad_norm), base)
         return base

@@ -5,6 +5,8 @@ the CQL regulariser logsumexp(Q(s,·)) - Q(s,a) that penalises OOD actions).
 
 from __future__ import annotations
 
+import functools
+
 from typing import Dict, Optional
 
 import jax
@@ -32,7 +34,7 @@ class CQN(DQN):
         double = self.double
         cql_alpha = self.cql_alpha
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, target_params, opt_state, batch, gamma, tau):
             obs, action = batch["obs"], batch["action"].astype(jnp.int32)
             reward = batch["reward"].astype(jnp.float32)

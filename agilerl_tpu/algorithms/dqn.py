@@ -142,7 +142,7 @@ class DQN(RLAlgorithm):
         tx = self.optimizer.tx
         double = self.double
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, target_params, opt_state, batch, gamma, tau):
             obs, action = batch["obs"], batch["action"].astype(jnp.int32)
             reward = batch["reward"].astype(jnp.float32)

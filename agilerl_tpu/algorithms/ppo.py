@@ -13,6 +13,8 @@ LSTM encoders (truncated BPTT over fixed-length chunks).
 
 from __future__ import annotations
 
+import functools
+
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -253,7 +255,7 @@ class PPO(RLAlgorithm):
         tx = self.optimizer.tx
         normalize_advantage = self.normalize_advantage
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def update(params, opt_state, batch, clip, ent_coef, vf_coef):
             def loss_fn(p):
                 obs = preprocess_observation(space, batch["obs"])
@@ -291,7 +293,7 @@ class PPO(RLAlgorithm):
         tx = self.optimizer.tx
         normalize_advantage = self.normalize_advantage
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def update(params, opt_state, batch, clip, ent_coef, vf_coef):
             # batch leaves: [B, S, ...]; hidden_state: per-net {h,c} [B, L, H]
             def loss_fn(p):
