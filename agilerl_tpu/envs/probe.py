@@ -187,6 +187,29 @@ def check_q_learning_with_probe_env(
         np.testing.assert_allclose(q1, 1.0, atol=0.15)
 
 
+def check_policy_q_learning_with_probe_env(
+    env: JaxEnv, algo_class, algo_args: dict, learn_steps: int = 400, seed: int = 42
+) -> None:
+    """Train an actor-critic off-policy agent (DDPG/TD3) on a continuous probe
+    env and assert actor/critic outputs (parity: probe_envs.py:1162)."""
+    from agilerl_tpu.components import ReplayBuffer
+
+    agent = algo_class(**algo_args)
+    memory = ReplayBuffer(max_size=2048)
+    fill_buffer_random(env, memory, steps=64, num_envs=8, seed=seed)
+    for _ in range(learn_steps):
+        agent.learn(memory.sample(64))
+
+    if isinstance(env, FixedObsPolicyEnv) and env.continuous:
+        import jax.numpy as jnp
+
+        action = np.asarray(agent.get_action(np.zeros((1, 1), np.float32),
+                                             training=False))
+        np.testing.assert_allclose(action, 0.5, atol=0.25)
+        q = np.asarray(agent.critic(jnp.zeros((1, 1)), jnp.full((1, 1), 0.5)))
+        np.testing.assert_allclose(q, 0.0, atol=0.25)
+
+
 def check_policy_on_policy_with_probe_env(
     env: JaxEnv, algo_class, algo_args: dict, train_iters: int = 60, seed: int = 42
 ) -> None:

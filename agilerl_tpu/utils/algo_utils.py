@@ -1,0 +1,52 @@
+"""Algorithm utilities (parity: agilerl/utils/algo_utils.py — observation
+preprocessing :889 lives in utils/spaces.py; module/checkpoint helpers :525 live
+in algorithms/core/base.py; the dataclasses below mirror the config objects
+:1406-1443).
+
+VLLMConfig has no analogue by design: generation is the in-tree jitted decode
+loop, configured by GenerationConfig instead (no engine, no tensor-parallel
+subgroups, no sleep mode — SURVEY.md §2.8 TP row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from agilerl_tpu.algorithms.core.optimizer import CosineLRScheduleConfig  # noqa: F401
+from agilerl_tpu.utils.spaces import (  # noqa: F401
+    action_dim,
+    obs_dim,
+    preprocess_observation,
+)
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Decode-loop settings for LLM algorithms (replaces VLLMConfig,
+    algo_utils.py:1406)."""
+
+    max_new_tokens: int = 64
+    temperature: float = 0.9
+    top_k: Optional[int] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+def chkpt_attribute_to_device(chkpt: dict, device=None) -> dict:
+    """Move checkpoint arrays onto device (parity: algo_utils chkpt helpers)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, chkpt
+    )
+
+
+def key_in_nested_dict(d: dict, key: str) -> bool:
+    """Recursive key search (parity: algo_utils.py key_in_nested_dict)."""
+    if key in d:
+        return True
+    return any(
+        isinstance(v, dict) and key_in_nested_dict(v, key) for v in d.values()
+    )

@@ -1,0 +1,46 @@
+"""Metric log combination across hosts (parity: agilerl/utils/log_utils.py —
+DistributeCombineLogs:10, used by the legacy ILQL stack).
+
+Host-side accumulation; the cross-host reduce rides
+jax.experimental.multihost_utils instead of torch.distributed gathers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+class CombineLogs:
+    """Accumulate (value, weight) pairs per metric and reduce to weighted means."""
+
+    def __init__(self):
+        self._logs: Dict[str, List] = {}
+
+    def accum(self, metrics: Dict[str, float], weight: float = 1.0) -> None:
+        for k, v in metrics.items():
+            self._logs.setdefault(k, []).append((float(v), float(weight)))
+
+    def reduce(self, across_hosts: bool = False) -> Dict[str, float]:
+        out = {}
+        for k, pairs in self._logs.items():
+            vals = np.array([p[0] for p in pairs])
+            wts = np.array([p[1] for p in pairs])
+            num, den = float((vals * wts).sum()), float(wts.sum())
+            if across_hosts:
+                import jax
+
+                if jax.process_count() > 1:
+                    from jax.experimental import multihost_utils
+
+                    both = multihost_utils.process_allgather(np.array([num, den]))
+                    num, den = float(both[..., 0].sum()), float(both[..., 1].sum())
+            out[k] = num / max(den, 1e-12)
+        return out
+
+    def clear(self) -> None:
+        self._logs = {}
+
+
+DistributeCombineLogs = CombineLogs  # parity alias

@@ -191,6 +191,21 @@ def make_vect_envs(
     return cls(fns)
 
 
+def make_multi_agent_vect_envs(
+    env,
+    num_envs: int = 1,
+    should_async_vector: bool = True,
+    **env_kwargs,
+):
+    """Vectorise a PettingZoo parallel env factory (parity: utils/utils.py:82).
+    `env` is a callable returning a fresh parallel env."""
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv, PettingZooVecEnv
+
+    fns = [lambda: env(**env_kwargs) for _ in range(num_envs)]
+    cls = AsyncPettingZooVecEnv if should_async_vector else PettingZooVecEnv
+    return cls(fns)
+
+
 def tournament_selection_and_mutation(
     population: List,
     tournament,

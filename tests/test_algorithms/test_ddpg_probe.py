@@ -1,0 +1,24 @@
+import pytest
+
+from agilerl_tpu.algorithms import DDPG
+from agilerl_tpu.envs.probe import (
+    FixedObsPolicyEnv,
+    check_policy_q_learning_with_probe_env,
+)
+
+
+@pytest.mark.slow
+def test_ddpg_continuous_probe():
+    env = FixedObsPolicyEnv(continuous=True)
+    check_policy_q_learning_with_probe_env(
+        env,
+        DDPG,
+        dict(
+            observation_space=env.observation_space,
+            action_space=env.action_space,
+            lr_actor=3e-3, lr_critic=5e-3, gamma=0.9, tau=0.3,
+            policy_freq=1, O_U_noise=False, seed=2,
+            net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}},
+        ),
+        learn_steps=400,
+    )
