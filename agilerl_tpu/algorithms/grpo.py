@@ -180,11 +180,15 @@ class GRPO(EvolvableAlgorithm):
     def _logprob_fn(self):
         config = self.model_config
         base = self.base_params
-        scale = self.lora_scale
+        # no-grad passes use the fused Pallas lm-head kernel on TPU
+        use_pallas = jax.default_backend() == "tpu"
 
         @jax.jit
         def logprobs(lora, tokens, mask):
-            return M.token_logprobs(config, base, tokens, attention_mask=mask, lora=lora)
+            return M.token_logprobs(
+                config, base, tokens, attention_mask=mask, lora=lora,
+                use_pallas=use_pallas,
+            )
 
         return logprobs
 

@@ -335,9 +335,23 @@ def token_logprobs(
     lora: Optional[Params] = None,
     temperature: float = 1.0,
     chunk_size: int = 128,
+    use_pallas: bool = False,
 ) -> jax.Array:
-    """log p(tokens[:, t] | tokens[:, <t]) for t>=1, shape [B, T-1]."""
+    """log p(tokens[:, t] | tokens[:, <t]) for t>=1, shape [B, T-1].
+
+    use_pallas=True routes the lm-head+log-softmax through the fused Pallas
+    kernel (ops/fused_loss.py, the Liger replacement) — forward-only, for the
+    no-grad logprob passes (GRPO old/reference logprobs)."""
     hidden, _ = forward(config, params, tokens, attention_mask=attention_mask, lora=lora)
+    if use_pallas:
+        from agilerl_tpu.ops.fused_loss import fused_token_logprob
+
+        head = params["tok_emb"].T if config.tie_embeddings else params["lm_head"]
+        B, T, D = hidden.shape
+        flat_h = hidden[:, :-1].reshape(-1, D)
+        flat_t = tokens[:, 1:].reshape(-1)
+        lp = fused_token_logprob(flat_h, head, flat_t, temperature=temperature)
+        return lp.reshape(B, T - 1)
     hidden = hidden[:, :-1]  # predict next token
     targets = tokens[:, 1:]
     head = (params["tok_emb"].T if config.tie_embeddings else params["lm_head"]).astype(

@@ -40,6 +40,21 @@ def auto_mesh(n_devices: Optional[int] = None) -> Mesh:
     return make_mesh(dp=1, fsdp=len(devices), tp=1, devices=devices)
 
 
+def make_multislice_mesh(dcn_dp: int, fsdp: int, tp: int = 1) -> Mesh:
+    """Multi-slice pod mesh: the slow DCN links carry only the data-parallel
+    axis (gradient all-reduce once per step), fsdp/tp collectives stay on ICI
+    within a slice — the layout "How to Scale Your Model" prescribes and the
+    reference approximates with NCCL process groups (SURVEY.md §2.8)."""
+    from jax.experimental import mesh_utils
+
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(fsdp, tp),
+        dcn_mesh_shape=(dcn_dp, 1),
+        devices=jax.devices(),
+    )
+    return Mesh(devices.reshape(dcn_dp, fsdp, tp), axis_names=("dp", "fsdp", "tp"))
+
+
 # --------------------------------------------------------------------------- #
 # GPT param shardings (megatron-style TP + fsdp second axis)
 # --------------------------------------------------------------------------- #

@@ -28,7 +28,48 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def bench_grpo():
+    """Secondary bench: GRPO learn-step tokens/sec + MFU on a GPT-2-small-class
+    model (the BASELINE.md LLM metric at reduced scale for one chip)."""
+    import jax.numpy as jnp
+
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.utils.profiling import estimate_mfu
+
+    B = int(os.environ.get("BENCH_GRPO_BATCH", 16))
+    T = int(os.environ.get("BENCH_GRPO_SEQ", 512))
+    cfg = M.GPTConfig(
+        vocab_size=32_000, n_layer=12, n_head=12, d_model=768, max_seq_len=T,
+    )
+    agent = GRPO(config=cfg, pad_token_id=0, eos_token_id=1, group_size=4,
+                 batch_size=B, seed=0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 31_000, size=(B, T)).astype(np.int32))
+    loss_mask = np.zeros((B, T - 1), np.float32)
+    loss_mask[:, T // 2:] = 1.0
+    rewards = rng.normal(size=(B // 4, 4)).astype(np.float32)
+    exp = (ids, jnp.asarray(loss_mask), jnp.asarray(rewards))
+    log("bench_grpo: compiling")
+    agent.learn(exp)  # compile
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        agent.learn(exp)
+    dt = (time.perf_counter() - t0) / iters
+    tokens = B * T
+    mfu = estimate_mfu(cfg, tokens, dt)
+    print(json.dumps({
+        "metric": f"GRPO learn-step tokens/sec (GPT2-small class, B={B} T={T})",
+        "value": round(tokens / dt),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.35, 3),  # BASELINE: 35% MFU target
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_MODE") == "grpo":
+        return bench_grpo()
     import optax
 
     from agilerl_tpu.envs import CartPole
