@@ -30,6 +30,9 @@ from agilerl_tpu.algorithms.core.registry import (
 )
 from agilerl_tpu.utils.spaces import preprocess_observation
 
+# process-global compiled-function cache shared across population members
+_GLOBAL_JIT_CACHE: Dict[tuple, Callable] = {}
+
 
 class EvolvableAlgorithm:
     """Base for all evolvable agents."""
@@ -97,11 +100,31 @@ class EvolvableAlgorithm:
         return self.registry.hp_config
 
     # -- jit cache ------------------------------------------------------- #
-    def jit_fn(self, name: str, factory: Callable[[], Callable]) -> Callable:
-        """Get-or-build a jitted function; dropped on architecture mutation."""
+    def jit_fn(
+        self,
+        name: str,
+        factory: Callable[[], Callable],
+        static_key: Optional[tuple] = None,
+    ) -> Callable:
+        """Get-or-build a jitted function; dropped on architecture mutation.
+
+        With ``static_key`` (a hashable tuple of everything the traced function
+        closes over — net configs, algo flags, optimizer spec), the compiled
+        function is shared ACROSS agents via a process-global cache: population
+        members with identical architectures reuse one XLA executable instead
+        of compiling per member (the recompilation-economics answer to
+        SURVEY.md §7 hard-part #1 — the reference re-instantiates torch modules
+        per member and pays full re-setup every clone)."""
         fn = self._jit_cache.get(name)
         if fn is None:
-            fn = factory()
+            if static_key is not None:
+                gkey = (type(self).__name__, name, static_key)
+                fn = _GLOBAL_JIT_CACHE.get(gkey)
+                if fn is None:
+                    fn = factory()
+                    _GLOBAL_JIT_CACHE[gkey] = fn
+            else:
+                fn = factory()
             self._jit_cache[name] = fn
         return fn
 

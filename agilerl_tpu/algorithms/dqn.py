@@ -131,7 +131,10 @@ class DQN(RLAlgorithm):
             obs = jax.tree_util.tree_map(lambda x: x[None], obs)
         eps = epsilon if training else 0.0
         mask = None if action_mask is None else jnp.asarray(action_mask)
-        act = self.jit_fn("act" if mask is None else "act_masked", self._act_fn)
+        act = self.jit_fn(
+            "act" if mask is None else "act_masked", self._act_fn,
+            static_key=(self.actor.config, str(self.observation_space)),
+        )
         actions = act(self.actor.params, obs, self.next_key(), jnp.float32(eps), mask)
         actions = np.asarray(actions)
         return actions[0] if single else actions
@@ -177,7 +180,11 @@ class DQN(RLAlgorithm):
         batch = dict(experiences)
         batch["obs"] = self.preprocess_observation(batch["obs"])
         batch["next_obs"] = self.preprocess_observation(batch["next_obs"])
-        train_step = self.jit_fn("train", self._train_fn)
+        train_step = self.jit_fn(
+            "train", self._train_fn,
+            static_key=(self.actor.config, self.double,
+                        self.optimizer.optimizer_name, self.optimizer.max_grad_norm),
+        )
         params, tparams, opt_state, loss = train_step(
             self.actor.params,
             self.actor_target.params,

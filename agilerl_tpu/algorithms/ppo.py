@@ -219,7 +219,11 @@ class PPO(RLAlgorithm):
             if self._hidden is None:
                 self._hidden = self.get_initial_hidden_state()
             hidden = self._hidden
-        act = self.jit_fn("act", self._act_fn)
+        act = self.jit_fn(
+            "act", self._act_fn,
+            static_key=(self.actor.config, self.critic.config, self.recurrent,
+                        str(self.observation_space), str(self.action_space)),
+        )
         if deterministic:
             obs_p = self.preprocess_observation(obs)
             if self.recurrent:
@@ -375,7 +379,13 @@ class PPO(RLAlgorithm):
                 if self.target_kl is not None and float(aux[3]) > 1.5 * self.target_kl:
                     break
         else:
-            update = self.jit_fn("update", self._update_fn)
+            update = self.jit_fn(
+                "update", self._update_fn,
+                static_key=(self.actor.config, self.critic.config,
+                            self.normalize_advantage, str(self.observation_space),
+                            str(self.action_space), self.optimizer.optimizer_name,
+                            self.optimizer.max_grad_norm),
+            )
             for _ in range(self.update_epochs):
                 idxs = buf.minibatch_indices(self.batch_size, key=self.next_key())
                 for idx in idxs:
