@@ -187,7 +187,7 @@ class GRPO(EvolvableAlgorithm):
         def logprobs(lora, tokens, mask):
             return M.token_logprobs(
                 config, base, tokens, attention_mask=mask, lora=lora,
-                use_pallas=use_pallas,
+                use_pallas=use_pallas, flash=use_pallas,
             )
 
         return logprobs

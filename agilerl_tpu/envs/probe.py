@@ -125,6 +125,35 @@ class PolicyEnv(JaxEnv):
         return state, state.obs, reward, jnp.bool_(True), jnp.bool_(False)
 
 
+class MemoryEnv(JaxEnv):
+    """POMDP probe: a cue bit is shown ONLY at t=0; at t=2 the agent must act
+    equal to the cue. Solvable only with memory — separates recurrent PPO from
+    flat PPO (the capability the reference's recurrent stack exists for,
+    agilerl/components/rollout_buffer.py BPTT path)."""
+
+    max_episode_steps = 3
+
+    def __init__(self):
+        self.observation_space = spaces.Box(0.0, 1.0, (2,), np.float32)
+        self.action_space = spaces.Discrete(2)
+
+    def reset_fn(self, key):
+        cue = jax.random.bernoulli(key).astype(jnp.float32)
+        obs = jnp.stack([cue, jnp.float32(1.0)])  # [cue, is_first_step]
+        return _ScalarState(obs, jnp.int32(0)), obs
+
+    def step_fn(self, state, action, key):
+        t = state.t + 1
+        cue = state.obs[0]
+        blank = jnp.stack([jnp.float32(0.0), jnp.float32(0.0)])  # cue hidden
+        done = t >= 3
+        reward = jnp.where(
+            done, jnp.where(action == cue.astype(jnp.int32), 1.0, -1.0), 0.0
+        )
+        new_obs = blank
+        return _ScalarState(jnp.stack([cue, jnp.float32(0.0)]), t), new_obs, reward, done, jnp.bool_(False)
+
+
 # --------------------------------------------------------------------------- #
 # Check functions
 # --------------------------------------------------------------------------- #

@@ -41,6 +41,7 @@ class GPTConfig:
     rms_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
+    use_flash_attention: bool = False  # Pallas kernel on the non-cached path
 
     @property
     def kv_heads(self) -> int:
@@ -205,6 +206,9 @@ def forward(
     cache: Optional[KVCache] = None,  # per-layer caches stacked: dict of layer->KVCache
     lora: Optional[Params] = None,
     lora_scale: float = 2.0,
+    flash: Optional[bool] = None,  # override config.use_flash_attention
+    # (the Pallas kernel is forward-only: keep flash OFF inside loss grads
+    # until the custom-VJP lands; no-grad logprob/generate paths may enable it)
 ) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
     """Returns (hidden [B, T, D] float32, new caches). With a cache, tokens are
     appended at cache.length (all rows share a length — use left-padding for
@@ -217,6 +221,7 @@ def forward(
         positions = jnp.cumsum(attention_mask, axis=-1) - 1
         positions = jnp.maximum(positions, 0)
 
+    use_flash = config.use_flash_attention if flash is None else flash
     h = jnp.take(params["tok_emb"], tokens, axis=0).astype(dtype)
 
     new_caches: Optional[Dict[str, KVCache]] = {} if cache is not None else None
@@ -264,15 +269,23 @@ def forward(
             k_all = jnp.repeat(k_all, rep, axis=2)
             v_all = jnp.repeat(v_all, rep, axis=2)
 
-        # attention: [B, H, T, S]
-        qh = jnp.moveaxis(q, 2, 1)
+        qh = jnp.moveaxis(q, 2, 1)  # [B, H, T, d]
         kh = jnp.moveaxis(k_all, 2, 1)
         vh = jnp.moveaxis(v_all, 2, 1)
-        scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
-        scores = scores / math.sqrt(config.head_dim)
-        scores = jnp.where(mask[:, None, :, :], scores, -1e9)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-        attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+        if use_flash and layer_cache is None:
+            # Pallas flash attention (causal + padding mask) on the training/
+            # prefill-free path; the cached decode path stays on XLA attention
+            from agilerl_tpu.ops.flash_attention import flash_attention
+
+            attn = flash_attention(
+                qh, kh, vh, padding_mask=attention_mask, causal=True
+            )
+        else:
+            scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
+            scores = scores / math.sqrt(config.head_dim)
+            scores = jnp.where(mask[:, None, :, :], scores, -1e9)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
         attn = jnp.moveaxis(attn, 1, 2).reshape(B, T, config.n_head * config.head_dim)
         attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
         h = h + attn
@@ -336,13 +349,16 @@ def token_logprobs(
     temperature: float = 1.0,
     chunk_size: int = 128,
     use_pallas: bool = False,
+    flash: Optional[bool] = None,
 ) -> jax.Array:
     """log p(tokens[:, t] | tokens[:, <t]) for t>=1, shape [B, T-1].
 
     use_pallas=True routes the lm-head+log-softmax through the fused Pallas
     kernel (ops/fused_loss.py, the Liger replacement) — forward-only, for the
-    no-grad logprob passes (GRPO old/reference logprobs)."""
-    hidden, _ = forward(config, params, tokens, attention_mask=attention_mask, lora=lora)
+    no-grad logprob passes (GRPO old/reference logprobs); flash likewise
+    enables the Pallas attention kernel on those passes."""
+    hidden, _ = forward(config, params, tokens, attention_mask=attention_mask,
+                        lora=lora, flash=flash)
     if use_pallas:
         from agilerl_tpu.ops.fused_loss import fused_token_logprob
 

@@ -24,8 +24,16 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def _make_kernel(scale: float, causal: bool, block_q: int, block_k: int, seq_len: int):
-    def kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref):
+def _make_kernel(
+    scale: float, causal: bool, block_q: int, block_k: int, seq_len: int,
+    with_mask: bool,
+):
+    def kernel(*refs):
+        if with_mask:
+            q_ref, k_ref, v_ref, mask_ref, out_ref, m_ref, l_ref, acc_ref = refs
+        else:
+            q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref = refs
+            mask_ref = None
         qi = pl.program_id(1)
         kj = pl.program_id(2)
         nk = pl.num_programs(2)
@@ -51,6 +59,9 @@ def _make_kernel(scale: float, causal: bool, block_q: int, block_k: int, seq_len
             mask = k_ids < seq_len
             if causal:
                 mask = jnp.logical_and(mask, k_ids <= q_ids)
+            if mask_ref is not None:
+                # padding mask for this kv block: [1, BK] -> broadcast rows
+                mask = jnp.logical_and(mask, mask_ref[0][None, :] > 0)
             scores = jnp.where(mask, scores, -1e30)
 
             m_old = m_ref[:]
@@ -85,6 +96,7 @@ def flash_attention(
     q: jax.Array,  # [B, H, T, d]
     k: jax.Array,
     v: jax.Array,
+    padding_mask: Optional[jax.Array] = None,  # [B, T] 1=real token
     causal: bool = True,
     block_q: int = 128,
     block_k: int = 128,
@@ -112,14 +124,23 @@ def flash_attention(
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas tpu module unavailable")
     grid = (bh, Tp // block_q, Tp // block_k)
+    with_mask = padding_mask is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qf, kf, vf]
+    if with_mask:
+        mp = jnp.pad(padding_mask.astype(jnp.int32), ((0, 0), (0, pad_t)))
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b, i, j, H=H: (b // H, j))
+        )
+        args.append(mp)
     out = pl.pallas_call(
-        _make_kernel(scale, causal, block_q, block_k, T),
+        _make_kernel(scale, causal, block_q, block_k, T, with_mask),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, Tp, d), q.dtype),
         scratch_shapes=[
@@ -128,5 +149,5 @@ def flash_attention(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return out.reshape(B, H, Tp, d)[:, :, :T, :]

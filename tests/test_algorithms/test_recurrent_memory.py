@@ -1,0 +1,52 @@
+"""BPTT correctness: recurrent PPO must solve the memory probe that flat
+policies cannot (cue shown only at t=0, decision at t=2)."""
+
+import jax
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms.ppo import PPO
+from agilerl_tpu.envs import JaxVecEnv
+from agilerl_tpu.envs.probe import MemoryEnv
+from agilerl_tpu.rollouts.on_policy import collect_rollouts
+
+
+@pytest.mark.slow
+def test_recurrent_ppo_solves_memory_env():
+    env = MemoryEnv()
+    vec = JaxVecEnv(env, num_envs=8, seed=0)
+    agent = PPO(
+        observation_space=env.observation_space,
+        action_space=env.action_space,
+        num_envs=8,
+        learn_step=24,  # divisible by seq_len; episodes are 3 steps
+        seq_len=3,
+        batch_size=96,
+        update_epochs=4,
+        lr=5e-3,
+        gamma=0.9,
+        ent_coef=0.02,
+        recurrent=True,
+        seed=1,
+        net_config={
+            "latent_dim": 16,
+            "encoder_config": {"hidden_size": 32, "num_layers": 1},
+        },
+    )
+    rewards = []
+    for i in range(60):
+        r = collect_rollouts(agent, vec, n_steps=agent.learn_step)
+        agent.learn()
+        rewards.append(r)
+    # mean reward per step approaches 1/3 (one +-1 reward every 3 steps)
+    late = float(np.mean(rewards[-10:]))
+    assert late > 0.15, f"recurrent PPO failed to use memory: {late:.3f}"
+
+
+def test_memory_env_blank_obs():
+    env = MemoryEnv()
+    vec = JaxVecEnv(env, num_envs=4, seed=0)
+    obs, _ = vec.reset()
+    assert set(np.unique(obs[:, 1])) == {1.0}  # first-step flag
+    obs2, r, term, trunc, _ = vec.step(np.zeros(4, np.int64))
+    np.testing.assert_array_equal(obs2, np.zeros_like(obs2))  # cue hidden

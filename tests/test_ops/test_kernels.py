@@ -62,3 +62,29 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
         want = self._dense(q, k, v, True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+class TestFlashAttentionMask:
+    def test_padding_mask_matches_dense(self):
+        key = jax.random.PRNGKey(2)
+        B, H, T, d = 2, 2, 32, 16
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+            for i in range(3)
+        )
+        mask = jnp.ones((B, T), jnp.int32)
+        mask = mask.at[0, :8].set(0)  # left padding on row 0
+        got = flash_attention(q, k, v, padding_mask=mask, causal=True,
+                              block_q=16, block_k=16)
+        # dense reference with combined causal+padding mask
+        scale = 1.0 / np.sqrt(d)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        full = jnp.logical_and(causal[None, None], mask[:, None, None, :].astype(bool))
+        scores = jnp.where(full, scores, -1e30)
+        want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+        # padded query rows attend only to pads -> compare real rows only
+        np.testing.assert_allclose(
+            np.asarray(got[0, :, 8:]), np.asarray(want[0, :, 8:]), atol=2e-5
+        )
+        np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]), atol=2e-5)
