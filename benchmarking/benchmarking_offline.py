@@ -1,0 +1,32 @@
+"""Offline CQN benchmarking (parity: benchmarking/benchmarking_offline.py):
+generates an offline dataset on demand (replaces the bundled h5 files)."""
+
+from agilerl_tpu.components import ReplayBuffer
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+from agilerl_tpu.training.train_offline import train_offline
+from agilerl_tpu.utils.minari_utils import collect_offline_dataset
+from agilerl_tpu.utils.utils import create_population, make_vect_envs
+
+
+def main():
+    env = make_vect_envs("CartPole-v1", num_envs=8)
+    dataset = collect_offline_dataset(env, steps=20_000, epsilon=1.0)
+    pop = create_population(
+        "CQN", env.single_observation_space, env.single_action_space,
+        population_size=2,
+        net_config={"latent_dim": 32, "encoder_config": {"hidden_size": (64,)}},
+        INIT_HP={"BATCH_SIZE": 128, "LR": 1e-3, "LEARN_STEP": 1},
+    )
+    memory = ReplayBuffer(max_size=len(dataset["rewards"]))
+    pop, fitnesses = train_offline(
+        env, "CartPole-v1", dataset, "CQN", pop, memory,
+        max_steps=20_000, evo_steps=2_000,
+        tournament=TournamentSelection(2, True, 2, 1),
+        mutation=Mutations(no_mutation=0.4, architecture=0.2, parameters=0.2,
+                           activation=0.2, rl_hp=0.2),
+    )
+    print(f"best fitness: {max(max(f) for f in fitnesses):.1f}")
+
+
+if __name__ == "__main__":
+    main()
