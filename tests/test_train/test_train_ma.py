@@ -1,0 +1,59 @@
+"""End-to-end multi-agent training loops on tiny budgets
+(parity: tests/test_train/ multi-agent loop coverage)."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.components import MultiAgentReplayBuffer
+from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+from agilerl_tpu.training.train_multi_agent_off_policy import (
+    train_multi_agent_off_policy,
+)
+from agilerl_tpu.training.train_multi_agent_on_policy import (
+    train_multi_agent_on_policy,
+)
+from agilerl_tpu.utils.utils import create_population
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+
+@pytest.fixture
+def ma_env():
+    return MultiAgentJaxVecEnv(SimpleSpreadJax(n_agents=2), num_envs=2, seed=0)
+
+
+def test_train_multi_agent_off_policy_e2e(ma_env):
+    pop = create_population(
+        "MADDPG", ma_env.observation_spaces, ma_env.action_spaces,
+        agent_ids=ma_env.agent_ids, population_size=2, seed=0, net_config=NET,
+        INIT_HP={"BATCH_SIZE": 16, "LEARN_STEP": 8},
+    )
+    memory = MultiAgentReplayBuffer(max_size=1024, agent_ids=ma_env.agent_ids)
+    pop, fitnesses = train_multi_agent_off_policy(
+        ma_env, "SimpleSpread", "MADDPG", pop, memory,
+        max_steps=200, evo_steps=100, eval_steps=10, eval_loop=1,
+        tournament=TournamentSelection(2, True, 2, 1),
+        mutation=Mutations(no_mutation=0.5, architecture=0.25, parameters=0.25,
+                           activation=0.0, rl_hp=0.0, rand_seed=0),
+        verbose=False,
+    )
+    assert len(pop) == 2
+    assert all(np.isfinite(f).all() for f in fitnesses)
+
+
+def test_train_multi_agent_on_policy_e2e(ma_env):
+    pop = create_population(
+        "IPPO", ma_env.observation_spaces, ma_env.action_spaces,
+        agent_ids=ma_env.agent_ids, population_size=2, seed=0, net_config=NET,
+        num_envs=2, learn_step=16, batch_size=32, update_epochs=2,
+    )
+    pop, fitnesses = train_multi_agent_on_policy(
+        ma_env, "SimpleSpread", "IPPO", pop,
+        max_steps=200, evo_steps=64, eval_steps=10, eval_loop=1,
+        tournament=TournamentSelection(2, True, 2, 1),
+        mutation=Mutations(no_mutation=0.6, architecture=0.2, parameters=0.2,
+                           activation=0.0, rl_hp=0.0, rand_seed=0),
+        verbose=False,
+    )
+    assert all(np.isfinite(f).all() for f in fitnesses)
