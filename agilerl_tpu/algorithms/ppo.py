@@ -216,8 +216,11 @@ class PPO(RLAlgorithm):
         if single:
             obs = jax.tree_util.tree_map(lambda x: np.asarray(x)[None], obs)
         if self.recurrent and hidden is None:
-            if self._hidden is None:
-                self._hidden = self.get_initial_hidden_state()
+            batch = jax.tree_util.tree_leaves(obs)[0].shape[0]
+            if self._hidden is None or (
+                jax.tree_util.tree_leaves(self._hidden)[0].shape[1] != batch
+            ):
+                self._hidden = self.get_initial_hidden_state(batch)
             hidden = self._hidden
         act = self.jit_fn(
             "act", self._act_fn,
@@ -227,10 +230,14 @@ class PPO(RLAlgorithm):
         if deterministic:
             obs_p = self.preprocess_observation(obs)
             if self.recurrent:
-                latent, _ = _lstm_encode(
+                latent, new_ha = _lstm_encode(
                     self.actor.config, self.actor.params, obs_p,
                     hidden["actor"] if hidden else self.get_initial_hidden_state()["actor"],
                 )
+                # advance hidden during greedy eval too — without this, test()
+                # on a recurrent policy would re-zero memory every step
+                if hidden is not None:
+                    self._hidden = {**hidden, "actor": new_ha}
                 from agilerl_tpu.modules.mlp import EvolvableMLP
 
                 logits = EvolvableMLP.apply(self.actor.config.head, self.actor.params["head"], latent)
