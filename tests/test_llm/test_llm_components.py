@@ -1,0 +1,108 @@
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.generate import generate, left_pad
+from agilerl_tpu.utils.llm_utils import CharTokenizer, PreferenceGym
+
+CFG = M.GPTConfig(vocab_size=64, n_layer=2, n_head=4, n_kv_head=2, d_model=64,
+                  max_seq_len=64, dtype=jnp.float32)
+
+
+class TestGenerate:
+    def test_left_pad(self):
+        toks, mask = left_pad([[1, 2, 3], [4]], pad_id=0)
+        np.testing.assert_array_equal(toks, [[1, 2, 3], [0, 0, 4]])
+        np.testing.assert_array_equal(mask, [[1, 1, 1], [0, 0, 1]])
+
+    def test_eos_stops_mask(self):
+        params = M.init_params(jax.random.PRNGKey(0), CFG)
+        toks = jnp.ones((2, 4), jnp.int32)
+        mask = jnp.ones((2, 4), jnp.int32)
+        comp, cmask = generate(CFG, params, toks, mask, jax.random.PRNGKey(1),
+                               max_new_tokens=12, temperature=1.5, eos_id=5, pad_id=0)
+        comp, cmask = np.asarray(comp), np.asarray(cmask)
+        for row in range(2):
+            if (comp[row] == 5).any():
+                stop = int(np.argmax(comp[row] == 5))
+                assert cmask[row, stop] == 1  # eos included
+                assert cmask[row, stop + 1:].sum() == 0  # nothing after
+                assert (comp[row, stop + 1:] == 0).all()  # padded
+
+    def test_top_k_restricts(self):
+        params = M.init_params(jax.random.PRNGKey(0), CFG)
+        toks = jnp.ones((1, 4), jnp.int32)
+        mask = jnp.ones((1, 4), jnp.int32)
+        greedy, _ = generate(CFG, params, toks, mask, jax.random.PRNGKey(1),
+                             max_new_tokens=1, temperature=0.0)
+        topk1, _ = generate(CFG, params, toks, mask, jax.random.PRNGKey(2),
+                            max_new_tokens=1, temperature=5.0, top_k=1)
+        assert int(greedy[0, 0]) == int(topk1[0, 0])  # top_k=1 == greedy
+
+    def test_remat_matches(self):
+        params = M.init_params(jax.random.PRNGKey(0), CFG)
+        toks = jnp.arange(1, 9)[None]
+        base, _ = M.apply(CFG, params, toks)
+        remat_cfg = dataclasses.replace(CFG, remat=True)
+        remat, _ = M.apply(remat_cfg, params, toks)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(remat), atol=1e-5)
+
+
+class TestLoRA:
+    def test_merge_matches_runtime_adapter(self):
+        params = M.init_params(jax.random.PRNGKey(0), CFG)
+        lora = M.init_lora(jax.random.PRNGKey(1), CFG, rank=4)
+        # give B nonzero values so the adapter does something
+        lora = jax.tree_util.tree_map(
+            lambda x: x + 0.01 if x.ndim == 2 else x, lora
+        )
+        toks = jnp.arange(1, 9)[None]
+        with_adapter, _ = M.apply(CFG, params, toks, lora=lora, lora_scale=2.0)
+        merged = M.merge_lora(params, lora, scale=2.0)
+        with_merged, _ = M.apply(CFG, merged, toks)
+        np.testing.assert_allclose(
+            np.asarray(with_adapter), np.asarray(with_merged), atol=2e-4
+        )
+
+
+class TestTokenizerAndGym:
+    def test_char_tokenizer_roundtrip(self):
+        tok = CharTokenizer()
+        ids = tok.encode("12+3=15")
+        assert tok.decode(ids) == "12+3=15"
+
+    def test_preference_gym_loss_masks_cover_completion_only(self):
+        tok = CharTokenizer()
+        rows = [{"prompt": "12+1=", "chosen": "13", "rejected": "12"}]
+        gym = PreferenceGym(rows, rows, tok, data_batch_size=1)
+        batch = gym.reset()
+        ids = batch["chosen_ids"][0]
+        lm = batch["chosen_loss_mask"][0]
+        # completion = 2 chars + eos = 3 predictions
+        assert lm.sum() == 3
+        # the masked targets are the completion tokens (+ eos)
+        target_ids = ids[1:][lm.astype(bool)]
+        assert tok.decode([t for t in target_ids if t > 1]) == "13"
+
+
+@pytest.mark.slow
+class TestHFConversion:
+    def test_llama_logit_parity(self):
+        torch = pytest.importorskip("torch")
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        from agilerl_tpu.llm.hf import convert_hf_model, verify_against_hf
+
+        torch.manual_seed(0)
+        lcfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, tie_word_embeddings=False,
+        )
+        model = LlamaForCausalLM(lcfg).eval()
+        cfg, params = convert_hf_model(model)
+        assert verify_against_hf(model, cfg, params) < 2e-4
