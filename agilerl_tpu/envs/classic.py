@@ -137,10 +137,46 @@ class MountainCar(JaxEnv):
         return new, jnp.stack([position, velocity]), jnp.float32(-1.0), terminated, jnp.bool_(False)
 
 
+class VisualCartPole(CartPole):
+    """CartPole with an on-device rendered image observation [H, W, 1] —
+    exercises the CNN encoder path end-to-end without an Atari dependency
+    (parity target: the reference's Atari Pong CNN workload, BASELINE.md)."""
+
+    def __init__(self, size: int = 24):
+        super().__init__()
+        self.size = size
+        self.observation_space = spaces.Box(0.0, 1.0, (size, size, 1), np.float32)
+
+    def _render(self, state: CartPoleState) -> jax.Array:
+        s = self.size
+        xs = jnp.arange(s, dtype=jnp.float32)[None, :]
+        ys = jnp.arange(s, dtype=jnp.float32)[:, None]
+        cart_col = (state.x + 2.4) / 4.8 * (s - 1)
+        cart_row = jnp.float32(s - 3)
+        cart = jnp.exp(-((xs - cart_col) ** 2) / 4.0) * jnp.exp(
+            -((ys - cart_row) ** 2) / 2.0
+        )
+        tip_col = cart_col + jnp.sin(state.theta) * s * 0.4
+        tip_row = cart_row - jnp.cos(state.theta) * s * 0.4
+        pole = jnp.exp(-((xs - tip_col) ** 2) / 4.0) * jnp.exp(
+            -((ys - tip_row) ** 2) / 4.0
+        )
+        return jnp.clip(cart + pole, 0.0, 1.0)[..., None]
+
+    def reset_fn(self, key):
+        state, _ = super().reset_fn(key)
+        return state, self._render(state)
+
+    def step_fn(self, state, action, key):
+        new, _, reward, terminated, truncated = super().step_fn(state, action, key)
+        return new, self._render(new), reward, terminated, truncated
+
+
 REGISTRY = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "MountainCar-v0": MountainCar,
+    "VisualCartPole-v0": VisualCartPole,
 }
 
 

@@ -1,0 +1,50 @@
+"""End-to-end CNN (image-obs) path: DQN with an EvolvableCNN encoder on the
+on-device rendered VisualCartPole (the Atari-workload stand-in)."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms import DQN
+from agilerl_tpu.components import ReplayBuffer
+from agilerl_tpu.envs import JaxVecEnv
+from agilerl_tpu.envs.classic import VisualCartPole
+
+
+@pytest.mark.slow
+def test_cnn_dqn_end_to_end():
+    env = JaxVecEnv(VisualCartPole(size=24), num_envs=4, seed=0)
+    agent = DQN(
+        env.single_observation_space, env.single_action_space,
+        lr=1e-3, batch_size=32, learn_step=4, seed=0,
+        net_config={
+            "latent_dim": 32,
+            "encoder_config": {
+                "channel_size": (8, 8), "kernel_size": (3, 3), "stride_size": (2, 2),
+            },
+        },
+    )
+    assert agent.actor.config.encoder_kind == "cnn"
+    buf = ReplayBuffer(max_size=2048)
+    obs, _ = env.reset()
+    for step in range(60):
+        action = agent.get_action(obs, epsilon=0.5)
+        next_obs, reward, term, trunc, _ = env.step(action)
+        buf.add({"obs": obs, "action": action,
+                 "reward": np.asarray(reward, np.float32),
+                 "next_obs": next_obs, "done": np.asarray(term, np.float32)},
+                batched=True)
+        obs = next_obs
+        if len(buf) > 64 and step % 4 == 0:
+            loss = agent.learn(buf.sample(32))
+            assert np.isfinite(loss)
+    # CNN arch mutations keep working end-to-end
+    agent.actor.apply_mutation("encoder.add_channel")
+    agent.actor_target.config = agent.actor.config
+    import jax, jax.numpy as jnp
+
+    agent.actor_target.params = jax.tree_util.tree_map(jnp.copy, agent.actor.params)
+    agent.reinit_optimizers()
+    agent.mutation_hook()
+    assert np.isfinite(agent.learn(buf.sample(32)))
+    fitness = agent.test(env, max_steps=50, loop=1)
+    assert np.isfinite(fitness)

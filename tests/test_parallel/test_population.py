@@ -67,3 +67,17 @@ def test_pod_generation_on_8_device_mesh():
     # second generation reuses compiled program
     pop, fitness2 = gen(pop, jax.random.PRNGKey(2))
     assert np.isfinite(np.asarray(fitness2)).all()
+
+
+def test_evolution_deterministic_across_replicas():
+    """Same PRNG key => identical tournament outcome — the invariant that
+    replaces the reference's rank-0-decides + broadcast_object_list
+    (hpo/tournament.py:161) on multi-host pods."""
+    evo = make_evo()
+    pop = evo.init_population(jax.random.PRNGKey(0), pop_size=4)
+    fitness = jnp.array([3.0, 1.0, 4.0, 1.5])
+    a = evo.evolve(pop, fitness, jax.random.PRNGKey(7))
+    b = evo.evolve(pop, fitness, jax.random.PRNGKey(7))
+    for la, lb in zip(jax.tree_util.tree_leaves(a.actor),
+                      jax.tree_util.tree_leaves(b.actor)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
