@@ -1,0 +1,44 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.long_context import make_sp_logprob_fn
+
+CFG = M.GPTConfig(vocab_size=64, n_layer=2, n_head=4, n_kv_head=2, d_model=64,
+                  max_seq_len=128, dtype=jnp.float32)
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.asarray(jax.devices()), axis_names=("sp",))
+
+
+def test_sp_logprobs_match_single_device(mesh):
+    params = M.init_params(jax.random.PRNGKey(0), CFG)
+    lora = M.init_lora(jax.random.PRNGKey(1), CFG, rank=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 2, 64)
+
+    sp_fn = make_sp_logprob_fn(CFG, mesh)
+    got = sp_fn(params, lora, tokens)
+
+    want = M.token_logprobs(CFG, params, tokens, lora=lora)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_sp_logprobs_differentiable(mesh):
+    """The SP path must be usable inside a GRPO-style loss (grad wrt lora)."""
+    params = M.init_params(jax.random.PRNGKey(0), CFG)
+    lora = M.init_lora(jax.random.PRNGKey(1), CFG, rank=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 2, 64)
+    sp_fn = make_sp_logprob_fn(CFG, mesh)
+
+    def loss(lo):
+        return -sp_fn(params, lo, tokens).mean()
+
+    g = jax.grad(loss)(lora)
+    norms = [float(jnp.abs(l).max()) for l in jax.tree_util.tree_leaves(g)]
+    assert max(norms) > 0  # nonzero gradient flows through the ring
+    assert all(np.isfinite(n) for n in norms)
