@@ -259,6 +259,27 @@ def print_hyperparams(population: List) -> None:
         )
 
 
+def plot_population_score(pop, path: Optional[str] = None):
+    """Plot per-agent fitness curves (parity: utils/utils.py:945). Gated on
+    matplotlib availability."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:  # pragma: no cover
+        return None
+    fig, ax = plt.subplots()
+    for agent in pop:
+        ax.plot(agent.fitness, label=f"agent {agent.index}")
+    ax.set_xlabel("evaluation")
+    ax.set_ylabel("fitness")
+    ax.legend()
+    if path:
+        fig.savefig(path)
+    return fig
+
+
 def aggregate_metrics_across_hosts(value: float) -> float:
     """Mean-reduce a host scalar across processes (parity: utils/utils.py:1004
     aggregate_metrics_across_gpus — torch.distributed gather becomes a psum over

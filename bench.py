@@ -15,13 +15,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# persistent compile cache: repeated bench runs skip XLA compilation
-os.makedirs("/tmp/agilerl_tpu_xla_cache", exist_ok=True)
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/agilerl_tpu_xla_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+# NOTE: deliberately NO persistent compile cache — the remote-compile service
+# in this image can poison a shared cache with foreign-host executables
+# (machine-feature mismatch aborts on load).
 
 
 def log(msg):

@@ -23,13 +23,9 @@ import jax  # noqa: E402
 # force the config back *after* jax import but before any backend init.
 jax.config.update("jax_platforms", "cpu")
 
-# persistent compile cache: repeat suite runs skip most XLA compilation
-os.makedirs("/tmp/agilerl_tpu_test_xla_cache", exist_ok=True)
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/agilerl_tpu_test_xla_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:
-    pass
+# NOTE: no persistent compile cache here — this image's remote-compile service
+# can poison a shared cache dir with executables built for a different host
+# (AOT machine-feature mismatch -> abort/SIGILL on load).
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
