@@ -197,12 +197,15 @@ class GRPO(EvolvableAlgorithm):
         base = self.base_params
         scale = self.lora_scale
         tx = self.optimizer.tx
+        # the flash kernel has a custom VJP, so the TRAINING loss can use it too
+        use_flash = jax.default_backend() == "tpu"
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def update(lora, opt_state, batch, clip, beta):
             def loss_fn(lo):
                 lp = M.token_logprobs(
-                    config, base, batch["tokens"], attention_mask=batch["mask"], lora=lo
+                    config, base, batch["tokens"], attention_mask=batch["mask"],
+                    lora=lo, flash=use_flash,
                 )
                 lp = lp * batch["loss_mask"]
                 ratio = jnp.exp(lp - batch["old_lp"])

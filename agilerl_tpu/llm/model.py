@@ -273,13 +273,12 @@ def forward(
         kh = jnp.moveaxis(k_all, 2, 1)
         vh = jnp.moveaxis(v_all, 2, 1)
         if use_flash and layer_cache is None:
-            # Pallas flash attention (causal + padding mask) on the training/
-            # prefill-free path; the cached decode path stays on XLA attention
-            from agilerl_tpu.ops.flash_attention import flash_attention
+            # Pallas flash attention (causal + padding mask, custom VJP so it
+            # also serves training losses); the cached decode path stays on
+            # XLA attention
+            from agilerl_tpu.ops.flash_attention_vjp import flash_attention_diff
 
-            attn = flash_attention(
-                qh, kh, vh, padding_mask=attention_mask, causal=True
-            )
+            attn = flash_attention_diff(qh, kh, vh, attention_mask, True)
         else:
             scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
             scores = scores / math.sqrt(config.head_dim)

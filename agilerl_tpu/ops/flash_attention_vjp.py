@@ -1,0 +1,354 @@
+"""Differentiable Pallas flash attention (custom VJP, FlashAttention-2 style
+backward) — lets the fused kernel serve the TRAINING losses (GRPO/DPO forward-
+backward), not just the no-grad passes.
+
+Forward saves per-row logsumexp L; backward recomputes probabilities blockwise:
+  D_i  = rowsum(dO_i * O_i)
+  P_ij = exp(q_i k_j^T * scale - L_i)
+  dV_j = sum_i P_ij^T dO_i
+  dS   = P * (dO V^T - D)
+  dQ_i = dS_ij K_j * scale        (grid: kv innermost, accumulate in VMEM)
+  dK_j = dS_ij^T Q_i * scale      (grid: q innermost, accumulate in VMEM)
+
+Causal masking mirrors the forward. Interpret mode on CPU for tests; native on
+TPU. Supports an optional [B, T] padding mask like the forward kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+# --------------------------------------------------------------------------- #
+# Forward kernel that also emits L = m + log(l)
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
+    def kernel(*refs):
+        if with_mask:
+            (q_ref, k_ref, v_ref, pm_ref, out_ref, lse_ref,
+             m_ref, l_ref, acc_ref) = refs
+        else:
+            q_ref, k_ref, v_ref, out_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+            pm_ref = None
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def body():
+            q, k, v = q_ref[0], k_ref[0], v_ref[0]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_ids = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = k_ids < seq_len
+            if causal:
+                mask = jnp.logical_and(mask, k_ids <= q_ids)
+            if pm_ref is not None:
+                mask = jnp.logical_and(mask, pm_ref[0][None, :] > 0)
+            s = jnp.where(mask, s, -1e30)
+            m_old = m_ref[:]
+            m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_old - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
+            m_ref[:] = m_new
+
+        if causal:
+            @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(kj == nk - 1)
+        def _done():
+            out_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(out_ref.dtype)
+            lse_ref[0] = (m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+
+    return kernel
+
+
+def _dq_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
+    def kernel(*refs):
+        if with_mask:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, pm_ref,
+             dq_ref, acc_ref) = refs
+        else:
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, acc_ref = refs
+            pm_ref = None
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(kj == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def body():
+            q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_ids = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = k_ids < seq_len
+            if causal:
+                mask = jnp.logical_and(mask, k_ids <= q_ids)
+            if pm_ref is not None:
+                mask = jnp.logical_and(mask, pm_ref[0][None, :] > 0)
+            p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+            dov = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # [BQ, BK]
+            ds = p * (dov - dd_ref[0][:, None])
+            acc_ref[:] = acc_ref[:] + jnp.dot(
+                ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+            ) * scale
+
+        if causal:
+            @pl.when(kj * block_k <= qi * block_q + block_q - 1)
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(kj == nk - 1)
+        def _done():
+            dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _dkv_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
+    def kernel(*refs):
+        if with_mask:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, pm_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        else:
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+             dk_ref, dv_ref, dk_acc, dv_acc) = refs
+            pm_ref = None
+        kj = pl.program_id(1)
+        qi = pl.program_id(2)
+        nq = pl.num_programs(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        def body():
+            q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_ids = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = k_ids < seq_len
+            if causal:
+                mask = jnp.logical_and(mask, k_ids <= q_ids)
+            if pm_ref is not None:
+                mask = jnp.logical_and(mask, pm_ref[0][None, :] > 0)
+            p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+            dv_acc[:] = dv_acc[:] + jnp.dot(
+                p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
+            )
+            dov = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = p * (dov - dd_ref[0][:, None])
+            dk_acc[:] = dk_acc[:] + jnp.dot(
+                ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32
+            ) * scale
+
+        if causal:
+            # q blocks strictly before this kv block contribute nothing
+            @pl.when(qi * block_q + block_q - 1 >= kj * block_k)
+            def _run():
+                body()
+        else:
+            body()
+
+        @pl.when(qi == nq - 1)
+        def _done():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    return kernel
+
+
+# --------------------------------------------------------------------------- #
+# custom_vjp wrapper
+# --------------------------------------------------------------------------- #
+
+
+def _pad_t(x, pad):
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_diff(
+    q: jax.Array,  # [B, H, T, d]
+    k: jax.Array,
+    v: jax.Array,
+    padding_mask: Optional[jax.Array] = None,  # [B, T] 1=real
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    out, _ = _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret)
+    return out
+
+
+def _prep(q, T, block_q, block_k):
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    pad = (-T) % max(block_q, block_k)
+    return block_q, block_k, pad
+
+
+def _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas tpu module unavailable")
+    B, H, T, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k, pad = _prep(q, T, block_q, block_k)
+    Tp = T + pad
+    qf = _pad_t(q, pad).reshape(B * H, Tp, d)
+    kf = _pad_t(k, pad).reshape(B * H, Tp, d)
+    vf = _pad_t(v, pad).reshape(B * H, Tp, d)
+    with_mask = padding_mask is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qf, kf, vf]
+    if with_mask:
+        mp = jnp.pad(padding_mask.astype(jnp.int32), ((0, 0), (0, pad)))
+        in_specs.append(pl.BlockSpec((1, block_k), lambda b, i, j, H=H: (b // H, j)))
+        args.append(mp)
+    grid = (B * H, Tp // block_q, Tp // block_k)
+    out, lse = pl.pallas_call(
+        _fwd_kernel(scale, causal, block_q, block_k, T, with_mask),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tp, d), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    out4 = out.reshape(B, H, Tp, d)[:, :, :T, :]
+    return out4, (q, k, v, padding_mask, out4, lse)
+
+
+def _fwd_rule(q, k, v, padding_mask, causal, block_q, block_k, interpret):
+    out, res = _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret)
+    return out, res
+
+
+def _bwd_rule(causal, block_q, block_k, interpret, res, do):
+    q, k, v, padding_mask, out, lse = res
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, T, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k, pad = _prep(q, T, block_q, block_k)
+    Tp = T + pad
+    bh = B * H
+    qf = _pad_t(q, pad).reshape(bh, Tp, d)
+    kf = _pad_t(k, pad).reshape(bh, Tp, d)
+    vf = _pad_t(v, pad).reshape(bh, Tp, d)
+    dof = _pad_t(do, pad).reshape(bh, Tp, d)
+    # D_i = rowsum(dO * O); lse already [bh, Tp]
+    dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pad))).reshape(bh, Tp)
+    with_mask = padding_mask is not None
+    mask_args = []
+    if with_mask:
+        mask_args = [jnp.pad(padding_mask.astype(jnp.int32), ((0, 0), (0, pad)))]
+
+    common_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q by qi
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k by kj
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v by kj
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do by qi
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),  # lse by qi
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),  # dd by qi
+    ]
+    if with_mask:
+        common_specs.append(
+            pl.BlockSpec((1, block_k), lambda b, i, j, H=H: (b // H, j))
+        )
+    dq = pl.pallas_call(
+        _dq_kernel(scale, causal, block_q, block_k, T, with_mask),
+        grid=(bh, Tp // block_q, Tp // block_k),
+        in_specs=common_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, Tp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dd, *mask_args)
+
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+    ]
+    if with_mask:
+        dkv_specs.append(
+            pl.BlockSpec((1, block_k), lambda b, j, i, H=H: (b // H, j))
+        )
+    dk, dv = pl.pallas_call(
+        _dkv_kernel(scale, causal, block_q, block_k, T, with_mask),
+        grid=(bh, Tp // block_k, Tp // block_q),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, Tp, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, Tp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dd, *mask_args)
+
+    unpad = lambda x: x.reshape(B, H, Tp, d)[:, :, :T, :]  # noqa: E731
+    return unpad(dq), unpad(dk), unpad(dv), None
+
+
+flash_attention_diff.defvjp(_fwd_rule, _bwd_rule)

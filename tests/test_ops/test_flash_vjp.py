@@ -1,0 +1,99 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.ops.flash_attention_vjp import flash_attention_diff
+
+
+def dense_attention(q, k, v, causal):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches(causal):
+    key = jax.random.PRNGKey(0)
+    B, H, T, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+               for i in range(3))
+    got = flash_attention_diff(q, k, v, None, causal, 16, 16)
+    want = dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_dense(causal):
+    key = jax.random.PRNGKey(1)
+    B, H, T, d = 1, 2, 32, 16
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+               for i in range(3))
+    tgt = jax.random.normal(jax.random.fold_in(key, 9), (B, H, T, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum((flash_attention_diff(q, k, v, None, causal, 16, 16) - tgt) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum((dense_attention(q, k, v, causal) - tgt) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=5e-4,
+            err_msg=f"grad mismatch for {name}",
+        )
+
+
+def test_gradients_ragged_length():
+    key = jax.random.PRNGKey(2)
+    B, H, T, d = 1, 1, 24, 16  # T not divisible by blocks
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+               for i in range(3))
+
+    def loss_flash(q):
+        return jnp.sum(flash_attention_diff(q, k, v, None, True, 16, 16) ** 2)
+
+    def loss_dense(q):
+        return jnp.sum(dense_attention(q, k, v, True) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_flash)(q)), np.asarray(jax.grad(loss_dense)(q)),
+        atol=5e-4,
+    )
+
+
+def test_gradients_with_padding_mask():
+    key = jax.random.PRNGKey(3)
+    B, H, T, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, H, T, d))
+               for i in range(3))
+    mask = jnp.ones((B, T), jnp.int32).at[0, :8].set(0)
+
+    def dense_masked(q, k, v):
+        scale = 1.0 / np.sqrt(d)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        full = jnp.logical_and(causal[None, None], mask[:, None, None, :].astype(bool))
+        scores = jnp.where(full, scores, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+    # compare grads on real (unpadded) rows only: weight the loss by the mask
+    w = mask[:, None, :, None].astype(jnp.float32)
+
+    def lf(q, k, v):
+        return jnp.sum((flash_attention_diff(q, k, v, mask, True, 16, 16) * w) ** 2)
+
+    def ld(q, k, v):
+        return jnp.sum((dense_masked(q, k, v) * w) ** 2)
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   err_msg=name)
