@@ -44,7 +44,16 @@ def train_on_policy(
     verbose: bool = True,
     accelerator=None,
     wandb_api_key: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[List, List[List[float]]]:
+    if resume and checkpoint_path is not None:
+        from pathlib import Path as _P
+
+        for agent in pop:
+            p = _P(checkpoint_path)
+            f = p.parent / f"{p.stem}_{agent.index}{p.suffix or '.ckpt'}"
+            if f.exists():
+                agent.load_checkpoint(f)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
     num_envs = getattr(env, "num_envs", 1)
     pop_fitnesses: List[List[float]] = [[] for _ in pop]

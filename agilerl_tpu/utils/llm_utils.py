@@ -94,8 +94,14 @@ class HuggingFaceGym:
 
     def _tokenize_prompts(self, rows: List[Dict]) -> Dict[str, np.ndarray]:
         seqs = [self.tokenizer.encode(str(r[self.question_key])) for r in rows]
+        max_len = self.max_context_length
+        if max_len is None:
+            # bucket prompt length to a multiple of 32 so generate/learn jit
+            # caches stay bounded instead of recompiling per batch shape
+            longest = max(len(s) for s in seqs)
+            max_len = ((longest + 31) // 32) * 32
         ids, mask = left_pad(seqs, pad_id=self.tokenizer.pad_token_id,
-                             max_len=self.max_context_length)
+                             max_len=max_len)
         return {"input_ids": ids, "attention_mask": mask}
 
     def __len__(self):
