@@ -340,6 +340,66 @@ class PPO(RLAlgorithm):
 
         return update
 
+    def _scan_learn_fn(self, total: int):
+        """Whole PPO update (epochs x minibatches) as ONE jitted program —
+        no host dispatch per minibatch (the TPU-side answer to the reference's
+        per-minibatch torch steps)."""
+        actor_cfg = self.actor.config
+        critic_cfg = self.critic.config
+        dist_cfg = self.actor.dist_config
+        space = self.observation_space
+        tx = self.optimizer.tx
+        normalize_advantage = self.normalize_advantage
+        mb = min(self.batch_size, total)
+        n_mb = max(total // mb, 1)
+        epochs = self.update_epochs
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def scan_learn(params, opt_state, data, key, clip, ent_coef, vf_coef):
+            def minibatch(carry, b):
+                params, opt_state = carry
+
+                def loss_fn(p):
+                    obs = preprocess_observation(space, b["obs"])
+                    logits = EvolvableNetwork.apply(actor_cfg, p["actor"], obs)
+                    extra = p["actor"].get("dist")
+                    new_logp = D.log_prob(dist_cfg, logits, b["action"], extra)
+                    entropy = D.entropy(dist_cfg, logits, extra).mean()
+                    value = EvolvableNetwork.apply(critic_cfg, p["critic"], obs)[..., 0]
+                    adv = b["advantages"]
+                    if normalize_advantage:
+                        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                    ratio = jnp.exp(new_logp - b["log_prob"])
+                    pg = jnp.maximum(
+                        -adv * ratio, -adv * jnp.clip(ratio, 1 - clip, 1 + clip)
+                    ).mean()
+                    v_loss = 0.5 * jnp.square(value - b["returns"]).mean()
+                    return pg - ent_coef * entropy + vf_coef * v_loss
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            def epoch(carry, k):
+                params, opt_state = carry
+                perm = jax.random.permutation(k, total)[: n_mb * mb]
+                batches = jax.tree_util.tree_map(
+                    lambda x: x[perm].reshape((n_mb, mb) + x.shape[1:]), data
+                )
+                (params, opt_state), losses = jax.lax.scan(
+                    minibatch, (params, opt_state), batches
+                )
+                return (params, opt_state), losses.mean()
+
+            keys = jax.random.split(key, epochs)
+            (params, opt_state), losses = jax.lax.scan(
+                epoch, (params, opt_state), keys
+            )
+            return params, opt_state, losses.mean()
+
+        return scan_learn
+
     def learn(self, experiences: Optional[Tuple] = None) -> float:
         """Update from the rollout buffer (parity: ppo.py:635)."""
         buf = self.rollout_buffer
@@ -385,6 +445,25 @@ class PPO(RLAlgorithm):
                     n_updates += 1
                 if self.target_kl is not None and float(aux[3]) > 1.5 * self.target_kl:
                     break
+        elif self.target_kl is None:
+            # fully device-side path: the whole update is one XLA program
+            data = buf.get_all_flat()
+            total = jax.tree_util.tree_leaves(data["action"])[0].shape[0]
+            scan_learn = self.jit_fn(
+                f"scan_learn_{total}", lambda: self._scan_learn_fn(total),
+                static_key=(self.actor.config, self.critic.config,
+                            self.normalize_advantage, total, self.batch_size,
+                            self.update_epochs, str(self.observation_space),
+                            str(self.action_space), self.optimizer.optimizer_name,
+                            self.optimizer.max_grad_norm),
+            )
+            params, opt_state, loss = scan_learn(
+                params, opt_state, data, self.next_key(),
+                jnp.float32(self.clip_coef), jnp.float32(self.ent_coef),
+                jnp.float32(self.vf_coef),
+            )
+            mean_loss += float(loss)
+            n_updates += 1
         else:
             update = self.jit_fn(
                 "update", self._update_fn,
