@@ -26,7 +26,11 @@ def _block_attn(q, k, v, mask, scale):
     Returns (numerator [B, Tq, H, d], row max m [B, Tq, H], denom l [B, Tq, H])."""
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Tq, Tk]
     if mask is not None:
-        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+        if mask.ndim == 2:  # [Tq, Tk]
+            mask = mask[None, None]
+        elif mask.ndim == 3:  # [B, Tq, Tk]
+            mask = mask[:, None]
+        scores = jnp.where(mask, scores, -1e30)
     m = jnp.max(scores, axis=-1)  # [B, H, Tq]
     p = jnp.exp(scores - m[..., None])
     l = jnp.sum(p, axis=-1)  # [B, H, Tq]
@@ -40,6 +44,8 @@ def ring_attention(
     v: jax.Array,
     axis_name: str = "sp",
     causal: bool = True,
+    kv_mask: Optional[jax.Array] = None,  # [B, T_local] 1 = real token; the
+    # mask ROTATES around the ring with its k/v block (ragged/right-padded seqs)
 ) -> jax.Array:
     """Call INSIDE shard_map with q/k/v sharded on the sequence axis."""
     p_size = lax.axis_size(axis_name)
@@ -48,17 +54,22 @@ def ring_attention(
     scale = 1.0 / (d ** 0.5)
 
     t_ids = jnp.arange(T)
-    intra_mask = t_ids[:, None] >= t_ids[None, :]  # causal within a block
+    intra_causal = t_ids[:, None] >= t_ids[None, :]  # causal within a block
 
     def step(carry, i):
-        k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        k_blk, v_blk, m_blk, o_acc, m_acc, l_acc = carry
         src_idx = (my_idx - i) % p_size  # which block this k/v shard came from
 
-        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, None, scale)
+        pad_mask = (
+            jnp.broadcast_to(m_blk[:, None, :].astype(bool), (B, T, T))
+            if m_blk is not None else None
+        )
+        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, pad_mask, scale)
         if causal:
-            # block-level causality: src block strictly after mine contributes
-            # nothing; same block uses the intra-block causal mask
-            o_diag, m_diag, l_diag = _block_attn(q, k_blk, v_blk, intra_mask, scale)
+            diag_mask = intra_causal[None, :, :]
+            if pad_mask is not None:
+                diag_mask = jnp.logical_and(diag_mask, pad_mask)
+            o_diag, m_diag, l_diag = _block_attn(q, k_blk, v_blk, diag_mask, scale)
             same = src_idx == my_idx
             after = src_idx > my_idx
             o_b = jnp.where(same, o_diag, o_b)
@@ -76,34 +87,47 @@ def ring_attention(
         l_new = l_acc * alpha + l_b * beta
         o_new = o_acc * alpha[..., None] + o_b * beta[..., None]
 
-        # rotate k/v around the ring
-        k_next = lax.ppermute(k_blk, axis_name,
-                              [(j, (j + 1) % p_size) for j in range(p_size)])
-        v_next = lax.ppermute(v_blk, axis_name,
-                              [(j, (j + 1) % p_size) for j in range(p_size)])
-        return (k_next, v_next, o_new, m_new, l_new), None
+        # rotate k/v (and their mask) around the ring
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        m_next = (
+            lax.ppermute(m_blk, axis_name, perm) if m_blk is not None else None
+        )
+        return (k_next, v_next, m_next, o_new, m_new, l_new), None
 
     # derive accumulators from q so they carry the same varying-axis ("vma")
     # type as the per-device loop outputs (new shard_map type system)
     o0 = q * 0.0
     m0 = jnp.sum(o0, axis=-1) - 1e30
     l0 = jnp.sum(o0, axis=-1)
-    (k_f, v_f, o, m, l), _ = lax.scan(
-        step, (k, v, o0, m0, l0), jnp.arange(p_size)
+    (k_f, v_f, _mf, o, m, l), _ = lax.scan(
+        step, (k, v, kv_mask, o0, m0, l0), jnp.arange(p_size)
     )
     return o / jnp.maximum(l[..., None], 1e-30)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = True):
-    """Wrap ring_attention in shard_map: takes [B, T, H, d] arrays sharded on T."""
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = "sp", causal: bool = True, with_mask: bool = False
+):
+    """Wrap ring_attention in shard_map: takes [B, T, H, d] arrays sharded on T
+    (+ an optional [B, T] kv padding mask when with_mask=True)."""
 
-    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
     spec = P(None, axis_name, None, None)
-    return jax.jit(
-        shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-           
+    mspec = P(None, axis_name)
+    if with_mask:
+        fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+
+        def wrapped(q, k, v, m):
+            return fn(q, k, v, kv_mask=m)
+
+        return jax.jit(
+            shard_map(wrapped, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+                      out_specs=spec)
         )
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     )
 
 

@@ -20,3 +20,30 @@ def test_ring_matches_dense(causal):
     out = ring(q, k, v)
     ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_with_padding_mask_matches_dense():
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), axis_names=("sp",))
+    B, T, H, d = 2, 64, 2, 16
+    key = jax.random.PRNGKey(1)
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (B, T, H, d)) for i in range(3)
+    )
+    # right-padded: row 0 has 48 real tokens, row 1 full
+    mask = jnp.ones((B, T), jnp.int32).at[0, 48:].set(0)
+
+    from agilerl_tpu.ops.ring_attention import make_ring_attention
+
+    ring = make_ring_attention(mesh, causal=True, with_mask=True)
+    got = ring(q, k, v, mask)
+
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+    full = jnp.logical_and(causal[None, None], mask[:, None, None, :].astype(bool))
+    scores = jnp.where(full, scores, -1e30)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+    # compare only real query rows
+    np.testing.assert_allclose(np.asarray(got[0, :48]), np.asarray(want[0, :48]), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]), atol=2e-5)
