@@ -35,7 +35,7 @@ class CQN(DQN):
         cql_alpha = self.cql_alpha
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, target_params, opt_state, batch, gamma, tau):
+        def train_step(params, target_params, opt_state, batch, weights, gamma, tau):
             obs, action = batch["obs"], batch["action"].astype(jnp.int32)
             reward = batch["reward"].astype(jnp.float32)
             done = batch["done"].astype(jnp.float32)
@@ -52,17 +52,18 @@ class CQN(DQN):
             def loss_fn(p):
                 q = QNetwork.apply(config, p, obs)
                 q_sel = jnp.take_along_axis(q, action[..., None], axis=-1)[..., 0]
-                td = jnp.mean(jnp.square(q_sel - jax.lax.stop_gradient(target)))
+                td_err = q_sel - jax.lax.stop_gradient(target)
+                td = jnp.mean(weights * jnp.square(td_err))
                 # conservative penalty: push down logsumexp, push up data actions
                 cql = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1) - q_sel)
-                return td + cql_alpha * cql
+                return td + cql_alpha * cql, jnp.abs(td_err)
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            (loss, td_abs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             target_params = jax.tree_util.tree_map(
                 lambda t, p: (1.0 - tau) * t + tau * p, target_params, params
             )
-            return params, target_params, opt_state, loss
+            return params, target_params, opt_state, loss, td_abs
 
         return train_step

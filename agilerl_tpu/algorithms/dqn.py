@@ -146,7 +146,7 @@ class DQN(RLAlgorithm):
         double = self.double
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, target_params, opt_state, batch, gamma, tau):
+        def train_step(params, target_params, opt_state, batch, weights, gamma, tau):
             obs, action = batch["obs"], batch["action"].astype(jnp.int32)
             reward = batch["reward"].astype(jnp.float32)
             done = batch["done"].astype(jnp.float32)
@@ -163,21 +163,32 @@ class DQN(RLAlgorithm):
             def loss_fn(p):
                 q = QNetwork.apply(config, p, obs)
                 q_sel = jnp.take_along_axis(q, action[..., None], axis=-1)[..., 0]
-                return jnp.mean(jnp.square(q_sel - jax.lax.stop_gradient(target)))
+                td = q_sel - jax.lax.stop_gradient(target)
+                return jnp.mean(weights * jnp.square(td)), jnp.abs(td)
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            (loss, td_abs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             target_params = jax.tree_util.tree_map(
                 lambda t, p: (1.0 - tau) * t + tau * p, target_params, params
             )
-            return params, target_params, opt_state, loss
+            return params, target_params, opt_state, loss, td_abs
 
         return train_step
 
-    def learn(self, experiences: Dict[str, jax.Array]) -> float:
-        """One TD update from a sampled batch (parity: dqn.py learn/update)."""
-        batch = dict(experiences)
+    def learn(self, experiences) -> float:
+        """One TD update from a sampled batch (parity: dqn.py learn/update).
+
+        experiences: batch dict, or a PER tuple (batch, idxs, weights) — then
+        the loss is importance-weighted and (loss, new_priorities) is returned."""
+        idxs = None
+        if isinstance(experiences, tuple):
+            batch, idxs, weights = experiences[0], experiences[1], experiences[2]
+            weights = jnp.asarray(weights, jnp.float32)
+        else:
+            batch = experiences
+            weights = jnp.ones_like(jnp.asarray(batch["reward"], jnp.float32))
+        batch = dict(batch)
         batch["obs"] = self.preprocess_observation(batch["obs"])
         batch["next_obs"] = self.preprocess_observation(batch["next_obs"])
         train_step = self.jit_fn(
@@ -185,17 +196,20 @@ class DQN(RLAlgorithm):
             static_key=(self.actor.config, self.double,
                         self.optimizer.optimizer_name, self.optimizer.max_grad_norm),
         )
-        params, tparams, opt_state, loss = train_step(
+        params, tparams, opt_state, loss, td_abs = train_step(
             self.actor.params,
             self.actor_target.params,
             self.optimizer.opt_state,
             batch,
+            weights,
             jnp.float32(self.gamma),
             jnp.float32(self.tau),
         )
         self.actor.params = params
         self.actor_target.params = tparams
         self.optimizer.opt_state = opt_state
+        if idxs is not None:
+            return float(loss), np.asarray(td_abs) + 1e-6
         return float(loss)
 
     def soft_update(self) -> None:

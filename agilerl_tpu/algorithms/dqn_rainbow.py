@@ -154,7 +154,13 @@ class RainbowDQN(RLAlgorithm):
 
         return act
 
-    def get_action(self, obs, action_mask=None, training: bool = True) -> np.ndarray:
+    def get_action(
+        self, obs, epsilon: float = 0.0, action_mask=None, training: bool = True,
+        **kwargs,
+    ) -> np.ndarray:
+        """epsilon is accepted for train-loop compatibility but ignored —
+        exploration comes from the noisy nets (parity: the reference's Rainbow
+        also takes the loop's epsilon and relies on noise instead)."""
         from agilerl_tpu.algorithms.dqn import _is_single
 
         obs = self.preprocess_observation(obs)

@@ -133,10 +133,19 @@ class MultiStepReplayBuffer(ReplayBuffer):
     """N-step return folding over vectorised envs
     (parity: replay_buffer.py:141, _get_n_step_info:206).
 
-    Keeps a host-side deque of the last n vectorised transitions per env; on
-    every add once the horizon is full, folds reward/next_obs/done with gamma
-    and pushes the fused transition into the device ring buffer. Returns the
-    fused transition so PER can mirror it (parity: sample_from_indices:196).
+    Keeps a host-side window of the last n vectorised transitions; once the
+    window is full, every ``add``:
+      1. pushes the FUSED n-step transition (gamma-folded reward, n-ahead
+         next_obs/done) into this buffer's own device ring, and
+      2. returns the OLDEST raw 1-step transition for the caller to store in
+         the main replay buffer.
+    Because both buffers then append in lockstep, index i refers to the same
+    start step in both — so PER indices sampled from the main buffer can be
+    mirrored here via ``sample_from_indices`` (parity: the reference's paired
+    buffers, replay_buffer.py:196 + train_off_policy.py:340).
+
+    Call ``reset_horizon()`` whenever the env is reset or the acting agent
+    changes — otherwise folds would span unrelated trajectories.
     """
 
     def __init__(self, max_size: int, n_step: int = 3, gamma: float = 0.99, device=None):
@@ -145,17 +154,21 @@ class MultiStepReplayBuffer(ReplayBuffer):
         self.gamma = float(gamma)
         self._horizon: list = []
 
+    def reset_horizon(self) -> None:
+        self._horizon = []
+
     def add(self, transition: Dict, batched: bool = False) -> Optional[Dict]:
-        """transition keys: obs, action, reward, next_obs, done."""
+        """transition keys: obs, action, reward, next_obs, done.
+        Returns the oldest raw transition once the window is full, else None."""
         self._horizon.append(
             jax.tree_util.tree_map(lambda x: np.asarray(x), transition)
         )
         if len(self._horizon) < self.n_step:
             return None
         fused = self._fold()
-        self._horizon.pop(0)
+        oldest = self._horizon.pop(0)
         super().add(fused, batched=batched)
-        return fused
+        return oldest
 
     def _fold(self) -> Dict:
         first = self._horizon[0]

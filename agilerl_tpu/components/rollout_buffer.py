@@ -42,22 +42,32 @@ def _write_step(state: RolloutState, step: Dict[str, PyTree]) -> RolloutState:
 def _compute_gae(
     rewards: jax.Array,  # [T, N]
     values: jax.Array,  # [T, N]
-    dones: jax.Array,  # [T, N] done AFTER step t
-    last_value: jax.Array,  # [N]
-    last_done: jax.Array,  # [N]
+    dones: jax.Array,  # [T, N] done AFTER step t (the step's own terminal flag)
+    last_value: jax.Array,  # [N] V(s_T) — value of the obs after the last step
+    last_done: jax.Array,  # [N] unused (kept for API compat; dones[T-1] already
+    # carries the final step's terminal flag under this storage convention)
     gamma: float,
     gae_lambda: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """GAE via reverse lax.scan (parity: rollout_buffer.py:413)."""
+    """GAE via reverse lax.scan (parity: rollout_buffer.py:413).
+
+    Storage convention: dones[t] = 1 iff the episode ended AT step t (the env
+    autoresets, so obs[t+1] belongs to the next episode). Hence step t's own
+    done masks BOTH its bootstrap and the advantage carried from t+1:
+        delta_t = r_t + gamma * V(s_{t+1}) * (1 - done_t) - V(s_t)
+        A_t     = delta_t + gamma * lambda * (1 - done_t) * A_{t+1}
+    (The CleanRL form indexes dones[t+1] because it stores reset flags; using
+    it with per-step terminal flags leaks values across episode boundaries.)"""
 
     def step(carry, xs):
-        gae, next_value, next_nonterminal = carry
+        gae, next_value = carry
         reward, value, done = xs
-        delta = reward + gamma * next_value * next_nonterminal - value
-        gae = delta + gamma * gae_lambda * next_nonterminal * gae
-        return (gae, value, 1.0 - done), gae
+        nonterminal = 1.0 - done
+        delta = reward + gamma * next_value * nonterminal - value
+        gae = delta + gamma * gae_lambda * nonterminal * gae
+        return (gae, value), gae
 
-    init = (jnp.zeros_like(last_value), last_value, 1.0 - last_done)
+    init = (jnp.zeros_like(last_value), last_value)
     _, adv_rev = jax.lax.scan(
         step, init, (rewards[::-1], values[::-1], dones[::-1])
     )

@@ -108,8 +108,18 @@ class Mutations:
                 if hasattr(sub, "apply_mutation") and _has_method(sub, method):
                     try:
                         sub.apply_mutation(method, rng=np.random.default_rng(seed))
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # surface sibling-mutation failures instead of silently
+                        # diverging architectures (review finding)
+                        import warnings
+
+                        warnings.warn(
+                            f"mutation {method!r} failed on {group.eval} "
+                            f"({type(sub).__name__}): {e!r} — network left "
+                            f"unmutated",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
         self._reinit_shared(agent)
         agent.reinit_optimizers()
         agent.mutation_hook()

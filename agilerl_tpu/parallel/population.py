@@ -136,14 +136,17 @@ class EvoPPO:
         return traj, vstate, obs, fitness, key
 
     def _gae(self, traj, last_value):
+        # dones are per-step terminal flags: step t's own done masks both its
+        # bootstrap and the carried advantage (see components/rollout_buffer.py)
         def step(carry, xs):
-            gae, next_v, next_nt = carry
+            gae, next_v = carry
             r, v, d = xs
-            delta = r + self.gamma * next_v * next_nt - v
-            gae = delta + self.gamma * self.gae_lambda * next_nt * gae
-            return (gae, v, 1.0 - d), gae
+            nonterm = 1.0 - d
+            delta = r + self.gamma * next_v * nonterm - v
+            gae = delta + self.gamma * self.gae_lambda * nonterm * gae
+            return (gae, v), gae
 
-        init = (jnp.zeros_like(last_value), last_value, jnp.ones_like(last_value))
+        init = (jnp.zeros_like(last_value), last_value)
         _, adv = jax.lax.scan(
             step, init,
             (traj["reward"][::-1], traj["value"][::-1], traj["done"][::-1]),

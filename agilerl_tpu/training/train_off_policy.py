@@ -73,6 +73,9 @@ def train_off_policy(
     while np.min([agent.steps[-1] for agent in pop]) < max_steps:
         for agent in pop:
             obs, _ = env.reset()
+            if n_step and n_step_memory is not None:
+                # folds must not span the reset / the previous agent's steps
+                n_step_memory.reset_horizon()
             scores = np.zeros(num_envs)
             completed_scores: List[float] = []
             steps = 0
@@ -94,9 +97,13 @@ def train_off_policy(
                     "done": np.asarray(terminated, np.float32),
                 }
                 if n_step and n_step_memory is not None:
-                    fused = n_step_memory.add(transition, batched=num_envs > 1)
-                    if fused is not None:
-                        memory.add(fused, batched=num_envs > 1)
+                    # fused n-step goes into n_step_memory's own ring; the
+                    # returned OLDEST raw transition goes into the main buffer
+                    # so both rings stay index-aligned (parity: reference's
+                    # paired-buffer scheme, train_off_policy.py:340)
+                    one_step = n_step_memory.add(transition, batched=num_envs > 1)
+                    if one_step is not None:
+                        memory.add(one_step, batched=num_envs > 1)
                 else:
                     memory.add(transition, batched=num_envs > 1)
 
@@ -112,7 +119,14 @@ def train_off_policy(
                 ):
                     if per:
                         batch, idxs, weights = memory.sample(agent.batch_size)
-                        new_priorities = agent.learn((batch, idxs, weights))
+                        if n_step and n_step_memory is not None:
+                            n_batch = n_step_memory.sample_from_indices(idxs)
+                            result = agent.learn((batch, idxs, weights, n_batch))
+                        else:
+                            result = agent.learn((batch, idxs, weights))
+                        new_priorities = (
+                            result[1] if isinstance(result, tuple) else None
+                        )
                         if new_priorities is not None:
                             memory.update_priorities(idxs, new_priorities)
                     else:

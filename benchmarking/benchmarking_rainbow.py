@@ -35,13 +35,15 @@ def main():
                 tr = {"obs": obs, "action": action,
                       "reward": np.asarray(reward, np.float32),
                       "next_obs": next_obs, "done": np.asarray(term, np.float32)}
-                fused = n_step_memory.add(tr, batched=True)
-                memory.add(tr, batched=True)
+                one_step = n_step_memory.add(tr, batched=True)
+                if one_step is not None:
+                    memory.add(one_step, batched=True)  # index-aligned pair
                 obs = next_obs
                 total += num_envs
                 if len(memory) > agent.batch_size and total % (agent.learn_step * num_envs) == 0:
                     batch, idxs, weights = memory.sample(agent.batch_size)
-                    loss, pri = agent.learn((batch, idxs, weights))
+                    n_batch = n_step_memory.sample_from_indices(idxs)
+                    loss, pri = agent.learn((batch, idxs, weights, n_batch))
                     if pri is not None:
                         memory.update_priorities(idxs, pri)
             agent.test(env, max_steps=200, loop=1)

@@ -57,16 +57,21 @@ class TestReplayBuffer:
 
 
 class TestNStep:
-    def test_fold(self):
+    def test_fold_in_ring_and_oldest_returned(self):
         buf = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.5)
-        fused = None
-        for i in range(4):
-            t = tr(i, n_envs=2)
-            fused = buf.add(t, batched=True)
-        assert fused is not None
-        # first fused transition: rewards 1 + .5*2 + .25*3 for the second add
-        np.testing.assert_allclose(fused["reward"], 1 + 0.5 * 2 + 0.25 * 3)
-        np.testing.assert_allclose(fused["next_obs"][0], np.full(4, 4.0))
+        outs = [buf.add(tr(i, n_envs=2), batched=True) for i in range(4)]
+        # warmup returns None; afterwards the OLDEST raw transition comes back
+        assert outs[0] is None and outs[1] is None
+        np.testing.assert_allclose(outs[2]["reward"], 0.0)  # raw step-0 reward
+        np.testing.assert_allclose(outs[3]["reward"], 1.0)  # raw step-1 reward
+        # the buffer's own ring holds the FUSED transitions, index-aligned with
+        # the raw returns (2 rows per batched add). Slot 2 = step-1/env-0 fold:
+        # 1 + .5*2 + .25*3
+        fused = buf.sample_from_indices(np.array([2]))
+        np.testing.assert_allclose(
+            np.asarray(fused["reward"])[0], 1 + 0.5 * 2 + 0.25 * 3
+        )
+        np.testing.assert_allclose(np.asarray(fused["next_obs"])[0], np.full(4, 4.0))
 
     def test_done_truncates(self):
         buf = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.5)
@@ -74,11 +79,19 @@ class TestNStep:
         t0["done"] = np.ones(1, np.float32)
         buf.add(t0, batched=True)
         buf.add(tr(1, n_envs=1), batched=True)
-        fused = buf.add(tr(2, n_envs=1), batched=True)
+        buf.add(tr(2, n_envs=1), batched=True)
+        fused = buf.sample_from_indices(np.array([0]))
         # env died at step 0 -> only reward 0 counts, next_obs from step 0
-        np.testing.assert_allclose(fused["reward"], 0.0)
-        np.testing.assert_allclose(fused["done"], 1.0)
-        np.testing.assert_allclose(fused["next_obs"][0], np.full(4, 1.0))
+        np.testing.assert_allclose(np.asarray(fused["reward"])[0], 0.0)
+        np.testing.assert_allclose(np.asarray(fused["done"])[0], 1.0)
+        np.testing.assert_allclose(np.asarray(fused["next_obs"])[0, 0], np.full(4, 1.0))
+
+    def test_reset_horizon(self):
+        buf = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.5)
+        buf.add(tr(0, n_envs=1), batched=True)
+        buf.add(tr(1, n_envs=1), batched=True)
+        buf.reset_horizon()
+        assert buf.add(tr(2, n_envs=1), batched=True) is None  # window restarts
 
 
 class TestPER:
@@ -123,19 +136,42 @@ class TestRollout:
         last_done = np.zeros(N, np.float32)
         buf.compute_returns_and_advantages(last_value, last_done)
 
-        # reference numpy GAE
+        # reference numpy GAE for the "done AFTER step t" storage convention:
+        # step t's OWN done masks its bootstrap and the carried advantage
         adv = np.zeros((T, N), np.float32)
         gae = np.zeros(N, np.float32)
-        next_v, next_nt = last_value, 1.0 - last_done
+        next_v = last_value
         for t in reversed(range(T)):
-            delta = rewards[t] + 0.9 * next_v * next_nt - values[t]
-            gae = delta + 0.9 * 0.8 * next_nt * gae
+            nonterm = 1.0 - dones[t]
+            delta = rewards[t] + 0.9 * next_v * nonterm - values[t]
+            gae = delta + 0.9 * 0.8 * nonterm * gae
             adv[t] = gae
-            next_v, next_nt = values[t], 1.0 - dones[t]
+            next_v = values[t]
         np.testing.assert_allclose(np.asarray(buf.state.advantages), adv, rtol=1e-4)
         np.testing.assert_allclose(
             np.asarray(buf.state.returns), adv + values, rtol=1e-4
         )
+
+    def test_gae_respects_episode_boundary(self):
+        """Terminal at step t: A_t must not bootstrap the next episode's value,
+        and A_{t-1} must still include step t (same episode)."""
+        T, N = 4, 1
+        buf = RolloutBuffer(capacity=T, num_envs=N, gamma=1.0, gae_lambda=1.0)
+        rewards = np.array([[0.0], [1.0], [5.0], [0.0]], np.float32)
+        dones = np.array([[0.0], [1.0], [0.0], [0.0]], np.float32)  # ep ends @1
+        values = np.zeros((T, N), np.float32)
+        for t in range(T):
+            buf.add(obs=np.zeros((N, 2), np.float32), action=np.zeros(N, np.int32),
+                    reward=rewards[t], done=dones[t], value=values[t],
+                    log_prob=np.zeros(N, np.float32))
+        buf.compute_returns_and_advantages(np.full(N, 99.0, np.float32),
+                                           np.zeros(N, np.float32))
+        adv = np.asarray(buf.state.advantages)[:, 0]
+        # episode 1: A_0 = r_0 + r_1 = 1 (stops at the terminal, no leak of 5)
+        assert adv[0] == pytest.approx(1.0)
+        assert adv[1] == pytest.approx(1.0)
+        # episode 2: A_2 = r_2 + r_3 + V(s_T)=99 bootstrap
+        assert adv[2] == pytest.approx(5.0 + 0.0 + 99.0)
 
     def test_minibatches_cover_all(self):
         T, N = 4, 2
