@@ -100,3 +100,31 @@ def test_checkpointing(tmp_path, vec_env):
 
     loaded = load_population_checkpoint("DQN", str(ckpt), [0, 1])
     assert len(loaded) == 2
+
+
+def test_train_off_policy_rainbow_per_nstep(vec_env):
+    """The full PER + n-step + Rainbow path through the training loop
+    (regression: epsilon compat, paired-buffer alignment, priority plumbing)."""
+    from agilerl_tpu.components import PrioritizedReplayBuffer
+    from agilerl_tpu.utils.utils import create_population
+
+    pop = create_population(
+        "RainbowDQN", vec_env.single_observation_space, vec_env.single_action_space,
+        population_size=1, seed=0, net_config=small_net(),
+        INIT_HP={"BATCH_SIZE": 32, "LR": 1e-3, "LEARN_STEP": 8,
+                 "V_MIN": 0.0, "V_MAX": 200.0, "NUM_ATOMS": 21, "N_STEP": 3},
+    )
+    memory = PrioritizedReplayBuffer(max_size=2048, alpha=0.6)
+    from agilerl_tpu.components import MultiStepReplayBuffer
+
+    n_step_memory = MultiStepReplayBuffer(max_size=2048, n_step=3, gamma=0.99)
+    pop, fitnesses = train_off_policy(
+        vec_env, "CartPole-v1", "RainbowDQN", pop, memory,
+        max_steps=400, evo_steps=200, eval_steps=40, eval_loop=1,
+        per=True, n_step=True, n_step_memory=n_step_memory, verbose=False,
+    )
+    assert all(np.isfinite(f).all() for f in fitnesses)
+    # priorities were actually updated away from the initial max value
+    pri = np.asarray(pop[0:1][0] is not None and memory.per_state.priorities)
+    filled = pri[: len(memory)]
+    assert (filled > 0).all() and filled.std() > 0
