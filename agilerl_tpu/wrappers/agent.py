@@ -84,9 +84,18 @@ class RSNorm:
 
 
 class AsyncAgentsWrapper:
-    """Turn-based (AEC-style) PettingZoo support (parity: agent.py:458):
-    buffers each agent's pending experience until its next turn, presenting a
-    parallel-env-like interface to the algorithms."""
+    """Turn-based (AEC-style) PettingZoo support (parity: agent.py:458).
+
+    In a turn-based env only a subset of agents observes/acts each step, and an
+    agent's experience spans from its action until its NEXT turn (accumulating
+    the rewards in between). This wrapper:
+    - ``get_action``: filters to the active agents (entries whose obs is not
+      None) before delegating, so multi-agent algorithms always see full
+      batched dicts;
+    - ``record_step``: buffers each acting agent's (obs, action) and, when that
+      agent's next turn (or episode end) arrives, emits its completed
+      transition with the accumulated inter-turn reward.
+    """
 
     def __init__(self, agent):
         self.agent = agent
@@ -94,8 +103,40 @@ class AsyncAgentsWrapper:
 
     def get_action(self, obs, *args, **kwargs):
         active = {a: o for a, o in obs.items() if o is not None}
+        if not active:
+            return {a: None for a in obs}
         actions = self.agent.get_action(active, *args, **kwargs)
         return {a: actions.get(a) for a in obs}
+
+    def record_step(self, obs, actions, rewards, dones):
+        """Feed one env step; returns {agent: completed transition} for agents
+        whose inter-turn experience just closed (parity: the reference's
+        inactive-agent experience buffering, agent.py:458)."""
+        completed: Dict[str, Dict[str, Any]] = {}
+        for aid, r in rewards.items():
+            if aid in self._pending:
+                self._pending[aid]["reward"] += float(np.asarray(r).squeeze())
+        for aid, o in obs.items():
+            pending = self._pending.get(aid)
+            acted_now = actions.get(aid) is not None and o is not None
+            done = bool(np.asarray(dones.get(aid, False)).squeeze())
+            if pending is not None and (acted_now or done):
+                completed[aid] = {
+                    "obs": pending["obs"],
+                    "action": pending["action"],
+                    "reward": np.float32(pending["reward"]),
+                    "next_obs": o if o is not None else pending["obs"],
+                    "done": np.float32(done),
+                }
+                del self._pending[aid]
+            if acted_now and not done:
+                self._pending[aid] = {
+                    "obs": o, "action": actions[aid], "reward": 0.0,
+                }
+        return completed
+
+    def reset(self):
+        self._pending = {}
 
     def learn(self, experiences, *args, **kwargs):
         return self.agent.learn(experiences, *args, **kwargs)

@@ -39,3 +39,38 @@ def test_bandit_env():
     assert ctx.shape == (2, 6)
     next_ctx, r = env.step(0)
     assert r in (0.0, 1.0)
+
+
+def test_async_agents_wrapper_turn_buffering():
+    from agilerl_tpu.wrappers import AsyncAgentsWrapper
+
+    class StubMA:
+        def get_action(self, obs, **kw):
+            return {a: np.int32(1) for a in obs}
+
+    w = AsyncAgentsWrapper(StubMA())
+    # turn 1: only agent a acts
+    acts = w.get_action({"a": np.ones(2, np.float32), "b": None})
+    assert acts["a"] is not None and acts["b"] is None
+    out = w.record_step({"a": np.ones(2, np.float32), "b": None}, acts,
+                        {"a": 0.0, "b": 0.0}, {"a": False, "b": False})
+    assert out == {}  # a's transition still open
+    # turn 2: b acts; a receives reward while inactive
+    acts2 = w.get_action({"a": None, "b": np.zeros(2, np.float32)})
+    out = w.record_step({"a": None, "b": np.zeros(2, np.float32)}, acts2,
+                        {"a": 0.5, "b": 0.0}, {"a": False, "b": False})
+    assert out == {}
+    # turn 3: a acts again -> its transition closes with accumulated reward
+    obs3 = {"a": 2 * np.ones(2, np.float32), "b": None}
+    acts3 = w.get_action(obs3)
+    out = w.record_step(obs3, acts3, {"a": 0.25, "b": 0.0},
+                        {"a": False, "b": False})
+    assert "a" in out
+    np.testing.assert_allclose(out["a"]["reward"], 0.75)
+    np.testing.assert_array_equal(out["a"]["obs"], np.ones(2, np.float32))
+    np.testing.assert_array_equal(out["a"]["next_obs"], 2 * np.ones(2, np.float32))
+    # episode end closes b's open transition too
+    out = w.record_step({"a": None, "b": None}, {"a": None, "b": None},
+                        {"a": 0.0, "b": 1.0}, {"a": True, "b": True})
+    assert "b" in out and out["b"]["done"] == 1.0
+    np.testing.assert_allclose(out["b"]["reward"], 1.25)
