@@ -73,4 +73,4 @@ def test_async_agents_wrapper_turn_buffering():
     out = w.record_step({"a": None, "b": None}, {"a": None, "b": None},
                         {"a": 0.0, "b": 1.0}, {"a": True, "b": True})
     assert "b" in out and out["b"]["done"] == 1.0
-    np.testing.assert_allclose(out["b"]["reward"], 1.25)
+    np.testing.assert_allclose(out["b"]["reward"], 1.0)
