@@ -6,9 +6,11 @@ from agilerl_tpu.parallel.mesh import (
     make_mesh,
     shard_params,
 )
+from agilerl_tpu.parallel.multihost import barrier, broadcast_seed, init_multihost
 from agilerl_tpu.parallel.population import EvoPPO, MemberState
 
 __all__ = [
     "make_mesh", "auto_mesh", "gpt_param_specs", "lora_specs", "shard_params",
     "batch_sharding", "EvoPPO", "MemberState",
+    "init_multihost", "broadcast_seed", "barrier",
 ]

@@ -1,0 +1,69 @@
+"""Multi-host (multi-slice pod) initialisation and coordination helpers — the
+torch.distributed/Accelerate-launch replacement (SURVEY.md §2.8 comm backend:
+the reference needs `accelerate launch` + NCCL env plumbing; JAX needs one
+`jax.distributed.initialize` call per host and everything else rides GSPMD).
+
+Patterns preserved from the reference, redesigned:
+- rank-0-decides + broadcast_object_list (hpo/tournament.py:161) ->
+  deterministic replicated RNG (every host seeds the same tournament) with
+  `broadcast_seed` for the one-time seed agreement;
+- wait_for_everyone barriers (train_llm.py:207) -> `barrier()`;
+- metric gathers (utils/utils.py:985) -> utils.utils.aggregate_metrics_across_hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise JAX's distributed runtime (no-op if single-process or already
+    initialised). On TPU pods arguments are auto-detected from the metadata
+    server; on CPU/GPU fleets pass them explicitly."""
+    import jax
+
+    if jax.process_count() > 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        # single-process run or already initialised — both fine
+        pass
+
+
+def broadcast_seed(seed: Optional[int] = None) -> int:
+    """Agree on one RNG seed across hosts (host 0 decides). With this seed,
+    tournament/mutation decisions are computed identically everywhere — no
+    object broadcast per generation (parity contrast: core/base.py:2094)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return seed if seed is not None else int(np.random.randint(0, 2**31 - 1))
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(
+        [seed if seed is not None else np.random.randint(0, 2**31 - 1)], np.int64
+    )
+    agreed = multihost_utils.broadcast_one_to_all(local)
+    return int(agreed[0])
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (parity: accelerator.wait_for_everyone)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
