@@ -1,0 +1,220 @@
+"""Fully-on-device evolutionary DQN: env stepping, replay, TD learning, and
+evolution in ONE jitted SPMD program (the off-policy sibling of
+population.EvoPPO; SURVEY.md §7 step 4's 'both hot loops collapse into one
+jitted scan' taken to the population level).
+
+Per member: a device-resident ring replay buffer; each scan tick = one
+vectorised env step + one TD update on a uniformly sampled batch (gated until
+the buffer has warmup data). vmap over members on one chip; shard_map one
+member per device on a pod.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from agilerl_tpu.envs.core import JaxEnv, VecState, make_autoreset_step
+from agilerl_tpu.networks.base import EvolvableNetwork
+
+
+class DQNMemberState(NamedTuple):
+    params: Any
+    target: Any
+    opt_state: Any
+    buf_obs: jax.Array  # [C, obs_dim]
+    buf_action: jax.Array  # [C]
+    buf_reward: jax.Array
+    buf_next_obs: jax.Array
+    buf_done: jax.Array
+    buf_pos: jax.Array  # [] int32
+    buf_size: jax.Array
+    env_state: Any
+    obs: jax.Array
+    epsilon: jax.Array
+    key: jax.Array
+
+
+class EvoDQN:
+    def __init__(
+        self,
+        env: JaxEnv,
+        net_config,
+        tx=None,
+        num_envs: int = 64,
+        steps_per_iter: int = 128,
+        buffer_size: int = 10_000,
+        batch_size: int = 64,
+        gamma: float = 0.99,
+        tau: float = 0.01,
+        learn_every: int = 1,
+        eps_decay: float = 0.999,
+        eps_end: float = 0.05,
+        elitism: bool = True,
+        tournament_size: int = 2,
+        mutation_sd: float = 0.02,
+        mutation_prob: float = 0.5,
+    ):
+        self.env = env
+        self.net_config = net_config
+        self.tx = tx or optax.adam(1e-3)
+        self.num_envs = num_envs
+        self.steps_per_iter = steps_per_iter
+        self.buffer_size = buffer_size
+        self.batch_size = batch_size
+        self.gamma = gamma
+        self.tau = tau
+        self.learn_every = learn_every
+        self.eps_decay = eps_decay
+        self.eps_end = eps_end
+        self.elitism = elitism
+        self.tournament_size = tournament_size
+        self.mutation_sd = mutation_sd
+        self.mutation_prob = mutation_prob
+        self._vec_step = make_autoreset_step(env)
+        self._reset = jax.vmap(env.reset_fn)
+        self.obs_dim = int(np.prod(env.observation_space.shape))
+        self.num_actions = int(env.action_space.n)
+
+    # ------------------------------------------------------------------ #
+    def init_member(self, key: jax.Array) -> DQNMemberState:
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = EvolvableNetwork.init_params(k1, self.net_config)
+        target = jax.tree_util.tree_map(jnp.copy, params)
+        opt_state = self.tx.init(params)
+        env_state, obs = self._reset(jax.random.split(k2, self.num_envs))
+        C = self.buffer_size
+        return DQNMemberState(
+            params=params, target=target, opt_state=opt_state,
+            buf_obs=jnp.zeros((C, self.obs_dim)),
+            buf_action=jnp.zeros((C,), jnp.int32),
+            buf_reward=jnp.zeros((C,)),
+            buf_next_obs=jnp.zeros((C, self.obs_dim)),
+            buf_done=jnp.zeros((C,)),
+            buf_pos=jnp.zeros((), jnp.int32),
+            buf_size=jnp.zeros((), jnp.int32),
+            env_state=VecState(env_state, jnp.zeros(self.num_envs, jnp.int32), k3),
+            obs=obs, epsilon=jnp.float32(1.0), key=key,
+        )
+
+    def init_population(self, key: jax.Array, pop_size: int) -> DQNMemberState:
+        return jax.vmap(self.init_member)(jax.random.split(key, pop_size))
+
+    # ------------------------------------------------------------------ #
+    def member_iteration(self, s: DQNMemberState) -> Tuple[DQNMemberState, jax.Array]:
+        cfg = self.net_config
+        C, N = self.buffer_size, self.num_envs
+
+        def tick(carry, _):
+            s, ep_ret, fsum, fn = carry
+            key, k_act, k_samp = jax.random.split(s.key, 3)
+            # eps-greedy act
+            q = EvolvableNetwork.apply(cfg, s.params, s.obs)
+            greedy = jnp.argmax(q, axis=-1)
+            rand = jax.random.randint(k_act, greedy.shape, 0, self.num_actions)
+            explore = jax.random.uniform(jax.random.fold_in(k_act, 1), greedy.shape)
+            action = jnp.where(explore < s.epsilon, rand, greedy)
+            vstate, next_obs, reward, term, trunc = self._vec_step(s.env_state, action)
+            done = jnp.logical_or(term, trunc).astype(jnp.float32)
+
+            # ring-buffer write (N rows per tick)
+            idx = (s.buf_pos + jnp.arange(N)) % C
+            buf_obs = s.buf_obs.at[idx].set(s.obs)
+            buf_action = s.buf_action.at[idx].set(action.astype(jnp.int32))
+            buf_reward = s.buf_reward.at[idx].set(reward)
+            buf_next = s.buf_next_obs.at[idx].set(next_obs)
+            buf_done = s.buf_done.at[idx].set(term.astype(jnp.float32))
+            pos = (s.buf_pos + N) % C
+            size = jnp.minimum(s.buf_size + N, C)
+
+            # TD update on a uniform batch (identity update until warm)
+            bidx = jax.random.randint(k_samp, (self.batch_size,), 0,
+                                      jnp.maximum(size, 1))
+            b_obs, b_act = buf_obs[bidx], buf_action[bidx]
+            b_rew, b_next, b_done = buf_reward[bidx], buf_next[bidx], buf_done[bidx]
+            q_next = EvolvableNetwork.apply(cfg, s.target, b_next)
+            tgt = b_rew + self.gamma * (1 - b_done) * jnp.max(q_next, axis=-1)
+
+            def loss_fn(p):
+                qv = EvolvableNetwork.apply(cfg, p, b_obs)
+                qa = jnp.take_along_axis(qv, b_act[:, None], axis=-1)[:, 0]
+                return jnp.mean(jnp.square(qa - tgt))
+
+            warm = size >= self.batch_size
+            loss, grads = jax.value_and_grad(loss_fn)(s.params)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(warm, g, jnp.zeros_like(g)), grads
+            )
+            updates, opt_state = self.tx.update(grads, s.opt_state, s.params)
+            params = optax.apply_updates(s.params, updates)
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1 - self.tau) * t + self.tau * p, s.target, params
+            )
+
+            ep_ret = ep_ret + reward
+            fsum = fsum + jnp.sum(ep_ret * done)
+            fn = fn + jnp.sum(done)
+            ep_ret = ep_ret * (1 - done)
+            s = s._replace(
+                params=params, target=target, opt_state=opt_state,
+                buf_obs=buf_obs, buf_action=buf_action, buf_reward=buf_reward,
+                buf_next_obs=buf_next, buf_done=buf_done, buf_pos=pos,
+                buf_size=size, env_state=vstate, obs=next_obs,
+                epsilon=jnp.maximum(s.epsilon * self.eps_decay, self.eps_end),
+                key=key,
+            )
+            return (s, ep_ret, fsum, fn), None
+
+        zero = 0.0 * jnp.sum(s.obs.astype(jnp.float32))
+        (s, _, fsum, fn), _ = jax.lax.scan(
+            tick, (s, jnp.zeros(N) + zero, zero, zero), None,
+            length=self.steps_per_iter,
+        )
+        fitness = jnp.where(fn > 0, fsum / jnp.maximum(fn, 1.0), zero)
+        return s, fitness
+
+    # ------------------------------------------------------------------ #
+    def evolve(self, pop: DQNMemberState, fitness: jax.Array, key: jax.Array):
+        P = fitness.shape[0]
+        k_t, k_m = jax.random.split(key)
+        entrants = jax.random.randint(k_t, (P, self.tournament_size), 0, P)
+        winners = entrants[jnp.arange(P), jnp.argmax(fitness[entrants], axis=1)]
+        if self.elitism:
+            winners = winners.at[0].set(jnp.argmax(fitness))
+
+        def gather(x):
+            return x[winners]
+
+        new_params = jax.tree_util.tree_map(gather, pop.params)
+        new_target = jax.tree_util.tree_map(gather, pop.target)
+        new_opt = jax.tree_util.tree_map(gather, pop.opt_state)
+        # param mutation on non-elite members
+        do_mut = (jax.random.uniform(k_m, (P,)) < self.mutation_prob).astype(jnp.float32)
+        if self.elitism:
+            do_mut = do_mut.at[0].set(0.0)
+        keys = jax.random.split(k_m, P)
+
+        def mutate(params, k, do):
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            ks = jax.random.split(k, len(leaves))
+            return jax.tree_util.tree_unflatten(
+                treedef,
+                [l + do * self.mutation_sd * jax.random.normal(kk, l.shape)
+                 for l, kk in zip(leaves, ks)],
+            )
+
+        new_params = jax.vmap(mutate)(new_params, keys, do_mut)
+        return pop._replace(params=new_params, target=new_target, opt_state=new_opt)
+
+    def make_vmap_generation(self) -> Callable:
+        @jax.jit
+        def generation(pop: DQNMemberState, key: jax.Array):
+            pop, fitness = jax.vmap(self.member_iteration)(pop)
+            pop = self.evolve(pop, fitness, key)
+            return pop, fitness
+
+        return generation

@@ -81,3 +81,28 @@ def test_evolution_deterministic_across_replicas():
     for la, lb in zip(jax.tree_util.tree_leaves(a.actor),
                       jax.tree_util.tree_leaves(b.actor)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_evo_dqn_on_device():
+    import optax
+
+    from agilerl_tpu.envs import CartPole
+    from agilerl_tpu.modules.mlp import MLPConfig
+    from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+    from agilerl_tpu.parallel.off_policy import EvoDQN
+
+    env = CartPole()
+    kind, enc = default_encoder_config(env.observation_space, latent_dim=16,
+                                       encoder_config={"hidden_size": (32,)})
+    cfg = NetworkConfig(encoder_kind=kind, encoder=enc,
+                        head=MLPConfig(num_inputs=16, num_outputs=2,
+                                       hidden_size=(32,)), latent_dim=16)
+    evo = EvoDQN(env, cfg, optax.adam(1e-3), num_envs=8, steps_per_iter=32,
+                 buffer_size=512, batch_size=32)
+    pop = evo.init_population(jax.random.PRNGKey(0), pop_size=4)
+    gen = evo.make_vmap_generation()
+    for i in range(3):
+        pop, fitness = gen(pop, jax.random.PRNGKey(i))
+    assert np.asarray(fitness).shape == (4,)
+    assert np.isfinite(np.asarray(fitness)).all()
+    assert int(pop.buf_size[0]) > 0
