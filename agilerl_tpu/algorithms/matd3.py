@@ -175,10 +175,14 @@ class MATD3(MADDPG):
             actors, a_opt = jax.lax.cond(
                 update_actor, do_actor, lambda args: args, (actors, a_opt)
             )
+            # TD3-style: ALL target updates delayed to the policy cadence
+            eff_tau = jnp.where(update_actor, tau, 0.0)
             actor_ts = jax.tree_util.tree_map(
-                lambda t, p: (1 - tau) * t + tau * p, actor_ts, actors)
-            c1ts = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c1ts, c1s)
-            c2ts = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c2ts, c2s)
+                lambda t, p: (1 - eff_tau) * t + eff_tau * p, actor_ts, actors)
+            c1ts = jax.tree_util.tree_map(
+                lambda t, p: (1 - eff_tau) * t + eff_tau * p, c1ts, c1s)
+            c2ts = jax.tree_util.tree_map(
+                lambda t, p: (1 - eff_tau) * t + eff_tau * p, c2ts, c2s)
             return actors, actor_ts, c1s, c1ts, c2s, c2ts, a_opt, c1_opt, c2_opt, closs
 
         return train_step

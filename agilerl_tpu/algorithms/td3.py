@@ -70,7 +70,8 @@ class TD3(DDPG):
 
         @jax.jit
         def critic_step(
-            c1, c1t, c2, c2t, at_params, opt1, opt2, batch, gamma, tau, key
+            c1, c1t, c2, c2t, at_params, opt1, opt2, batch, gamma, tau, key,
+            update_targets,
         ):
             obs = batch["obs"]
             action = batch["action"].astype(jnp.float32)
@@ -108,8 +109,12 @@ class TD3(DDPG):
             c1 = optax.apply_updates(c1, u1)
             u2, opt2 = tx2.update(g2, opt2, c2)
             c2 = optax.apply_updates(c2, u2)
-            c1t = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c1t, c1)
-            c2t = jax.tree_util.tree_map(lambda t, p: (1 - tau) * t + tau * p, c2t, c2)
+            # TD3 delays ALL target updates to the policy cadence
+            eff_tau = jnp.where(update_targets, tau, 0.0)
+            c1t = jax.tree_util.tree_map(
+                lambda t, p: (1 - eff_tau) * t + eff_tau * p, c1t, c1)
+            c2t = jax.tree_util.tree_map(
+                lambda t, p: (1 - eff_tau) * t + eff_tau * p, c2t, c2)
             return c1, c1t, c2, c2t, opt1, opt2, l1 + l2
 
         return critic_step
@@ -119,6 +124,8 @@ class TD3(DDPG):
         batch["obs"] = self.preprocess_observation(batch["obs"])
         batch["next_obs"] = self.preprocess_observation(batch["next_obs"])
 
+        self._learn_counter += 1
+        update_targets = self._learn_counter % self.policy_freq == 0
         critic_step = self.jit_fn("twin_critic", self._twin_critic_fn)
         (c1, c1t, c2, c2t, opt1, opt2, closs) = critic_step(
             self.critic.params, self.critic_target.params,
@@ -126,6 +133,7 @@ class TD3(DDPG):
             self.actor_target.params,
             self.critic_optimizer.opt_state, self.critic_2_optimizer.opt_state,
             batch, jnp.float32(self.gamma), jnp.float32(self.tau), self.next_key(),
+            jnp.bool_(update_targets),
         )
         self.critic.params = c1
         self.critic_target.params = c1t
@@ -134,8 +142,7 @@ class TD3(DDPG):
         self.critic_optimizer.opt_state = opt1
         self.critic_2_optimizer.opt_state = opt2
 
-        self._learn_counter += 1
-        if self._learn_counter % self.policy_freq == 0:
+        if update_targets:
             actor_step = self.jit_fn("actor", self._actor_fn)
             aparams, at_params, a_opt, _ = actor_step(
                 self.actor.params, self.actor_target.params, self.critic.params,

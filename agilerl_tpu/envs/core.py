@@ -68,17 +68,20 @@ def make_autoreset_step(env: JaxEnv) -> Callable:
             lambda r, n: jnp.where(done, r, n), reset_obs, obs
         )
         out_count = jnp.where(done, 0, step_count)
-        return out_state, out_obs, reward, terminated, truncated, out_count
+        # obs BEFORE any autoreset — needed so truncated transitions can
+        # bootstrap from the true successor state, not the next episode's
+        # reset obs (gymnasium's final_observation semantics)
+        return out_state, out_obs, reward, terminated, truncated, out_count, obs
 
     @jax.jit
     def vec_step(vstate: VecState, actions: jax.Array):
         key, sub = jax.random.split(vstate.key)
         n = vstate.step_count.shape[0]
         keys = jax.random.split(sub, n)
-        new_state, obs, reward, terminated, truncated, counts = jax.vmap(single_step)(
-            vstate.env_state, vstate.step_count, actions, keys
-        )
-        return VecState(new_state, counts, key), obs, reward, terminated, truncated
+        new_state, obs, reward, terminated, truncated, counts, final_obs = jax.vmap(
+            single_step
+        )(vstate.env_state, vstate.step_count, actions, keys)
+        return VecState(new_state, counts, key), obs, reward, terminated, truncated, final_obs
 
     return vec_step
 
@@ -112,7 +115,7 @@ class JaxVecEnv:
         return np.asarray(obs), {}
 
     def step(self, actions):
-        self._state, obs, reward, terminated, truncated = self._step(
+        self._state, obs, reward, terminated, truncated, final_obs = self._step(
             self._state, jnp.asarray(actions)
         )
         return (
@@ -120,7 +123,7 @@ class JaxVecEnv:
             np.asarray(reward),
             np.asarray(terminated),
             np.asarray(truncated),
-            {},
+            {"final_obs": np.asarray(final_obs)},
         )
 
     def close(self):
@@ -154,7 +157,7 @@ def rollout_scan(
         vstate, obs, key = carry
         key, k_act = jax.random.split(key)
         actions = policy_fn(policy_params, obs, k_act)
-        vstate, next_obs, reward, terminated, truncated, = _unpack(vec_step(vstate, actions))
+        vstate, next_obs, reward, terminated, truncated, _final = vec_step(vstate, actions)
         out = {
             "obs": obs,
             "action": actions,
@@ -170,7 +173,3 @@ def rollout_scan(
     )
     return traj, (vstate, last_obs)
 
-
-def _unpack(step_out):
-    vstate, obs, reward, terminated, truncated = step_out
-    return vstate, obs, reward, terminated, truncated

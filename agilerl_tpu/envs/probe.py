@@ -173,13 +173,13 @@ def fill_buffer_random(env: JaxEnv, memory, steps: int, num_envs: int = 8, seed:
             )
         else:
             action = rng.integers(0, env.action_space.n, size=num_envs)
-        next_obs, reward, terminated, truncated, _ = vec.step(action)
+        next_obs, reward, terminated, truncated, info = vec.step(action)
         memory.add(
             {
                 "obs": obs,
                 "action": action,
                 "reward": reward.astype(np.float32),
-                "next_obs": next_obs,
+                "next_obs": info.get("final_obs", next_obs),
                 "done": np.asarray(terminated, np.float32),
             },
             batched=True,

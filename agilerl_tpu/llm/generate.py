@@ -74,27 +74,36 @@ def generate(
     last_logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]  # [B, V]
     pos = prompt_mask.sum(axis=-1)  # next position per row
 
+    # first token comes straight from the prefill logits; each scan step then
+    # advances the model with the PREVIOUS token and samples the next — exactly
+    # max_new_tokens - 1 decode forwards, none wasted on logits never sampled
+    key, k0 = jax.random.split(key)
+    tok0 = _sample_token(last_logits, k0, temperature, top_k)
+    mask0 = jnp.ones((B,), bool)
+    done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
+
     def step(carry, _):
-        caches, logits, pos, done, key = carry
+        caches, prev_tok, prev_valid, pos, done, key = carry
+        hidden, caches = M.forward(
+            config, params, prev_tok[:, None],
+            attention_mask=prev_valid.astype(jnp.int32)[:, None],
+            positions=pos[:, None], cache=caches, lora=lora,
+        )
+        logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
+        pos = pos + prev_valid.astype(pos.dtype)
         key, k_s = jax.random.split(key)
         tok = _sample_token(logits, k_s, temperature, top_k)
         if eos_id is not None:
             tok = jnp.where(done, pad_id, tok)
-        emit = tok
         emit_mask = jnp.logical_not(done)
         if eos_id is not None:
             done = jnp.logical_or(done, tok == eos_id)
-        hidden, caches = M.forward(
-            config, params, tok[:, None],
-            attention_mask=emit_mask.astype(jnp.int32)[:, None],
-            positions=pos[:, None], cache=caches, lora=lora,
-        )
-        logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
-        pos = pos + emit_mask.astype(pos.dtype)
-        return (caches, logits, pos, done, key), (emit, emit_mask)
+        return (caches, tok, emit_mask, pos, done, key), (tok, emit_mask)
 
-    done0 = jnp.zeros((B,), bool)
-    (_, _, _, _, _), (tokens, masks) = jax.lax.scan(
-        step, (caches, last_logits, pos, done0, key), None, length=max_new_tokens
+    (_, _, _, _, _, _), (tokens, masks) = jax.lax.scan(
+        step, (caches, tok0, mask0, pos, done0, key), None,
+        length=max_new_tokens - 1,
     )
+    tokens = jnp.concatenate([tok0[None], tokens], axis=0)
+    masks = jnp.concatenate([mask0[None], masks], axis=0)
     return tokens.T, masks.T.astype(jnp.int32)  # [B, N]

@@ -108,7 +108,7 @@ def flash_attention(
     scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, T)
     block_k = min(block_k, T)
-    pad_t = (-T) % max(block_q, block_k)
+    pad_t = (-T) % math.lcm(block_q, block_k)
     if pad_t:
         qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
         kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_t), (0, 0)))

@@ -217,7 +217,9 @@ def flash_attention_diff(
 def _prep(q, T, block_q, block_k):
     block_q = min(block_q, T)
     block_k = min(block_k, T)
-    pad = (-T) % max(block_q, block_k)
+    # pad to a multiple of BOTH block sizes, else the grid floor-division
+    # silently drops trailing rows (review finding)
+    pad = (-T) % math.lcm(block_q, block_k)
     return block_q, block_k, pad
 
 

@@ -35,6 +35,7 @@ class DQNMemberState(NamedTuple):
     buf_size: jax.Array
     env_state: Any
     obs: jax.Array
+    ep_ret: jax.Array  # [num_envs] running episode return (spans iterations)
     epsilon: jax.Array
     key: jax.Array
 
@@ -98,7 +99,8 @@ class EvoDQN:
             buf_pos=jnp.zeros((), jnp.int32),
             buf_size=jnp.zeros((), jnp.int32),
             env_state=VecState(env_state, jnp.zeros(self.num_envs, jnp.int32), k3),
-            obs=obs, epsilon=jnp.float32(1.0), key=key,
+            obs=obs, ep_ret=jnp.zeros(self.num_envs), epsilon=jnp.float32(1.0),
+            key=key,
         )
 
     def init_population(self, key: jax.Array, pop_size: int) -> DQNMemberState:
@@ -118,7 +120,7 @@ class EvoDQN:
             rand = jax.random.randint(k_act, greedy.shape, 0, self.num_actions)
             explore = jax.random.uniform(jax.random.fold_in(k_act, 1), greedy.shape)
             action = jnp.where(explore < s.epsilon, rand, greedy)
-            vstate, next_obs, reward, term, trunc = self._vec_step(s.env_state, action)
+            vstate, next_obs, reward, term, trunc, final_obs = self._vec_step(s.env_state, action)
             done = jnp.logical_or(term, trunc).astype(jnp.float32)
 
             # ring-buffer write (N rows per tick)
@@ -126,7 +128,7 @@ class EvoDQN:
             buf_obs = s.buf_obs.at[idx].set(s.obs)
             buf_action = s.buf_action.at[idx].set(action.astype(jnp.int32))
             buf_reward = s.buf_reward.at[idx].set(reward)
-            buf_next = s.buf_next_obs.at[idx].set(next_obs)
+            buf_next = s.buf_next_obs.at[idx].set(final_obs)  # true successor, pre-autoreset
             buf_done = s.buf_done.at[idx].set(term.astype(jnp.float32))
             pos = (s.buf_pos + N) % C
             size = jnp.minimum(s.buf_size + N, C)
@@ -170,17 +172,19 @@ class EvoDQN:
             return (s, ep_ret, fsum, fn), None
 
         zero = 0.0 * jnp.sum(s.obs.astype(jnp.float32))
-        (s, _, fsum, fn), _ = jax.lax.scan(
-            tick, (s, jnp.zeros(N) + zero, zero, zero), None,
+        # carry the running episode return across iterations (review finding)
+        (s, ep_ret, fsum, fn), _ = jax.lax.scan(
+            tick, (s, s.ep_ret + zero, zero, zero), None,
             length=self.steps_per_iter,
         )
+        s = s._replace(ep_ret=ep_ret)
         fitness = jnp.where(fn > 0, fsum / jnp.maximum(fn, 1.0), zero)
         return s, fitness
 
     # ------------------------------------------------------------------ #
     def evolve(self, pop: DQNMemberState, fitness: jax.Array, key: jax.Array):
         P = fitness.shape[0]
-        k_t, k_m = jax.random.split(key)
+        k_t, k_m, k_sel = jax.random.split(key, 3)
         entrants = jax.random.randint(k_t, (P, self.tournament_size), 0, P)
         winners = entrants[jnp.arange(P), jnp.argmax(fitness[entrants], axis=1)]
         if self.elitism:
@@ -193,7 +197,7 @@ class EvoDQN:
         new_target = jax.tree_util.tree_map(gather, pop.target)
         new_opt = jax.tree_util.tree_map(gather, pop.opt_state)
         # param mutation on non-elite members
-        do_mut = (jax.random.uniform(k_m, (P,)) < self.mutation_prob).astype(jnp.float32)
+        do_mut = (jax.random.uniform(k_sel, (P,)) < self.mutation_prob).astype(jnp.float32)
         if self.elitism:
             do_mut = do_mut.at[0].set(0.0)
         keys = jax.random.split(k_m, P)

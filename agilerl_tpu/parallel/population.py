@@ -36,6 +36,7 @@ class MemberState(NamedTuple):
     opt_state: Any
     env_state: Any  # VecState
     obs: jax.Array
+    ep_ret: jax.Array  # [num_envs] running episode return (spans iterations)
     key: jax.Array
 
 
@@ -95,7 +96,8 @@ class EvoPPO:
         opt_state = self.tx.init({"actor": actor, "critic": critic})
         env_state, obs = self._reset(jax.random.split(k3, self.num_envs))
         vstate = VecState(env_state, jnp.zeros(self.num_envs, jnp.int32), k4)
-        return MemberState(actor, critic, opt_state, vstate, obs, key)
+        return MemberState(actor, critic, opt_state, vstate, obs,
+                           jnp.zeros(self.num_envs), key)
 
     def init_population(self, key: jax.Array, pop_size: int) -> MemberState:
         return jax.vmap(self.init_member)(jax.random.split(key, pop_size))
@@ -111,7 +113,7 @@ class EvoPPO:
             action = D.sample(self.dist_config, logits, k_act, state.actor.get("dist"))
             logp = D.log_prob(self.dist_config, logits, action, state.actor.get("dist"))
             value = EvolvableNetwork.apply(self.critic_config, state.critic, obs)[..., 0]
-            vstate, next_obs, reward, term, trunc = self._vec_step(vstate, action)
+            vstate, next_obs, reward, term, trunc, _final = self._vec_step(vstate, action)
             done = jnp.logical_or(term, trunc).astype(jnp.float32)
             ep_ret = ep_ret + reward
             fitness_sum = fitness_sum + jnp.sum(ep_ret * done)
@@ -125,15 +127,17 @@ class EvoPPO:
         # derive zero accumulators from state.obs so they carry the same
         # varying-axis type as loop outputs under shard_map (new vma checks)
         zero = 0.0 * jnp.sum(state.obs.astype(jnp.float32))
+        # ep_ret carries across iterations so episodes spanning the boundary
+        # report their FULL return (review finding)
         init = (state.env_state, state.obs,
-                jnp.zeros(self.num_envs) + zero, zero, zero, sub)
-        (vstate, obs, _, fsum, fn, _), traj = jax.lax.scan(
+                state.ep_ret + zero, zero, zero, sub)
+        (vstate, obs, ep_ret, fsum, fn, _), traj = jax.lax.scan(
             body, init, None, length=self.rollout_len
         )
         fitness = jnp.where(fn > 0, fsum / jnp.maximum(fn, 1.0),
                             jnp.mean(traj["reward"]) * self.env.max_episode_steps
                             if self.env.max_episode_steps else jnp.mean(traj["reward"]))
-        return traj, vstate, obs, fitness, key
+        return traj, vstate, obs, ep_ret, fitness, key
 
     def _gae(self, traj, last_value):
         # dones are per-step terminal flags: step t's own done masks both its
@@ -209,14 +213,14 @@ class EvoPPO:
     # ------------------------------------------------------------------ #
     def member_iteration(self, state: MemberState) -> Tuple[MemberState, jax.Array]:
         """One generation for one member: rollout -> GAE -> PPO epochs."""
-        traj, vstate, obs, fitness, key = self._rollout(state)
+        traj, vstate, obs, ep_ret, fitness, key = self._rollout(state)
         last_value = EvolvableNetwork.apply(self.critic_config, state.critic, obs)[..., 0]
         adv, ret = self._gae(traj, last_value)
         key, k_up = jax.random.split(key)
         actor, critic, opt_state, _loss = self._ppo_update(
             state.actor, state.critic, state.opt_state, traj, adv, ret, k_up
         )
-        return MemberState(actor, critic, opt_state, vstate, obs, key), fitness
+        return MemberState(actor, critic, opt_state, vstate, obs, ep_ret, key), fitness
 
     # ------------------------------------------------------------------ #
     def evolve(self, pop: MemberState, fitness: jax.Array, key: jax.Array) -> MemberState:
@@ -224,7 +228,7 @@ class EvoPPO:
         pop leaves have leading pop axis; fitness [P]. Same key on every host
         => same winners everywhere (replaces rank-0 + broadcast)."""
         P_ = fitness.shape[0]
-        k_t, k_m = jax.random.split(key)
+        k_t, k_m, k_sel = jax.random.split(key, 3)
         entrants = jax.random.randint(
             k_t, (P_, self.tournament_size), 0, P_
         )  # [P, k]
@@ -252,13 +256,14 @@ class EvoPPO:
             return jax.tree_util.tree_unflatten(treedef, out)
 
         do_mut = (
-            jax.random.uniform(k_m, (P_,)) < self.mutation_prob
+            jax.random.uniform(k_sel, (P_,)) < self.mutation_prob
         ).astype(jnp.float32)
         if self.elitism:
             do_mut = do_mut.at[0].set(0.0)
         new_actor = jax.vmap(mutate_member)(new_actor, mutate_keys, do_mut)
         return MemberState(
-            new_actor, new_critic, new_opt, pop.env_state, pop.obs, pop.key
+            new_actor, new_critic, new_opt, pop.env_state, pop.obs,
+            pop.ep_ret, pop.key
         )
 
     # ------------------------------------------------------------------ #

@@ -81,8 +81,11 @@ def train_off_policy(
             steps = 0
             for _ in range(max(evo_steps // num_envs, 1)):
                 action = agent.get_action(obs, epsilon=epsilon)
-                next_obs, reward, terminated, truncated, _ = env.step(np.asarray(action))
+                next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
                 done = np.logical_or(terminated, truncated)
+                # bootstrap target must see the TRUE successor state, not the
+                # autoreset obs (review finding; gymnasium final_observation)
+                store_next = info.get("final_obs", info.get("final_observation", next_obs))                     if isinstance(info, dict) else next_obs
                 scores += np.asarray(reward)
                 for i, d in enumerate(np.atleast_1d(done)):
                     if d:
@@ -93,7 +96,7 @@ def train_off_policy(
                     "obs": obs,
                     "action": action,
                     "reward": np.asarray(reward, np.float32),
-                    "next_obs": next_obs,
+                    "next_obs": store_next,
                     "done": np.asarray(terminated, np.float32),
                 }
                 if n_step and n_step_memory is not None:

@@ -86,11 +86,11 @@ def collect_offline_dataset(
                 action = rng.uniform(sp.low, sp.high, size=(num_envs,) + sp.shape).astype(
                     np.float32
                 )
-        next_obs, reward, terminated, truncated, _ = env.step(action)
+        next_obs, reward, terminated, truncated, info = env.step(action)
         obs_l.append(obs)
         act_l.append(action)
         rew_l.append(reward)
-        next_l.append(next_obs)
+        next_l.append(info.get("final_obs", next_obs) if isinstance(info, dict) else next_obs)
         term_l.append(np.asarray(terminated, np.float32))
         obs = next_obs
     return {
