@@ -114,6 +114,8 @@ def _set_injected_lr(opt_state: Any, lr: float) -> Any:
             hp = dict(state.hyperparams)
             hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
             return state._replace(hyperparams=hp)
+        if isinstance(state, dict):  # per-group state dicts (IPPO)
+            return {k: visit(v) for k, v in state.items()}
         if isinstance(state, tuple) and not hasattr(state, "_fields"):
             return tuple(visit(s) for s in state)
         return state

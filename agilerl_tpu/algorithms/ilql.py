@@ -136,8 +136,11 @@ class ILQL(EvolvableAlgorithm):
                     tq[:, :-1], a[..., None].astype(jnp.int32), axis=-1
                 )[..., 0]
                 v_next = vs[:, 1:]
-                r = rewards[:, :-1]
-                nonterm = 1.0 - terminals[:, :-1]
+                # transition t's action is token t+1 — its reward/terminal live
+                # at index t+1 in the tokenised episode (review finding: the
+                # :-1 slice dropped every episode reward from the TD target)
+                r = rewards[:, 1:]
+                nonterm = 1.0 - terminals[:, 1:]
                 td_target = jax.lax.stop_gradient(r + gamma * nonterm * v_next)
                 q_loss = jnp.sum(jnp.square(q_a - td_target) * valid) / jnp.maximum(
                     valid.sum(), 1.0
@@ -191,11 +194,12 @@ class ILQL(EvolvableAlgorithm):
         self, tokens: np.ndarray, mask: np.ndarray, key=None, q_scale: float = 1.0
     ) -> np.ndarray:
         """Sample next tokens from pi perturbed by Q-advantage
-        (parity: ILQL_Policy sample path :1308)."""
+        (parity: ILQL_Policy sample path :1308). q_scale is a traced argument,
+        so sweeping it never recompiles nor hits a stale jit cache."""
         config = self.model_config
 
         @jax.jit
-        def act(params, tokens, mask, key):
+        def act(params, tokens, mask, key, q_scale):
             hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
             logits = M.logits_fn(config, params["gpt"], hidden)[:, -1]
             qs = L.dense_apply(params["q_head"], hidden)[:, -1]
@@ -205,7 +209,8 @@ class ILQL(EvolvableAlgorithm):
 
         act_fn = self.jit_fn("act", lambda: act)
         key = key if key is not None else self.next_key()
-        return np.asarray(act_fn(self.actor.params, jnp.asarray(tokens), jnp.asarray(mask), key))
+        return np.asarray(act_fn(self.actor.params, jnp.asarray(tokens),
+                                 jnp.asarray(mask), key, jnp.float32(q_scale)))
 
 
 class BC_LM(EvolvableAlgorithm):

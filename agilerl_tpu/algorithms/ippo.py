@@ -111,8 +111,25 @@ class IPPO(MultiAgentRLAlgorithm):
             OptimizerConfig(name="optimizer", networks=["actors", "critics"], lr="lr")
         )
         self.finalize_registry()
+        # one optax state PER GROUP: a single shared Adam state would keep
+        # applying stale momentum to group A's params while group B trains
+        # (review finding)
+        self._init_group_opt_states()
         self._last_obs = None
         self._last_done = None
+
+    def _group_params(self, gid: str):
+        return {"actors": {gid: self.actors[gid].params},
+                "critics": {gid: self.critics[gid].params}}
+
+    def _init_group_opt_states(self) -> None:
+        self.optimizer.opt_state = {
+            gid: self.optimizer.tx.init(self._group_params(gid))
+            for gid in self.grouped_agents
+        }
+
+    def reinit_optimizers(self) -> None:
+        self._init_group_opt_states()
 
     @property
     def init_dict(self) -> Dict[str, Any]:
@@ -255,13 +272,10 @@ class IPPO(MultiAgentRLAlgorithm):
         return update
 
     def learn(self, experiences=None) -> float:
-        params = {
-            "actors": {g: self.actors[g].params for g in self.actors},
-            "critics": {g: self.critics[g].params for g in self.critics},
-        }
-        opt_state = self.optimizer.opt_state
         total, n = 0.0, 0
         for gid, members in self.grouped_agents.items():
+            params = self._group_params(gid)
+            opt_state = self.optimizer.opt_state[gid]
             buf = self.rollout_buffers[gid]
             if buf.state is None:
                 continue
@@ -286,8 +300,7 @@ class IPPO(MultiAgentRLAlgorithm):
                     total += float(loss)
                     n += 1
             buf.reset()
-        for g in self.actors:
-            self.actors[g].params = params["actors"][g]
-            self.critics[g].params = params["critics"][g]
-        self.optimizer.opt_state = opt_state
+            self.actors[gid].params = params["actors"][gid]
+            self.critics[gid].params = params["critics"][gid]
+            self.optimizer.opt_state[gid] = opt_state
         return total / max(n, 1)

@@ -113,7 +113,8 @@ class MultiAgentJaxVecEnv:
             out_obs = {
                 a: jnp.where(done, reset_obs[a], obs[a]) for a in env.agent_ids
             }
-            return out_state, out_obs, rew, term, trunc
+            # obs BEFORE any autoreset (true successor for bootstrapping)
+            return out_state, out_obs, rew, term, trunc, obs
 
         def vec_step(state, actions, key):
             keys = jax.random.split(key, self.num_envs)
@@ -131,9 +132,12 @@ class MultiAgentJaxVecEnv:
     def step(self, actions: Dict[str, np.ndarray]):
         self._key, sub = jax.random.split(self._key)
         actions = {a: jnp.asarray(v) for a, v in actions.items()}
-        self._state, obs, rew, term, trunc = self._step_v(self._state, actions, sub)
+        self._state, obs, rew, term, trunc, final_obs = self._step_v(
+            self._state, actions, sub
+        )
         to_np = lambda d: {a: np.asarray(v) for a, v in d.items()}  # noqa: E731
-        return to_np(obs), to_np(rew), to_np(term), to_np(trunc), {}
+        return (to_np(obs), to_np(rew), to_np(term), to_np(trunc),
+                {"final_obs": to_np(final_obs)})
 
     def close(self):
         pass

@@ -130,9 +130,16 @@ def log_prob(
     if config.kind == "bernoulli":
         logp = -jax.nn.softplus(-logits) * action - jax.nn.softplus(logits) * (1 - action)
         return jnp.sum(logp, axis=-1)
-    # normal (diagonal)
+    # normal (diagonal); squash=True scores a=tanh(u) with the change of
+    # variables log p(a) = log N(atanh(a)) - sum log(1 - a^2)
     log_std = dist_extra["log_std"]
     var = jnp.exp(2 * log_std)
+    if config.squash:
+        a = jnp.clip(action, -1.0 + 1e-6, 1.0 - 1e-6)
+        u = jnp.arctanh(a)
+        logp = -0.5 * ((u - logits) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi))
+        logp = logp - jnp.log(1.0 - jnp.square(a) + 1e-6)
+        return jnp.sum(logp, axis=-1)
     logp = -0.5 * ((action - logits) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi))
     return jnp.sum(logp, axis=-1)
 
@@ -158,9 +165,15 @@ def entropy(
         h = jax.nn.softplus(-logits) + logits * (1 - p)
         return jnp.sum(h, axis=-1)
     log_std = dist_extra["log_std"]
-    return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1) * jnp.ones(
+    base = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1) * jnp.ones(
         logits.shape[:-1]
     )
+    if config.squash:
+        # H[tanh(u)] = H[u] + E[log(1 - tanh(u)^2)]; the expectation is
+        # approximated at the mean (documented approximation — exact value has
+        # no closed form)
+        base = base + jnp.sum(jnp.log(1.0 - jnp.square(jnp.tanh(logits)) + 1e-6), axis=-1)
+    return base
 
 
 def _md_slices(config: DistConfig):

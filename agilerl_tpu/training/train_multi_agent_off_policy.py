@@ -59,13 +59,15 @@ def train_multi_agent_off_policy(
             steps = 0
             for _ in range(max(evo_steps // num_envs, 1)):
                 actions = agent.get_action(obs)
-                next_obs, reward, terminated, truncated, _ = env.step(actions)
+                next_obs, reward, terminated, truncated, info = env.step(actions)
                 done = {
-                    a: np.logical_or(terminated[a], truncated[a]).astype(np.float32)
-                    for a in agent_ids
+                    a: np.asarray(terminated[a], np.float32) for a in agent_ids
                 }
+                store_next = (
+                    info.get("final_obs", next_obs) if isinstance(info, dict) else next_obs
+                )
                 memory.save_to_memory(
-                    obs, actions, reward, next_obs, done, is_vectorised=num_envs > 1
+                    obs, actions, reward, store_next, done, is_vectorised=num_envs > 1
                 )
                 obs = next_obs
                 steps += num_envs

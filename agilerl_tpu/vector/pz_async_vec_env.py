@@ -119,12 +119,17 @@ class AsyncPettingZooVecEnv:
     def _read_obs(self) -> Dict[str, np.ndarray]:
         out = {}
         for a in self.agents:
+            space = self.observation_spaces[a]
             arr = np.frombuffer(self._shm[a].get_obj(), dtype=np.float32).copy()
-            shape = self.observation_spaces[a].shape
+            shape = space.shape
             if shape and int(np.prod(shape)) == self._obs_dims[a]:
-                out[a] = arr.reshape(self.num_envs, *shape)
+                arr = arr.reshape(self.num_envs, *shape)
+            elif shape == ():  # Discrete and friends: scalar per env
+                arr = arr.reshape(self.num_envs)
             else:
-                out[a] = arr.reshape(self.num_envs, self._obs_dims[a])
+                arr = arr.reshape(self.num_envs, self._obs_dims[a])
+            dtype = getattr(space, "dtype", None)
+            out[a] = arr.astype(dtype) if dtype is not None else arr
         return out
 
     def reset(self, seed: Optional[int] = None, options=None):
