@@ -70,9 +70,16 @@ def train_off_policy(
     checkpoint_count = 0
     start = time.time()
 
+    # gymnasium >=1.0 vector envs autoreset on the NEXT step: the post-done
+    # step ignores the action and returns (reset_obs, reward 0) — such rows
+    # must not enter the replay buffer. JaxVecEnv autoresets same-step, so
+    # every row is valid there.
+    next_step_autoreset = "NEXT_STEP" in str(getattr(env, "autoreset_mode", ""))
+
     while np.min([agent.steps[-1] for agent in pop]) < max_steps:
         for agent in pop:
             obs, _ = env.reset()
+            prev_done = np.zeros(num_envs, dtype=bool)
             if n_step and n_step_memory is not None:
                 # folds must not span the reset / the previous agent's steps
                 n_step_memory.reset_horizon()
@@ -107,8 +114,16 @@ def train_off_policy(
                     one_step = n_step_memory.add(transition, batched=num_envs > 1)
                     if one_step is not None:
                         memory.add(one_step, batched=num_envs > 1)
+                elif next_step_autoreset and prev_done.any():
+                    keep = np.where(~prev_done)[0]
+                    if keep.size:
+                        memory.add(
+                            {k: np.asarray(v)[keep] for k, v in transition.items()},
+                            batched=True,
+                        )
                 else:
                     memory.add(transition, batched=num_envs > 1)
+                prev_done = np.atleast_1d(done).astype(bool)
 
                 obs = next_obs
                 steps += num_envs

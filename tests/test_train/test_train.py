@@ -128,3 +128,25 @@ def test_train_off_policy_rainbow_per_nstep(vec_env):
     pri = np.asarray(pop[0:1][0] is not None and memory.per_state.priorities)
     filled = pri[: len(memory)]
     assert (filled > 0).all() and filled.std() > 0
+
+
+def test_train_off_policy_gymnasium_host_path():
+    """End-to-end through real gymnasium vector envs (NEXT_STEP autoreset):
+    post-done bogus transitions must be filtered from the buffer."""
+    import gymnasium as gym
+
+    env = gym.vector.SyncVectorEnv([lambda: gym.make("CartPole-v1") for _ in range(2)])
+    pop = create_population(
+        "DQN", env.single_observation_space, env.single_action_space,
+        population_size=1, seed=0, net_config=small_net(),
+        INIT_HP={"BATCH_SIZE": 32, "LR": 1e-3, "LEARN_STEP": 8},
+    )
+    memory = ReplayBuffer(max_size=2048)
+    pop, fitnesses = train_off_policy(
+        env, "CartPole-v1", "DQN", pop, memory,
+        max_steps=400, evo_steps=200, eval_steps=40, eval_loop=1, verbose=False,
+    )
+    assert all(np.isfinite(f).all() for f in fitnesses)
+    # no bogus zero-reward post-done rows: CartPole rewards are always 1.0
+    stored_rewards = np.asarray(memory.state.storage["reward"])[: len(memory)]
+    assert (stored_rewards == 1.0).all()
