@@ -216,7 +216,20 @@ class IPPO(MultiAgentRLAlgorithm):
         total_r = 0.0
         for _ in range(n_steps):
             actions = self.get_action(obs)
-            next_obs, rew, term, trunc, _ = env.step(actions)
+            next_obs, rew, term, trunc, info = env.step(actions)
+            # time-limit bootstrapping per agent at truncation boundaries
+            final = info.get("final_obs") if isinstance(info, dict) else None
+            if final is not None:
+                rew = dict(rew)
+                for aid in self.agent_ids:
+                    t_arr = np.asarray(trunc[aid], bool)
+                    if t_arr.any():
+                        gid = self.get_group_id(aid)
+                        o = preprocess_observation(self.observation_spaces[aid], final[aid])
+                        v = np.asarray(EvolvableNetwork.apply(
+                            self.critics[gid].config, self.critics[gid].params, o
+                        )[..., 0])
+                        rew[aid] = np.asarray(rew[aid], np.float32) + self.gamma * v * t_arr
             for gid, members in self.grouped_agents.items():
                 g_obs = np.concatenate([np.asarray(obs[a]) for a in members], axis=0)
                 g_act = np.concatenate([np.asarray(actions[a]) for a in members], axis=0)

@@ -154,6 +154,26 @@ class PPO(RLAlgorithm):
             "seq_len": self.seq_len,
         }
 
+    def value_of(self, obs: Any) -> np.ndarray:
+        """Critic value of a (batched) observation — used for time-limit
+        bootstrapping at truncation boundaries."""
+        obs_p = self.preprocess_observation(obs)
+        if self.recurrent:
+            hidden = self._hidden or self.get_initial_hidden_state(
+                jax.tree_util.tree_leaves(obs_p)[0].shape[0]
+            )
+            latent, _ = _lstm_encode(
+                self.critic.config, self.critic.params, obs_p, hidden["critic"]
+            )
+            from agilerl_tpu.modules.mlp import EvolvableMLP
+
+            return np.asarray(
+                EvolvableMLP.apply(self.critic.config.head, self.critic.params["head"], latent)[..., 0]
+            )
+        return np.asarray(
+            EvolvableNetwork.apply(self.critic.config, self.critic.params, obs_p)[..., 0]
+        )
+
     def get_initial_hidden_state(self, num_envs: Optional[int] = None) -> Dict:
         """Zero hidden states for actor+critic LSTM encoders
         (parity: ppo.py:504)."""

@@ -171,10 +171,11 @@ class Mutations:
             return agent
         new_value = hp_config[name].mutate(getattr(agent, name), self.rng)
         setattr(agent, name, new_value)
-        if name == "lr":
-            for cfg in agent.registry.optimizer_configs:
-                if cfg.lr == name:
-                    getattr(agent, cfg.name).set_lr(new_value)
+        # any optimizer whose lr attribute matches gets the new rate (covers
+        # lr, lr_actor, lr_critic, ... — review finding)
+        for cfg in agent.registry.optimizer_configs:
+            if cfg.lr == name:
+                getattr(agent, cfg.name).set_lr(new_value)
         if name == "learn_step" and hasattr(agent, "rollout_buffer"):
             agent.rollout_buffer.capacity = int(new_value)
             agent.rollout_buffer.state = None

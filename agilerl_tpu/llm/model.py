@@ -141,11 +141,14 @@ def init_lora(
         "w_down": (config.ff_dim, d),
     }
     lora: Dict = {"blocks": {}}
+    target_ids = {name: idx for idx, name in enumerate(sorted(dims))}
     for i in range(config.n_layer):
         k = jax.random.fold_in(key, i)
         layer = {}
         for t in targets:
-            ka = jax.random.fold_in(k, hash(t) % (2**31))
+            # fixed per-name fold (NOT hash(): salted per process, which would
+            # desync adapter init across hosts — review finding)
+            ka = jax.random.fold_in(k, target_ids[t])
             din, dout = dims[t]
             layer[t] = {
                 "A": _normal(ka, (din, rank), 0.02),

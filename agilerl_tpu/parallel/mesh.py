@@ -140,9 +140,11 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def make_sharded_grpo_step(agent, mesh: Mesh):
-    """Return (sharded_update_fn, placed_state). The update is the same pure
-    function GRPO uses; sharding comes entirely from placing params/batch with
-    NamedShardings and letting GSPMD insert collectives."""
+    """Place the agent's params/opt-state with GSPMD shardings IN PLACE and
+    return the sharded update fn. The update is the same pure function GRPO
+    uses; sharding comes entirely from placing params/batch with NamedShardings
+    and letting GSPMD insert collectives. (Prefer agent.to_mesh(mesh) + the
+    normal learn() API; this builder returns the raw update for benchmarking.)"""
     config = agent.model_config
     specs = gpt_param_specs(config)
     base = jax.tree_util.tree_map(

@@ -113,14 +113,19 @@ class EvoPPO:
             action = D.sample(self.dist_config, logits, k_act, state.actor.get("dist"))
             logp = D.log_prob(self.dist_config, logits, action, state.actor.get("dist"))
             value = EvolvableNetwork.apply(self.critic_config, state.critic, obs)[..., 0]
-            vstate, next_obs, reward, term, trunc, _final = self._vec_step(vstate, action)
+            vstate, next_obs, reward, term, trunc, final_obs = self._vec_step(vstate, action)
             done = jnp.logical_or(term, trunc).astype(jnp.float32)
+            # time-limit bootstrapping at truncations (fold gamma*V(s_final))
+            v_final = EvolvableNetwork.apply(
+                self.critic_config, state.critic, final_obs
+            )[..., 0]
+            reward_adj = reward + self.gamma * v_final * trunc.astype(jnp.float32)
             ep_ret = ep_ret + reward
             fitness_sum = fitness_sum + jnp.sum(ep_ret * done)
             fitness_n = fitness_n + jnp.sum(done)
             ep_ret = ep_ret * (1.0 - done)
             out = dict(obs=obs, action=action, logp=logp, value=value,
-                       reward=reward, done=done)
+                       reward=reward_adj, done=done)
             return (vstate, next_obs, ep_ret, fitness_sum, fitness_n, key), out
 
         key, sub = jax.random.split(state.key)

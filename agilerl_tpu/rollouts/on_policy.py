@@ -29,8 +29,15 @@ def collect_rollouts(agent, env, n_steps: Optional[int] = None) -> float:
     for _ in range(n_steps):
         hidden_before = agent._hidden if agent.recurrent else None
         action, logp, value, _ = agent.get_action_and_value(obs)
-        next_obs, reward, terminated, truncated, _ = env.step(np.asarray(action))
+        next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
         done = np.logical_or(terminated, truncated).astype(np.float32)
+        # time-limit bootstrapping: truncated episodes fold gamma*V(s') into
+        # the final reward so GAE (which treats done as terminal) stays
+        # unbiased at truncation boundaries (review finding)
+        trunc_arr = np.asarray(truncated, bool)
+        if trunc_arr.any() and isinstance(info, dict) and "final_obs" in info:
+            v_final = np.asarray(agent.value_of(info["final_obs"]))
+            reward = np.asarray(reward, np.float32) + agent.gamma * v_final * trunc_arr
         step = dict(
             obs=obs,
             action=action,
