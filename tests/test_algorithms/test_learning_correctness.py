@@ -43,11 +43,12 @@ def test_cqn_is_conservative_vs_dqn():
     )
     dqn = DQN(**kwargs)
     cqn = CQN(cql_alpha=2.0, **kwargs)
-    for _ in range(200):
-        batch = buf.sample(64, key=jax.random.PRNGKey(np.random.randint(1 << 30)))
+    for i in range(200):
+        batch = buf.sample(64, key=jax.random.PRNGKey(i))
         dqn.learn(batch)
         cqn.learn(batch)
-    obs = jnp.zeros((1, 1))
+    # conservatism over both probe observations (mean Q must sit lower)
+    obs = jnp.array([[0.0], [1.0]])
     q_dqn = float(np.asarray(dqn.actor(obs)).mean())
     q_cqn = float(np.asarray(cqn.actor(obs)).mean())
     assert q_cqn < q_dqn  # conservatism
