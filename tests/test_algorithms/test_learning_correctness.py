@@ -32,23 +32,33 @@ def test_rainbow_value_convergence():
 
 
 @pytest.mark.slow
-def test_cqn_is_conservative_vs_dqn():
-    """The CQL term must push Q-values DOWN relative to plain DQN on the same
-    data (conservatism on out-of-distribution actions)."""
-    env = ObsDependentRewardEnv()
-    buf = fill_buffer_random(env, ReplayBuffer(max_size=1024), steps=32, seed=3)
+def test_cqn_is_conservative_on_ood_actions():
+    """The CQL term must push Q of actions ABSENT from the dataset down
+    relative to plain DQN trained on the same data (that is the point of
+    conservative Q-learning: in-distribution actions are both taken uniformly
+    so the penalty's softmax-minus-onehot gradient cancels there)."""
+    env = ConstantRewardEnv()
+    buf = ReplayBuffer(max_size=1024)
+    rng = np.random.default_rng(0)
+    for _ in range(128):  # dataset contains ONLY action 0
+        buf.add({
+            "obs": np.zeros(1, np.float32), "action": np.int32(0),
+            "reward": np.float32(1.0), "next_obs": np.zeros(1, np.float32),
+            "done": np.float32(1.0),
+        })
     kwargs = dict(
         observation_space=env.observation_space, action_space=env.action_space,
         net_config=NET, lr=2e-3, tau=0.5, gamma=0.9, seed=0,
     )
     dqn = DQN(**kwargs)
-    cqn = CQN(cql_alpha=2.0, **kwargs)
+    cqn = CQN(cql_alpha=1.0, **kwargs)
     for i in range(200):
         batch = buf.sample(64, key=jax.random.PRNGKey(i))
         dqn.learn(batch)
         cqn.learn(batch)
-    # conservatism over both probe observations (mean Q must sit lower)
-    obs = jnp.array([[0.0], [1.0]])
-    q_dqn = float(np.asarray(dqn.actor(obs)).mean())
-    q_cqn = float(np.asarray(cqn.actor(obs)).mean())
-    assert q_cqn < q_dqn  # conservatism
+    obs = jnp.zeros((1, 1))
+    q_dqn_ood = float(np.asarray(dqn.actor(obs))[0, 1])  # unseen action 1
+    q_cqn_ood = float(np.asarray(cqn.actor(obs))[0, 1])
+    assert q_cqn_ood < q_dqn_ood - 0.05  # conservatism on the OOD action
+    # while the data action still converges near its true value
+    assert abs(float(np.asarray(cqn.actor(obs))[0, 0]) - 1.0) < 0.4
