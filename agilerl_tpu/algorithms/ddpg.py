@@ -121,6 +121,9 @@ class DDPG(RLAlgorithm):
             "policy_freq": self.policy_freq,
             "O_U_noise": self.O_U_noise,
             "expl_noise": self.expl_noise,
+            "mean_noise": self.mean_noise,
+            "theta": self.theta,
+            "dt": self.dt,
         }
 
     # ------------------------------------------------------------------ #

@@ -45,9 +45,11 @@ class NeuralTS(NeuralUCB):
 
     def get_action(self, context, training: bool = True, **kw) -> np.ndarray:
         context = self.preprocess_observation(np.asarray(context))
+        if not training:
+            greedy = self.jit_fn("greedy", self._greedy_fn)
+            return np.asarray(greedy(self.actor.params, context))
         score = self.jit_fn("score", self._score_fn)
-        nu = jnp.float32(self.gamma if training else 0.0)
-        arm, new_U = score(self.actor.params, self.U, context, nu, self.next_key())
-        if training:
-            self.U = new_U
+        arm, new_U = score(self.actor.params, self.U, context,
+                           jnp.float32(self.gamma), self.next_key())
+        self.U = new_U
         return np.asarray(arm)

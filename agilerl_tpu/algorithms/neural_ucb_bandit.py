@@ -103,6 +103,25 @@ class NeuralUCB(RLAlgorithm):
         self.theta_0 = jax.tree_util.tree_map(jnp.copy, parent.theta_0)
         self.U = jax.tree_util.tree_map(jnp.copy, parent.U)
 
+    def checkpoint_dict(self):
+        ckpt = super().checkpoint_dict()
+        # the anchor params and design matrix ARE the bandit's belief state —
+        # without them a loaded agent regularises toward a random init and
+        # explores from scratch (review finding)
+        ckpt["bandit_state"] = {
+            "theta_0": jax.device_get(self.theta_0),
+            "U": jax.device_get(self.U),
+        }
+        return ckpt
+
+    def _restore(self, ckpt) -> None:
+        super()._restore(ckpt)
+        if "bandit_state" in ckpt:
+            self.theta_0 = jax.tree_util.tree_map(
+                jnp.asarray, ckpt["bandit_state"]["theta_0"]
+            )
+            self.U = jax.tree_util.tree_map(jnp.asarray, ckpt["bandit_state"]["U"])
+
     # ------------------------------------------------------------------ #
     def _score_fn(self):
         config = self.actor.config
@@ -136,14 +155,26 @@ class NeuralUCB(RLAlgorithm):
 
         return score
 
+    def _greedy_fn(self):
+        config = self.actor.config
+
+        @jax.jit
+        def greedy(params, context):
+            values = EvolvableNetwork.apply(config, params, context)[..., 0]
+            return jnp.argmax(values)
+
+        return greedy
+
     def get_action(self, context: Any, training: bool = True, **kw) -> np.ndarray:
         """context: [num_arms, context_dim] features; returns chosen arm."""
         context = self.preprocess_observation(np.asarray(context))
+        if not training:
+            # eval path: value-only (no per-arm gradients / U update)
+            greedy = self.jit_fn("greedy", self._greedy_fn)
+            return np.asarray(greedy(self.actor.params, context))
         score = self.jit_fn("score", self._score_fn)
-        nu = jnp.float32(self.gamma if training else 0.0)
-        arm, new_U = score(self.actor.params, self.U, context, nu)
-        if training:
-            self.U = new_U
+        arm, new_U = score(self.actor.params, self.U, context, jnp.float32(self.gamma))
+        self.U = new_U
         return np.asarray(arm)
 
     # ------------------------------------------------------------------ #

@@ -158,13 +158,13 @@ class EvolvableMultiInput(EvolvableModule):
         """Add a layer to a random sub-extractor (nested-module mutation;
         parity: the reference recurses @mutation calls into sub-modules,
         modules/base.py:629)."""
-        return self._mutate_sub("add_layer", "add_block", rng)
+        return self._mutate_sub("add_layer", rng)
 
     @mutation(MutationType.LAYER, shrink_params=True)
     def remove_sub_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
-        return self._mutate_sub("remove_layer", "remove_block", rng)
+        return self._mutate_sub("remove_layer", rng)
 
-    def _mutate_sub(self, mlp_method: str, _alt: str, rng) -> Dict:
+    def _mutate_sub(self, method: str, rng) -> Dict:
         rng = rng or np.random.default_rng()
         cfg = self.config
         idx = int(rng.integers(0, len(cfg.sub_configs)))
@@ -177,7 +177,6 @@ class EvolvableMultiInput(EvolvableModule):
         sub.params = self.params[f"sub_{name}"]
         sub.last_mutation_attr = None
         sub.last_mutation = {}
-        method = mlp_method if hasattr(sub, mlp_method) else _alt
         getattr(sub, method)(rng=rng)
         new_subs = list(cfg.sub_configs)
         new_subs[idx] = (name, kind, sub.config)

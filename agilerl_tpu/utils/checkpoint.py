@@ -64,11 +64,20 @@ def save_llm_checkpoint(agent, path: Union[str, Path], include_base: bool = Fals
 
 
 def load_llm_checkpoint(agent, path: Union[str, Path]) -> None:
-    """Restore adapters into an existing agent (the reference deliberately
-    requires re-instantiation for LLM load, core/base.py:2196 — same here)."""
+    """Restore adapters + training attrs into an existing agent (the reference
+    deliberately requires re-instantiation for LLM load, core/base.py:2196 —
+    same here)."""
+    import pickle
+
     path = Path(path).absolute()
     agent.actor.params = load_pytree(path / "actor_adapter", agent.actor.params)
     agent.reference.params = load_pytree(path / "reference_adapter", agent.reference.params)
     if (path / "base_params").exists():
         agent.base_params = load_pytree(path / "base_params", agent.base_params)
+    attrs_file = path / "attributes.pkl"
+    if attrs_file.exists():
+        with open(attrs_file, "rb") as f:
+            attrs = pickle.load(f)
+        agent.fitness = list(attrs.get("fitness", agent.fitness))
+        agent.steps = list(attrs.get("steps", agent.steps))
     agent._clear_jit_cache()

@@ -111,8 +111,8 @@ _INIT_HP_MAP = {
     "DT": "dt",
     "NUM_ENVS": "num_envs",
     "AGENT_IDS": "agent_ids",
-    "LAMBDA": "reg_lambda",
-    "REG": "reg_lambda",
+    "LAMBDA": "lamb",
+    "REG": "reg",
 }
 
 
@@ -231,10 +231,15 @@ def tournament_selection_and_mutation(
 def save_population_checkpoint(
     population: List, save_path: str, overwrite_checkpoints: bool = True, accelerator=None
 ) -> None:
-    """Checkpoint every member (parity: utils/utils.py:656)."""
+    """Checkpoint every member (parity: utils/utils.py:656).
+    overwrite_checkpoints=False keeps per-step history by appending the
+    member's current step count to the filename."""
     for agent in population:
         p = Path(save_path)
-        path = p.parent / f"{p.stem}_{agent.index}{p.suffix or '.ckpt'}"
+        stem = f"{p.stem}_{agent.index}"
+        if not overwrite_checkpoints:
+            stem = f"{stem}_step{agent.steps[-1]}"
+        path = p.parent / f"{stem}{p.suffix or '.ckpt'}"
         agent.save_checkpoint(path)
 
 

@@ -91,10 +91,16 @@ def test_checkpointing(tmp_path, vec_env):
     train_off_policy(
         vec_env, "CartPole-v1", "DQN", pop, memory,
         max_steps=200, evo_steps=100, eval_steps=20, eval_loop=1,
-        checkpoint=100, checkpoint_path=str(ckpt), verbose=False,
+        checkpoint=100, checkpoint_path=str(ckpt), overwrite_checkpoints=True,
+        verbose=False,
     )
     assert (tmp_path / "pop_0.ckpt").exists()
     assert (tmp_path / "pop_1.ckpt").exists()
+    # overwrite_checkpoints=False keeps per-step history instead
+    from agilerl_tpu.utils.utils import save_population_checkpoint
+
+    save_population_checkpoint(pop, str(ckpt), overwrite_checkpoints=False)
+    assert any("step" in p.name for p in tmp_path.glob("pop_*_step*.ckpt"))
 
     from agilerl_tpu.utils.utils import load_population_checkpoint
 
