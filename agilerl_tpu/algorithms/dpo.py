@@ -73,15 +73,15 @@ class DPO(GRPO):
                     batch["rejected_loss_mask"],
                 )
                 logits = beta * ((pol_c - ref_c) - (pol_r - ref_r))
-                # sigmoid DPO loss with optional label smoothing (parity :361)
+                # sigmoid DPO loss with optional label smoothing (parity :361);
+                # logits IS the implicit reward margin (parity:
+                # _compute_implicit_reward:530)
                 loss = (
                     -jax.nn.log_sigmoid(logits) * (1 - smooth)
                     - jax.nn.log_sigmoid(-logits) * smooth
                 ).mean()
-                # implicit rewards (parity: _compute_implicit_reward:530)
-                reward_margin = beta * ((pol_c - ref_c) - (pol_r - ref_r))
-                acc = (reward_margin > 0).astype(jnp.float32).mean()
-                return loss, (acc, reward_margin.mean())
+                acc = (logits > 0).astype(jnp.float32).mean()
+                return loss, (acc, logits.mean())
 
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(lora)
             updates, opt_state = tx.update(grads, opt_state, lora)
@@ -106,13 +106,13 @@ class DPO(GRPO):
         return float(loss), float(acc)
 
     def test(self, env) -> float:
-        """Preference accuracy on the eval split (parity: dpo.py test)."""
+        """Preference accuracy on the eval split (parity: dpo.py test) — runs
+        through the shared jitted logprob fn (fused/flash fast paths on TPU)."""
         batch = {k: jnp.asarray(v) for k, v in env.reset(eval_mode=True).items()}
-        config, base = self.model_config, self.base_params
+        logprobs = self.jit_fn("logprobs", self._logprob_fn)
 
         def seq_lp(lora, ids, mask, loss_mask):
-            lp = M.token_logprobs(config, base, ids, attention_mask=mask, lora=lora)
-            return (lp * loss_mask).sum(axis=-1)
+            return (logprobs(lora, ids, mask) * loss_mask).sum(axis=-1)
 
         pol_c = seq_lp(self.actor.params, batch["chosen_ids"], batch["chosen_mask"],
                        batch["chosen_loss_mask"])

@@ -133,6 +133,7 @@ class RainbowDQN(RLAlgorithm):
             "gamma": self.gamma,
             "tau": self.tau,
             "beta": self.beta,
+            "prior_eps": self.prior_eps,
             "num_atoms": self.num_atoms,
             "v_min": self.v_min,
             "v_max": self.v_max,

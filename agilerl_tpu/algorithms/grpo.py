@@ -164,6 +164,7 @@ class GRPO(EvolvableAlgorithm):
         comp, cmask = generate(
             self.model_config, self.base_params, ids, mask, self.next_key(),
             max_new_tokens=self.max_output_tokens, lora=self.actor.params,
+            lora_scale=self.lora_scale,
             temperature=self.temperature if training else 0.0,
             eos_id=self.eos_token_id, pad_id=self.pad_token_id,
         )
@@ -180,6 +181,7 @@ class GRPO(EvolvableAlgorithm):
     def _logprob_fn(self):
         config = self.model_config
         base = self.base_params
+        scale = self.lora_scale
         # no-grad passes use the fused Pallas lm-head kernel on TPU
         use_pallas = jax.default_backend() == "tpu"
 
@@ -187,7 +189,7 @@ class GRPO(EvolvableAlgorithm):
         def logprobs(lora, tokens, mask):
             return M.token_logprobs(
                 config, base, tokens, attention_mask=mask, lora=lora,
-                use_pallas=use_pallas, flash=use_pallas,
+                lora_scale=scale, use_pallas=use_pallas, flash=use_pallas,
             )
 
         return logprobs
@@ -205,7 +207,7 @@ class GRPO(EvolvableAlgorithm):
             def loss_fn(lo):
                 lp = M.token_logprobs(
                     config, base, batch["tokens"], attention_mask=batch["mask"],
-                    lora=lo, flash=use_flash,
+                    lora=lo, lora_scale=scale, flash=use_flash,
                 )
                 lp = lp * batch["loss_mask"]
                 ratio = jnp.exp(lp - batch["old_lp"])
@@ -228,15 +230,20 @@ class GRPO(EvolvableAlgorithm):
         return update
 
     def learn(self, experiences: Tuple) -> Tuple[float, float]:
-        """experiences = (ids, action_masks, rewards):
+        """experiences = (ids, action_masks, rewards[, attention_mask]):
         ids [B*G, P+N] full prompt+completion sequences, action_masks [B*G, P+N-1]
-        marking completion-token predictions, rewards [B, G]
+        marking completion-token predictions, rewards [B, G]; pass the optional
+        4th element when pad_token_id collides with a real vocabulary token
+        (otherwise attention defaults to ids != pad_token_id)
         (parity: grpo.py:321). Returns (mean loss, mean |kl| proxy)."""
-        ids, action_masks, rewards = experiences
-        ids = jnp.asarray(ids)
-        mask = (ids != self.pad_token_id).astype(jnp.int32)
-        # attention mask must also cover pads inside prompt (left-pad) — caller
-        # supplies full attention separately when pad==real token id
+        if len(experiences) == 4:
+            ids, action_masks, rewards, attn = experiences
+            ids = jnp.asarray(ids)
+            mask = jnp.asarray(attn, jnp.int32)
+        else:
+            ids, action_masks, rewards = experiences
+            ids = jnp.asarray(ids)
+            mask = (ids != self.pad_token_id).astype(jnp.int32)
         loss_mask = jnp.asarray(action_masks, jnp.float32)
         rewards = jnp.asarray(rewards, jnp.float32)
         advantage = self._calculate_advantage(rewards)
@@ -266,6 +273,10 @@ class GRPO(EvolvableAlgorithm):
                     jnp.float32(self.beta),
                 )
                 if not np.isfinite(float(loss)):
+                    # the update donated the previous buffers — store the (live)
+                    # returned state first so the agent stays usable/savable
+                    self.actor.params = lora
+                    self.optimizer.opt_state = opt_state
                     raise RuntimeError(
                         f"Non-finite GRPO loss {float(loss)} — aborting "
                         "(parity: grpo.py:370 NaN guard)"

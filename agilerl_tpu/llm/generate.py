@@ -46,7 +46,8 @@ def _sample_token(logits, key, temperature, top_k):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "max_new_tokens", "temperature", "top_k", "eos_id", "pad_id"),
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k", "eos_id",
+                     "pad_id", "lora_scale"),
 )
 def generate(
     config: M.GPTConfig,
@@ -56,6 +57,7 @@ def generate(
     key: jax.Array,
     max_new_tokens: int = 64,
     lora=None,
+    lora_scale: float = 2.0,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     eos_id: Optional[int] = None,
@@ -69,7 +71,7 @@ def generate(
     positions = jnp.maximum(jnp.cumsum(prompt_mask, axis=-1) - 1, 0)
     hidden, caches = M.forward(
         config, params, prompt, attention_mask=prompt_mask, positions=positions,
-        cache=caches, lora=lora,
+        cache=caches, lora=lora, lora_scale=lora_scale,
     )
     last_logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]  # [B, V]
     pos = prompt_mask.sum(axis=-1)  # next position per row
@@ -88,6 +90,7 @@ def generate(
             config, params, prev_tok[:, None],
             attention_mask=prev_valid.astype(jnp.int32)[:, None],
             positions=pos[:, None], cache=caches, lora=lora,
+            lora_scale=lora_scale,
         )
         logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
         pos = pos + prev_valid.astype(pos.dtype)

@@ -77,14 +77,15 @@ def _forward_local(config: GPTConfig, params, tokens, lora, lora_scale, axis_nam
     return _rms(h, params["ln_f"], config.rms_eps).astype(jnp.float32)
 
 
-def make_sp_logprob_fn(config: GPTConfig, mesh: Mesh, axis_name: str = "sp"):
+def make_sp_logprob_fn(config: GPTConfig, mesh: Mesh, axis_name: str = "sp",
+                       lora_scale: float = 2.0):
     """Build a jitted fn(params, lora, tokens [B, T]) -> per-token logprobs
     [B, T-1] with the sequence sharded over `axis_name`. Differentiable —
     usable directly inside GRPO/DPO losses for long sequences."""
 
     def local_fn(params, lora, tokens):
         # tokens: local shard [B, T_local]
-        hidden = _forward_local(config, params, tokens, lora, 2.0, axis_name)
+        hidden = _forward_local(config, params, tokens, lora, lora_scale, axis_name)
         head = params["tok_emb"].T if config.tie_embeddings else params["lm_head"]
         logits = hidden @ head.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)

@@ -348,6 +348,7 @@ def token_logprobs(
     tokens: jax.Array,  # [B, T]
     attention_mask: Optional[jax.Array] = None,
     lora: Optional[Params] = None,
+    lora_scale: float = 2.0,
     temperature: float = 1.0,
     chunk_size: int = 128,
     use_pallas: bool = False,
@@ -360,7 +361,7 @@ def token_logprobs(
     no-grad logprob passes (GRPO old/reference logprobs); flash likewise
     enables the Pallas attention kernel on those passes."""
     hidden, _ = forward(config, params, tokens, attention_mask=attention_mask,
-                        lora=lora, flash=flash)
+                        lora=lora, lora_scale=lora_scale, flash=flash)
     if use_pallas:
         from agilerl_tpu.ops.fused_loss import fused_token_logprob
 
