@@ -55,3 +55,28 @@ def test_bc_lm_loss_decreases():
     comp, cmask = agent.generate(np.ones((1, 4), np.int32), np.ones((1, 4), np.int32),
                                  max_new_tokens=4)
     assert comp.shape == (1, 4)
+
+
+def test_ilql_rewards_shape_q_values():
+    """After the token-alignment fix, Q(prompt, good_token) must rise above
+    Q(prompt, bad_token) when only 'good' completions are rewarded."""
+    import jax
+    from agilerl_tpu.modules import layers as L
+    from agilerl_tpu.llm import model as M
+
+    good, bad = TOK.encode("8")[0], TOK.encode("9")[0]
+    obs = []
+    for _ in range(16):
+        obs.append(Language_Observation(sequence=[("7+1=", None), ("8", 1.0)]))
+        obs.append(Language_Observation(sequence=[("7+1=", None), ("9", -1.0)]))
+    ds = RL_Dataset(obs, TOK, max_len=8)
+    agent = ILQL(config=CFG, lr=3e-3, gamma=0.9, cql_weight=0.0, seed=0)
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        agent.learn(ds.sample_batch(16, rng))
+    toks = np.asarray([TOK.encode("7+1=")], np.int32)
+    mask = np.ones_like(toks)
+    hidden, _ = M.forward(CFG, agent.actor.params["gpt"], jnp.asarray(toks),
+                          attention_mask=jnp.asarray(mask))
+    qs = np.asarray(L.dense_apply(agent.actor.params["q_head"], hidden))[0, -1]
+    assert qs[good] > qs[bad] + 0.2, (qs[good], qs[bad])
