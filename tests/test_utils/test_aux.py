@@ -1,0 +1,90 @@
+"""Coverage for auxiliary utilities: profiling, log combination, MakeEvolvable,
+offline data helpers, multihost shims."""
+
+import numpy as np
+import pytest
+
+
+def test_step_timer_throughput():
+    import time
+
+    from agilerl_tpu.utils.profiling import StepTimer
+
+    t = StepTimer(window=4)
+    assert t.tick() is None
+    for _ in range(3):
+        time.sleep(0.01)
+        dt = t.tick()
+        assert dt is not None and dt > 0
+    assert t.mean_step_time > 0
+    assert t.throughput(100) > 0
+
+
+def test_estimate_mfu_bounds():
+    import jax.numpy as jnp
+
+    from agilerl_tpu.llm.model import GPTConfig
+    from agilerl_tpu.utils.profiling import estimate_mfu, transformer_flops_per_token
+
+    cfg = GPTConfig(vocab_size=32000, n_layer=12, n_head=12, d_model=768,
+                    max_seq_len=1024)
+    flops = transformer_flops_per_token(cfg)
+    assert flops > 6 * 80e6  # at least 6x params for a ~124M model
+    mfu = estimate_mfu(cfg, tokens_per_step=16384, step_time_s=1.0,
+                       peak_flops=197e12)
+    assert 0 < mfu < 1
+
+
+def test_combine_logs_weighted_mean():
+    from agilerl_tpu.utils.log_utils import CombineLogs
+
+    logs = CombineLogs()
+    logs.accum({"loss": 1.0}, weight=1.0)
+    logs.accum({"loss": 3.0}, weight=3.0)
+    out = logs.reduce()
+    assert out["loss"] == pytest.approx(2.5)
+    logs.clear()
+    assert logs.reduce() == {}
+
+
+def test_make_evolvable_mlp_and_cnn():
+    import jax
+
+    from agilerl_tpu.wrappers.make_evolvable import MakeEvolvable
+
+    with pytest.warns(DeprecationWarning):
+        mlp = MakeEvolvable(num_inputs=4, num_outputs=2, hidden_layers=[32, 32],
+                            key=jax.random.PRNGKey(0))
+    assert mlp(np.zeros((1, 4), np.float32)).shape == (1, 2)
+    with pytest.warns(DeprecationWarning):
+        cnn = MakeEvolvable(input_shape=(16, 16, 3), num_outputs=2,
+                            channels=[8, 8], key=jax.random.PRNGKey(1))
+    assert cnn(np.zeros((1, 16, 16, 3), np.float32)).shape == (1, 2)
+
+
+def test_h5_roundtrip(tmp_path):
+    from agilerl_tpu.utils.minari_utils import load_h5_dataset, save_h5_dataset
+
+    ds = {
+        "observations": np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32),
+        "actions": np.zeros(10, np.int64),
+        "rewards": np.ones(10, np.float32),
+        "next_observations": np.zeros((10, 4), np.float32),
+        "terminals": np.zeros(10, np.float32),
+    }
+    save_h5_dataset(tmp_path / "d.h5", ds)
+    back = load_h5_dataset(tmp_path / "d.h5")
+    np.testing.assert_array_equal(back["observations"], ds["observations"])
+    assert set(back) == set(ds)
+
+
+def test_offline_dataset_generation_and_training():
+    from agilerl_tpu.envs import CartPole, JaxVecEnv
+    from agilerl_tpu.utils.minari_utils import collect_offline_dataset
+
+    env = JaxVecEnv(CartPole(), num_envs=4, seed=0)
+    ds = collect_offline_dataset(env, steps=64, epsilon=1.0, seed=0)
+    assert ds["observations"].shape[0] == 64
+    assert ds["rewards"].shape == (64,)
+    assert set(ds) == {"observations", "actions", "rewards",
+                       "next_observations", "terminals"}
