@@ -158,15 +158,19 @@ class MultiStepReplayBuffer(ReplayBuffer):
         self._horizon = []
 
     def add(self, transition: Dict, batched: bool = False) -> Optional[Dict]:
-        """transition keys: obs, action, reward, next_obs, done.
-        Returns the oldest raw transition once the window is full, else None."""
+        """transition keys: obs, action, reward, next_obs, done
+        (+ optional "_boundary" = terminated|truncated so folds stop at
+        truncations/autoresets too — "done" itself stays terminated-only for
+        correct bootstrapping). Returns the oldest raw transition once the
+        window is full, else None."""
         self._horizon.append(
             jax.tree_util.tree_map(lambda x: np.asarray(x), transition)
         )
         if len(self._horizon) < self.n_step:
             return None
         fused = self._fold()
-        oldest = self._horizon.pop(0)
+        oldest = dict(self._horizon.pop(0))
+        oldest.pop("_boundary", None)
         super().add(fused, batched=batched)
         return oldest
 
@@ -179,12 +183,15 @@ class MultiStepReplayBuffer(ReplayBuffer):
         alive = np.ones_like(done)
         for tr in self._horizon:
             r = np.asarray(tr["reward"], np.float32)
-            d = np.asarray(tr["done"], np.float32)
+            # the fold freezes at ANY episode boundary (terminated OR
+            # truncated/autoreset) — review finding; stored done stays
+            # terminated-only via the "done" key handling below
+            d = np.asarray(tr.get("_boundary", tr["done"]), np.float32)
             reward = reward + discount * r * alive
             # next_obs/done from the last alive step per env
             if next_obs is None:
                 next_obs = jax.tree_util.tree_map(np.asarray, tr["next_obs"])
-                done = d.copy()
+                done = np.asarray(tr["done"], np.float32).copy()
             else:
                 step_next = jax.tree_util.tree_map(np.asarray, tr["next_obs"])
                 upd = alive.astype(bool)
@@ -195,10 +202,12 @@ class MultiStepReplayBuffer(ReplayBuffer):
                     next_obs,
                     step_next,
                 )
-                done = np.where(upd, d, done)
+                done = np.where(upd, np.asarray(tr["done"], np.float32), done)
             alive = alive * (1.0 - d)
             discount *= self.gamma
-        return {**first, "reward": reward, "next_obs": next_obs, "done": done}
+        out = {**first, "reward": reward, "next_obs": next_obs, "done": done}
+        out.pop("_boundary", None)
+        return out
 
 
 # --------------------------------------------------------------------------- #
@@ -301,3 +310,6 @@ class PrioritizedReplayBuffer(ReplayBuffer):
 
     def sample_from_indices(self, idx) -> PyTree:
         return _gather(self.per_state.buffer, jnp.asarray(idx))
+
+    def clear(self) -> None:
+        self.per_state = None

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -99,7 +100,12 @@ def convert_hf_model(model, hf_cfg=None) -> Tuple[GPTConfig, Dict[str, Any]]:
 def load_hf_model(
     name_or_path: str, dtype=jnp.bfloat16
 ) -> Tuple[GPTConfig, Dict[str, Any]]:
-    """Load a pretrained Llama/Qwen2-class causal LM into the in-tree format."""
+    """Load a pretrained Llama/Qwen2-class causal LM into the in-tree format.
+    Weights are stored in `dtype` (bf16 default halves HBM; norm scales stay
+    float32 since _rms computes in f32 regardless) and config.dtype is set to
+    match."""
+    import dataclasses
+
     import torch
     from transformers import AutoConfig, AutoModelForCausalLM
 
@@ -107,9 +113,18 @@ def load_hf_model(
     model = AutoModelForCausalLM.from_pretrained(
         name_or_path, torch_dtype=torch.float32, low_cpu_mem_usage=True
     )
-    out = convert_hf_model(model, hf_cfg)
+    config, params = convert_hf_model(model, hf_cfg)
     del model
-    return out
+    config = dataclasses.replace(config, dtype=dtype)
+
+    def cast(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("ln1", "ln2", "ln_f"):
+            return leaf  # norm scales stay f32
+        return leaf.astype(dtype)
+
+    params = jax.tree_util.tree_map_with_path(cast, params)
+    return config, params
 
 
 def load_hf_tokenizer(name_or_path: str):

@@ -86,6 +86,8 @@ class EvolvableBERT(EvolvableModule):
         config: Optional[BERTConfig] = None,
         min_layers: int = 1,
         max_layers: int = 8,
+        min_d_model: int = 64,
+        max_d_model: int = 1024,
         **kwargs,
     ):
         if config is None:
@@ -94,6 +96,8 @@ class EvolvableBERT(EvolvableModule):
             key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
         self.min_layers = min_layers
         self.max_layers = max_layers
+        self.min_d_model = min_d_model
+        self.max_d_model = max_d_model
         super().__init__(config, key)
 
     @staticmethod
@@ -208,7 +212,7 @@ class EvolvableBERT(EvolvableModule):
         cfg = self.config
         if numb_new_nodes is None:
             numb_new_nodes = cfg.n_head * int(rng.choice([4, 8]))
-        new_d = min(cfg.d_model + numb_new_nodes, 1024)
+        new_d = min(cfg.d_model + numb_new_nodes, self.max_d_model)
         new_d -= new_d % cfg.n_head
         self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
         return {"numb_new_nodes": numb_new_nodes}
@@ -221,7 +225,9 @@ class EvolvableBERT(EvolvableModule):
         cfg = self.config
         if numb_new_nodes is None:
             numb_new_nodes = cfg.n_head * int(rng.choice([4, 8]))
-        new_d = max(cfg.d_model - numb_new_nodes, 64)
+        new_d = max(cfg.d_model - numb_new_nodes, self.min_d_model)
         new_d -= new_d % cfg.n_head
+        if new_d < self.min_d_model:  # head-divisible floor must not undershoot
+            new_d += cfg.n_head
         self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
         return {"numb_new_nodes": numb_new_nodes}

@@ -208,6 +208,10 @@ class EvolvableNetwork:
         sub.last_mutation = {}
         return sub
 
+    # subclasses whose head consumes latent ⊕ extra features (e.g. the
+    # obs+action critic) set this offset instead of overriding _change_latent
+    _head_extra_inputs: int = 0
+
     def _change_latent(self, delta: int) -> Dict:
         cfg = self.config
         new_latent = int(
@@ -216,7 +220,9 @@ class EvolvableNetwork:
         if new_latent == cfg.latent_dim:
             return {"numb_new_nodes": 0}
         enc_cfg = config_replace(cfg.encoder, num_outputs=new_latent)
-        head_cfg = config_replace(cfg.head, num_inputs=new_latent)
+        head_cfg = config_replace(
+            cfg.head, num_inputs=new_latent + self._head_extra_inputs
+        )
         new_cfg = config_replace(cfg, encoder=enc_cfg, head=head_cfg, latent_dim=new_latent)
         new_params = self.init_params(self._next_key(), new_cfg)
         preserved = preserve_params(self.params, new_params)

@@ -64,21 +64,10 @@ class ContinuousQNetwork(EvolvableNetwork):
     def __call__(self, obs, action, **kw):
         return type(self).apply(self.config, self.params, obs, action=action, **kw)
 
-    def _change_latent(self, delta: int) -> Dict:
-        cfg = self.config
-        new_latent = int(
-            np.clip(cfg.latent_dim + delta, cfg.min_latent_dim, cfg.max_latent_dim)
-        )
-        if new_latent == cfg.latent_dim:
-            return {"numb_new_nodes": 0}
-        enc_cfg = config_replace(cfg.encoder, num_outputs=new_latent)
-        head_cfg = config_replace(cfg.head, num_inputs=new_latent + self.action_dim)
-        new_cfg = config_replace(cfg, encoder=enc_cfg, head=head_cfg, latent_dim=new_latent)
-        new_params = self.init_params(self._next_key(), new_cfg)
-        self.params = preserve_params(self.params, new_params)
-        self.config = new_cfg
-        self.last_mutation = {"numb_new_nodes": abs(delta)}
-        return self.last_mutation
+    @property
+    def _head_extra_inputs(self) -> int:
+        # head consumes latent ⊕ action (base _change_latent handles the rest)
+        return self.action_dim
 
     @property
     def init_dict(self):
@@ -131,19 +120,21 @@ class RainbowQNetwork(EvolvableNetwork):
                 "layer_norm": True,
                 "output_vanish": False,
             }
+            # build the plain NetworkConfig without allocating params twice:
+            # lift it into a RainbowConfig FIRST, then let the base initialise
+            # against the final config (single init, value stream included)
             super().__init__(
-                observation_space, num_outputs=num_actions * num_atoms, **kwargs
+                observation_space, num_outputs=num_actions * num_atoms,
+                config=None, **kwargs,
             )
+            base_fields = {
+                f.name: getattr(self.config, f.name)
+                for f in dataclasses.fields(NetworkConfig)
+            }
             self.config = RainbowConfig(
-                **dataclasses.asdict_shallow(self.config)
-                if hasattr(dataclasses, "asdict_shallow")
-                else {f.name: getattr(self.config, f.name) for f in dataclasses.fields(self.config)},
-                num_atoms=num_atoms,
-                num_actions=num_actions,
-                v_min=v_min,
-                v_max=v_max,
+                **base_fields, num_atoms=num_atoms, num_actions=num_actions,
+                v_min=v_min, v_max=v_max,
             )
-            # re-init params so the value stream exists
             self.params = self.init_params(self._next_key(), self.config)
         else:
             super().__init__(observation_space, num_outputs=num_actions * num_atoms,

@@ -110,7 +110,9 @@ def train_off_policy(
                     # fused n-step goes into n_step_memory's own ring; the
                     # returned OLDEST raw transition goes into the main buffer
                     # so both rings stay index-aligned (parity: reference's
-                    # paired-buffer scheme, train_off_policy.py:340)
+                    # paired-buffer scheme, train_off_policy.py:340).
+                    # _boundary stops folds at truncations/autoresets.
+                    transition["_boundary"] = np.asarray(done, np.float32)
                     one_step = n_step_memory.add(transition, batched=num_envs > 1)
                     if one_step is not None:
                         memory.add(one_step, batched=num_envs > 1)
