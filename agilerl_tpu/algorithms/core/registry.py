@@ -125,7 +125,11 @@ class MutationRegistry:
         return names
 
     def validate(self) -> None:
-        """One group must be the policy (parity: core/base.py:582)."""
-        assert self.policy_group is not None, (
-            "An algorithm must register exactly one NetworkGroup with policy=True"
-        )
+        """Exactly one group must be the policy (parity: core/base.py:582).
+        Raises (not asserts — survives python -O) on zero or multiple."""
+        n_policy = sum(1 for g in self.groups if g.policy)
+        if n_policy != 1:
+            raise ValueError(
+                f"An algorithm must register exactly one NetworkGroup with "
+                f"policy=True (found {n_policy})"
+            )

@@ -8,14 +8,11 @@ The distributed path becomes per-host key-folded sampling (see data.ReplayDatase
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
-
-import jax
+from typing import Optional
 
 from agilerl_tpu.components.replay_buffer import (
     MultiStepReplayBuffer,
     PrioritizedReplayBuffer,
-    ReplayBuffer,
 )
 
 
@@ -24,6 +21,8 @@ class Sampler:
         self.memory = memory
         self.dataset = dataset
         self.per = per or isinstance(memory, PrioritizedReplayBuffer)
+        # informational: n-step pairing is driven by the training loop's
+        # paired-buffer scheme, not by the sampler itself
         self.n_step = n_step or isinstance(memory, MultiStepReplayBuffer)
         self._iter = iter(dataset) if dataset is not None else None
 

@@ -68,7 +68,6 @@ class EvolvableResNet(EvolvableModule):
                 "conv2": L.conv2d_init(keys[2 * i + 2], 3, 3, c, c),
                 "norm2": L.layer_norm_init(c),
             }
-        h, w, _ = config.input_shape
         params["output"] = L.dense_init(keys[-1], c, config.num_outputs)
         return params
 

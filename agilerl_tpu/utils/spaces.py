@@ -95,8 +95,6 @@ def preprocess_observation(space: Any, obs: Any) -> Any:
                 # channels-first input -> NHWC
                 x = jnp.moveaxis(x, -3, -1)
             return x
-        if len(space.shape) <= 1 and space.shape != x.shape[x.ndim - len(space.shape):]:
-            pass
         flat_from = x.ndim - len(space.shape) if space.shape else x.ndim
         if len(space.shape) > 1:
             x = x.reshape(*x.shape[:flat_from], -1)

@@ -65,15 +65,27 @@ class RSNorm:
         obs = self._norm_obs(obs, update=training)
         return self.agent.get_action(obs, *args, training=training, **kwargs)
 
+    def _norm_batch(self, batch):
+        batch = dict(batch)
+        if "obs" in batch and not isinstance(batch["obs"], dict):
+            batch["obs"] = self.rms.normalize(np.asarray(batch["obs"]))
+        if "next_obs" in batch and not isinstance(batch["next_obs"], dict):
+            batch["next_obs"] = self.rms.normalize(np.asarray(batch["next_obs"]))
+        return batch
+
     def learn(self, experiences, *args, **kwargs):
         if isinstance(experiences, dict):
-            experiences = dict(experiences)
-            if "obs" in experiences and not isinstance(experiences["obs"], dict):
-                experiences["obs"] = self.rms.normalize(np.asarray(experiences["obs"]))
-            if "next_obs" in experiences and not isinstance(experiences["next_obs"], dict):
-                experiences["next_obs"] = self.rms.normalize(
-                    np.asarray(experiences["next_obs"])
-                )
+            experiences = self._norm_batch(experiences)
+        elif isinstance(experiences, tuple) and experiences and isinstance(
+            experiences[0], dict
+        ):
+            # PER/n-step tuples: (batch, idxs, weights[, n_batch]) — normalise
+            # every dict element (review finding; parity with the reference's
+            # tuple handling)
+            experiences = tuple(
+                self._norm_batch(e) if isinstance(e, dict) else e
+                for e in experiences
+            )
         return self.agent.learn(experiences, *args, **kwargs)
 
     def test(self, env, *args, **kwargs):
@@ -105,8 +117,19 @@ class AsyncAgentsWrapper:
         active = {a: o for a, o in obs.items() if o is not None}
         if not active:
             return {a: None for a in obs}
-        actions = self.agent.get_action(active, *args, **kwargs)
-        return {a: actions.get(a) for a in obs}
+        # multi-agent algorithms index obs by EVERY agent id — substitute
+        # zero placeholders for inactive agents, then drop their actions
+        ref = next(iter(active.values()))
+        batch_shape = np.asarray(ref).shape[:1] if np.asarray(ref).ndim > 1 else ()
+        full = {}
+        for aid in obs:
+            if obs[aid] is not None:
+                full[aid] = obs[aid]
+            else:
+                space = self.agent.observation_spaces[aid]
+                full[aid] = np.zeros(batch_shape + tuple(space.shape), np.float32)
+        actions = self.agent.get_action(full, *args, **kwargs)
+        return {a: (actions.get(a) if obs[a] is not None else None) for a in obs}
 
     def record_step(self, obs, actions, rewards, dones):
         """Feed one env step; returns {agent: completed transition} for agents
@@ -132,6 +155,16 @@ class AsyncAgentsWrapper:
             if acted_now and not done:
                 self._pending[aid] = {
                     "obs": o, "action": actions[aid], "reward": 0.0,
+                }
+            elif acted_now and done:
+                # the episode-ending action closes immediately with this
+                # step's reward (it would otherwise be dropped — review finding)
+                completed[f"{aid}#final"] = {
+                    "obs": o,
+                    "action": actions[aid],
+                    "reward": np.float32(np.asarray(rewards.get(aid, 0.0)).squeeze()),
+                    "next_obs": o,
+                    "done": np.float32(1.0),
                 }
         return completed
 

@@ -44,7 +44,12 @@ def test_bandit_env():
 def test_async_agents_wrapper_turn_buffering():
     from agilerl_tpu.wrappers import AsyncAgentsWrapper
 
+    from gymnasium import spaces as gspaces
+
     class StubMA:
+        observation_spaces = {"a": gspaces.Box(-1, 1, (2,)),
+                              "b": gspaces.Box(-1, 1, (2,))}
+
         def get_action(self, obs, **kw):
             return {a: np.int32(1) for a in obs}
 
