@@ -157,6 +157,12 @@ class MultiStepReplayBuffer(ReplayBuffer):
     def reset_horizon(self) -> None:
         self._horizon = []
 
+    def clear(self) -> None:
+        # transitions added after clear() must not fold with stale pre-clear
+        # steps (advisor finding)
+        super().clear()
+        self.reset_horizon()
+
     def add(self, transition: Dict, batched: bool = False) -> Optional[Dict]:
         """transition keys: obs, action, reward, next_obs, done
         (+ optional "_boundary" = terminated|truncated so folds stop at
@@ -251,14 +257,25 @@ def _per_sample(
     batch = jax.tree_util.tree_map(lambda buf: buf[idx], state.buffer.storage)
     probs = p[idx] / jnp.maximum(total, 1e-12)
     weights = (size.astype(jnp.float32) * probs) ** (-beta)
-    # normalise by max weight over the sampled batch (parity: _calculate_weights:383)
-    weights = weights / jnp.maximum(jnp.max(weights), 1e-12)
+    # normalise by the buffer-global max weight, derived from the minimum valid
+    # priority (parity: _calculate_weights:383 uses min_tree.min()/sum_tree.sum())
+    # — batch-max normalisation would inflate step sizes whenever the sampled
+    # batch misses the lowest-priority rows (advisor finding).
+    p_min = jnp.min(jnp.where(valid, state.priorities, jnp.inf)) / jnp.maximum(
+        total, 1e-12
+    )
+    max_weight = (size.astype(jnp.float32) * jnp.maximum(p_min, 1e-12)) ** (-beta)
+    weights = weights / jnp.maximum(max_weight, 1e-12)
     return batch, idx, weights
 
 
 @jax.jit
 def _per_update(state: PERState, idx: jax.Array, priorities: jax.Array, alpha: jax.Array) -> PERState:
-    powered = jnp.abs(priorities) ** alpha
+    # floor the raw priority (parity: reference replay_buffer.py:425
+    # max(priority, 1e-5)): a zero TD error must not zero the priority — the
+    # row would never be resampled, and the global-min IS normalisation would
+    # divide by an astronomical max weight, collapsing every weight to ~0
+    powered = jnp.maximum(jnp.abs(priorities), 1e-5) ** alpha
     pri = state.priorities.at[idx].set(powered)
     return PERState(
         buffer=state.buffer,

@@ -20,6 +20,60 @@ from agilerl_tpu.utils.utils import (
 )
 
 
+def merge_final_obs(next_obs, final_obs, done):
+    """Bootstrap-target obs: ``final_obs`` only where done, else ``next_obs``.
+
+    gymnasium SAME_STEP autoreset envs provide ``final_observation`` as an
+    object array with None entries for non-done envs (advisor finding) —
+    substituting it wholesale would corrupt non-done rows. JaxVecEnv returns a
+    dense array with final_obs == next_obs when not done, so the merge is a
+    no-op there.
+    """
+    if final_obs is None:
+        return next_obs
+    done = np.atleast_1d(np.asarray(done)).astype(bool)
+    if isinstance(final_obs, np.ndarray) and final_obs.dtype == object:
+        # gymnasium object array: one entry per env, None where not done
+        if isinstance(next_obs, dict):
+            out = {k: np.array(v, copy=True) for k, v in next_obs.items()}
+            for i, f in enumerate(final_obs):
+                if f is not None and done[i]:
+                    for k in out:
+                        out[k][i] = np.asarray(f[k])
+            return out
+        out = np.array(next_obs, copy=True)
+        for i, f in enumerate(final_obs):
+            if f is not None and done[i]:
+                out[i] = np.asarray(f)
+        return out
+
+    def merge(n, f):
+        n, f = np.asarray(n), np.asarray(f)
+        if f.shape != n.shape:
+            return n
+        d = done.reshape(done.shape + (1,) * max(n.ndim - done.ndim, 0))
+        return np.where(d, f, n)
+
+    import jax
+
+    return jax.tree_util.tree_map(merge, next_obs, final_obs)
+
+
+def _substitute_rows(transition, prev_transition, mask):
+    """Replace rows of `transition` where `mask` is set with the corresponding
+    rows of `prev_transition` (obs leaves may be pytrees)."""
+    import jax
+
+    def sub(tv, pv):
+        tv, pv = np.asarray(tv), np.asarray(pv)
+        if tv.ndim == 0:
+            return pv if mask[0] else tv
+        m = mask.reshape(mask.shape + (1,) * (tv.ndim - mask.ndim))
+        return np.where(m, pv, tv)
+
+    return jax.tree_util.tree_map(sub, transition, prev_transition)
+
+
 def train_off_policy(
     env,
     env_name: str,
@@ -80,6 +134,7 @@ def train_off_policy(
         for agent in pop:
             obs, _ = env.reset()
             prev_done = np.zeros(num_envs, dtype=bool)
+            prev_transition = None
             if n_step and n_step_memory is not None:
                 # folds must not span the reset / the previous agent's steps
                 n_step_memory.reset_horizon()
@@ -91,8 +146,13 @@ def train_off_policy(
                 next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
                 done = np.logical_or(terminated, truncated)
                 # bootstrap target must see the TRUE successor state, not the
-                # autoreset obs (review finding; gymnasium final_observation)
-                store_next = info.get("final_obs", info.get("final_observation", next_obs))                     if isinstance(info, dict) else next_obs
+                # autoreset obs (review finding; gymnasium final_observation);
+                # merged per-env — final_obs applies only where done
+                final = (
+                    info.get("final_obs", info.get("final_observation"))
+                    if isinstance(info, dict) else None
+                )
+                store_next = merge_final_obs(next_obs, final, done)
                 scores += np.asarray(reward)
                 for i, d in enumerate(np.atleast_1d(done)):
                     if d:
@@ -113,14 +173,30 @@ def train_off_policy(
                     # paired-buffer scheme, train_off_policy.py:340).
                     # _boundary stops folds at truncations/autoresets.
                     transition["_boundary"] = np.asarray(done, np.float32)
+                    if next_step_autoreset and prev_done.any() and prev_transition:
+                        # gymnasium NEXT_STEP autoreset: this row is a bogus
+                        # filler (obs = old terminal obs, ignored action, done
+                        # False — training on it would bootstrap the old
+                        # terminal obs into the NEW episode). Substitute the
+                        # env's previous (real, episode-ending) row: a benign
+                        # duplicate whose _boundary=1 keeps folds frozen, and
+                        # paired-buffer indices stay aligned (advisor finding).
+                        transition = _substitute_rows(
+                            transition, prev_transition, prev_done
+                        )
+                    prev_transition = transition
                     one_step = n_step_memory.add(transition, batched=num_envs > 1)
                     if one_step is not None:
                         memory.add(one_step, batched=num_envs > 1)
                 elif next_step_autoreset and prev_done.any():
                     keep = np.where(~prev_done)[0]
                     if keep.size:
+                        import jax as _jax
+
                         memory.add(
-                            {k: np.asarray(v)[keep] for k, v in transition.items()},
+                            _jax.tree_util.tree_map(
+                                lambda v: np.asarray(v)[keep], transition
+                            ),
                             batched=True,
                         )
                 else:

@@ -132,10 +132,16 @@ class AsyncAgentsWrapper:
         return {a: (actions.get(a) if obs[a] is not None else None) for a in obs}
 
     def record_step(self, obs, actions, rewards, dones):
-        """Feed one env step; returns {agent: completed transition} for agents
-        whose inter-turn experience just closed (parity: the reference's
-        inactive-agent experience buffering, agent.py:458)."""
-        completed: Dict[str, Dict[str, Any]] = {}
+        """Feed one env step; returns a list of ``(agent_id, transition)``
+        pairs for experiences that just closed (parity: the reference's
+        inactive-agent experience buffering, agent.py:458).
+
+        A list (not a dict) because one step can close TWO transitions for the
+        same agent — the buffered inter-turn one and the episode-ending action
+        — and consumers key multi-agent buffers by real agent ids (advisor
+        finding: synthetic '#final' keys would mis-key them).
+        """
+        completed: list = []
         for aid, r in rewards.items():
             if aid in self._pending:
                 self._pending[aid]["reward"] += float(np.asarray(r).squeeze())
@@ -144,13 +150,13 @@ class AsyncAgentsWrapper:
             acted_now = actions.get(aid) is not None and o is not None
             done = bool(np.asarray(dones.get(aid, False)).squeeze())
             if pending is not None and (acted_now or done):
-                completed[aid] = {
+                completed.append((aid, {
                     "obs": pending["obs"],
                     "action": pending["action"],
                     "reward": np.float32(pending["reward"]),
                     "next_obs": o if o is not None else pending["obs"],
                     "done": np.float32(done),
-                }
+                }))
                 del self._pending[aid]
             if acted_now and not done:
                 self._pending[aid] = {
@@ -159,13 +165,13 @@ class AsyncAgentsWrapper:
             elif acted_now and done:
                 # the episode-ending action closes immediately with this
                 # step's reward (it would otherwise be dropped — review finding)
-                completed[f"{aid}#final"] = {
+                completed.append((aid, {
                     "obs": o,
                     "action": actions[aid],
                     "reward": np.float32(np.asarray(rewards.get(aid, 0.0)).squeeze()),
                     "next_obs": o,
                     "done": np.float32(1.0),
-                }
+                }))
         return completed
 
     def reset(self):

@@ -93,6 +93,16 @@ class TestNStep:
         buf.reset_horizon()
         assert buf.add(tr(2, n_envs=1), batched=True) is None  # window restarts
 
+    def test_clear_resets_horizon(self):
+        """clear() must also drop the fold window, or post-clear transitions
+        would fold with stale pre-clear steps (advisor finding)."""
+        buf = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.5)
+        buf.add(tr(0, n_envs=1), batched=True)
+        buf.add(tr(1, n_envs=1), batched=True)
+        buf.clear()
+        assert buf.add(tr(2, n_envs=1), batched=True) is None  # window restarts
+        assert len(buf) == 0
+
 
 class TestPER:
     def test_priorities_bias_sampling(self):
@@ -113,6 +123,48 @@ class TestPER:
             buf.add(tr(i))
         _, _, w = buf.sample(16, beta=0.4, key=jax.random.PRNGKey(1))
         np.testing.assert_allclose(np.asarray(w), 1.0, rtol=1e-5)
+
+    def test_zero_td_error_does_not_collapse_weights(self):
+        """A TD error of exactly 0 must not zero a priority: the row would
+        never be resampled and the global-min IS normalisation would collapse
+        every sampled weight to ~0 (review finding)."""
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=1.0)
+        for i in range(8):
+            buf.add(tr(i))
+        buf.update_priorities(jnp.array([3]), jnp.array([0.0]))
+        _, idx, w = buf.sample(64, beta=1.0, key=jax.random.PRNGKey(0))
+        w = np.asarray(w)
+        # priority floored at 1e-5 (parity: reference replay_buffer.py:425)
+        np.testing.assert_allclose(np.asarray(buf.per_state.priorities)[3], 1e-5)
+        # ordinary rows follow the exact reference IS formula: with priorities
+        # [1e-5, 1 x7], w = (N*p/total)^-1 normalised by the global max weight
+        # = 1e-5 — NOT the ~1e-12 collapse a zero priority caused
+        np.testing.assert_allclose(w[np.asarray(idx) != 3], 1e-5, rtol=1e-3)
+
+    def test_weights_normalised_by_global_min_priority(self):
+        """IS weights normalise by the buffer-global max weight (from the
+        buffer-wide min priority), not the batch max — a batch missing the
+        lowest-priority row must NOT have its weights inflated to 1
+        (advisor finding; parity: reference _calculate_weights:383)."""
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=1.0)
+        for i in range(8):
+            buf.add(tr(i))
+        # index 0 has tiny priority -> it defines the global max weight
+        buf.update_priorities(jnp.arange(8), jnp.array(
+            [0.01, 10.0, 10.0, 10.0, 10.0, 10.0, 10.0, 10.0]))
+        beta = 1.0
+        p = np.array([0.01] + [10.0] * 7)
+        probs = p / p.sum()
+        expected = (8 * probs) ** (-beta)
+        expected = expected / expected.max()  # global max is at index 0
+        _, idx, w = buf.sample(256, beta=beta, key=jax.random.PRNGKey(2))
+        idx = np.asarray(idx)
+        w = np.asarray(w)
+        # high-priority rows must keep their small global-normalised weight
+        # even in batches that happen to miss index 0
+        np.testing.assert_allclose(w[idx != 0], expected[1], rtol=1e-4)
+        if (idx == 0).any():
+            np.testing.assert_allclose(w[idx == 0], 1.0, rtol=1e-4)
 
 
 class TestRollout:

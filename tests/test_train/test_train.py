@@ -136,6 +136,113 @@ def test_train_off_policy_rainbow_per_nstep(vec_env):
     assert (filled > 0).all() and filled.std() > 0
 
 
+class ScriptedNextStepVecEnv:
+    """2 synchronised envs, episode length 3, NEXT_STEP autoreset, reward 1.
+    Obs value encodes 10*episode + step so rows are identifiable in buffers."""
+
+    autoreset_mode = "NEXT_STEP"
+    num_envs = 2
+
+    def __init__(self):
+        import gymnasium as gym
+
+        self.single_observation_space = gym.spaces.Box(
+            -np.inf, np.inf, (1,), np.float32
+        )
+        self.single_action_space = gym.spaces.Discrete(2)
+        self.ep = 0
+        self.t = 0
+        self.pending_reset = False
+
+    def _obs(self):
+        return np.full((2, 1), self.ep * 10 + self.t, np.float32)
+
+    def reset(self, **kw):
+        self.ep, self.t, self.pending_reset = 0, 0, False
+        return self._obs(), {}
+
+    def step(self, action):
+        if self.pending_reset:  # bogus autoreset step: action ignored
+            self.ep += 1
+            self.t = 0
+            self.pending_reset = False
+            return (self._obs(), np.zeros(2, np.float32),
+                    np.zeros(2, bool), np.zeros(2, bool), {})
+        self.t += 1
+        done = self.t >= 3
+        if done:
+            self.pending_reset = True
+        return (self._obs(), np.ones(2, np.float32),
+                np.full(2, done), np.zeros(2, bool), {})
+
+
+def test_nstep_folds_do_not_cross_next_step_autoreset():
+    """Advisor (medium): with n_step=True on gymnasium NEXT_STEP autoreset
+    envs, the bogus post-done row must be neutralised — folds starting at it
+    must NOT accumulate the new episode's rewards onto the old terminal obs."""
+    env = ScriptedNextStepVecEnv()
+    pop = create_population(
+        "DQN", env.single_observation_space, env.single_action_space,
+        population_size=1, seed=0, net_config=small_net(),
+        # huge batch size -> learning never triggers; we only inspect buffers
+        INIT_HP={"BATCH_SIZE": 100_000, "LR": 1e-3, "LEARN_STEP": 8},
+    )
+    memory = ReplayBuffer(max_size=512)
+    n_step_memory = MultiStepReplayBuffer(max_size=512, n_step=3, gamma=0.5)
+    train_off_policy(
+        env, "scripted", "DQN", pop, memory,
+        max_steps=80, evo_steps=80, eval_steps=6, eval_loop=1, verbose=False,
+        n_step=True, n_step_memory=n_step_memory,
+    )
+    fused_obs = np.asarray(n_step_memory.state.storage["obs"])[: len(n_step_memory)]
+    fused_rew = np.asarray(n_step_memory.state.storage["reward"])[: len(n_step_memory)]
+    fused_done = np.asarray(n_step_memory.state.storage["done"])[: len(n_step_memory)]
+    step_in_ep = fused_obs[:, 0] % 10
+    # the bogus post-done filler row (obs = terminal obs, step 3) must never
+    # appear — it is substituted by a duplicate of the episode-ending row
+    assert not (step_in_ep == 3).any()
+    # folds starting at episode starts span the full horizon: 1 + .5 + .25
+    np.testing.assert_allclose(fused_rew[step_in_ep == 0], 1.75)
+    # folds starting mid-episode freeze at the terminal boundary
+    np.testing.assert_allclose(fused_rew[step_in_ep == 1], 1.5)
+    np.testing.assert_allclose(fused_rew[step_in_ep == 2], 1.0)
+    # the duplicated episode-ending rows keep done=1, so nothing bootstraps
+    # across the reset; main-buffer rows stay pure (reward always 1)
+    np.testing.assert_allclose(fused_done[step_in_ep == 2], 1.0)
+    main_rew = np.asarray(memory.state.storage["reward"])[: len(memory)]
+    np.testing.assert_allclose(main_rew, 1.0)
+
+
+def test_merge_final_obs_same_step_object_array():
+    """Advisor (low): SAME_STEP autoreset envs give final_observation as an
+    object array with None for non-done envs — merge per env, never wholesale."""
+    from agilerl_tpu.training.train_off_policy import merge_final_obs
+
+    next_obs = np.arange(8, dtype=np.float32).reshape(4, 2)
+    final = np.empty(4, object)
+    final[1] = np.array([100.0, 101.0], np.float32)
+    done = np.array([False, True, False, False])
+    out = merge_final_obs(next_obs, final, done)
+    np.testing.assert_array_equal(out[1], [100.0, 101.0])
+    np.testing.assert_array_equal(out[[0, 2, 3]], next_obs[[0, 2, 3]])
+    # dense final_obs (JaxVecEnv): applied only where done
+    dense_final = next_obs + 50.0
+    out = merge_final_obs(next_obs, dense_final, done)
+    np.testing.assert_array_equal(out[1], next_obs[1] + 50.0)
+    np.testing.assert_array_equal(out[[0, 2, 3]], next_obs[[0, 2, 3]])
+    # None final_obs passes through
+    assert merge_final_obs(next_obs, None, done) is next_obs
+    # Dict observation spaces: per-env object array of per-env dicts
+    dict_next = {"a": next_obs.copy(), "b": next_obs.copy() + 10}
+    dict_final = np.empty(4, object)
+    dict_final[1] = {"a": np.array([100.0, 101.0], np.float32),
+                     "b": np.array([200.0, 201.0], np.float32)}
+    out = merge_final_obs(dict_next, dict_final, done)
+    np.testing.assert_array_equal(out["a"][1], [100.0, 101.0])
+    np.testing.assert_array_equal(out["b"][1], [200.0, 201.0])
+    np.testing.assert_array_equal(out["a"][[0, 2, 3]], dict_next["a"][[0, 2, 3]])
+
+
 def test_train_off_policy_gymnasium_host_path():
     """End-to-end through real gymnasium vector envs (NEXT_STEP autoreset):
     post-done bogus transitions must be filtered from the buffer."""

@@ -59,23 +59,56 @@ def test_async_agents_wrapper_turn_buffering():
     assert acts["a"] is not None and acts["b"] is None
     out = w.record_step({"a": np.ones(2, np.float32), "b": None}, acts,
                         {"a": 0.0, "b": 0.0}, {"a": False, "b": False})
-    assert out == {}  # a's transition still open
+    assert out == []  # a's transition still open
     # turn 2: b acts; a receives reward while inactive
     acts2 = w.get_action({"a": None, "b": np.zeros(2, np.float32)})
     out = w.record_step({"a": None, "b": np.zeros(2, np.float32)}, acts2,
                         {"a": 0.5, "b": 0.0}, {"a": False, "b": False})
-    assert out == {}
+    assert out == []
     # turn 3: a acts again -> its transition closes with accumulated reward
     obs3 = {"a": 2 * np.ones(2, np.float32), "b": None}
     acts3 = w.get_action(obs3)
-    out = w.record_step(obs3, acts3, {"a": 0.25, "b": 0.0},
-                        {"a": False, "b": False})
+    out = dict(w.record_step(obs3, acts3, {"a": 0.25, "b": 0.0},
+                             {"a": False, "b": False}))
     assert "a" in out
     np.testing.assert_allclose(out["a"]["reward"], 0.75)
     np.testing.assert_array_equal(out["a"]["obs"], np.ones(2, np.float32))
     np.testing.assert_array_equal(out["a"]["next_obs"], 2 * np.ones(2, np.float32))
     # episode end closes b's open transition too
-    out = w.record_step({"a": None, "b": None}, {"a": None, "b": None},
-                        {"a": 0.0, "b": 1.0}, {"a": True, "b": True})
+    out = dict(w.record_step({"a": None, "b": None}, {"a": None, "b": None},
+                             {"a": 0.0, "b": 1.0}, {"a": True, "b": True}))
     assert "b" in out and out["b"]["done"] == 1.0
     np.testing.assert_allclose(out["b"]["reward"], 1.0)
+
+
+def test_async_agents_wrapper_final_transitions_use_real_agent_ids():
+    """An episode-ending action must close under the REAL agent id, even when
+    the same step also closes that agent's buffered inter-turn transition
+    (advisor finding: synthetic '#final' keys mis-key MA buffers)."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.wrappers import AsyncAgentsWrapper
+
+    class StubMA:
+        observation_spaces = {"a": gspaces.Box(-1, 1, (2,)),
+                              "b": gspaces.Box(-1, 1, (2,))}
+
+        def get_action(self, obs, **kw):
+            return {a: np.int32(1) for a in obs}
+
+    w = AsyncAgentsWrapper(StubMA())
+    obs1 = {"a": np.ones(2, np.float32), "b": None}
+    acts1 = w.get_action(obs1)
+    w.record_step(obs1, acts1, {"a": 0.0, "b": 0.0}, {"a": False, "b": False})
+    # a acts again on the episode-ending step: BOTH its buffered transition and
+    # the final action close, both under id "a"
+    obs2 = {"a": 2 * np.ones(2, np.float32), "b": None}
+    acts2 = w.get_action(obs2)
+    out = w.record_step(obs2, acts2, {"a": 1.0, "b": 0.0},
+                        {"a": True, "b": True})
+    ids = [aid for aid, _ in out]
+    assert ids == ["a", "a"]
+    closed_first, closed_final = out[0][1], out[1][1]
+    assert closed_first["done"] == 1.0 and closed_final["done"] == 1.0
+    np.testing.assert_array_equal(closed_first["obs"], np.ones(2, np.float32))
+    np.testing.assert_array_equal(closed_final["obs"], 2 * np.ones(2, np.float32))
