@@ -48,8 +48,13 @@ class DPO(GRPO):
         tx = self.optimizer.tx
         smooth = self.label_smoothing
 
+        # fused Pallas head + flash attention on TPU — both have custom VJPs,
+        # so the differentiable DPO loss uses them too (Liger parity: dpo.py:409)
+        use_pallas = jax.default_backend() == "tpu"
+
         def seq_logprob(lora, ids, mask, loss_mask):
-            lp = M.token_logprobs(config, base, ids, attention_mask=mask, lora=lora)
+            lp = M.token_logprobs(config, base, ids, attention_mask=mask, lora=lora,
+                                  use_pallas=use_pallas, flash=use_pallas)
             return (lp * loss_mask).sum(axis=-1)
 
         @jax.jit

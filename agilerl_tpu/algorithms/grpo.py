@@ -43,6 +43,24 @@ def default_hp_config() -> HyperparameterConfig:
     )
 
 
+def _grpo_loss_core(lp, batch, clip, beta):
+    """Clipped-ratio + k3-KL GRPO loss from per-token logprobs
+    (parity: grpo.py:517 _grpo_loss_standard). Returns (loss, mean k3 KL)."""
+    lp = lp * batch["loss_mask"]
+    ratio = jnp.exp(lp - batch["old_lp"])
+    adv = batch["advantage"][:, None]
+    s1 = ratio * adv
+    s2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+    pg = -jnp.minimum(s1, s2)
+    # k3 KL estimator vs the reference adapter (parity: grpo.py:517)
+    log_ratio_ref = batch["ref_lp"] - lp
+    kl = jnp.exp(log_ratio_ref) - log_ratio_ref - 1.0
+    denom = jnp.maximum(batch["loss_mask"].sum(), 1.0)
+    loss = ((pg + beta * kl) * batch["loss_mask"]).sum() / denom
+    kl_mean = (kl * batch["loss_mask"]).sum() / denom
+    return loss, kl_mean
+
+
 class _LoraNet:
     """Minimal network-shaped holder so the registry/clone machinery sees the
     adapter as an evolvable attribute (configs never mutate for LLMs — the
@@ -76,6 +94,7 @@ class GRPO(EvolvableAlgorithm):
         lora_rank: int = 8,
         lora_targets: Tuple[str, ...] = ("wq", "wv"),
         lora_scale: float = 2.0,
+        sequence_parallel_axis: Optional[str] = None,
         **kwargs,
     ):
         super().__init__(index=index, hp_config=hp_config or default_hp_config(), **kwargs)
@@ -94,6 +113,9 @@ class GRPO(EvolvableAlgorithm):
         self.lora_rank = int(lora_rank)
         self.lora_targets = tuple(lora_targets)
         self.lora_scale = float(lora_scale)
+        # long-context: shard the SEQUENCE over this mesh axis (ring attention)
+        # — requires to_mesh() with a mesh containing the axis before learn()
+        self.sequence_parallel_axis = sequence_parallel_axis
 
         if base_params is None:
             base_params = M.init_params(self.next_key(), config)
@@ -136,6 +158,7 @@ class GRPO(EvolvableAlgorithm):
             "lora_rank": self.lora_rank,
             "lora_targets": self.lora_targets,
             "lora_scale": self.lora_scale,
+            "sequence_parallel_axis": self.sequence_parallel_axis,
         }
 
     def _on_clone(self, parent) -> None:
@@ -199,7 +222,8 @@ class GRPO(EvolvableAlgorithm):
         base = self.base_params
         scale = self.lora_scale
         tx = self.optimizer.tx
-        # the flash kernel has a custom VJP, so the TRAINING loss can use it too
+        # both Pallas kernels carry custom VJPs (flash_attention_vjp.py,
+        # fused_loss.py), so the TRAINING loss runs fully fused on TPU
         use_flash = jax.default_backend() == "tpu"
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -208,24 +232,66 @@ class GRPO(EvolvableAlgorithm):
                 lp = M.token_logprobs(
                     config, base, batch["tokens"], attention_mask=batch["mask"],
                     lora=lo, lora_scale=scale, flash=use_flash,
+                    use_pallas=use_flash,
                 )
-                lp = lp * batch["loss_mask"]
-                ratio = jnp.exp(lp - batch["old_lp"])
-                adv = batch["advantage"][:, None]
-                s1 = ratio * adv
-                s2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
-                pg = -jnp.minimum(s1, s2)
-                # k3 KL estimator vs the reference adapter (parity: grpo.py:517)
-                log_ratio_ref = batch["ref_lp"] - lp
-                kl = jnp.exp(log_ratio_ref) - log_ratio_ref - 1.0
-                per_tok = (pg + beta * kl) * batch["loss_mask"]
-                denom = jnp.maximum(batch["loss_mask"].sum(), 1.0)
-                return per_tok.sum() / denom
+                return _grpo_loss_core(lp, batch, clip, beta)
 
-            loss, grads = jax.value_and_grad(loss_fn)(lora)
+            (loss, kl), grads = jax.value_and_grad(loss_fn, has_aux=True)(lora)
             updates, opt_state = tx.update(grads, opt_state, lora)
             lora = optax.apply_updates(lora, updates)
-            return lora, opt_state, loss
+            return lora, opt_state, loss, kl
+
+        return update
+
+    # -- sequence-parallel (long-context) variants ---------------------- #
+    def _require_sp_mesh(self):
+        axis = self.sequence_parallel_axis
+        mesh = getattr(self, "mesh", None)
+        if mesh is None or axis not in mesh.axis_names:
+            raise RuntimeError(
+                f"sequence_parallel_axis={axis!r} requires to_mesh() with a "
+                f"mesh containing that axis (got {getattr(mesh, 'axis_names', None)})"
+            )
+        return mesh, axis
+
+    def _sp_logprob_fn(self):
+        from agilerl_tpu.llm.long_context import make_sp_logprob_fn
+
+        mesh, axis = self._require_sp_mesh()
+        fn = make_sp_logprob_fn(
+            self.model_config, mesh, axis_name=axis, lora_scale=self.lora_scale
+        )
+        base = self.base_params
+
+        @jax.jit
+        def logprobs(lora, tokens, mask):
+            # ring attention is causal over the real+pad suffix; pads are
+            # excluded from the loss via loss_mask (right-padding constraint,
+            # llm/long_context.py)
+            return fn(base, lora, tokens)
+
+        return logprobs
+
+    def _sp_update_fn(self):
+        from agilerl_tpu.llm.long_context import make_sp_logprob_fn
+
+        mesh, axis = self._require_sp_mesh()
+        sp_fn = make_sp_logprob_fn(
+            self.model_config, mesh, axis_name=axis, lora_scale=self.lora_scale
+        )
+        base = self.base_params
+        tx = self.optimizer.tx
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def update(lora, opt_state, batch, clip, beta):
+            def loss_fn(lo):
+                lp = sp_fn(base, lo, batch["tokens"])
+                return _grpo_loss_core(lp, batch, clip, beta)
+
+            (loss, kl), grads = jax.value_and_grad(loss_fn, has_aux=True)(lora)
+            updates, opt_state = tx.update(grads, opt_state, lora)
+            lora = optax.apply_updates(lora, updates)
+            return lora, opt_state, loss, kl
 
         return update
 
@@ -235,7 +301,13 @@ class GRPO(EvolvableAlgorithm):
         marking completion-token predictions, rewards [B, G]; pass the optional
         4th element when pad_token_id collides with a real vocabulary token
         (otherwise attention defaults to ids != pad_token_id)
-        (parity: grpo.py:321). Returns (mean loss, mean |kl| proxy)."""
+        (parity: grpo.py:321). Returns (mean loss, mean k3 KL vs reference).
+
+        With ``sequence_parallel_axis`` set (and ``to_mesh`` called with a mesh
+        containing that axis), every forward — old/ref logprobs AND the
+        differentiable update — runs with the sequence sharded across the axis
+        via ring attention (llm/long_context.py); sequences must be
+        right-padded and T divisible by the axis size."""
         if len(experiences) == 4:
             ids, action_masks, rewards, attn = experiences
             ids = jnp.asarray(ids)
@@ -248,14 +320,36 @@ class GRPO(EvolvableAlgorithm):
         rewards = jnp.asarray(rewards, jnp.float32)
         advantage = self._calculate_advantage(rewards)
 
-        logprobs = self.jit_fn("logprobs", self._logprob_fn)
+        if self.sequence_parallel_axis is not None:
+            mesh, axis = self._require_sp_mesh()
+            sp_size = mesh.shape[axis]
+            if ids.shape[1] % sp_size:
+                raise ValueError(
+                    f"sequence length {ids.shape[1]} not divisible by "
+                    f"sp axis size {sp_size}"
+                )
+            # ring attention carries no key-padding mask: correctness relies
+            # on RIGHT padding (causal attention never lets real tokens attend
+            # pads; pad-position outputs are excluded via loss_mask). Reject
+            # anything else instead of silently computing wrong logprobs.
+            m = np.asarray(mask)
+            if (np.diff(m, axis=1) > 0).any():
+                raise ValueError(
+                    "sequence_parallel_axis requires right-padded sequences "
+                    "(attention mask must be non-increasing per row)"
+                )
+            logprobs = self.jit_fn("sp_logprobs", self._sp_logprob_fn)
+            update = self.jit_fn("sp_update", self._sp_update_fn)
+        else:
+            logprobs = self.jit_fn("logprobs", self._logprob_fn)
+            update = self.jit_fn("update", self._update_fn)
+
         old_lp = logprobs(self.actor.params, ids, mask) * loss_mask
         ref_lp = logprobs(self.reference.params, ids, mask) * loss_mask
 
-        update = self.jit_fn("update", self._update_fn)
         lora, opt_state = self.actor.params, self.optimizer.opt_state
         n_rows = ids.shape[0]
-        total, n_updates = 0.0, 0
+        total, total_kl, n_updates = 0.0, 0.0, 0
         for _ in range(self.update_epochs):
             perm = np.asarray(jax.random.permutation(self.next_key(), n_rows))
             for s in range(0, n_rows, self.batch_size):
@@ -268,7 +362,7 @@ class GRPO(EvolvableAlgorithm):
                     "ref_lp": ref_lp[idx],
                     "advantage": advantage[idx],
                 }
-                lora, opt_state, loss = update(
+                lora, opt_state, loss, kl = update(
                     lora, opt_state, batch, jnp.float32(self.clip_coef),
                     jnp.float32(self.beta),
                 )
@@ -282,10 +376,12 @@ class GRPO(EvolvableAlgorithm):
                         "(parity: grpo.py:370 NaN guard)"
                     )
                 total += float(loss)
+                total_kl += float(kl)
                 n_updates += 1
         self.actor.params = lora
         self.optimizer.opt_state = opt_state
-        return total / max(n_updates, 1), 0.0
+        n = max(n_updates, 1)
+        return total / n, total_kl / n
 
     # ------------------------------------------------------------------ #
     def test(self, env) -> float:
@@ -305,14 +401,34 @@ class GRPO(EvolvableAlgorithm):
         core/base.py:2961-3009)."""
         from jax.sharding import NamedSharding
 
-        from agilerl_tpu.parallel.mesh import gpt_param_specs, lora_specs, shard_like
+        from agilerl_tpu.parallel.mesh import (
+            filter_spec,
+            gpt_param_specs,
+            lora_specs,
+            shard_like,
+        )
 
-        specs = gpt_param_specs(self.model_config)
+        # cached logprob/update closures capture the OLD base_params (and, for
+        # sp fns, the old mesh) — drop them so learn() rebuilds against the
+        # re-placed params
+        self._clear_jit_cache()
+
+        # axes absent from the mesh (e.g. an sp-only long-context mesh) fall
+        # back to replication for those dims
+        specs = jax.tree_util.tree_map(
+            lambda s: filter_spec(s, mesh),
+            gpt_param_specs(self.model_config),
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
         self.base_params = jax.tree_util.tree_map(
             lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
             self.base_params, specs,
         )
-        lspecs = lora_specs(self.actor.params)
+        lspecs = jax.tree_util.tree_map(
+            lambda s: filter_spec(s, mesh),
+            lora_specs(self.actor.params),
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+        )
         place = lambda tree: jax.tree_util.tree_map(  # noqa: E731
             lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
             tree, lspecs,

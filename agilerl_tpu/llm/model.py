@@ -357,19 +357,21 @@ def token_logprobs(
     """log p(tokens[:, t] | tokens[:, <t]) for t>=1, shape [B, T-1].
 
     use_pallas=True routes the lm-head+log-softmax through the fused Pallas
-    kernel (ops/fused_loss.py, the Liger replacement) — forward-only, for the
-    no-grad logprob passes (GRPO old/reference logprobs); flash likewise
-    enables the Pallas attention kernel on those passes."""
+    kernel (ops/fused_loss.py, the Liger replacement). The kernel carries a
+    custom VJP that recomputes per vocab chunk, so this path serves BOTH the
+    no-grad logprob passes and the differentiable GRPO/DPO training losses
+    (Liger parity: its fused losses are differentiable, ref grpo.py:558);
+    flash likewise enables the Pallas attention kernel (own VJP)."""
     hidden, _ = forward(config, params, tokens, attention_mask=attention_mask,
                         lora=lora, lora_scale=lora_scale, flash=flash)
     if use_pallas:
-        from agilerl_tpu.ops.fused_loss import fused_token_logprob
+        from agilerl_tpu.ops.fused_loss import fused_token_logprob_diff
 
         head = params["tok_emb"].T if config.tie_embeddings else params["lm_head"]
         B, T, D = hidden.shape
         flat_h = hidden[:, :-1].reshape(-1, D)
         flat_t = tokens[:, 1:].reshape(-1)
-        lp = fused_token_logprob(flat_h, head, flat_t, temperature=temperature)
+        lp = fused_token_logprob_diff(flat_h, head, flat_t, temperature)
         return lp.reshape(B, T - 1)
     hidden = hidden[:, :-1]  # predict next token
     targets = tokens[:, 1:]

@@ -8,11 +8,13 @@ grid walks vocab chunks innermost, keeping an online (max, sum-exp,
 chosen-logit) accumulator in VMEM scratch; each chunk is one [BN, D] x [D, BV]
 matmul on the MXU.
 
-Forward-only by design: it accelerates the no-grad logprob passes (GRPO's
-old/reference logprobs are half the learn-step FLOPs); the differentiable path
-stays on the XLA-chunked implementation (llm/model.token_logprobs). On CPU the
-kernel runs in pallas interpret mode (how the tests exercise it); on TPU it
-compiles natively.
+``fused_token_logprob`` is the forward kernel; ``fused_token_logprob_diff``
+wraps it in a custom VJP (the Liger parity point: liger's losses are
+differentiable) whose backward pass RECOMPUTES logits per vocab chunk from the
+saved (hidden, head, lse) residuals — two more Pallas kernels (dH accumulates
+over vocab blocks, dW over row blocks), so the [N, V] logits never materialise
+in either direction. On CPU the kernels run in pallas interpret mode (how the
+tests exercise them); on TPU they compile natively.
 """
 
 from __future__ import annotations
@@ -22,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:
@@ -31,7 +34,7 @@ except ImportError:  # pragma: no cover
 
 
 def _make_kernel(vocab_size: int, inv_temp: float):
-    def kernel(hidden_ref, head_ref, target_ref, out_ref, m_ref, s_ref, c_ref):
+    def kernel(hidden_ref, head_ref, target_ref, out_ref, lse_ref, m_ref, s_ref, c_ref):
         j = pl.program_id(1)
         nv = pl.num_programs(1)
 
@@ -65,9 +68,121 @@ def _make_kernel(vocab_size: int, inv_temp: float):
 
         @pl.when(j == nv - 1)
         def _finish():
-            out_ref[:] = c_ref[:] - m_ref[:] - jnp.log(s_ref[:])
+            lse = m_ref[:] + jnp.log(s_ref[:])
+            out_ref[:] = c_ref[:] - lse
+            lse_ref[:] = lse
 
     return kernel
+
+
+def _bwd_coef(hidden_ref, head_ref, target_ref, lse_ref, g_ref, j, inv_temp,
+              vocab_size):
+    """Recompute softmax probs for one (row-block, vocab-block) tile and return
+    the shared bwd coefficient g * (onehot(target) - p)."""
+    h = hidden_ref[:]  # [BN, D]
+    w = head_ref[:]  # [D, BV]
+    logits = jnp.dot(h, w, preferred_element_type=jnp.float32) * inv_temp
+    bn, bv = logits.shape
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    valid = cols < vocab_size
+    p = jnp.where(valid, jnp.exp(logits - lse_ref[:]), 0.0)
+    hit = (cols == target_ref[:]) & valid
+    return (hit.astype(jnp.float32) - p) * g_ref[:]  # [BN, BV]
+
+
+def _make_dh_kernel(vocab_size: int, inv_temp: float):
+    """grid (i, j), j innermost: accumulate dH_i over vocab blocks."""
+
+    def kernel(hidden_ref, head_ref, target_ref, lse_ref, g_ref, dh_ref, acc_ref):
+        j = pl.program_id(1)
+        nv = pl.num_programs(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        coef = _bwd_coef(hidden_ref, head_ref, target_ref, lse_ref, g_ref, j,
+                         inv_temp, vocab_size)
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            coef, head_ref[:].T, preferred_element_type=jnp.float32
+        ) * inv_temp
+
+        @pl.when(j == nv - 1)
+        def _finish():
+            dh_ref[:] = acc_ref[:]
+
+    return kernel
+
+
+def _make_dw_kernel(vocab_size: int, inv_temp: float):
+    """grid (j, i), i innermost: accumulate dW_j over row blocks."""
+
+    def kernel(hidden_ref, head_ref, target_ref, lse_ref, g_ref, dw_ref, acc_ref):
+        i = pl.program_id(1)
+        ni = pl.num_programs(1)
+        j = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        coef = _bwd_coef(hidden_ref, head_ref, target_ref, lse_ref, g_ref, j,
+                         inv_temp, vocab_size)
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            hidden_ref[:].T, coef, preferred_element_type=jnp.float32
+        ) * inv_temp
+
+        @pl.when(i == ni - 1)
+        def _finish():
+            dw_ref[:] = acc_ref[:]
+
+    return kernel
+
+
+def _pad_inputs(hidden, head, targets, block_n, block_v):
+    N, D = hidden.shape
+    V = head.shape[1]
+    block_n = min(block_n, max(8, N))
+    block_v = min(block_v, V + (-V) % 128)
+    pad_n = (-N) % block_n
+    pad_v = (-V) % block_v
+    h = jnp.pad(hidden.astype(jnp.float32), ((0, pad_n), (0, 0)))
+    w = jnp.pad(head.astype(jnp.float32), ((0, 0), (0, pad_v)))
+    t = jnp.pad(targets.astype(jnp.int32), (0, pad_n))[:, None]
+    return h, w, t, block_n, block_v
+
+
+def _fwd_call(hidden, head, targets, temperature, block_n, block_v, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    N, D = hidden.shape
+    V = head.shape[1]
+    h, w, t, block_n, block_v = _pad_inputs(hidden, head, targets, block_n, block_v)
+    grid = (h.shape[0] // block_n, w.shape[1] // block_v)
+    if pltpu is None:  # pragma: no cover - CPU wheels without pltpu
+        raise RuntimeError("pallas tpu module unavailable")
+    scratch = [pltpu.VMEM((block_n, 1), jnp.float32) for _ in range(3)]
+
+    out, lse = pl.pallas_call(
+        _make_kernel(V, 1.0 / temperature),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h.shape[0], 1), jnp.float32),
+            jax.ShapeDtypeStruct((h.shape[0], 1), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(h, w, t)
+    return out[:N, 0], lse[:N, 0]
 
 
 @functools.partial(
@@ -82,38 +197,92 @@ def fused_token_logprob(
     block_v: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Per-row log softmax(hidden @ head / T)[target]. Returns [N] float32."""
+    """Per-row log softmax(hidden @ head / T)[target]. Returns [N] float32.
+    Forward-only entry point; use ``fused_token_logprob_diff`` inside losses."""
+    return _fwd_call(hidden, head, targets, temperature, block_n, block_v,
+                     interpret)[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def fused_token_logprob_diff(
+    hidden: jax.Array,
+    head: jax.Array,
+    targets: jax.Array,
+    temperature: float = 1.0,
+    block_n: int = 256,
+    block_v: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Differentiable fused per-token logprob (the Liger parity point: liger's
+    fused GRPO/DPO/CE losses are differentiable, ref grpo.py:558, dpo.py:409).
+    Backward recomputes logits per vocab chunk from (hidden, head, lse) — the
+    [N, V] logits never materialise in either pass."""
+    return _fwd_call(hidden, head, targets, temperature, block_n, block_v,
+                     interpret)[0]
+
+
+def _diff_fwd(hidden, head, targets, temperature, block_n, block_v, interpret):
+    out, lse = _fwd_call(hidden, head, targets, temperature, block_n, block_v,
+                         interpret)
+    return out, (hidden, head, targets, lse)
+
+
+def _diff_bwd(temperature, block_n, block_v, interpret, res, g):
+    hidden, head, targets, lse = res
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     N, D = hidden.shape
     V = head.shape[1]
-    block_n = min(block_n, max(8, N))
-    block_v = min(block_v, V + (-V) % 128)
-    pad_n = (-N) % block_n
-    pad_v = (-V) % block_v
-    h = jnp.pad(hidden.astype(jnp.float32), ((0, pad_n), (0, 0)))
-    w = jnp.pad(head.astype(jnp.float32), ((0, 0), (0, pad_v)))
-    t = jnp.pad(targets.astype(jnp.int32), (0, pad_n))[:, None]
+    h, w, t, block_n, block_v = _pad_inputs(hidden, head, targets, block_n, block_v)
+    lse_p = jnp.pad(lse.astype(jnp.float32), (0, h.shape[0] - N))[:, None]
+    # padded rows must contribute nothing: zero their upstream grad (their
+    # recomputed p over the padded head is garbage otherwise)
+    g_p = jnp.pad(g.astype(jnp.float32), (0, h.shape[0] - N))[:, None]
+    ni = h.shape[0] // block_n
+    nv = w.shape[1] // block_v
+    inv_temp = 1.0 / temperature
 
-    grid = (h.shape[0] // block_n, w.shape[1] // block_v)
-    if pltpu is None:  # pragma: no cover - CPU wheels without pltpu
-        raise RuntimeError("pallas tpu module unavailable")
-    scratch = [pltpu.VMEM((block_n, 1), jnp.float32) for _ in range(3)]
-
-    out = pl.pallas_call(
-        _make_kernel(V, 1.0 / temperature),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
-            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
-            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((h.shape[0], 1), jnp.float32),
-        scratch_shapes=scratch,
+    row_specs = [
+        pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+        pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
+        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+    ]
+    dh = pl.pallas_call(
+        _make_dh_kernel(V, inv_temp),
+        grid=(ni, nv),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((h.shape[0], D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_n, D), jnp.float32)],
         interpret=interpret,
-    )(h, w, t)
-    return out[:N, 0]
+    )(h, w, t, lse_p, g_p)
+
+    col_specs = [
+        pl.BlockSpec((block_n, D), lambda j, i: (i, 0)),
+        pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
+        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+    ]
+    dw = pl.pallas_call(
+        _make_dw_kernel(V, inv_temp),
+        grid=(nv, ni),
+        in_specs=col_specs,
+        out_specs=pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((D, w.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((D, block_v), jnp.float32)],
+        interpret=interpret,
+    )(h, w, t, lse_p, g_p)
+
+    dhidden = dh[:N].astype(hidden.dtype)
+    dhead = dw[:, :V].astype(head.dtype)
+    dtargets = np.zeros(targets.shape, jax.dtypes.float0)
+    return dhidden, dhead, dtargets
+
+
+fused_token_logprob_diff.defvjp(_diff_fwd, _diff_bwd)
 
 
 def reference_token_logprob(hidden, head, targets, temperature: float = 1.0):

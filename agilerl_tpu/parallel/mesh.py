@@ -83,6 +83,22 @@ def gpt_param_specs(config: GPTConfig) -> Dict:
     return specs
 
 
+def filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't have (e.g. placing fsdp/tp-spec'd
+    params on an sp-only long-context mesh -> replicated)."""
+    names = set(mesh.axis_names)
+
+    def keep(e):
+        if e is None:
+            return None
+        if isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a in names)
+            return kept if kept else None
+        return e if e in names else None
+
+    return P(*(keep(e) for e in spec))
+
+
 def lora_specs(lora: Any) -> Any:
     """LoRA: A row-sharded on fsdp, B col-sharded on tp."""
 

@@ -29,6 +29,54 @@ class TestFusedLoss:
         want = reference_token_logprob(hidden, head, targets, temperature=1.7)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
+    def test_grad_matches_dense(self):
+        """VERDICT #7: the fused loss is differentiable (custom VJP recomputes
+        per vocab chunk); grads wrt hidden AND head must match the dense path."""
+        from agilerl_tpu.ops.fused_loss import fused_token_logprob_diff
+
+        key = jax.random.PRNGKey(7)
+        N, D, V = 33, 16, 130  # non-divisible -> exercises padding in bwd too
+        hidden = jax.random.normal(key, (N, D))
+        head = 0.2 * jax.random.normal(jax.random.fold_in(key, 1), (D, V))
+        targets = jax.random.randint(jax.random.fold_in(key, 2), (N,), 0, V)
+        wts = jax.random.normal(jax.random.fold_in(key, 3), (N,))
+
+        def fused_loss(h, w):
+            return jnp.sum(
+                fused_token_logprob_diff(h, w, targets, 1.3, 16, 64, None) * wts
+            )
+
+        def dense_loss(h, w):
+            return jnp.sum(
+                reference_token_logprob(h, w, targets, temperature=1.3) * wts
+            )
+
+        v_f, (gh_f, gw_f) = jax.value_and_grad(fused_loss, argnums=(0, 1))(hidden, head)
+        v_d, (gh_d, gw_d) = jax.value_and_grad(dense_loss, argnums=(0, 1))(hidden, head)
+        np.testing.assert_allclose(float(v_f), float(v_d), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gh_f), np.asarray(gh_d), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_d), atol=2e-4)
+
+    def test_grad_under_jit_and_second_use(self):
+        from agilerl_tpu.ops.fused_loss import fused_token_logprob_diff
+
+        key = jax.random.PRNGKey(11)
+        N, D, V = 32, 8, 64
+        hidden = jax.random.normal(key, (N, D))
+        head = 0.2 * jax.random.normal(jax.random.fold_in(key, 1), (D, V))
+        targets = jax.random.randint(jax.random.fold_in(key, 2), (N,), 0, V)
+
+        @jax.jit
+        def loss(h, w):
+            return -fused_token_logprob_diff(h, w, targets, 1.0, 16, 64, None).mean()
+
+        g = jax.grad(loss)(hidden, head)
+        assert np.isfinite(np.asarray(g)).all()
+        # grad step should reduce the NLL
+        l0 = float(loss(hidden, head))
+        l1 = float(loss(hidden - 0.1 * g, head))
+        assert l1 < l0
+
 
 class TestFlashAttention:
     def _dense(self, q, k, v, causal):
@@ -88,3 +136,32 @@ class TestFlashAttentionMask:
             np.asarray(got[0, :, 8:]), np.asarray(want[0, :, 8:]), atol=2e-5
         )
         np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]), atol=2e-5)
+
+
+class TestFusedTrainingPath:
+    def test_token_logprobs_grad_pallas_vs_xla(self):
+        """The use_pallas path must be differentiable end-to-end (LoRA grads
+        through the fused head) and match the XLA-chunked path."""
+        from agilerl_tpu.llm import model as M
+
+        cfg = M.GPTConfig(vocab_size=96, n_layer=1, n_head=2, d_model=16,
+                          max_seq_len=16, dtype=jnp.float32)
+        params = M.init_params(jax.random.PRNGKey(0), cfg)
+        lora = M.init_lora(jax.random.PRNGKey(1), cfg, rank=4)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 12), 2, 95)
+        mask = jnp.ones_like(toks)
+
+        def loss(lo, use_pallas):
+            lp = M.token_logprobs(cfg, params, toks, attention_mask=mask,
+                                  lora=lo, use_pallas=use_pallas)
+            return -lp.mean()
+
+        v_x, g_x = jax.value_and_grad(lambda lo: loss(lo, False))(lora)
+        v_p, g_p = jax.value_and_grad(lambda lo: loss(lo, True))(lora)
+        np.testing.assert_allclose(float(v_p), float(v_x), rtol=1e-5)
+        for (pa, gx), (_, gp) in zip(
+            jax.tree_util.tree_leaves_with_path(g_x),
+            jax.tree_util.tree_leaves_with_path(g_p),
+        ):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                                       atol=2e-5, err_msg=str(pa))
