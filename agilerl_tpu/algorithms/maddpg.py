@@ -49,6 +49,25 @@ def gumbel_softmax(logits: jax.Array, key: jax.Array, tau: float = 1.0, hard: bo
     return y
 
 
+def flatten_ma_obs(obs_spaces, agent_ids, obs):
+    """Centralized-critic obs input: per-agent preprocessed obs flattened and
+    concatenated in agent order. Single source of truth for the critic input
+    layout (shared by the train steps and critic_values)."""
+    outs = []
+    for aid in agent_ids:
+        o = preprocess_observation(obs_spaces[aid], obs[aid])
+        outs.append(o.reshape(o.shape[0], -1))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def encode_ma_action(discrete, action_dims, aid, a):
+    """Centralized-critic action encoding: one-hot for discrete agents, flat
+    float vector otherwise."""
+    if discrete[aid]:
+        return jax.nn.one_hot(a.astype(jnp.int32), action_dims[aid])
+    return a.astype(jnp.float32).reshape(a.shape[0], -1)
+
+
 class MADDPG(MultiAgentRLAlgorithm):
     supports_activation_mutation = False
 
@@ -67,6 +86,7 @@ class MADDPG(MultiAgentRLAlgorithm):
         gamma: float = 0.95,
         tau: float = 1e-2,
         expl_noise: float = 0.1,
+        action_reg: float = 1e-3,
         **kwargs,
     ):
         super().__init__(
@@ -80,6 +100,7 @@ class MADDPG(MultiAgentRLAlgorithm):
         self.gamma = float(gamma)
         self.tau = float(tau)
         self.expl_noise = float(expl_noise)
+        self.action_reg = float(action_reg)
         self.net_config = dict(net_config or {})
 
         self.discrete = {
@@ -140,6 +161,7 @@ class MADDPG(MultiAgentRLAlgorithm):
             "gamma": self.gamma,
             "tau": self.tau,
             "expl_noise": self.expl_noise,
+            "action_reg": self.action_reg,
         }
 
     def evolvable_attributes(self) -> Dict[str, Any]:
@@ -195,6 +217,30 @@ class MADDPG(MultiAgentRLAlgorithm):
             out = {a: v[0] for a, v in out.items()}
         return out
 
+    def critic_values(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Per-agent centralized-critic value Q_i(all obs, all current-policy
+        actions) at the given batched dict obs — the probe-check surface
+        (parity: the reference checks critic outputs directly,
+        probe_envs_ma.py:1867)."""
+        obs = {a: jnp.asarray(np.asarray(o)) for a, o in obs.items()}
+        acts = self.get_action(obs, training=False)
+        all_obs = flatten_ma_obs(self.observation_spaces, self.agent_ids, obs)
+        enc = [
+            encode_ma_action(
+                self.discrete, self.action_dims, aid, jnp.asarray(acts[aid])
+            )
+            for aid in self.agent_ids
+        ]
+        q_in = jnp.concatenate([all_obs] + enc, axis=-1)
+        return {
+            aid: np.asarray(
+                EvolvableNetwork.apply(
+                    self.critics[aid].config, self.critics[aid].params, q_in
+                )[..., 0]
+            )
+            for aid in self.agent_ids
+        }
+
     # -- learning --------------------------------------------------------- #
     def _train_fn(self):
         agent_ids = tuple(self.agent_ids)
@@ -206,25 +252,18 @@ class MADDPG(MultiAgentRLAlgorithm):
         action_dims = self.action_dims
         a_tx = self.actor_optimizers.tx
         c_tx = self.critic_optimizers.tx
+        action_reg = getattr(self, "action_reg", 1e-3)
 
         def flat_obs(obs):
-            outs = []
-            for aid in agent_ids:
-                o = preprocess_observation(obs_spaces[aid], obs[aid])
-                outs.append(o.reshape(o.shape[0], -1))
-            return jnp.concatenate(outs, axis=-1)
+            return flatten_ma_obs(obs_spaces, agent_ids, obs)
 
         def encode_action(aid, a):
-            if discrete[aid]:
-                return jax.nn.one_hot(a.astype(jnp.int32), action_dims[aid])
-            return a.astype(jnp.float32).reshape(a.shape[0], -1)
+            return encode_ma_action(discrete, action_dims, aid, a)
 
-        def actor_out(aid, params, obs, key=None, differentiable=False):
+        def actor_out(aid, params, obs):
             o = preprocess_observation(obs_spaces[aid], obs[aid])
             raw = EvolvableNetwork.apply(actor_cfgs[aid], params, o)
             if discrete[aid]:
-                if differentiable:
-                    return gumbel_softmax(raw, key)
                 return jax.nn.one_hot(jnp.argmax(raw, axis=-1), action_dims[aid])
             low = jnp.asarray(act_spaces[aid].low, jnp.float32)
             high = jnp.asarray(act_spaces[aid].high, jnp.float32)
@@ -271,10 +310,8 @@ class MADDPG(MultiAgentRLAlgorithm):
             # --- actor updates ------------------------------------------- #
             a_grads = {}
             for i, aid in enumerate(agent_ids):
-                k = jax.random.fold_in(key, i)
 
-                def a_loss(p, aid=aid, k=k):
-                    my_action = actor_out(aid, p, obs, key=k, differentiable=True)
+                def joint_q(aid, my_action):
                     parts = []
                     for other in agent_ids:
                         if other == aid:
@@ -283,8 +320,43 @@ class MADDPG(MultiAgentRLAlgorithm):
                             parts.append(encode_action(other, actions[other]))
                     joint = jnp.concatenate(parts, axis=-1)
                     q_in = jnp.concatenate([all_obs, joint], axis=-1)
-                    q = EvolvableNetwork.apply(critic_cfgs[aid], critics[aid], q_in)[..., 0]
-                    return -jnp.mean(q)
+                    return EvolvableNetwork.apply(
+                        critic_cfgs[aid], critics[aid], q_in
+                    )[..., 0]
+
+                def a_loss(p, aid=aid, joint_q=joint_q):
+                    o = preprocess_observation(obs_spaces[aid], obs[aid])
+                    raw = EvolvableNetwork.apply(actor_cfgs[aid], p, o)
+                    reg = action_reg * jnp.mean(jnp.square(raw))
+                    if discrete[aid]:
+                        # expected-Q policy loss: Σ_a π(a|o) Q(s, onehot(a)) —
+                        # queries the critic ONLY at the one-hot vertices it
+                        # was trained on. Differentiating THROUGH the critic at
+                        # a vertex (gumbel straight-through) follows an
+                        # interpolation the critic never fit, and its local
+                        # gradient can point away from the better action
+                        # (probe-grid finding: the actor saturated on the
+                        # wrong action while the critic was vertex-perfect).
+                        n = action_dims[aid]
+                        probs = jax.nn.softmax(raw, axis=-1)  # [B, n]
+                        B = raw.shape[0]
+                        qs = jnp.stack(
+                            [
+                                joint_q(
+                                    aid,
+                                    jnp.broadcast_to(jnp.eye(n)[j], (B, n)),
+                                )
+                                for j in range(n)
+                            ],
+                            axis=-1,
+                        )  # [B, n]
+                        return -jnp.mean(
+                            jnp.sum(probs * jax.lax.stop_gradient(qs), axis=-1)
+                        ) + reg
+                    low = jnp.asarray(act_spaces[aid].low, jnp.float32)
+                    high = jnp.asarray(act_spaces[aid].high, jnp.float32)
+                    my_action = low + (raw + 1.0) * 0.5 * (high - low)
+                    return -jnp.mean(joint_q(aid, my_action)) + reg
 
                 loss, grads = jax.value_and_grad(a_loss)(actors[aid])
                 losses[f"actor_{aid}"] = loss

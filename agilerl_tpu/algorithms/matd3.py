@@ -15,7 +15,7 @@ from gymnasium import spaces
 
 from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
 from agilerl_tpu.algorithms.core.registry import NetworkGroup, OptimizerConfig
-from agilerl_tpu.algorithms.maddpg import MADDPG, gumbel_softmax
+from agilerl_tpu.algorithms.maddpg import MADDPG
 from agilerl_tpu.networks.base import EvolvableNetwork
 from agilerl_tpu.utils.spaces import obs_dim, preprocess_observation
 
@@ -73,25 +73,20 @@ class MATD3(MADDPG):
         c1_tx = self.critic_optimizers.tx
         c2_tx = self.critic_2_optimizers.tx
         policy_noise, noise_clip = self.policy_noise, self.noise_clip
+        action_reg = getattr(self, "action_reg", 1e-3)
+
+        from agilerl_tpu.algorithms.maddpg import encode_ma_action, flatten_ma_obs
 
         def flat_obs(obs):
-            outs = []
-            for aid in agent_ids:
-                o = preprocess_observation(obs_spaces[aid], obs[aid])
-                outs.append(o.reshape(o.shape[0], -1))
-            return jnp.concatenate(outs, axis=-1)
+            return flatten_ma_obs(obs_spaces, agent_ids, obs)
 
         def encode_action(aid, a):
-            if discrete[aid]:
-                return jax.nn.one_hot(a.astype(jnp.int32), action_dims[aid])
-            return a.astype(jnp.float32).reshape(a.shape[0], -1)
+            return encode_ma_action(discrete, action_dims, aid, a)
 
-        def actor_out(aid, params, obs, key=None, differentiable=False, smooth_key=None):
+        def actor_out(aid, params, obs, smooth_key=None):
             o = preprocess_observation(obs_spaces[aid], obs[aid])
             raw = EvolvableNetwork.apply(actor_cfgs[aid], params, o)
             if discrete[aid]:
-                if differentiable:
-                    return gumbel_softmax(raw, key)
                 return jax.nn.one_hot(jnp.argmax(raw, axis=-1), action_dims[aid])
             low = jnp.asarray(act_spaces[aid].low, jnp.float32)
             high = jnp.asarray(act_spaces[aid].high, jnp.float32)
@@ -153,19 +148,49 @@ class MATD3(MADDPG):
                 actors, a_opt = args
                 a_grads = {}
                 for i, aid in enumerate(agent_ids):
-                    k = jax.random.fold_in(smooth_keys[-1], i)
 
-                    def a_loss(p, aid=aid, k=k):
-                        my = actor_out(aid, p, obs, key=k, differentiable=True)
+                    def joint_q1(aid, my_action):
                         parts = [
-                            my if other == aid else encode_action(other, actions[other])
+                            my_action if other == aid
+                            else encode_action(other, actions[other])
                             for other in agent_ids
                         ]
                         q_in = jnp.concatenate(
                             [all_obs, jnp.concatenate(parts, axis=-1)], axis=-1
                         )
-                        q = EvolvableNetwork.apply(c1_cfgs[aid], c1s[aid], q_in)[..., 0]
-                        return -jnp.mean(q)
+                        return EvolvableNetwork.apply(
+                            c1_cfgs[aid], c1s[aid], q_in
+                        )[..., 0]
+
+                    def a_loss(p, aid=aid, joint_q1=joint_q1):
+                        o = preprocess_observation(obs_spaces[aid], obs[aid])
+                        raw = EvolvableNetwork.apply(actor_cfgs[aid], p, o)
+                        reg = action_reg * jnp.mean(jnp.square(raw))
+                        if discrete[aid]:
+                            # expected-Q loss at the one-hot vertices (same
+                            # rationale as MADDPG: the critic is only trained
+                            # at vertices; gumbel-through-critic gradients
+                            # follow an unfit interpolation)
+                            n = action_dims[aid]
+                            probs = jax.nn.softmax(raw, axis=-1)
+                            B = raw.shape[0]
+                            qs = jnp.stack(
+                                [
+                                    joint_q1(
+                                        aid,
+                                        jnp.broadcast_to(jnp.eye(n)[j], (B, n)),
+                                    )
+                                    for j in range(n)
+                                ],
+                                axis=-1,
+                            )
+                            return -jnp.mean(
+                                jnp.sum(probs * jax.lax.stop_gradient(qs), axis=-1)
+                            ) + reg
+                        low = jnp.asarray(act_spaces[aid].low, jnp.float32)
+                        high = jnp.asarray(act_spaces[aid].high, jnp.float32)
+                        my = low + (raw + 1.0) * 0.5 * (high - low)
+                        return -jnp.mean(joint_q1(aid, my)) + reg
 
                     _, g = jax.value_and_grad(a_loss)(actors[aid])
                     a_grads[aid] = g

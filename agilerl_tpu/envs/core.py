@@ -86,6 +86,12 @@ def make_autoreset_step(env: JaxEnv) -> Callable:
     return vec_step
 
 
+def _to_np(tree):
+    """Device->host conversion that preserves Dict/Tuple obs pytrees
+    (np.asarray on a dict would yield a useless object array)."""
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
 class JaxVecEnv:
     """gymnasium.vector-style host API over a JAX-native env."""
 
@@ -112,18 +118,18 @@ class JaxVecEnv:
             step_count=jnp.zeros(self.num_envs, jnp.int32),
             key=self._key,
         )
-        return np.asarray(obs), {}
+        return _to_np(obs), {}
 
     def step(self, actions):
         self._state, obs, reward, terminated, truncated, final_obs = self._step(
             self._state, jnp.asarray(actions)
         )
         return (
-            np.asarray(obs),
+            _to_np(obs),
             np.asarray(reward),
             np.asarray(terminated),
             np.asarray(truncated),
-            {"final_obs": np.asarray(final_obs)},
+            {"final_obs": _to_np(final_obs)},
         )
 
     def close(self):

@@ -4,8 +4,15 @@ check_q_learning_with_probe_env:1114, check_policy_q_learning_with_probe_env:116
 check_policy_on_policy_with_probe_env:1233).
 
 Each probe isolates one capability: value prediction, discounting,
-obs-conditioning, action-conditioning. Implemented as pure-JAX envs so the
-checks run entirely on device.
+obs-conditioning, action-conditioning — across the same observation grid the
+reference covers (vector / image / Dict) x (discrete / continuous actions).
+Implemented pure-JAX (NamedTuple state, one parametrised family per reward
+structure instead of 30 hand-copied gym classes) so the checks run entirely on
+device; images are NHWC (TPU-native) where the reference is CHW.
+
+Like the reference, every env carries ground-truth tables — ``sample_obs``,
+``q_values``, ``v_values``, ``policy_values`` (+ ``sample_actions`` for
+continuous probes) — and the check fns assert against the tables generically.
 """
 
 from __future__ import annotations
@@ -19,110 +26,331 @@ from gymnasium import spaces
 
 from agilerl_tpu.envs.core import JaxEnv, JaxVecEnv
 
+_IMG_SHAPE = (3, 3, 1)  # NHWC (reference uses CHW (1,3,3), probe_envs.py:45)
+
+
+class _ProbeState(NamedTuple):
+    v: jax.Array  # primary scalar (drives reward / box obs)
+    w: jax.Array  # secondary scalar (Dict probes' discrete key)
+    t: jax.Array
+
+
+class _ProbeBase(JaxEnv):
+    """Shared machinery: obs emission per kind + space construction."""
+
+    obs_kind = "vector"  # vector | image | dict
+    continuous = False
+    max_episode_steps = 1
+
+    def __init__(self):
+        if self.obs_kind == "vector":
+            self.observation_space = spaces.Box(0.0, 1.0, (1,), np.float32)
+        elif self.obs_kind == "image":
+            self.observation_space = spaces.Box(0.0, 1.0, _IMG_SHAPE, np.float32)
+        else:
+            self.observation_space = spaces.Dict(
+                {
+                    "discrete": spaces.Discrete(2),
+                    "box": spaces.Box(0.0, 1.0, _IMG_SHAPE, np.float32),
+                }
+            )
+        if self.continuous:
+            self.action_space = spaces.Box(0.0, 1.0, (1,), np.float32)
+        else:
+            self.action_space = spaces.Discrete(2)
+        self._init_tables()
+
+    # -- obs plumbing ---------------------------------------------------- #
+    def _emit(self, v, w):
+        v = jnp.asarray(v, jnp.float32)
+        if self.obs_kind == "vector":
+            return jnp.full((1,), v, jnp.float32)
+        if self.obs_kind == "image":
+            return jnp.full(_IMG_SHAPE, v, jnp.float32)
+        return {
+            "discrete": jnp.asarray(w, jnp.int32),
+            "box": jnp.full(_IMG_SHAPE, v, jnp.float32),
+        }
+
+    def raw_obs(self, v, w=0):
+        """Host-side obs (unbatched) for the ground-truth tables."""
+        if self.obs_kind == "vector":
+            return np.full((1,), v, np.float32)
+        if self.obs_kind == "image":
+            return np.full(_IMG_SHAPE, v, np.float32)
+        return {"discrete": np.int64(w), "box": np.full(_IMG_SHAPE, v, np.float32)}
+
+    def _cont_a(self, action):
+        a = jnp.asarray(action)
+        return a.reshape(())[()] if a.ndim == 0 else a.reshape(-1)[0]
+
+    def _init_tables(self):
+        self.sample_obs = []
+        self.sample_actions = None
+        self.q_values = None
+        self.v_values = None
+        self.policy_values = None
+
+
+# --------------------------------------------------------------------------- #
+# Families
+# --------------------------------------------------------------------------- #
+
+
+class _ConstantReward(_ProbeBase):
+    """One step, fixed obs, reward 1 regardless of action. Value -> 1."""
+
+    def reset_fn(self, key):
+        st = _ProbeState(jnp.float32(0), jnp.float32(0), jnp.int32(0))
+        return st, self._emit(st.v, st.w)
+
+    def step_fn(self, state, action, key):
+        return (
+            state, self._emit(state.v, state.w), jnp.float32(1.0),
+            jnp.bool_(True), jnp.bool_(False),
+        )
+
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs(0, 0)]
+        self.v_values = [1.0]
+        if self.continuous:
+            self.sample_actions = [np.full((1,), 0.5, np.float32)]
+            self.q_values = [[1.0]]
+        else:
+            self.q_values = [[1.0, 1.0]]
+
+
+class _ObsDependentReward(_ProbeBase):
+    """One step; reward fixed by the observation, not the action.
+    vector/image: r = +1 if v==1 else -1. Dict: r = +1 iff discrete==box mean
+    (forces fusing both keys, parity: ObsDependentRewardDictEnv)."""
+
+    def reset_fn(self, key):
+        k1, k2 = jax.random.split(key)
+        v = jax.random.bernoulli(k1).astype(jnp.float32)
+        if self.obs_kind == "dict":
+            w = jax.random.bernoulli(k2).astype(jnp.float32)
+        else:
+            w = v
+        return _ProbeState(v, w, jnp.int32(0)), self._emit(v, w)
+
+    def _reward(self, state, action):
+        if self.obs_kind == "dict":
+            return jnp.where(state.v == state.w, 1.0, -1.0)
+        return jnp.where(state.v > 0.5, 1.0, -1.0)
+
+    def step_fn(self, state, action, key):
+        return (
+            state, self._emit(state.v, state.w), self._reward(state, action),
+            jnp.bool_(True), jnp.bool_(False),
+        )
+
+    def _init_tables(self):
+        super()._init_tables()
+        if self.obs_kind == "dict":
+            self.sample_obs = [
+                self.raw_obs(v, w) for w in (0, 1) for v in (0, 1)
+            ]
+            rewards = [1.0, -1.0, -1.0, 1.0]  # (w,v): 00 01 10 11
+        else:
+            self.sample_obs = [self.raw_obs(0), self.raw_obs(1)]
+            rewards = [-1.0, 1.0]
+        self.v_values = rewards
+        if self.continuous:
+            self.sample_actions = [np.full((1,), 0.5, np.float32)] * len(rewards)
+            self.q_values = [[r] for r in rewards]
+        else:
+            self.q_values = [[r, r] for r in rewards]
+
+
+class _DiscountedReward(_ProbeBase):
+    """Two steps; obs = t; reward 1 only on the second step, so
+    value(s0) must equal gamma * value(s1) (the discounting probe)."""
+
+    max_episode_steps = 2
+    checks_discounting = True
+
+    def reset_fn(self, key):
+        st = _ProbeState(jnp.float32(0), jnp.float32(0), jnp.int32(0))
+        return st, self._emit(st.v, st.w)
+
+    def step_fn(self, state, action, key):
+        t = state.t + 1
+        v = t.astype(jnp.float32)
+        reward = jnp.where(t >= 2, 1.0, 0.0)
+        done = t >= 2
+        return _ProbeState(v, v, t), self._emit(v, v), reward, done, jnp.bool_(False)
+
+    def _init_tables(self):
+        super()._init_tables()
+        # chain: q(sample_obs[0]) == gamma * q(sample_obs[1]); q(s1) == 1
+        self.sample_obs = [self.raw_obs(0, 0), self.raw_obs(1, 1)]
+        if self.continuous:
+            self.sample_actions = [np.full((1,), 0.5, np.float32)] * 2
+
+
+class _FixedObsPolicy(_ProbeBase):
+    """One step, fixed obs; the ACTION determines the reward.
+    discrete: action 0 -> +1, action 1 -> -1. continuous: r = -(a - 0.5)^2."""
+
+    def __init__(self, continuous: bool | None = None):
+        if continuous is not None:
+            self.continuous = continuous
+        super().__init__()
+
+    def reset_fn(self, key):
+        st = _ProbeState(jnp.float32(0), jnp.float32(0), jnp.int32(0))
+        return st, self._emit(st.v, st.w)
+
+    def step_fn(self, state, action, key):
+        if self.continuous:
+            reward = -jnp.square(self._cont_a(action) - 0.5)
+        else:
+            reward = jnp.where(jnp.asarray(action) == 0, 1.0, -1.0)
+        return (
+            state, self._emit(state.v, state.w), reward,
+            jnp.bool_(True), jnp.bool_(False),
+        )
+
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs(0, 0)]
+        if self.continuous:
+            self.sample_actions = [np.full((1,), 0.5, np.float32)]
+            self.q_values = [[0.0]]
+            self.policy_values = [np.full((1,), 0.5, np.float32)]
+        else:
+            self.q_values = [[1.0, -1.0]]
+            self.policy_values = [0]
+
+
+class _Policy(_ProbeBase):
+    """One step; the correct action DEPENDS on the observation.
+    vector/image discrete: act == v. dict discrete: r=+1 iff act==discrete AND
+    discrete==box (parity: PolicyDictEnv). continuous: target a = v (or
+    1[v==w] for dict)."""
+
+    def reset_fn(self, key):
+        k1, k2 = jax.random.split(key)
+        v = jax.random.bernoulli(k1).astype(jnp.float32)
+        if self.obs_kind == "dict":
+            w = jax.random.bernoulli(k2).astype(jnp.float32)
+        else:
+            w = v
+        return _ProbeState(v, w, jnp.int32(0)), self._emit(v, w)
+
+    def step_fn(self, state, action, key):
+        if self.continuous:
+            if self.obs_kind == "dict":
+                target = (state.v == state.w).astype(jnp.float32)
+            else:
+                target = state.v
+            reward = -jnp.square(self._cont_a(action) - target)
+        else:
+            a = jnp.asarray(action)
+            if self.obs_kind == "dict":
+                reward = jnp.where(
+                    (a == state.w.astype(jnp.int32)) & (state.v == state.w),
+                    1.0, -1.0,
+                )
+            else:
+                reward = jnp.where(a == state.v.astype(jnp.int32), 1.0, -1.0)
+        return (
+            state, self._emit(state.v, state.w), reward,
+            jnp.bool_(True), jnp.bool_(False),
+        )
+
+    def _init_tables(self):
+        super()._init_tables()
+        if self.obs_kind == "dict":
+            self.sample_obs = [self.raw_obs(v, w) for w in (0, 1) for v in (0, 1)]
+            if self.continuous:
+                targets = [1.0, 0.0, 0.0, 1.0]  # (w,v): 00 01 10 11
+                self.sample_actions = [np.full((1,), t, np.float32) for t in targets]
+                self.q_values = [[0.0]] * 4
+                self.policy_values = [np.full((1,), t, np.float32) for t in targets]
+            else:
+                self.q_values = [
+                    [1.0, -1.0],   # (0,0): correct action 0
+                    [-1.0, -1.0],  # (0,1): mismatch, always -1
+                    [-1.0, -1.0],  # (1,0): mismatch
+                    [-1.0, 1.0],   # (1,1): correct action 1
+                ]
+                self.policy_values = [0, None, None, 1]
+        else:
+            self.sample_obs = [self.raw_obs(0), self.raw_obs(1)]
+            if self.continuous:
+                self.sample_actions = [
+                    np.zeros((1,), np.float32), np.ones((1,), np.float32)
+                ]
+                self.q_values = [[0.0], [0.0]]
+                self.policy_values = [
+                    np.zeros((1,), np.float32), np.ones((1,), np.float32)
+                ]
+            else:
+                self.q_values = [[1.0, -1.0], [-1.0, 1.0]]
+                self.policy_values = [0, 1]
+
+
+# --------------------------------------------------------------------------- #
+# Named variants (name parity with agilerl/utils/probe_envs.py:13-1110)
+# --------------------------------------------------------------------------- #
+
+
+def _variant(base, name, kind, continuous):
+    cls = type(name, (base,), {"obs_kind": kind, "continuous": continuous})
+    cls.__module__ = __name__
+    return cls
+
+
+ConstantRewardEnv = _variant(_ConstantReward, "ConstantRewardEnv", "vector", False)
+ConstantRewardImageEnv = _variant(_ConstantReward, "ConstantRewardImageEnv", "image", False)
+ConstantRewardDictEnv = _variant(_ConstantReward, "ConstantRewardDictEnv", "dict", False)
+ConstantRewardContActionsEnv = _variant(_ConstantReward, "ConstantRewardContActionsEnv", "vector", True)
+ConstantRewardContActionsImageEnv = _variant(_ConstantReward, "ConstantRewardContActionsImageEnv", "image", True)
+ConstantRewardContActionsDictEnv = _variant(_ConstantReward, "ConstantRewardContActionsDictEnv", "dict", True)
+
+ObsDependentRewardEnv = _variant(_ObsDependentReward, "ObsDependentRewardEnv", "vector", False)
+ObsDependentRewardImageEnv = _variant(_ObsDependentReward, "ObsDependentRewardImageEnv", "image", False)
+ObsDependentRewardDictEnv = _variant(_ObsDependentReward, "ObsDependentRewardDictEnv", "dict", False)
+ObsDependentRewardContActionsEnv = _variant(_ObsDependentReward, "ObsDependentRewardContActionsEnv", "vector", True)
+ObsDependentRewardContActionsImageEnv = _variant(_ObsDependentReward, "ObsDependentRewardContActionsImageEnv", "image", True)
+ObsDependentRewardContActionsDictEnv = _variant(_ObsDependentReward, "ObsDependentRewardContActionsDictEnv", "dict", True)
+
+DiscountedRewardEnv = _variant(_DiscountedReward, "DiscountedRewardEnv", "vector", False)
+DiscountedRewardImageEnv = _variant(_DiscountedReward, "DiscountedRewardImageEnv", "image", False)
+DiscountedRewardDictEnv = _variant(_DiscountedReward, "DiscountedRewardDictEnv", "dict", False)
+DiscountedRewardContActionsEnv = _variant(_DiscountedReward, "DiscountedRewardContActionsEnv", "vector", True)
+DiscountedRewardContActionsImageEnv = _variant(_DiscountedReward, "DiscountedRewardContActionsImageEnv", "image", True)
+DiscountedRewardContActionsDictEnv = _variant(_DiscountedReward, "DiscountedRewardContActionsDictEnv", "dict", True)
+
+
+class FixedObsPolicyEnv(_FixedObsPolicy):
+    """Vector FixedObsPolicy; ``continuous=True`` selects the Box-action probe
+    (back-compat constructor used by existing tests/check fns)."""
+
+    obs_kind = "vector"
+
+
+FixedObsPolicyImageEnv = _variant(_FixedObsPolicy, "FixedObsPolicyImageEnv", "image", False)
+FixedObsPolicyDictEnv = _variant(_FixedObsPolicy, "FixedObsPolicyDictEnv", "dict", False)
+FixedObsPolicyContActionsEnv = _variant(_FixedObsPolicy, "FixedObsPolicyContActionsEnv", "vector", True)
+FixedObsPolicyContActionsImageEnv = _variant(_FixedObsPolicy, "FixedObsPolicyContActionsImageEnv", "image", True)
+FixedObsPolicyContActionsDictEnv = _variant(_FixedObsPolicy, "FixedObsPolicyContActionsDictEnv", "dict", True)
+
+PolicyEnv = _variant(_Policy, "PolicyEnv", "vector", False)
+PolicyImageEnv = _variant(_Policy, "PolicyImageEnv", "image", False)
+PolicyDictEnv = _variant(_Policy, "PolicyDictEnv", "dict", False)
+PolicyContActionsEnv = _variant(_Policy, "PolicyContActionsEnv", "vector", True)
+PolicyContActionsImageEnv = _variant(_Policy, "PolicyContActionsImageEnv", "image", True)
+PolicyContActionsImageEnvSimple = _variant(_Policy, "PolicyContActionsImageEnvSimple", "image", True)
+PolicyContActionsDictEnv = _variant(_Policy, "PolicyContActionsDictEnv", "dict", True)
+
 
 class _ScalarState(NamedTuple):
     obs: jax.Array
     t: jax.Array
-
-
-class ConstantRewardEnv(JaxEnv):
-    """One step, obs=0, reward=1. Value must converge to 1."""
-
-    max_episode_steps = 1
-
-    def __init__(self):
-        self.observation_space = spaces.Box(0.0, 1.0, (1,), np.float32)
-        self.action_space = spaces.Discrete(2)
-
-    def reset_fn(self, key):
-        return _ScalarState(jnp.zeros(1), jnp.int32(0)), jnp.zeros(1)
-
-    def step_fn(self, state, action, key):
-        return state, jnp.zeros(1), jnp.float32(1.0), jnp.bool_(True), jnp.bool_(False)
-
-
-class ObsDependentRewardEnv(JaxEnv):
-    """One step; obs ∈ {0,1}; reward = -1 if obs==0 else +1."""
-
-    max_episode_steps = 1
-
-    def __init__(self):
-        self.observation_space = spaces.Box(0.0, 1.0, (1,), np.float32)
-        self.action_space = spaces.Discrete(2)
-
-    def reset_fn(self, key):
-        obs = jax.random.bernoulli(key).astype(jnp.float32).reshape(1)
-        return _ScalarState(obs, jnp.int32(0)), obs
-
-    def step_fn(self, state, action, key):
-        reward = jnp.where(state.obs[0] > 0.5, 1.0, -1.0)
-        return state, state.obs, reward, jnp.bool_(True), jnp.bool_(False)
-
-
-class DiscountedRewardEnv(JaxEnv):
-    """Two steps; obs = t; reward 1 only on second step — value(0) must equal
-    gamma * value(1)."""
-
-    max_episode_steps = 2
-
-    def __init__(self):
-        self.observation_space = spaces.Box(0.0, 1.0, (1,), np.float32)
-        self.action_space = spaces.Discrete(2)
-
-    def reset_fn(self, key):
-        return _ScalarState(jnp.zeros(1), jnp.int32(0)), jnp.zeros(1)
-
-    def step_fn(self, state, action, key):
-        t = state.t + 1
-        obs = jnp.full((1,), t, jnp.float32)
-        reward = jnp.where(t >= 2, 1.0, 0.0)
-        done = t >= 2
-        return _ScalarState(obs, t), obs, reward, done, jnp.bool_(False)
-
-
-class FixedObsPolicyEnv(JaxEnv):
-    """One step, obs=0; discrete: action 0 -> +1, action 1 -> -1.
-    continuous: reward = -(action - 0.5)^2 maximised at 0.5."""
-
-    max_episode_steps = 1
-
-    def __init__(self, continuous: bool = False):
-        self.continuous = continuous
-        self.observation_space = spaces.Box(0.0, 1.0, (1,), np.float32)
-        if continuous:
-            self.action_space = spaces.Box(-1.0, 1.0, (1,), np.float32)
-        else:
-            self.action_space = spaces.Discrete(2)
-
-    def reset_fn(self, key):
-        return _ScalarState(jnp.zeros(1), jnp.int32(0)), jnp.zeros(1)
-
-    def step_fn(self, state, action, key):
-        if self.continuous:
-            a = action[0] if action.ndim > 0 else action
-            reward = -jnp.square(a - 0.5)
-        else:
-            reward = jnp.where(action == 0, 1.0, -1.0)
-        return state, jnp.zeros(1), reward, jnp.bool_(True), jnp.bool_(False)
-
-
-class PolicyEnv(JaxEnv):
-    """One step; obs ∈ {0,1}; correct action must match obs."""
-
-    max_episode_steps = 1
-
-    def __init__(self):
-        self.observation_space = spaces.Box(0.0, 1.0, (1,), np.float32)
-        self.action_space = spaces.Discrete(2)
-
-    def reset_fn(self, key):
-        obs = jax.random.bernoulli(key).astype(jnp.float32).reshape(1)
-        return _ScalarState(obs, jnp.int32(0)), obs
-
-    def step_fn(self, state, action, key):
-        correct = (state.obs[0] > 0.5).astype(jnp.int32)
-        reward = jnp.where(action == correct, 1.0, -1.0)
-        return state, state.obs, reward, jnp.bool_(True), jnp.bool_(False)
 
 
 class MemoryEnv(JaxEnv):
@@ -155,8 +383,16 @@ class MemoryEnv(JaxEnv):
 
 
 # --------------------------------------------------------------------------- #
-# Check functions
+# Check functions (table-driven, parity: probe_envs.py:1114,1162,1233)
 # --------------------------------------------------------------------------- #
+
+
+def _pre(env, obs):
+    """Batch + preprocess one raw table obs for the agent's networks."""
+    from agilerl_tpu.utils.spaces import preprocess_observation
+
+    batched = jax.tree_util.tree_map(lambda x: np.asarray(x)[None], obs)
+    return preprocess_observation(env.observation_space, batched)
 
 
 def fill_buffer_random(env: JaxEnv, memory, steps: int, num_envs: int = 8, seed: int = 0):
@@ -189,38 +425,39 @@ def fill_buffer_random(env: JaxEnv, memory, steps: int, num_envs: int = 8, seed:
 
 
 def check_q_learning_with_probe_env(
-    env: JaxEnv, algo_class, algo_args: dict, learn_steps: int = 500, seed: int = 42
+    env: JaxEnv, algo_class, algo_args: dict, learn_steps: int = 500, seed: int = 42,
+    atol: float = 0.3,
 ) -> None:
-    """Train a Q-learner on a probe env and assert its Q-values
-    (parity: probe_envs.py:1114)."""
+    """Train a Q-learner on a probe env and assert its Q-values against the
+    env's ground-truth tables (parity: probe_envs.py:1114)."""
     from agilerl_tpu.components import ReplayBuffer
 
     agent = algo_class(**algo_args)
     memory = ReplayBuffer(max_size=2048)
     fill_buffer_random(env, memory, steps=256 // 8, num_envs=8, seed=seed)
-    for i in range(learn_steps):
+    for _ in range(learn_steps):
         agent.learn(memory.sample(64))
 
-    if isinstance(env, ConstantRewardEnv):
-        q = np.asarray(agent.actor(jnp.zeros((1, 1))))
-        np.testing.assert_allclose(q, 1.0, atol=0.2)
-    elif isinstance(env, ObsDependentRewardEnv):
-        q0 = np.asarray(agent.actor(jnp.zeros((1, 1))))
-        q1 = np.asarray(agent.actor(jnp.ones((1, 1))))
-        np.testing.assert_allclose(q0, -1.0, atol=0.3)
-        np.testing.assert_allclose(q1, 1.0, atol=0.3)
-    elif isinstance(env, DiscountedRewardEnv):
-        q0 = np.asarray(agent.actor(jnp.zeros((1, 1)))).max()
-        q1 = np.asarray(agent.actor(jnp.ones((1, 1)))).max()
-        np.testing.assert_allclose(q0, agent.gamma * q1, atol=0.15)
-        np.testing.assert_allclose(q1, 1.0, atol=0.15)
+    if getattr(env, "checks_discounting", False):
+        q0 = float(np.asarray(agent.actor(_pre(env, env.sample_obs[0]))).max())
+        q1 = float(np.asarray(agent.actor(_pre(env, env.sample_obs[1]))).max())
+        np.testing.assert_allclose(q1, 1.0, atol=max(atol, 0.15))
+        np.testing.assert_allclose(q0, agent.gamma * q1, atol=max(atol, 0.15))
+        return
+    for obs, qrow in zip(env.sample_obs, env.q_values):
+        if qrow is None:
+            continue
+        pred = np.asarray(agent.actor(_pre(env, obs)))[0]
+        np.testing.assert_allclose(pred, qrow, atol=atol)
 
 
 def check_policy_q_learning_with_probe_env(
-    env: JaxEnv, algo_class, algo_args: dict, learn_steps: int = 400, seed: int = 42
+    env: JaxEnv, algo_class, algo_args: dict, learn_steps: int = 400, seed: int = 42,
+    atol: float = 0.25,
 ) -> None:
     """Train an actor-critic off-policy agent (DDPG/TD3) on a continuous probe
-    env and assert actor/critic outputs (parity: probe_envs.py:1162)."""
+    env and assert actor/critic outputs against the tables
+    (parity: probe_envs.py:1162)."""
     from agilerl_tpu.components import ReplayBuffer
 
     agent = algo_class(**algo_args)
@@ -229,39 +466,66 @@ def check_policy_q_learning_with_probe_env(
     for _ in range(learn_steps):
         agent.learn(memory.sample(64))
 
-    if isinstance(env, FixedObsPolicyEnv) and env.continuous:
-        import jax.numpy as jnp
-
-        action = np.asarray(agent.get_action(np.zeros((1, 1), np.float32),
-                                             training=False))
-        np.testing.assert_allclose(action, 0.5, atol=0.25)
-        q = np.asarray(agent.critic(jnp.zeros((1, 1)), jnp.full((1, 1), 0.5)))
-        np.testing.assert_allclose(q, 0.0, atol=0.25)
+    if getattr(env, "checks_discounting", False):
+        # critic(s0, a) == gamma * critic(s1, a); critic(s1, a) ~ 1
+        a0, a1 = (jnp.asarray(a)[None] for a in env.sample_actions[:2])
+        q0 = float(np.asarray(agent.critic(_pre(env, env.sample_obs[0]), a0)).reshape(-1)[0])
+        q1 = float(np.asarray(agent.critic(_pre(env, env.sample_obs[1]), a1)).reshape(-1)[0])
+        np.testing.assert_allclose(q1, 1.0, atol=max(atol, 0.15))
+        np.testing.assert_allclose(q0, agent.gamma * q1, atol=max(atol, 0.15))
+        return
+    if env.q_values is not None and env.sample_actions is not None:
+        for obs, act, qrow in zip(env.sample_obs, env.sample_actions, env.q_values):
+            if qrow is None:
+                continue
+            q = np.asarray(
+                agent.critic(_pre(env, obs), jnp.asarray(act)[None])
+            )
+            np.testing.assert_allclose(q.reshape(-1), qrow, atol=atol)
+    if env.policy_values is not None:
+        for obs, pol in zip(env.sample_obs, env.policy_values):
+            if pol is None:
+                continue
+            raw = jax.tree_util.tree_map(lambda x: np.asarray(x)[None], obs)
+            action = np.asarray(agent.get_action(raw, training=False))
+            np.testing.assert_allclose(action.reshape(-1), pol, atol=atol)
 
 
 def check_policy_on_policy_with_probe_env(
-    env: JaxEnv, algo_class, algo_args: dict, train_iters: int = 60, seed: int = 42
+    env: JaxEnv, algo_class, algo_args: dict, train_iters: int = 60, seed: int = 42,
+    atol: float = 0.2, solved_reward: float = None,
 ) -> None:
-    """Train an on-policy agent (PPO-like) on a probe env and assert the policy
-    (parity: probe_envs.py:1233). Uses the agent's own rollout collection."""
+    """Train an on-policy agent (PPO-like) on a probe env and assert the
+    deterministic policy against the tables (parity: probe_envs.py:1233).
+
+    With ``solved_reward`` set, stops once the mean per-step reward stays
+    above it for three consecutive iterations: on a SOLVED one-step probe the
+    advantages are bootstrap noise and PPO updates on normalised noise can
+    destabilise a perfect policy — the probe asserts learnability, so
+    train-to-solve is the correct budget."""
     from agilerl_tpu.rollouts.on_policy import collect_rollouts
 
     agent = algo_class(**algo_args)
     vec = JaxVecEnv(env, num_envs=8, seed=seed)
-    obs_space = env.observation_space
+    streak = 0
     for _ in range(train_iters):
-        collect_rollouts(agent, vec, n_steps=agent.learn_step)
+        mean_rew = collect_rollouts(agent, vec, n_steps=agent.learn_step)
         agent.learn()
-
-    if isinstance(env, FixedObsPolicyEnv):
-        obs = jnp.zeros((1, 1))
-        if isinstance(env.action_space, spaces.Discrete):
-            action, _, _ = agent.actor(obs, deterministic=True)
-            assert int(action[0]) == 0
+        if solved_reward is not None and mean_rew >= solved_reward:
+            streak += 1
+            if streak >= 3:
+                break
         else:
-            action, _, _ = agent.actor(obs, deterministic=True)
-            np.testing.assert_allclose(np.asarray(action), 0.5, atol=0.2)
-    elif isinstance(env, PolicyEnv):
-        a0, _, _ = agent.actor(jnp.zeros((1, 1)), deterministic=True)
-        a1, _, _ = agent.actor(jnp.ones((1, 1)), deterministic=True)
-        assert int(a0[0]) == 0 and int(a1[0]) == 1
+            streak = 0
+
+    assert env.policy_values is not None, "probe env has no policy table"
+    for obs, pol in zip(env.sample_obs, env.policy_values):
+        if pol is None:
+            continue
+        action, _, _ = agent.actor(_pre(env, obs), deterministic=True)
+        if isinstance(env.action_space, spaces.Discrete):
+            assert int(np.asarray(action)[0]) == int(pol), (
+                f"policy({obs!r}) = {np.asarray(action)[0]}, want {pol}"
+            )
+        else:
+            np.testing.assert_allclose(np.asarray(action).reshape(-1), pol, atol=atol)

@@ -1,92 +1,318 @@
 """Multi-agent probe environments + checks
-(parity: agilerl/utils/probe_envs_ma.py — 2225 LoC of multi-agent diagnostic
-envs; the compact JAX set here isolates the same capabilities: constant reward,
-obs-dependent reward, action-dependent reward, per-agent reward asymmetry).
+(parity: agilerl/utils/probe_envs_ma.py — 2225 LoC / 22 diagnostic env classes:
+5 reward families x {vector, image} x {discrete, continuous} + the joint-action
+MultiPolicy pair, with check fns :1867 and :1958).
+
+Implemented as parametrised pure-JAX families (one class per reward structure,
+variants generated per obs kind / action kind) rather than 22 hand-copied gym
+classes; images are NHWC. Like the single-agent grid (envs/probe.py), every env
+carries ground-truth ``sample_obs`` / ``policy_values`` / ``v_values`` tables
+and the check fns assert against them generically.
 """
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from gymnasium import spaces
 
+_IMG_SHAPE = (3, 3, 1)  # NHWC (reference uses CHW)
+
 
 class _MAState(NamedTuple):
-    obs: jax.Array  # [n_agents, obs_dim]
+    v: jax.Array  # [n_agents] per-agent scalar (drives obs + reward)
     t: jax.Array
 
 
 class _MAProbeBase:
     n_agents = 2
-    obs_dim = 1
+    obs_kind = "vector"  # vector | image
+    continuous = False
     max_episode_steps = 1
 
     def __init__(self):
         self.agent_ids = [f"agent_{i}" for i in range(self.n_agents)]
-        self.observation_spaces = {
-            a: spaces.Box(0.0, 1.0, (self.obs_dim,), np.float32) for a in self.agent_ids
-        }
-        self.action_spaces = {a: spaces.Discrete(2) for a in self.agent_ids}
+        if self.obs_kind == "vector":
+            obs_space = spaces.Box(0.0, 1.0, (1,), np.float32)
+        else:
+            obs_space = spaces.Box(0.0, 1.0, _IMG_SHAPE, np.float32)
+        self.observation_spaces = {a: obs_space for a in self.agent_ids}
+        if self.continuous:
+            act_space = spaces.Box(0.0, 1.0, (1,), np.float32)
+        else:
+            act_space = spaces.Discrete(2)
+        self.action_spaces = {a: act_space for a in self.agent_ids}
+        self._init_tables()
+
+    # -- obs plumbing ---------------------------------------------------- #
+    def _emit_one(self, v):
+        if self.obs_kind == "vector":
+            return jnp.full((1,), v, jnp.float32)
+        return jnp.full(_IMG_SHAPE, v, jnp.float32)
 
     def _obs_dict(self, state):
-        return {a: state.obs[i] for i, a in enumerate(self.agent_ids)}
+        return {a: self._emit_one(state.v[i]) for i, a in enumerate(self.agent_ids)}
 
-    def reset_fn(self, key):
-        state = _MAState(jnp.zeros((self.n_agents, self.obs_dim)), jnp.int32(0))
-        return state, self._obs_dict(state)
+    def raw_obs(self, vs):
+        """Host-side dict obs for the tables; vs = per-agent scalars."""
+        out = {}
+        for a, v in zip(self.agent_ids, vs):
+            if self.obs_kind == "vector":
+                out[a] = np.full((1,), v, np.float32)
+            else:
+                out[a] = np.full(_IMG_SHAPE, v, np.float32)
+        return out
 
     def _done(self, val=True):
         return {a: jnp.bool_(val) for a in self.agent_ids}
 
+    def reset_fn(self, key):
+        state = _MAState(jnp.zeros(self.n_agents), jnp.int32(0))
+        return state, self._obs_dict(state)
 
-class ConstantRewardEnvMA(_MAProbeBase):
-    """Every agent gets reward 1 every (single-step) episode."""
+    def _cont_a(self, action):
+        a = jnp.asarray(action)
+        return a.reshape(-1)[0] if a.ndim else a
+
+    def _init_tables(self):
+        self.sample_obs = []
+        self.policy_values = None
+        self.v_values = None
+
+
+class _RandomBitsMixin:
+    """reset: independent bernoulli bit per agent."""
+
+    def reset_fn(self, key):
+        v = jax.random.bernoulli(key, shape=(self.n_agents,)).astype(jnp.float32)
+        return _MAState(v, jnp.int32(0)), self._obs_dict(_MAState(v, jnp.int32(0)))
+
+
+# --------------------------------------------------------------------------- #
+# Families
+# --------------------------------------------------------------------------- #
+
+
+class _ConstantRewardMA(_MAProbeBase):
+    """Every agent gets reward 1 every single-step episode: critics -> 1."""
 
     def step_fn(self, state, actions, key):
         rewards = {a: jnp.float32(1.0) for a in self.agent_ids}
         return state, self._obs_dict(state), rewards, self._done(), self._done(False)
 
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs([0.0] * self.n_agents)]
+        self.v_values = [{a: 1.0 for a in self.agent_ids}]
 
-class ObsDependentRewardEnvMA(_MAProbeBase):
-    """Reward +-1 depends on each agent's own observation."""
 
-    def reset_fn(self, key):
-        obs = jax.random.bernoulli(key, shape=(self.n_agents, 1)).astype(jnp.float32)
-        state = _MAState(obs, jnp.int32(0))
-        return state, self._obs_dict(state)
+class _ObsDependentRewardMA(_RandomBitsMixin, _MAProbeBase):
+    """Reward +-1 fixed by each agent's own observation bit."""
 
     def step_fn(self, state, actions, key):
         rewards = {
-            a: jnp.where(state.obs[i, 0] > 0.5, 1.0, -1.0)
+            a: jnp.where(state.v[i] > 0.5, 1.0, -1.0)
             for i, a in enumerate(self.agent_ids)
         }
         return state, self._obs_dict(state), rewards, self._done(), self._done(False)
 
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs([0.0, 0.0]), self.raw_obs([1.0, 1.0])]
+        self.v_values = [
+            {a: -1.0 for a in self.agent_ids},
+            {a: 1.0 for a in self.agent_ids},
+        ]
 
-class PolicyEnvMA(_MAProbeBase):
-    """Reward depends on each agent matching its own observation bit."""
 
-    def reset_fn(self, key):
-        obs = jax.random.bernoulli(key, shape=(self.n_agents, 1)).astype(jnp.float32)
-        state = _MAState(obs, jnp.int32(0))
-        return state, self._obs_dict(state)
+class _DiscountedRewardMA(_MAProbeBase):
+    """Two steps; reward 1 only on the second: value(s0) = gamma * value(s1)."""
+
+    max_episode_steps = 2
+    checks_discounting = True
+
+    def step_fn(self, state, actions, key):
+        t = state.t + 1
+        v = jnp.full(self.n_agents, t.astype(jnp.float32))
+        reward = jnp.where(t >= 2, 1.0, 0.0)
+        rewards = {a: reward for a in self.agent_ids}
+        done = {a: t >= 2 for a in self.agent_ids}
+        return (
+            _MAState(v, t), self._obs_dict(_MAState(v, t)), rewards, done,
+            self._done(False),
+        )
+
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs([0.0, 0.0]), self.raw_obs([1.0, 1.0])]
+
+
+class _FixedObsPolicyMA(_MAProbeBase):
+    """Fixed obs; each agent's ACTION sets its reward.
+    discrete: action 0 -> +1 else -1; continuous: r = -(a - 0.5)^2."""
+
+    def step_fn(self, state, actions, key):
+        rewards = {}
+        for a in self.agent_ids:
+            if self.continuous:
+                rewards[a] = -jnp.square(self._cont_a(actions[a]) - 0.5)
+            else:
+                rewards[a] = jnp.where(jnp.asarray(actions[a]) == 0, 1.0, -1.0)
+        return state, self._obs_dict(state), rewards, self._done(), self._done(False)
+
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs([0.0] * self.n_agents)]
+        if self.continuous:
+            self.policy_values = [
+                {a: np.full((1,), 0.5, np.float32) for a in self.agent_ids}
+            ]
+        else:
+            self.policy_values = [{a: 0 for a in self.agent_ids}]
+
+
+class _PolicyMA(_RandomBitsMixin, _MAProbeBase):
+    """Each agent must match its own observation bit.
+    discrete: act == bit; continuous: r = -(a - bit)^2."""
 
     def step_fn(self, state, actions, key):
         rewards = {}
         for i, a in enumerate(self.agent_ids):
-            correct = (state.obs[i, 0] > 0.5).astype(jnp.int32)
-            rewards[a] = jnp.where(actions[a] == correct, 1.0, -1.0)
+            if self.continuous:
+                rewards[a] = -jnp.square(self._cont_a(actions[a]) - state.v[i])
+            else:
+                rewards[a] = jnp.where(
+                    jnp.asarray(actions[a]) == state.v[i].astype(jnp.int32), 1.0, -1.0
+                )
         return state, self._obs_dict(state), rewards, self._done(), self._done(False)
+
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs([0.0, 0.0]), self.raw_obs([1.0, 1.0])]
+        if self.continuous:
+            self.policy_values = [
+                {a: np.zeros((1,), np.float32) for a in self.agent_ids},
+                {a: np.ones((1,), np.float32) for a in self.agent_ids},
+            ]
+        else:
+            self.policy_values = [
+                {a: 0 for a in self.agent_ids},
+                {a: 1 for a in self.agent_ids},
+            ]
+
+
+class _MultiPolicyMA(_RandomBitsMixin, _MAProbeBase):
+    """Joint-action probe (parity: probe_envs_ma.py MultiPolicyEnv:1542): an
+    agent is rewarded only when EVERY agent matches its own bit — the
+    centralized critic must model the joint action."""
+
+    def step_fn(self, state, actions, key):
+        if self.continuous:
+            errs = [
+                jnp.square(self._cont_a(actions[a]) - state.v[i])
+                for i, a in enumerate(self.agent_ids)
+            ]
+            joint = -sum(errs)
+            rewards = {a: joint for a in self.agent_ids}
+        else:
+            matches = [
+                jnp.asarray(actions[a]) == state.v[i].astype(jnp.int32)
+                for i, a in enumerate(self.agent_ids)
+            ]
+            all_match = jnp.all(jnp.stack(matches))
+            rewards = {a: jnp.where(all_match, 1.0, -1.0) for a in self.agent_ids}
+        return state, self._obs_dict(state), rewards, self._done(), self._done(False)
+
+    def _init_tables(self):
+        super()._init_tables()
+        self.sample_obs = [self.raw_obs([0.0, 0.0]), self.raw_obs([1.0, 1.0])]
+        if self.continuous:
+            self.policy_values = [
+                {a: np.zeros((1,), np.float32) for a in self.agent_ids},
+                {a: np.ones((1,), np.float32) for a in self.agent_ids},
+            ]
+        else:
+            self.policy_values = [
+                {a: 0 for a in self.agent_ids},
+                {a: 1 for a in self.agent_ids},
+            ]
+
+
+# --------------------------------------------------------------------------- #
+# Named variants (22-class parity with probe_envs_ma.py; *MA suffix because
+# the single-agent grid shares this package's namespace)
+# --------------------------------------------------------------------------- #
+
+
+def _variant(base, name, kind, continuous):
+    cls = type(name, (base,), {"obs_kind": kind, "continuous": continuous})
+    cls.__module__ = __name__
+    return cls
+
+
+_FAMILIES = {
+    "ConstantReward": _ConstantRewardMA,
+    "ObsDependentReward": _ObsDependentRewardMA,
+    "DiscountedReward": _DiscountedRewardMA,
+    "FixedObsPolicy": _FixedObsPolicyMA,
+    "Policy": _PolicyMA,
+}
+
+for _fam, _base in _FAMILIES.items():
+    for _img in (False, True):
+        for _cont in (False, True):
+            _name = (
+                f"{_fam}{'ContActions' if _cont else ''}"
+                f"{'Image' if _img else ''}EnvMA"
+            )
+            globals()[_name] = _variant(
+                _base, _name, "image" if _img else "vector", _cont
+            )
+
+MultiPolicyEnvMA = _variant(_MultiPolicyMA, "MultiPolicyEnvMA", "vector", False)
+MultiPolicyImageEnvMA = _variant(_MultiPolicyMA, "MultiPolicyImageEnvMA", "image", False)
+
+
+# --------------------------------------------------------------------------- #
+# Check functions (parity: probe_envs_ma.py:1867,1958)
+# --------------------------------------------------------------------------- #
+
+
+def _fill_ma_buffer(env, vec, buf, steps: int, seed: int):
+    rng = np.random.default_rng(seed)
+    n = vec.num_envs
+    obs, _ = vec.reset(seed=seed)
+    for _ in range(steps):
+        actions = {}
+        for a in env.agent_ids:
+            space = env.action_spaces[a]
+            if isinstance(space, spaces.Box):
+                actions[a] = rng.uniform(
+                    space.low, space.high, size=(n,) + space.shape
+                ).astype(np.float32)
+            else:
+                actions[a] = rng.integers(0, space.n, size=n)
+        next_obs, rew, term, trunc, _ = vec.step(actions)
+        done = {a: np.asarray(term[a], np.float32) for a in env.agent_ids}
+        buf.save_to_memory(obs, actions, rew, next_obs, done, is_vectorised=True)
+        obs = next_obs
+    return buf
+
+
+def _batch_one(obs_dict):
+    return {a: np.asarray(o)[None] for a, o in obs_dict.items()}
 
 
 def check_ma_q_learning_with_probe_env(
-    env, algo_class, algo_args: dict, learn_steps: int = 300, seed: int = 42
+    env, algo_class, algo_args: dict, learn_steps: int = 300, seed: int = 42,
+    atol: float = 0.25,
 ) -> None:
-    """Train a multi-agent algorithm on a probe env and assert critic values
-    (parity: probe_envs_ma.py check fns)."""
+    """Train a multi-agent off-policy algorithm (MADDPG/MATD3) on a probe env;
+    assert critic values and/or per-agent policies against the env tables
+    (parity: probe_envs_ma.py:1867)."""
     from agilerl_tpu.components import MultiAgentReplayBuffer
     from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv
 
@@ -95,26 +321,78 @@ def check_ma_q_learning_with_probe_env(
     vec.action_spaces = env.action_spaces
     agent = algo_class(**algo_args)
     buf = MultiAgentReplayBuffer(max_size=2048, agent_ids=env.agent_ids)
-    obs, _ = vec.reset(seed=seed)
-    rng = np.random.default_rng(seed)
-    for _ in range(64):
-        actions = {a: rng.integers(0, 2, size=8) for a in env.agent_ids}
-        next_obs, rew, term, trunc, _ = vec.step(actions)
-        done = {a: np.asarray(term[a], np.float32) for a in env.agent_ids}
-        buf.save_to_memory(obs, actions, rew, next_obs, done, is_vectorised=True)
-        obs = next_obs
+    _fill_ma_buffer(env, vec, buf, steps=64, seed=seed)
     for _ in range(learn_steps):
         agent.learn(buf.sample(64))
-    # constant-reward probe: every centralized critic must predict ~1
-    if isinstance(env, ConstantRewardEnvMA):
-        from agilerl_tpu.networks.base import EvolvableNetwork
 
-        n_in = agent.critics[env.agent_ids[0]].config.encoder.num_inputs
-        q = np.asarray(
-            EvolvableNetwork.apply(
-                agent.critics[env.agent_ids[0]].config,
-                agent.critics[env.agent_ids[0]].params,
-                jnp.zeros((1, n_in)),
-            )
-        )
-        np.testing.assert_allclose(q, 1.0, atol=0.25)
+    if getattr(env, "checks_discounting", False):
+        # value(s0) must equal gamma * value(s1), value(s1) ~ 1 (per agent)
+        v0 = agent.critic_values(_batch_one(env.sample_obs[0]))
+        v1 = agent.critic_values(_batch_one(env.sample_obs[1]))
+        for a in env.agent_ids:
+            q1 = float(np.asarray(v1[a]).reshape(-1)[0])
+            q0 = float(np.asarray(v0[a]).reshape(-1)[0])
+            np.testing.assert_allclose(q1, 1.0, atol=atol)
+            np.testing.assert_allclose(q0, agent.gamma * q1, atol=atol)
+    if env.v_values is not None:
+        # centralized critic value at the joint sample obs (uniform behavior
+        # policy): compare per agent
+        for obs_dict, vrow in zip(env.sample_obs, env.v_values):
+            preds = agent.critic_values(_batch_one(obs_dict))
+            for a, want in vrow.items():
+                np.testing.assert_allclose(
+                    float(np.asarray(preds[a]).reshape(-1)[0]), want, atol=atol
+                )
+    if env.policy_values is not None:
+        for obs_dict, prow in zip(env.sample_obs, env.policy_values):
+            acts = agent.get_action(_batch_one(obs_dict), training=False)
+            for a, want in prow.items():
+                if want is None:
+                    continue
+                got = np.asarray(acts[a]).reshape(-1)
+                if isinstance(env.action_spaces[a], spaces.Discrete):
+                    assert int(got[0]) == int(want), (a, got, want)
+                else:
+                    np.testing.assert_allclose(got, want, atol=atol)
+
+
+def check_ma_on_policy_with_probe_env(
+    env, algo_class, algo_args: dict, train_iters: int = 60, seed: int = 42,
+    atol: float = 0.2, solved_reward: Optional[float] = 0.95,
+) -> None:
+    """Train a multi-agent on-policy algorithm (IPPO) on a probe env and assert
+    per-agent deterministic policies (parity: probe_envs_ma.py:1958).
+
+    Stops once the mean episodic reward stays >= ``solved_reward`` for three
+    consecutive iterations: on a SOLVED one-step probe the advantages are pure
+    bootstrap noise, and PPO-family updates on normalised noise destabilise a
+    perfect policy — the probe asserts the mapping is learnable, so train-to-
+    solve is the correct budget (same role as `target` in the trainers)."""
+    from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv
+
+    vec = MultiAgentJaxVecEnv(env, num_envs=8, seed=seed)
+    vec.observation_spaces = env.observation_spaces
+    vec.action_spaces = env.action_spaces
+    agent = algo_class(**algo_args)
+    solved_streak = 0
+    for _ in range(train_iters):
+        mean_rew = agent.collect_rollouts(vec)
+        agent.learn()
+        if solved_reward is not None and mean_rew >= solved_reward:
+            solved_streak += 1
+            if solved_streak >= 3:
+                break
+        else:
+            solved_streak = 0
+
+    assert env.policy_values is not None
+    for obs_dict, prow in zip(env.sample_obs, env.policy_values):
+        acts = agent.get_action(_batch_one(obs_dict), training=False)
+        for a, want in prow.items():
+            if want is None:
+                continue
+            got = np.asarray(acts[a]).reshape(-1)
+            if isinstance(env.action_spaces[a], spaces.Discrete):
+                assert int(got[0]) == int(want), (a, got, want)
+            else:
+                np.testing.assert_allclose(got, want, atol=atol)

@@ -47,6 +47,20 @@ class CNNConfig:
         assert (
             len(self.channel_size) == len(self.kernel_size) == len(self.stride_size)
         ), "channel/kernel/stride must align"
+        # a conv stack that collapses the spatial dims to zero would silently
+        # degenerate to a bias-only (input-independent!) network — the dense
+        # head on 0 flattened features still "works" (review finding: the
+        # multi-input probe's image key was invisible to the agent)
+        h, w, _ = self.input_shape
+        for k, s in zip(self.kernel_size, self.stride_size):
+            h = L.conv_out_size(h, k, s)
+            w = L.conv_out_size(w, k, s)
+        if h < 1 or w < 1:
+            raise ValueError(
+                f"CNN arch collapses {self.input_shape[:2]} spatial dims to "
+                f"({h}, {w}) — reduce kernel/stride or layer count "
+                f"(kernels {self.kernel_size}, strides {self.stride_size})"
+            )
 
 
 def _spatial_dims(config: CNNConfig) -> Tuple[int, int]:

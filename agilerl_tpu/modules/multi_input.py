@@ -55,12 +55,20 @@ def _build_sub_configs(
     subs = []
     for key, space in items:
         if is_image_space(space):
+            h, w, _ = image_shape_nhwc(space)
+            # scale the default stack to the image: tiny probe-sized images
+            # need kernel<=min(h,w) and stride 1 or the spatial dims collapse
+            if min(h, w) >= 8:
+                channel, kernel, stride = (16, 16), (3, 3), (2, 2)
+            else:
+                k = min(2, h, w)
+                channel, kernel, stride = (8,), (k,), (1,)
             cfg = CNNConfig(
                 input_shape=image_shape_nhwc(space),
                 num_outputs=feature_dim,
-                channel_size=(16, 16),
-                kernel_size=(3, 3),
-                stride_size=(2, 2),
+                channel_size=channel,
+                kernel_size=kernel,
+                stride_size=stride,
             )
             subs.append((key, "cnn", cfg))
         else:
