@@ -212,6 +212,200 @@ class ILQL(EvolvableAlgorithm):
         return np.asarray(act_fn(self.actor.params, jnp.asarray(tokens),
                                  jnp.asarray(mask), key, jnp.float32(q_scale)))
 
+    # ------------------------------------------------------------------ #
+    # Acting policies: full-sequence generation over the Q/V-reweighted LM
+    # (parity: ILQL_Policy beam/sample, agilerl/algorithms/ilql.py:1308-1500)
+    # ------------------------------------------------------------------ #
+
+    def _score_fn(self):
+        """Per-position policy scores: log pi + q_scale * (Q - V)."""
+        config = self.model_config
+
+        def scores(params, tokens, mask, q_scale):
+            hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
+            logits = M.logits_fn(config, params["gpt"], hidden)
+            qs = L.dense_apply(params["q_head"], hidden)
+            vs = L.dense_apply(params["v_head"], hidden)
+            return jax.nn.log_softmax(logits, axis=-1) + q_scale * (qs - vs)
+
+        return scores
+
+    def _sample_loop_fn(self, max_new_tokens: int, pad_id: int, eos_id: int):
+        scores_fn = self._score_fn()
+
+        @jax.jit
+        def run(params, tokens, mask, key, q_scale, temperature):
+            B, Lbuf = tokens.shape
+            lens = mask.sum(axis=-1).astype(jnp.int32)
+
+            def body(carry, _):
+                tokens, mask, lens, alive, key = carry
+                key, k = jax.random.split(key)
+                sc = scores_fn(params, tokens, mask, q_scale)  # [B, L, V]
+                last = jnp.take_along_axis(
+                    sc, (lens - 1)[:, None, None], axis=1
+                )[:, 0]  # [B, V]
+                greedy = jnp.argmax(last, axis=-1)
+                sampled = jax.random.categorical(
+                    k, last / jnp.maximum(temperature, 1e-6), axis=-1
+                )
+                tok = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+                tok = jnp.where(alive, tok, pad_id)
+                rows = jnp.arange(B)
+                write = jnp.minimum(lens, Lbuf - 1)
+                tokens = tokens.at[rows, write].set(
+                    jnp.where(alive, tok, tokens[rows, write])
+                )
+                mask = mask.at[rows, write].set(
+                    jnp.where(alive, 1, mask[rows, write])
+                )
+                lens = lens + alive.astype(jnp.int32)
+                alive = alive & (tok != eos_id) & (lens < Lbuf)
+                return (tokens, mask, lens, alive, key), tok
+
+            alive = jnp.ones((B,), bool)
+            (tokens, mask, lens, _, _), toks = jax.lax.scan(
+                body, (tokens, mask, lens, alive, key), None,
+                length=max_new_tokens,
+            )
+            return tokens, mask, toks.T  # completions [B, N]
+
+        return run
+
+    def _beam_loop_fn(self, max_new_tokens: int, beam_width: int, pad_id: int,
+                      eos_id: int):
+        scores_fn = self._score_fn()
+        W = beam_width
+
+        @jax.jit
+        def run(params, tokens, mask, q_scale):
+            B, Lbuf = tokens.shape
+            V = self.model_config.vocab_size
+            beams = jnp.repeat(tokens[:, None], W, axis=1)  # [B, W, L]
+            bmask = jnp.repeat(mask[:, None], W, axis=1)
+            lens = jnp.repeat(mask.sum(-1).astype(jnp.int32)[:, None], W, axis=1)
+            # only beam 0 live at the first expansion so top-k doesn't pick W
+            # copies of the same token
+            scores = jnp.where(jnp.arange(W)[None] == 0, 0.0, -1e9) * jnp.ones((B, 1))
+            alive = jnp.ones((B, W), bool)
+            # finished beams may only "emit" pad at no cost
+            stay = jnp.where(jnp.arange(V) == pad_id, 0.0, -1e9)
+
+            def body(carry, _):
+                beams, bmask, lens, scores, alive = carry
+                flat_t = beams.reshape(B * W, Lbuf)
+                flat_m = bmask.reshape(B * W, Lbuf)
+                sc = scores_fn(params, flat_t, flat_m, q_scale)
+                last = jnp.take_along_axis(
+                    sc, (lens.reshape(-1) - 1)[:, None, None], axis=1
+                )[:, 0].reshape(B, W, V)
+                step = jnp.where(alive[..., None], last, stay[None, None])
+                cand = (scores[..., None] + step).reshape(B, W * V)
+                top_sc, top_ix = jax.lax.top_k(cand, W)  # [B, W]
+                src = top_ix // V
+                tok = (top_ix % V).astype(jnp.int32)
+                gather = lambda x: jnp.take_along_axis(  # noqa: E731
+                    x, src.reshape(B, W, *([1] * (x.ndim - 2))), axis=1
+                )
+                beams, bmask, lens, alive = (
+                    gather(beams), gather(bmask), gather(lens), gather(alive),
+                )
+                rows = jnp.arange(B)[:, None]
+                cols = jnp.arange(W)[None]
+                write = jnp.minimum(lens, Lbuf - 1)
+                put = alive & (tok != pad_id)
+                beams = beams.at[rows, cols, write].set(
+                    jnp.where(put, tok, beams[rows, cols, write])
+                )
+                bmask = bmask.at[rows, cols, write].set(
+                    jnp.where(put, 1, bmask[rows, cols, write])
+                )
+                lens = lens + put.astype(jnp.int32)
+                alive = alive & (tok != eos_id) & (tok != pad_id) & (lens < Lbuf)
+                return (beams, bmask, lens, top_sc, alive), None
+
+            (beams, bmask, lens, scores, _), _ = jax.lax.scan(
+                body, (beams, bmask, lens, scores, alive), None,
+                length=max_new_tokens,
+            )
+            best = jnp.argmax(scores, axis=-1)
+            pick = lambda x: jnp.take_along_axis(  # noqa: E731
+                x, best.reshape(B, *([1] * (x.ndim - 1))), axis=1
+            )[:, 0]
+            return pick(beams), pick(bmask), pick(scores[..., None])[..., 0]
+
+        return run
+
+    def generate(
+        self,
+        prompt_tokens: np.ndarray,
+        prompt_mask: np.ndarray,
+        max_new_tokens: int = 16,
+        mode: str = "sample",
+        q_scale: float = 1.0,
+        temperature: float = 1.0,
+        beam_width: int = 4,
+        eos_id: Optional[int] = None,
+        pad_id: int = 0,
+        key=None,
+    ):
+        """Full-sequence acting policy over the Q/V-reweighted LM
+        (parity: ILQL_Policy, ilql.py:1308 — sample_raw/beam_raw collapse into
+        two jitted lax.scan programs; the per-step full re-forward trades the
+        reference's KV-cache plumbing for static shapes — the flagship KV-cache
+        decode lives in llm/generate.py).
+
+        mode: "sample" (temperature>0) | "greedy" (sample with temperature=0) |
+        "beam" (width ``beam_width``, cumulative reweighted-score search).
+        Returns (tokens [B, P+N], mask). Prompts must be right-padded.
+        """
+        assert mode in ("sample", "greedy", "beam"), mode
+        eos = self.model_config.vocab_size - 1 if eos_id is None else int(eos_id)
+        P = np.asarray(prompt_tokens).shape[1]
+        Lbuf = P + int(max_new_tokens)
+        B = np.asarray(prompt_tokens).shape[0]
+        tokens = np.full((B, Lbuf), pad_id, np.int32)
+        tokens[:, :P] = np.asarray(prompt_tokens)
+        mask = np.zeros((B, Lbuf), np.int32)
+        mask[:, :P] = np.asarray(prompt_mask)
+        if mode == "beam":
+            run = self.jit_fn(
+                f"beam_{max_new_tokens}_{beam_width}_{pad_id}_{eos}",
+                lambda: self._beam_loop_fn(max_new_tokens, beam_width, pad_id, eos),
+            )
+            toks, msk, scores = run(
+                self.actor.params, jnp.asarray(tokens), jnp.asarray(mask),
+                jnp.float32(q_scale),
+            )
+            return np.asarray(toks), np.asarray(msk)
+        run = self.jit_fn(
+            f"sample_{max_new_tokens}_{pad_id}_{eos}",
+            lambda: self._sample_loop_fn(max_new_tokens, pad_id, eos),
+        )
+        temp = 0.0 if mode == "greedy" else float(temperature)
+        key = key if key is not None else self.next_key()
+        toks, msk, _ = run(
+            self.actor.params, jnp.asarray(tokens), jnp.asarray(mask), key,
+            jnp.float32(q_scale), jnp.float32(temp),
+        )
+        return np.asarray(toks), np.asarray(msk)
+
+
+class ILQL_Policy:
+    """Thin acting-policy wrapper (parity: agilerl/algorithms/ilql.py:1308
+    ILQL_Policy(kind='beam'|'sample'))."""
+
+    def __init__(self, iql_model: "ILQL", kind: str = "sample", **generation_kwargs):
+        assert kind in ("beam", "sample", "greedy")
+        self.iql_model = iql_model
+        self.kind = kind
+        self.generation_kwargs = dict(generation_kwargs)
+
+    def act(self, prompt_tokens, prompt_mask):
+        return self.iql_model.generate(
+            prompt_tokens, prompt_mask, mode=self.kind, **self.generation_kwargs
+        )
+
 
 class BC_LM(EvolvableAlgorithm):
     """Behavioural-cloning language model (legacy; parity:

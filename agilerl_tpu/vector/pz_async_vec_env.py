@@ -1,12 +1,16 @@
 """Async (multiprocessing) PettingZoo vectorisation
 (parity: agilerl/vector/pz_async_vec_env.py — AsyncPettingZooVecEnv:79, worker
-loop _async_worker:906, pipe control, shared-memory observation buffers
-create_shared_memory:733, autoreset, error propagation _raise_if_errors:541).
+loop _async_worker:906, pipe control, typed shared-memory observation buffers
+create_shared_memory:733, autoreset with final-observation propagation,
+dead-agent placeholders get_placeholder_value:765, error propagation
+_raise_if_errors:541).
 
-Workers write observations into a shared multiprocessing.Array per agent (the
-reference's shared-memory design), commands travel over pipes. On TPU hosts the
-env processes overlap with device compute exactly like the reference overlaps
-with CUDA streams.
+Observations travel through per-agent, per-leaf typed shared-memory blocks
+(Dict/Tuple spaces decompose into leaves, each with its own dtype — parity with
+the reference's per-space typed segments); commands and small payloads
+(rewards, infos, final observations at episode ends) travel over pipes. On TPU
+hosts the env processes overlap with device compute exactly like the reference
+overlaps with CUDA streams.
 """
 
 from __future__ import annotations
@@ -14,7 +18,7 @@ from __future__ import annotations
 import enum
 import multiprocessing as mp
 import traceback
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -25,25 +29,100 @@ class AsyncState(enum.Enum):
     WAITING_STEP = "step"
 
 
-def _flatdim(space) -> int:
+# ctypes typecodes for the shared Arrays, keyed by numpy dtype name
+_TYPECODES = {
+    "float32": "f", "float64": "d",
+    "int8": "b", "int16": "h", "int32": "i", "int64": "q",
+    "uint8": "B", "uint16": "H", "uint32": "I", "uint64": "Q",
+    "bool": "B",  # stored as uint8, cast back on read
+}
+
+
+def _space_leaves(space, prefix: str = "") -> List[Tuple[str, np.dtype, tuple]]:
+    """Flatten a (possibly Dict/Tuple) space into (key, dtype, shape) leaves."""
     from gymnasium import spaces as S
 
+    if isinstance(space, S.Dict):
+        out = []
+        for k in space.spaces:
+            out.extend(_space_leaves(space.spaces[k], f"{prefix}{k}."))
+        return out
+    if isinstance(space, S.Tuple):
+        out = []
+        for i, sub in enumerate(space.spaces):
+            out.extend(_space_leaves(sub, f"{prefix}{i}."))
+        return out
     if isinstance(space, S.Discrete):
-        return 1
-    return int(np.prod(space.shape)) if space.shape else 1
+        return [(prefix, np.dtype(space.dtype or np.int64), ())]
+    shape = tuple(space.shape) if space.shape else ()
+    return [(prefix, np.dtype(space.dtype or np.float32), shape)]
 
 
-def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, obs_dims):
+def _obs_leaves(space, obs) -> List[np.ndarray]:
+    """Walk an observation in the same order as _space_leaves."""
+    from gymnasium import spaces as S
+
+    if isinstance(space, S.Dict):
+        out = []
+        for k in space.spaces:
+            out.extend(_obs_leaves(space.spaces[k], obs[k]))
+        return out
+    if isinstance(space, S.Tuple):
+        out = []
+        for i, sub in enumerate(space.spaces):
+            out.extend(_obs_leaves(sub, obs[i]))
+        return out
+    return [np.asarray(obs)]
+
+
+def _rebuild_obs(space, leaves: List[np.ndarray]):
+    """Inverse of _obs_leaves for batched [N, ...] leaf arrays (consumes from
+    the front of `leaves`)."""
+    from gymnasium import spaces as S
+
+    if isinstance(space, S.Dict):
+        return {k: _rebuild_obs(space.spaces[k], leaves) for k in space.spaces}
+    if isinstance(space, S.Tuple):
+        return tuple(_rebuild_obs(sub, leaves) for sub in space.spaces)
+    return leaves.pop(0)
+
+
+def placeholder_obs(space):
+    """Zeros-shaped observation for an agent absent from a step's dicts
+    (parity: get_placeholder_value:765)."""
+    from gymnasium import spaces as S
+
+    if isinstance(space, S.Dict):
+        return {k: placeholder_obs(space.spaces[k]) for k in space.spaces}
+    if isinstance(space, S.Tuple):
+        return tuple(placeholder_obs(sub) for sub in space.spaces)
+    if isinstance(space, S.Discrete):
+        return np.zeros((), dtype=space.dtype or np.int64)
+    return np.zeros(space.shape or (), dtype=space.dtype or np.float32)
+
+
+def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, spaces_by_agent):
     """Worker loop (parity: pz_async_vec_env.py:906)."""
     parent_pipe.close()
     env = env_fn()
+    # the leaf layout is static for the worker's lifetime — don't re-walk the
+    # space tree on every step
+    leaves_by_agent = {a: _space_leaves(spaces_by_agent[a]) for a in agents}
 
     def write_obs(obs):
         for a in agents:
-            arr = np.frombuffer(shm[a].get_obj(), dtype=np.float32)
-            dim = obs_dims[a]
-            flat = np.asarray(obs.get(a, np.zeros(dim)), np.float32).reshape(-1)
-            arr[index * dim : (index + 1) * dim] = flat[:dim]
+            space = spaces_by_agent[a]
+            value = obs.get(a) if isinstance(obs, dict) else None
+            if value is None:
+                value = placeholder_obs(space)
+            leaves = _obs_leaves(space, value)
+            for (key, dtype, shape), leaf in zip(leaves_by_agent[a], leaves):
+                block, np_dtype = shm[a][key]
+                size = int(np.prod(shape)) if shape else 1
+                arr = np.frombuffer(block.get_obj(), dtype=np_dtype)
+                arr[index * size : (index + 1) * size] = np.asarray(
+                    leaf, np_dtype
+                ).reshape(-1)
 
     try:
         while True:
@@ -51,17 +130,30 @@ def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, obs_dims):
             if cmd == "reset":
                 obs, info = env.reset(seed=data)
                 write_obs(obs)
-                pipe.send(((), True))
+                pipe.send((({a: info.get(a, {}) for a in agents}
+                            if isinstance(info, dict) else {}), True))
             elif cmd == "step":
                 action = {a: data[a] for a in env.agents} if env.agents else data
-                obs, rew, term, trunc, _ = env.step(action)
-                if not env.agents:  # autoreset
+                obs, rew, term, trunc, info = env.step(action)
+                final_obs = None
+                if not env.agents:  # episode over for every agent: autoreset
+                    # capture the TRUE final observations before reset —
+                    # without them MA off-policy bootstrap targets at episode
+                    # boundaries would use the next episode's reset obs
+                    final_obs = {
+                        a: np.asarray(v, copy=True) if not isinstance(v, (dict, tuple))
+                        else v
+                        for a, v in obs.items()
+                    }
                     obs, _ = env.reset()
                 write_obs(obs)
                 out = (
                     {a: float(rew.get(a, 0.0)) for a in agents},
                     {a: bool(term.get(a, False)) for a in agents},
                     {a: bool(trunc.get(a, False)) for a in agents},
+                    {a: info.get(a, {}) for a in agents}
+                    if isinstance(info, dict) else {},
+                    final_obs,
                 )
                 pipe.send((out, True))
             elif cmd == "close":
@@ -83,17 +175,24 @@ class AsyncPettingZooVecEnv:
         self.action_spaces = {a: probe.action_space(a) for a in self.agents}
         self.agent_ids = self.agents
         probe.close()
-        self._obs_dims = {a: _flatdim(self.observation_spaces[a]) for a in self.agents}
-        # shared-memory observation buffers (parity: create_shared_memory:733)
-        self._shm = {
-            a: ctx.Array("f", self.num_envs * self._obs_dims[a]) for a in self.agents
-        }
+        # typed shared-memory blocks, one per (agent, space leaf)
+        # (parity: create_shared_memory:733 — the reference types segments per
+        # sub-space; float32-flattening would corrupt int/uint8/Dict obs)
+        self._shm: Dict[str, Dict[str, tuple]] = {}
+        for a in self.agents:
+            self._shm[a] = {}
+            for key, dtype, shape in _space_leaves(self.observation_spaces[a]):
+                np_dtype = np.dtype("uint8") if dtype == np.dtype(bool) else dtype
+                code = _TYPECODES[dtype.name]
+                size = int(np.prod(shape)) if shape else 1
+                self._shm[a][key] = (ctx.Array(code, self.num_envs * size), np_dtype)
         self._pipes, self._procs = [], []
         for i, fn in enumerate(env_fns):
             parent, child = ctx.Pipe()
             proc = ctx.Process(
                 target=_async_worker,
-                args=(i, fn, child, parent, self._shm, self.agents, self._obs_dims),
+                args=(i, fn, child, parent, self._shm, self.agents,
+                      self.observation_spaces),
                 daemon=True,
             )
             proc.start()
@@ -120,16 +219,14 @@ class AsyncPettingZooVecEnv:
         out = {}
         for a in self.agents:
             space = self.observation_spaces[a]
-            arr = np.frombuffer(self._shm[a].get_obj(), dtype=np.float32).copy()
-            shape = space.shape
-            if shape and int(np.prod(shape)) == self._obs_dims[a]:
-                arr = arr.reshape(self.num_envs, *shape)
-            elif shape == ():  # Discrete and friends: scalar per env
-                arr = arr.reshape(self.num_envs)
-            else:
-                arr = arr.reshape(self.num_envs, self._obs_dims[a])
-            dtype = getattr(space, "dtype", None)
-            out[a] = arr.astype(dtype) if dtype is not None else arr
+            leaves = []
+            for key, dtype, shape in _space_leaves(space):
+                block, np_dtype = self._shm[a][key]
+                arr = np.frombuffer(block.get_obj(), dtype=np_dtype).copy()
+                if dtype == np.dtype(bool):
+                    arr = arr.astype(bool)
+                leaves.append(arr.reshape((self.num_envs,) + shape))
+            out[a] = _rebuild_obs(space, leaves)
         return out
 
     def reset(self, seed: Optional[int] = None, options=None):
@@ -138,7 +235,8 @@ class AsyncPettingZooVecEnv:
             pipe.send(("reset", None if seed is None else seed + i))
         results = [pipe.recv() for pipe in self._pipes]
         self._raise_if_errors(results)
-        return self._read_obs(), {}
+        infos = [r for r, ok in results]
+        return self._read_obs(), {"env_infos": infos}
 
     def step_async(self, actions: Dict[str, np.ndarray]) -> None:
         self._assert_is_running()
@@ -155,9 +253,34 @@ class AsyncPettingZooVecEnv:
         results = [pipe.recv() for pipe in self._pipes]
         self._raise_if_errors(results)
         self._state = AsyncState.DEFAULT
-        rews, terms, truncs = zip(*[r for r, ok in results])
+        rews, terms, truncs, env_infos, finals = zip(*[r for r, ok in results])
         stack = lambda ds: {a: np.array([d[a] for d in ds]) for a in self.agents}  # noqa: E731
-        return self._read_obs(), stack(rews), stack(terms), stack(truncs), {}
+        next_obs = self._read_obs()
+        info: Dict = {"env_infos": list(env_infos)}
+        if any(f is not None for f in finals):
+            # merged per-agent final-obs batch: the true pre-reset successor
+            # where an env just finished, the current obs elsewhere
+            final_obs = {}
+            for a in self.agents:
+                space = self.observation_spaces[a]
+                rows = [
+                    _obs_leaves(space, finals[i][a])
+                    if finals[i] is not None and a in finals[i]
+                    else None
+                    for i in range(self.num_envs)
+                ]
+                out_leaves = []
+                for li, (key, dtype, shape) in enumerate(_space_leaves(space)):
+                    block, np_dtype = self._shm[a][key]
+                    cur = np.frombuffer(block.get_obj(), dtype=np_dtype).copy()
+                    vals = cur.reshape((self.num_envs,) + shape).astype(dtype)
+                    for i in range(self.num_envs):
+                        if rows[i] is not None:
+                            vals[i] = np.asarray(rows[i][li], dtype).reshape(shape)
+                    out_leaves.append(vals)
+                final_obs[a] = _rebuild_obs(space, out_leaves)
+            info["final_obs"] = final_obs
+        return next_obs, stack(rews), stack(terms), stack(truncs), info
 
     def step(self, actions):
         self.step_async(actions)
@@ -169,7 +292,7 @@ class AsyncPettingZooVecEnv:
                 pipe.send(("close", None))
             for pipe in self._pipes:
                 pipe.recv()
-        except (BrokenPipeError, EOFError):
-            pass
+        except (BrokenPipeError, EOFError, ConnectionResetError):
+            pass  # workers already dead (e.g. after a propagated crash)
         for p in self._procs:
             p.join(timeout=2)

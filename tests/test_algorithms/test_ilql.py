@@ -57,6 +57,46 @@ def test_bc_lm_loss_decreases():
     assert comp.shape == (1, 4)
 
 
+def test_ilql_policy_generation_prefers_rewarded_tokens():
+    """VERDICT #6: the acting policy (sample/greedy/beam over the Q/V-
+    reweighted LM) must select the reward-preferred continuation after
+    training on a dataset where only '8' is rewarded for prompt '7+1='."""
+    from agilerl_tpu.algorithms.ilql import ILQL_Policy
+
+    good = TOK.encode("8")[0]
+    obs = []
+    for _ in range(16):
+        obs.append(Language_Observation(sequence=[("7+1=", None), ("8", 1.0)]))
+        obs.append(Language_Observation(sequence=[("7+1=", None), ("9", -1.0)]))
+    ds = RL_Dataset(obs, TOK, max_len=8)
+    agent = ILQL(config=CFG, lr=3e-3, gamma=0.9, cql_weight=0.0, seed=0)
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        agent.learn(ds.sample_batch(16, rng))
+
+    toks = np.asarray([TOK.encode("7+1=")] * 2, np.int32)
+    mask = np.ones_like(toks)
+    P = toks.shape[1]
+
+    # greedy and beam must both pick the rewarded token first
+    g_toks, g_mask = agent.generate(toks, mask, max_new_tokens=2, mode="greedy",
+                                    q_scale=2.0)
+    assert g_toks.shape == (2, P + 2)
+    assert (g_toks[:, P] == good).all(), g_toks[:, P]
+    assert (np.asarray(g_mask)[:, P] == 1).all()
+
+    policy = ILQL_Policy(agent, kind="beam", max_new_tokens=2, beam_width=3,
+                         q_scale=2.0)
+    b_toks, b_mask = policy.act(toks, mask)
+    assert b_toks.shape == (2, P + 2)
+    assert (b_toks[:, P] == good).all(), b_toks[:, P]
+
+    # sampling at low temperature should overwhelmingly agree
+    s_toks, _ = agent.generate(toks, mask, max_new_tokens=1, mode="sample",
+                               temperature=0.1, q_scale=2.0)
+    assert (s_toks[:, P] == good).all()
+
+
 def test_ilql_rewards_shape_q_values():
     """After the token-alignment fix, Q(prompt, good_token) must rise above
     Q(prompt, bad_token) when only 'good' completions are rewarded."""

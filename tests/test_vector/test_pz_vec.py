@@ -1,3 +1,5 @@
+import functools
+
 import numpy as np
 import pytest
 from gymnasium import spaces
@@ -63,4 +65,173 @@ def test_async_vec_env():
         obs, rew, term, trunc, _ = env.step(actions)
         assert obs["a_1"].shape == (2, 3)
         assert rew["a_0"].shape == (2,)
+    env.close()
+
+
+class DictObsParallelEnv(TinyParallelEnv):
+    """Dict observation space with mixed dtypes (float image + int flag)."""
+
+    def observation_space(self, agent):
+        return spaces.Dict({
+            "img": spaces.Box(0, 1, (2, 2, 1), np.float32),
+            "flag": spaces.Discrete(4),
+        })
+
+    def _obs(self):
+        return {
+            a: {"img": np.full((2, 2, 1), self._t, np.float32),
+                "flag": np.int64(self._t % 4)}
+            for a in self.agents
+        }
+
+    def reset(self, seed=None, options=None):
+        self.agents = list(self.possible_agents)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, actions):
+        self._t += 1
+        done = self._t >= self.episode_len
+        obs = self._obs()
+        rew = {a: 1.0 for a in self.agents}
+        term = {a: done for a in self.agents}
+        trunc = {a: False for a in self.agents}
+        if done:
+            self.agents = []
+        return obs, rew, term, trunc, {}
+
+
+class DyingAgentEnv(TinyParallelEnv):
+    """Agent a_1 dies (drops out of the dicts) after step 2."""
+
+    def step(self, actions):
+        self._t += 1
+        done = self._t >= self.episode_len
+        if self._t == 2:
+            self.agents = [a for a in self.agents if a != "a_1"]
+        obs = {a: np.full(3, self._t, np.float32) for a in self.agents}
+        rew = {a: 1.0 for a in self.agents}
+        term = {a: done for a in self.agents}
+        trunc = {a: False for a in self.agents}
+        if done:
+            self.agents = []
+        return obs, rew, term, trunc, {}
+
+
+class CrashingEnv(TinyParallelEnv):
+    def step(self, actions):
+        raise RuntimeError("worker exploded")
+
+
+def test_async_final_obs_at_autoreset():
+    """VERDICT #4: the TRUE final observation (pre-reset successor) must reach
+    the trainer — without it MA bootstrap targets at boundaries are corrupt."""
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    env = AsyncPettingZooVecEnv(
+        [functools.partial(TinyParallelEnv, episode_len=3) for _ in range(2)]
+    )
+    env.reset(seed=0)
+    for t in range(1, 3):
+        obs, rew, term, trunc, info = env.step(
+            {a: np.zeros(2, np.int64) for a in env.agents}
+        )
+        assert "final_obs" not in info
+    # 3rd step ends the episode in every env
+    obs, rew, term, trunc, info = env.step(
+        {a: np.zeros(2, np.int64) for a in env.agents}
+    )
+    assert trunc["a_0"].all()
+    # next_obs is the autoreset obs (t=0); final_obs is the true successor (t=3)
+    np.testing.assert_allclose(obs["a_0"], 0.0)
+    assert "final_obs" in info
+    np.testing.assert_allclose(info["final_obs"]["a_0"], 3.0)
+    np.testing.assert_allclose(info["final_obs"]["a_1"], 3.0)
+    env.close()
+
+
+def test_async_dict_obs_typed_shared_memory():
+    """Dict spaces decompose into typed shared-memory leaves; int leaves must
+    come back as ints, not float32-flattened."""
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    env = AsyncPettingZooVecEnv(
+        [functools.partial(DictObsParallelEnv, episode_len=4) for _ in range(2)]
+    )
+    obs, _ = env.reset(seed=0)
+    assert obs["a_0"]["img"].shape == (2, 2, 1, 1) or obs["a_0"]["img"].shape == (2, 2, 2, 1)
+    obs, rew, term, trunc, info = env.step(
+        {a: np.zeros(2, np.int64) for a in env.agents}
+    )
+    assert obs["a_0"]["img"].shape == (2, 2, 2, 1)
+    assert obs["a_0"]["img"].dtype == np.float32
+    np.testing.assert_allclose(obs["a_0"]["img"][:, 0, 0, 0], 1.0)
+    assert np.issubdtype(obs["a_0"]["flag"].dtype, np.integer)
+    np.testing.assert_array_equal(obs["a_0"]["flag"], [1, 1])
+    # final_obs carries the Dict structure too
+    for _ in range(3):
+        obs, rew, term, trunc, info = env.step(
+            {a: np.zeros(2, np.int64) for a in env.agents}
+        )
+    assert "final_obs" in info
+    np.testing.assert_allclose(info["final_obs"]["a_0"]["img"][:, 0, 0, 0], 4.0)
+    np.testing.assert_array_equal(info["final_obs"]["a_0"]["flag"], [0, 0])
+    env.close()
+
+
+def test_async_dead_agent_placeholder():
+    """An agent absent from a step's dicts gets a zero placeholder obs and
+    reward 0 (parity: get_placeholder_value:765)."""
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    env = AsyncPettingZooVecEnv([functools.partial(DyingAgentEnv, episode_len=4) for _ in range(2)])
+    env.reset(seed=0)
+    obs, rew, *_ = env.step({a: np.zeros(2, np.int64) for a in env.agents})
+    np.testing.assert_allclose(obs["a_1"], 1.0)  # still alive at t=1
+    obs, rew, *_ = env.step({a: np.zeros(2, np.int64) for a in env.agents})
+    np.testing.assert_allclose(obs["a_1"], 0.0)  # dead -> placeholder
+    np.testing.assert_allclose(rew["a_1"], 0.0)
+    np.testing.assert_allclose(obs["a_0"], 2.0)  # survivor unaffected
+    env.close()
+
+
+def test_async_worker_error_propagates():
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    env = AsyncPettingZooVecEnv([CrashingEnv for _ in range(2)])
+    env.reset(seed=0)
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        env.step({a: np.zeros(2, np.int64) for a in env.agents})
+    env.close()
+
+
+def test_ma_off_policy_buffer_purity_at_boundaries():
+    """e2e: transitions written through the async vec env must bootstrap from
+    the TRUE final obs at episode ends, never the autoreset obs (the MA mirror
+    of the single-agent final_obs test; VERDICT #4 'done' criterion)."""
+    from agilerl_tpu.components import MultiAgentReplayBuffer
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    ep_len = 3
+    env = AsyncPettingZooVecEnv(
+        [functools.partial(TinyParallelEnv, episode_len=ep_len) for _ in range(2)]
+    )
+    buf = MultiAgentReplayBuffer(max_size=64, agent_ids=env.agents)
+    obs, _ = env.reset(seed=0)
+    for _ in range(2 * ep_len):
+        actions = {a: np.zeros(2, np.int64) for a in env.agents}
+        next_obs, rew, term, trunc, info = env.step(actions)
+        store_next = info.get("final_obs", next_obs)
+        done = {a: np.logical_or(term[a], trunc[a]).astype(np.float32)
+                for a in env.agents}
+        buf.save_to_memory(obs, actions, rew, store_next, done, is_vectorised=True)
+        obs = next_obs
+    n = len(buf)
+    stored_obs = np.asarray(buf.state.storage["obs"]["a_0"])[:n]
+    stored_next = np.asarray(buf.state.storage["next_obs"]["a_0"])[:n]
+    stored_done = np.asarray(buf.state.storage["done"]["a_0"])[:n]
+    # every transition's successor is obs value + 1 — including at episode
+    # boundaries, where the autoreset obs (0) would break the chain
+    np.testing.assert_allclose(stored_next[:, 0], stored_obs[:, 0] + 1.0)
+    assert stored_done.sum() > 0  # boundaries were crossed
     env.close()
