@@ -55,6 +55,16 @@ def load_net_config(source: Union[str, Path, Dict[str, Any], None]) -> Dict[str,
     return out
 
 
+def _tuplify(x):
+    """YAML sequences arrive as lists; the frozen net-config dataclasses need
+    hashable tuples (they key the jit cache)."""
+    if isinstance(x, list):
+        return tuple(_tuplify(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tuplify(v) for k, v in x.items()}
+    return x
+
+
 def load_yaml_config(path: Union[str, Path]) -> Dict[str, Any]:
     """Load a full training YAML (INIT_HP / MUTATION_PARAMS / NET_CONFIG
     sections, parity with configs/training/*.yaml in the reference)."""
@@ -62,4 +72,8 @@ def load_yaml_config(path: Union[str, Path]) -> Dict[str, Any]:
 
     with open(path) as f:
         cfg = yaml.safe_load(f) or {}
+    if "NET_CONFIG" in cfg:
+        cfg["NET_CONFIG"] = _tuplify(cfg["NET_CONFIG"])
+    if "MODEL" in cfg:
+        cfg["MODEL"] = _tuplify(cfg["MODEL"])
     return cfg

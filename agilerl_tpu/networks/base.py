@@ -32,6 +32,7 @@ from agilerl_tpu.modules.multi_input import (
     MultiInputConfig,
     _build_sub_configs,
 )
+from agilerl_tpu.modules.resnet import EvolvableResNet, ResNetConfig
 from agilerl_tpu.modules.simba import EvolvableSimBa, SimBaConfig
 from agilerl_tpu.typing import MutationType
 from agilerl_tpu.utils.spaces import image_shape_nhwc, is_image_space, obs_dim
@@ -42,6 +43,7 @@ ENCODER_TYPES = {
     "multi_input": EvolvableMultiInput,
     "lstm": EvolvableLSTM,
     "simba": EvolvableSimBa,
+    "resnet": EvolvableResNet,
 }
 
 
@@ -50,6 +52,7 @@ def default_encoder_config(
     latent_dim: int,
     simba: bool = False,
     recurrent: bool = False,
+    resnet: bool = False,
     encoder_config: Optional[dict] = None,
 ) -> Tuple[str, Any]:
     """Pick encoder kind + config from the obs space
@@ -60,10 +63,26 @@ def default_encoder_config(
         return "multi_input", MultiInputConfig(
             sub_configs=subs, num_outputs=latent_dim, **encoder_config
         )
+    if resnet and is_image_space(observation_space):
+        return "resnet", ResNetConfig(
+            input_shape=image_shape_nhwc(observation_space),
+            num_outputs=latent_dim,
+            **encoder_config,
+        )
     if is_image_space(observation_space):
-        encoder_config.setdefault("channel_size", (32, 32))
-        encoder_config.setdefault("kernel_size", (8, 4))
-        encoder_config.setdefault("stride_size", (4, 2))
+        # scale defaults to the image: the Atari-style (8,4)/(4,2) stack
+        # collapses anything under ~36px to zero spatial dims (CNNConfig now
+        # rejects degenerate stacks instead of silently going bias-only)
+        h, w, _ = image_shape_nhwc(observation_space)
+        if min(h, w) >= 36:
+            defaults = ((32, 32), (8, 4), (4, 2))
+        elif min(h, w) >= 8:
+            defaults = ((32, 32), (3, 3), (2, 2))
+        else:
+            defaults = ((16,), (min(2, h, w),), (1,))
+        encoder_config.setdefault("channel_size", defaults[0])
+        encoder_config.setdefault("kernel_size", defaults[1])
+        encoder_config.setdefault("stride_size", defaults[2])
         return "cnn", CNNConfig(
             input_shape=image_shape_nhwc(observation_space),
             num_outputs=latent_dim,
@@ -100,6 +119,7 @@ class EvolvableNetwork:
         latent_dim: int = 32,
         simba: bool = False,
         recurrent: bool = False,
+        resnet: bool = False,
         encoder_config: Optional[dict] = None,
         head_config: Optional[dict] = None,
         config: Optional[NetworkConfig] = None,
@@ -110,7 +130,8 @@ class EvolvableNetwork:
         self.observation_space = observation_space
         if config is None:
             kind, enc_cfg = default_encoder_config(
-                observation_space, latent_dim, simba, recurrent, encoder_config
+                observation_space, latent_dim, simba, recurrent, resnet,
+                encoder_config,
             )
             head_kwargs = dict(head_config or {})
             head_kwargs.setdefault("hidden_size", (64,))

@@ -222,3 +222,44 @@ class EvoDQN:
             return pop, fitness
 
         return generation
+
+    def make_pod_generation(self, mesh) -> Callable:
+        """Pod-sharded generation: the population shards over the 'pop' mesh
+        axis (any number of members per device); training runs locally, then
+        fitness + member params all-gather over ICI and evolution runs
+        replicated-deterministically on every device (same key -> same
+        tournament, no rank-0 broadcast; parity contrast: hpo/tournament.py:161
+        broadcast_object_list)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        assert "pop" in mesh.axis_names
+
+        def gen(pop: DQNMemberState, key: jax.Array):
+            def per_device(pop_local, key):
+                pop_local, fit_local = jax.vmap(self.member_iteration)(pop_local)
+                fit_all = jax.lax.all_gather(fit_local, "pop", tiled=True)
+                gathered = jax.tree_util.tree_map(
+                    lambda x: jax.lax.all_gather(x, "pop", tiled=True), pop_local
+                )
+                new_pop = self.evolve(gathered, fit_all, key)
+                n_local = jax.tree_util.tree_leaves(pop_local)[0].shape[0]
+                my = jax.lax.axis_index("pop")
+                mine = jax.tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(
+                        x, my * n_local, n_local
+                    ),
+                    new_pop,
+                )
+                return mine, fit_all
+
+            specs = P("pop")
+            return shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
+                out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
+                check_vma=False,
+            )(pop, key)
+
+        return jax.jit(gen)

@@ -135,13 +135,28 @@ def create_population(
     pop_size = population_size or INIT_HP.get("POP_SIZE", INIT_HP.get("POPULATION_SIZE", 4))
     algo_cls = get_algo_class(algo)
 
+    import inspect
+
+    # named ctor params across the whole MRO (subclasses forward **kwargs to
+    # parents with the real named args, e.g. TD3 -> DDPG)
+    named = set()
+    for cls in algo_cls.__mro__:
+        init = cls.__dict__.get("__init__")
+        if init is None:
+            continue
+        for p in inspect.signature(init).parameters.values():
+            if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY):
+                named.add(p.name)
     ctor_kwargs: Dict[str, Any] = {}
     for k, v in INIT_HP.items():
         key = _INIT_HP_MAP.get(k)
-        if key is not None:
+        # INIT_HP holds trainer-level keys too (PER, NUM_ENVS, N_STEP for the
+        # loop) — only forward the ones this algorithm's signature names;
+        # explicit **kwargs from the caller still error loudly below
+        if key is not None and key in named:
             ctor_kwargs[key] = v
     ctor_kwargs.update(kwargs)
-    if "num_envs" in algo_cls.__init__.__code__.co_varnames:
+    if "num_envs" in named:
         ctor_kwargs.setdefault("num_envs", num_envs)
 
     population = []
