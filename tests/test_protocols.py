@@ -1,0 +1,198 @@
+"""Structural conformance of concrete classes to agilerl_tpu.protocols.
+
+The reference gets interface stability from agilerl/protocols.py; here the
+equivalent anti-drift check is executable: every concrete module, network,
+algorithm, wrapper, buffer and env class must satisfy its runtime-checkable
+Protocol. A new algorithm that renames ``learn`` or drops ``checkpoint_dict``
+fails here, not in a downstream trainer.
+"""
+
+import jax
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu import protocols as P
+
+BOX = spaces.Box(-1, 1, (4,))
+DISC = spaces.Discrete(2)
+KEY = jax.random.PRNGKey(0)
+
+
+# --------------------------------------------------------------------------
+# Modules
+# --------------------------------------------------------------------------
+
+def _module_instances():
+    import jax.numpy as jnp
+
+    from agilerl_tpu.modules.dummy import DummyEvolvable
+    from agilerl_tpu.modules.mlp import EvolvableMLP
+
+    yield EvolvableMLP(num_inputs=4, num_outputs=2, hidden_size=(8,), key=KEY)
+    yield DummyEvolvable(
+        init_fn=lambda k: {"w": jnp.zeros((4, 2))},
+        apply_fn=lambda cfg, p, x: x @ p["w"],
+        key=KEY,
+    )
+
+
+@pytest.mark.parametrize("mod", _module_instances(), ids=lambda m: type(m).__name__)
+def test_modules_satisfy_protocol(mod):
+    assert isinstance(mod, P.EvolvableModuleProtocol)
+
+
+def test_module_dict_satisfies_protocol():
+    from agilerl_tpu.modules.base import ModuleDict
+    from agilerl_tpu.modules.mlp import EvolvableMLP
+
+    md = ModuleDict(
+        {"a": EvolvableMLP(num_inputs=4, num_outputs=2, hidden_size=(8,), key=KEY)}
+    )
+    assert isinstance(md, P.ModuleDictProtocol)
+
+
+def test_mutation_method_metadata_satisfies_protocol():
+    from agilerl_tpu.modules.mlp import EvolvableMLP
+
+    methods = EvolvableMLP.get_mutation_methods()
+    assert methods
+    for m in methods.values():
+        assert isinstance(m, P.MutationMethodProtocol)
+
+
+# --------------------------------------------------------------------------
+# Networks
+# --------------------------------------------------------------------------
+
+def _network_instances():
+    from agilerl_tpu.networks.actors import DeterministicActor, StochasticActor
+    from agilerl_tpu.networks.q_networks import QNetwork
+    from agilerl_tpu.networks.value_networks import ValueNetwork
+
+    yield QNetwork(BOX, DISC, key=KEY)
+    yield StochasticActor(BOX, DISC, key=KEY)
+    yield DeterministicActor(BOX, spaces.Box(-1, 1, (2,)), key=KEY)
+    yield ValueNetwork(BOX, key=KEY)
+
+
+@pytest.mark.parametrize("net", _network_instances(), ids=lambda n: type(n).__name__)
+def test_networks_satisfy_protocol(net):
+    assert isinstance(net, P.EvolvableNetworkProtocol)
+
+
+# --------------------------------------------------------------------------
+# Algorithms — construct one of each family and check the HPO surface.
+# --------------------------------------------------------------------------
+
+def _single_agent_instances():
+    from agilerl_tpu.algorithms.cqn import CQN
+    from agilerl_tpu.algorithms.ddpg import DDPG
+    from agilerl_tpu.algorithms.dqn import DQN
+    from agilerl_tpu.algorithms.dqn_rainbow import RainbowDQN
+    from agilerl_tpu.algorithms.neural_ts_bandit import NeuralTS
+    from agilerl_tpu.algorithms.neural_ucb_bandit import NeuralUCB
+    from agilerl_tpu.algorithms.ppo import PPO
+    from agilerl_tpu.algorithms.td3 import TD3
+
+    net = {"latent_dim": 8, "encoder_config": {"hidden_size": (16,)}}
+    cbox = spaces.Box(-1, 1, (2,))
+    yield DQN(BOX, DISC, net_config=net, seed=0)
+    yield RainbowDQN(BOX, DISC, net_config=net, seed=0)
+    yield CQN(BOX, DISC, net_config=net, seed=0)
+    yield DDPG(BOX, cbox, net_config=net, seed=0)
+    yield TD3(BOX, cbox, net_config=net, seed=0)
+    yield PPO(BOX, DISC, net_config=net, seed=0)
+    yield NeuralUCB(BOX, DISC, net_config=net, seed=0)
+    yield NeuralTS(BOX, DISC, net_config=net, seed=0)
+
+
+@pytest.mark.parametrize(
+    "agent", _single_agent_instances(), ids=lambda a: type(a).__name__
+)
+def test_single_agent_algorithms_satisfy_protocols(agent):
+    assert isinstance(agent, P.EvolvableAlgorithmProtocol)
+    assert isinstance(agent, P.RLAlgorithmProtocol)
+    assert isinstance(agent.registry, P.MutationRegistryProtocol)
+    assert isinstance(agent.hp_config, P.HyperparameterConfigProtocol)
+    for g in agent.registry.groups:
+        assert isinstance(g, P.NetworkGroupProtocol)
+    for cfg in agent.registry.optimizer_configs:
+        assert isinstance(cfg, P.OptimizerConfigProtocol)
+        assert isinstance(getattr(agent, cfg.name), P.OptimizerWrapperProtocol)
+
+
+def _multi_agent_instances():
+    from agilerl_tpu.algorithms.ippo import IPPO
+    from agilerl_tpu.algorithms.maddpg import MADDPG
+    from agilerl_tpu.algorithms.matd3 import MATD3
+
+    obs = {"a_0": BOX, "a_1": BOX}
+    act = {"a_0": spaces.Box(-1, 1, (2,)), "a_1": spaces.Box(-1, 1, (2,))}
+    net = {"latent_dim": 8, "encoder_config": {"hidden_size": (16,)}}
+    yield MADDPG(obs, act, net_config=net, seed=0)
+    yield MATD3(obs, act, net_config=net, seed=0)
+    yield IPPO(obs, {"a_0": DISC, "a_1": DISC}, net_config=net, seed=0)
+
+
+@pytest.mark.parametrize(
+    "agent", _multi_agent_instances(), ids=lambda a: type(a).__name__
+)
+def test_multi_agent_algorithms_satisfy_protocols(agent):
+    assert isinstance(agent, P.EvolvableAlgorithmProtocol)
+    assert isinstance(agent, P.MultiAgentRLAlgorithmProtocol)
+
+
+def test_llm_algorithms_satisfy_evolvable_protocol():
+    """GRPO/DPO sit on the same HPO surface as the RL algorithms — the
+    tournament + mutation engine must be able to treat them uniformly."""
+    import jax.numpy as jnp
+
+    from agilerl_tpu.algorithms.dpo import DPO
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.llm import model as M
+
+    cfg = M.GPTConfig(vocab_size=64, n_layer=1, n_head=2, d_model=16,
+                      max_seq_len=16, dtype=jnp.float32)
+    for agent in (
+        GRPO(config=cfg, pad_token_id=0, eos_token_id=1, group_size=2,
+             batch_size=2, seed=0),
+        DPO(config=cfg, pad_token_id=0, eos_token_id=1, seed=0),
+    ):
+        assert isinstance(agent, P.EvolvableAlgorithmProtocol), type(agent).__name__
+
+
+# --------------------------------------------------------------------------
+# Wrappers / buffers / envs
+# --------------------------------------------------------------------------
+
+def test_rsnorm_satisfies_wrapper_protocol():
+    from agilerl_tpu.algorithms.dqn import DQN
+    from agilerl_tpu.wrappers.agent import RSNorm
+
+    agent = DQN(BOX, DISC, net_config={"latent_dim": 8,
+                                       "encoder_config": {"hidden_size": (16,)}}, seed=0)
+    assert isinstance(RSNorm(agent), P.AgentWrapperProtocol)
+
+
+def test_buffers_satisfy_protocol():
+    from agilerl_tpu.components.replay_buffer import (
+        MultiStepReplayBuffer,
+        PrioritizedReplayBuffer,
+        ReplayBuffer,
+    )
+
+    for buf in (
+        ReplayBuffer(max_size=16),
+        MultiStepReplayBuffer(max_size=16, n_step=2, gamma=0.99),
+        PrioritizedReplayBuffer(max_size=16),
+    ):
+        assert isinstance(buf, P.ReplayBufferProtocol)
+
+
+def test_envs_satisfy_protocol():
+    from agilerl_tpu.envs.classic import CartPole
+    from agilerl_tpu.envs.core import JaxVecEnv
+
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    assert isinstance(env, P.VecEnvProtocol)
