@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from agilerl_tpu.ops import pallas_enabled
+
 from agilerl_tpu.algorithms.core.registry import (
     HyperparameterConfig,
     RLParameter,
@@ -50,7 +52,7 @@ class DPO(GRPO):
 
         # fused Pallas head + flash attention on TPU — both have custom VJPs,
         # so the differentiable DPO loss uses them too (Liger parity: dpo.py:409)
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = pallas_enabled()
 
         def seq_logprob(lora, ids, mask, loss_mask):
             lp = M.token_logprobs(config, base, ids, attention_mask=mask, lora=lora,
@@ -111,23 +113,31 @@ class DPO(GRPO):
         return float(loss), float(acc)
 
     def test(self, env) -> float:
-        """Preference accuracy on the eval split (parity: dpo.py test) — runs
-        through the shared jitted logprob fn (fused/flash fast paths on TPU)."""
-        batch = {k: jnp.asarray(v) for k, v in env.reset(eval_mode=True).items()}
+        """Preference accuracy on the FULL eval split (parity: dpo.py test —
+        the reference iterates its whole test loader) — runs through the
+        shared jitted logprob fn (fused/flash fast paths on TPU)."""
         logprobs = self.jit_fn("logprobs", self._logprob_fn)
 
         def seq_lp(lora, ids, mask, loss_mask):
             return (logprobs(lora, ids, mask) * loss_mask).sum(axis=-1)
 
-        pol_c = seq_lp(self.actor.params, batch["chosen_ids"], batch["chosen_mask"],
-                       batch["chosen_loss_mask"])
-        pol_r = seq_lp(self.actor.params, batch["rejected_ids"], batch["rejected_mask"],
-                       batch["rejected_loss_mask"])
-        ref_c = seq_lp(self.reference.params, batch["chosen_ids"], batch["chosen_mask"],
-                       batch["chosen_loss_mask"])
-        ref_r = seq_lp(self.reference.params, batch["rejected_ids"], batch["rejected_mask"],
-                       batch["rejected_loss_mask"])
-        margin = (pol_c - ref_c) - (pol_r - ref_r)
-        fitness = float((margin > 0).mean())
+        batches = env.eval_batches() if hasattr(env, "eval_batches") else [
+            env.reset(eval_mode=True)
+        ]
+        correct, total = 0, 0
+        for raw in batches:
+            batch = {k: jnp.asarray(v) for k, v in raw.items()}
+            pol_c = seq_lp(self.actor.params, batch["chosen_ids"],
+                           batch["chosen_mask"], batch["chosen_loss_mask"])
+            pol_r = seq_lp(self.actor.params, batch["rejected_ids"],
+                           batch["rejected_mask"], batch["rejected_loss_mask"])
+            ref_c = seq_lp(self.reference.params, batch["chosen_ids"],
+                           batch["chosen_mask"], batch["chosen_loss_mask"])
+            ref_r = seq_lp(self.reference.params, batch["rejected_ids"],
+                           batch["rejected_mask"], batch["rejected_loss_mask"])
+            margin = (pol_c - ref_c) - (pol_r - ref_r)
+            correct += int((margin > 0).sum())
+            total += int(margin.shape[0])
+        fitness = correct / max(total, 1)
         self.fitness.append(fitness)
         return fitness

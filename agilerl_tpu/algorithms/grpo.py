@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from agilerl_tpu.ops import pallas_enabled
+
 from agilerl_tpu.algorithms.core.base import EvolvableAlgorithm
 from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
 from agilerl_tpu.algorithms.core.registry import (
@@ -206,7 +208,7 @@ class GRPO(EvolvableAlgorithm):
         base = self.base_params
         scale = self.lora_scale
         # no-grad passes use the fused Pallas lm-head kernel on TPU
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = pallas_enabled()
 
         @jax.jit
         def logprobs(lora, tokens, mask):
@@ -224,7 +226,7 @@ class GRPO(EvolvableAlgorithm):
         tx = self.optimizer.tx
         # both Pallas kernels carry custom VJPs (flash_attention_vjp.py,
         # fused_loss.py), so the TRAINING loss runs fully fused on TPU
-        use_flash = jax.default_backend() == "tpu"
+        use_flash = pallas_enabled()
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def update(lora, opt_state, batch, clip, beta):
@@ -385,12 +387,19 @@ class GRPO(EvolvableAlgorithm):
 
     # ------------------------------------------------------------------ #
     def test(self, env) -> float:
-        """Greedy-decode the eval split and average the reward
-        (parity: grpo.py:380)."""
-        prompts = env.reset(eval_mode=True)
-        comp, cmask = self.get_action(prompts, training=False)
-        _, rewards = env.step_eval(comp, cmask)
-        fitness = float(np.mean(rewards))
+        """Greedy-decode the FULL eval split and average the reward
+        (parity: grpo.py:380 — the reference iterates its whole test loader;
+        a fixed-slice eval would rank tournament members on the same handful
+        of prompts every generation)."""
+        all_rewards = []
+        batches = env.eval_batches() if hasattr(env, "eval_batches") else [
+            env.reset(eval_mode=True)
+        ]
+        for prompts in batches:
+            comp, cmask = self.get_action(prompts, training=False)
+            _, rewards = env.step_eval(comp, cmask)
+            all_rewards.append(np.ravel(np.asarray(rewards)))
+        fitness = float(np.mean(np.concatenate(all_rewards)))
         self.fitness.append(fitness)
         return fitness
 

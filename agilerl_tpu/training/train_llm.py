@@ -16,6 +16,8 @@ import numpy as np
 from agilerl_tpu.utils.utils import (
     init_wandb,
     print_hyperparams,
+    resume_population_from_checkpoint,
+    save_population_checkpoint,
     tournament_selection_and_mutation,
 )
 
@@ -47,9 +49,12 @@ def finetune_llm_reasoning(
     wandb_api_key: Optional[str] = None,
     save_elite: bool = False,
     elite_path: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[List, List[List[float]]]:
     """GRPO reasoning finetune (parity: train_llm.py:25)."""
     _assert_llm_mutations(mutation)
+    if resume:
+        resume_population_from_checkpoint(pop, checkpoint_path)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     start = time.time()
@@ -93,8 +98,7 @@ def finetune_llm_reasoning(
                 break
         if checkpoint_interval is not None and checkpoint_path is not None:
             if step % checkpoint_interval == 0:
-                for agent in pop:
-                    agent.save_checkpoint(f"{checkpoint_path}_{agent.index}.ckpt")
+                save_population_checkpoint(pop, checkpoint_path)
 
     return pop, pop_fitnesses
 
@@ -115,9 +119,12 @@ def finetune_llm_preference(
     wandb_api_key: Optional[str] = None,
     save_elite: bool = False,
     elite_path: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[List, List[List[float]]]:
     """DPO preference finetune (parity: train_llm.py:417)."""
     _assert_llm_mutations(mutation)
+    if resume:
+        resume_population_from_checkpoint(pop, checkpoint_path)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
 
@@ -147,7 +154,6 @@ def finetune_llm_preference(
                 )
         if checkpoint_interval is not None and checkpoint_path is not None:
             if step % checkpoint_interval == 0:
-                for agent in pop:
-                    agent.save_checkpoint(f"{checkpoint_path}_{agent.index}.ckpt")
+                save_population_checkpoint(pop, checkpoint_path)
 
     return pop, pop_fitnesses

@@ -13,6 +13,7 @@ import numpy as np
 from agilerl_tpu.utils.utils import (
     init_wandb,
     print_hyperparams,
+    resume_population_from_checkpoint,
     save_population_checkpoint,
     tournament_selection_and_mutation,
 )
@@ -42,7 +43,10 @@ def train_multi_agent_on_policy(
     verbose: bool = True,
     accelerator=None,
     wandb_api_key: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[List, List[List[float]]]:
+    if resume:
+        resume_population_from_checkpoint(pop, checkpoint_path)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
     num_envs = getattr(env, "num_envs", 1)
     pop_fitnesses: List[List[float]] = [[] for _ in pop]

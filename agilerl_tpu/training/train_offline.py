@@ -12,6 +12,7 @@ import numpy as np
 from agilerl_tpu.utils.utils import (
     init_wandb,
     print_hyperparams,
+    resume_population_from_checkpoint,
     save_population_checkpoint,
     tournament_selection_and_mutation,
 )
@@ -43,10 +44,13 @@ def train_offline(
     verbose: bool = True,
     accelerator=None,
     wandb_api_key: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[List, List[List[float]]]:
     """dataset: dict-like with observations/actions/rewards/next_observations/
     terminals arrays (h5py.File or numpy dict; parity with the reference's
     h5 format in data/cartpole)."""
+    if resume:
+        resume_population_from_checkpoint(pop, checkpoint_path)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
 
     if len(memory) == 0:

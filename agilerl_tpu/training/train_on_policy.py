@@ -15,6 +15,7 @@ from agilerl_tpu.rollouts.on_policy import collect_rollouts
 from agilerl_tpu.utils.utils import (
     init_wandb,
     print_hyperparams,
+    resume_population_from_checkpoint,
     save_population_checkpoint,
     tournament_selection_and_mutation,
 )
@@ -46,14 +47,8 @@ def train_on_policy(
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
 ) -> Tuple[List, List[List[float]]]:
-    if resume and checkpoint_path is not None:
-        from pathlib import Path as _P
-
-        for agent in pop:
-            p = _P(checkpoint_path)
-            f = p.parent / f"{p.stem}_{agent.index}{p.suffix or '.ckpt'}"
-            if f.exists():
-                agent.load_checkpoint(f)
+    if resume:
+        resume_population_from_checkpoint(pop, checkpoint_path)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
     num_envs = getattr(env, "num_envs", 1)
     pop_fitnesses: List[List[float]] = [[] for _ in pop]

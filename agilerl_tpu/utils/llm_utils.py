@@ -77,6 +77,14 @@ class HuggingFaceGym:
                 out.append(r)
         return out
 
+    def eval_row_batches(self):
+        """Yield the FULL test split in data_batch_size windows (parity: the
+        reference iterates its whole test dataloader per evaluation,
+        llm_utils.py test loader usage — a fixed first-slice eval would score
+        every generation on the same handful of prompts)."""
+        for start in range(0, len(self.test_rows), self.data_batch_size):
+            yield self.test_rows[start : start + self.data_batch_size]
+
     def _next_batch(self, eval_mode: bool = False) -> List[Dict]:
         rows = self.test_rows if eval_mode else self.train_rows
         if eval_mode:
@@ -151,6 +159,22 @@ class ReasoningGym(HuggingFaceGym):
         rewards = self._rewards(completion_ids, completion_mask, 1)
         return None, rewards.reshape(-1)
 
+    def eval_batches(self):
+        """Iterate tokenized prompt batches over the whole test split; each
+        yielded batch becomes current for step_eval reward computation. The
+        TRAIN state is snapshotted and restored afterwards — otherwise the
+        first training step after an evaluation would compute rewards against
+        the last eval window's answers and assemble learn batches from eval
+        prompt tokens (review finding: silent train-data corruption)."""
+        saved = (self._current, self._current_prompts)
+        try:
+            for rows in self.eval_row_batches():
+                self._current = rows
+                self._current_prompts = self._tokenize_prompts(rows)
+                yield self._current_prompts
+        finally:
+            self._current, self._current_prompts = saved
+
     def assemble_learn_batch(self, completion_ids, completion_mask):
         """Concatenate the last prompt batch with completions into full
         sequences + action masks for GRPO.learn.
@@ -188,7 +212,14 @@ class PreferenceGym(HuggingFaceGym):
         self.max_completion_length = max_completion_length
 
     def reset(self, eval_mode: bool = False) -> Dict[str, np.ndarray]:
-        rows = self._next_batch(eval_mode)
+        return self._build_batch(self._next_batch(eval_mode))
+
+    def eval_batches(self):
+        """Iterate preference batches over the whole test split."""
+        for rows in self.eval_row_batches():
+            yield self._build_batch(rows)
+
+    def _build_batch(self, rows: List[Dict]) -> Dict[str, np.ndarray]:
         tok = self.tokenizer
 
         def build(key):

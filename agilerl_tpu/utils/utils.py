@@ -258,6 +258,21 @@ def save_population_checkpoint(
         agent.save_checkpoint(path)
 
 
+def resume_population_from_checkpoint(pop: List, checkpoint_path: Optional[str]) -> List:
+    """Restore each member in place from its `{stem}_{index}` checkpoint file
+    if one exists (parity: the reference trainers' wandb-resume restore path,
+    agilerl/training/train_off_policy.py resume branch). Members without a file
+    (e.g. population grew) keep their fresh initialisation."""
+    if checkpoint_path is None:
+        return pop
+    for agent in pop:
+        p = Path(checkpoint_path)
+        f = p.parent / f"{p.stem}_{agent.index}{p.suffix or '.ckpt'}"
+        if f.exists():
+            agent.load_checkpoint(f)
+    return pop
+
+
 def load_population_checkpoint(algo: str, save_path: str, indices: List[int], **kwargs) -> List:
     cls = get_algo_class(algo)
     pop = []

@@ -26,6 +26,14 @@ class BanditEnv:
         self.context_dim = self.arms * self.dim
         self._rng = np.random.default_rng(0)
         self._idx = 0
+        # gym-style spaces so create_population can size networks directly
+        # (reference benchmarking scripts pass context_dim/action_dim by hand;
+        # exposing spaces keeps our single create_population signature)
+        from gymnasium import spaces
+
+        self.observation_space = spaces.Box(-np.inf, np.inf, (self.context_dim,),
+                                            np.float32)
+        self.action_space = spaces.Discrete(self.arms)
 
     def _context(self, i: int) -> np.ndarray:
         x = self.features[i]

@@ -1,0 +1,381 @@
+"""Checkpoint → resume round-trips for every training loop, plus the wandb
+logging branch (parity: the reference's tests/test_train/test_train.py covers
+these trainer branches across ~100 tests; this file is the distilled
+equivalent — every one of the 8 loops must checkpoint and resume in place).
+"""
+
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.components import MultiAgentReplayBuffer, ReplayBuffer
+from agilerl_tpu.envs import CartPole, JaxVecEnv
+from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
+from agilerl_tpu.training.train_bandits import train_bandits
+from agilerl_tpu.training.train_multi_agent_off_policy import (
+    train_multi_agent_off_policy,
+)
+from agilerl_tpu.training.train_multi_agent_on_policy import (
+    train_multi_agent_on_policy,
+)
+from agilerl_tpu.training.train_off_policy import train_off_policy
+from agilerl_tpu.training.train_offline import train_offline
+from agilerl_tpu.training.train_on_policy import train_on_policy
+from agilerl_tpu.utils.utils import create_population
+from agilerl_tpu.wrappers import BanditEnv
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+
+def policy_leaves(agent):
+    """Flat list of the acting policy's parameter arrays."""
+    net = getattr(agent, agent.registry.policy_group.eval)
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(net.params)]
+
+
+def assert_same_policy(a, b):
+    la, lb = policy_leaves(a), policy_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+def assert_restored(fresh_pop, trained_pop):
+    """fresh_pop (post-resume) must carry trained_pop's params and steps."""
+    for fresh, trained in zip(fresh_pop, trained_pop):
+        assert fresh.steps[-1] == trained.steps[-1] > 0
+        assert_same_policy(fresh, trained)
+
+
+# --------------------------------------------------------------------------
+# Single-agent loops
+# --------------------------------------------------------------------------
+
+def _dqn_pop(env, size=1):
+    return create_population(
+        "DQN", env.single_observation_space, env.single_action_space,
+        population_size=size, seed=0, net_config=NET,
+        INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3, "LEARN_STEP": 8},
+    )
+
+
+def test_resume_off_policy_roundtrip(tmp_path):
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    ckpt = str(tmp_path / "dqn.ckpt")
+    pop = _dqn_pop(env)
+    memory = ReplayBuffer(max_size=512)
+    trained, _ = train_off_policy(
+        env, "CartPole-v1", "DQN", pop, memory,
+        max_steps=100, evo_steps=50, eval_steps=10, eval_loop=1,
+        checkpoint=50, checkpoint_path=ckpt, overwrite_checkpoints=True,
+        verbose=False,
+    )
+    fresh = _dqn_pop(env)
+    assert fresh[0].steps[-1] == 0
+    # resume restores in place, then training continues from the saved steps
+    resumed, fitnesses = train_off_policy(
+        env, "CartPole-v1", "DQN", fresh, ReplayBuffer(max_size=512),
+        max_steps=trained[0].steps[-1] + 60, evo_steps=50, eval_steps=10,
+        eval_loop=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    assert resumed[0].steps[-1] > trained[0].steps[-1]
+    assert all(np.isfinite(f).all() for f in fitnesses)
+
+    # restore-only round-trip: max_steps below saved steps -> no training,
+    # params must be bit-identical to the checkpointed agent
+    fresh2 = _dqn_pop(env)
+    restored, _ = train_off_policy(
+        env, "CartPole-v1", "DQN", fresh2, ReplayBuffer(max_size=512),
+        max_steps=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    assert_restored(restored, trained)
+
+
+def test_resume_on_policy_roundtrip(tmp_path):
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    ckpt = str(tmp_path / "ppo.ckpt")
+
+    def make():
+        return create_population(
+            "PPO", env.single_observation_space, env.single_action_space,
+            population_size=1, seed=0, net_config=NET,
+            num_envs=2, learn_step=16, batch_size=16, update_epochs=1,
+        )
+
+    trained, _ = train_on_policy(
+        env, "CartPole-v1", "PPO", make(),
+        max_steps=100, evo_steps=32, eval_steps=10, eval_loop=1,
+        checkpoint=32, checkpoint_path=ckpt, overwrite_checkpoints=True,
+        verbose=False,
+    )
+    restored, _ = train_on_policy(
+        env, "CartPole-v1", "PPO", make(),
+        max_steps=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    assert_restored(restored, trained)
+
+
+def _offline_dataset(n=128):
+    rng = np.random.default_rng(0)
+    return {
+        "observations": rng.normal(size=(n, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=(n, 1)),
+        "rewards": np.ones((n, 1), np.float32),
+        "next_observations": rng.normal(size=(n, 4)).astype(np.float32),
+        "terminals": (rng.random((n, 1)) < 0.1).astype(np.float32),
+    }
+
+
+def test_resume_offline_roundtrip(tmp_path):
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    ckpt = str(tmp_path / "cqn.ckpt")
+
+    def make():
+        return create_population(
+            "CQN", env.single_observation_space, env.single_action_space,
+            population_size=1, seed=0, net_config=NET,
+            INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3, "LEARN_STEP": 8},
+        )
+
+    dataset = _offline_dataset()
+    trained, _ = train_offline(
+        env, "CartPole-v1", dataset, "CQN", make(), ReplayBuffer(max_size=256),
+        max_steps=64, evo_steps=32, eval_steps=10, eval_loop=1,
+        checkpoint=16, checkpoint_path=ckpt, overwrite_checkpoints=True,
+        verbose=False,
+    )
+    restored, _ = train_offline(
+        env, "CartPole-v1", dataset, "CQN", make(), ReplayBuffer(max_size=256),
+        max_steps=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    assert_restored(restored, trained)
+
+
+def _bandit_env():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 3, 60)
+    centers = rng.normal(size=(3, 4)) * 2.0
+    features = centers[labels] + rng.normal(size=(60, 4)) * 0.5
+    return BanditEnv(features, labels)
+
+
+def test_resume_bandits_roundtrip(tmp_path):
+    env = _bandit_env()
+    ckpt = str(tmp_path / "ucb.ckpt")
+
+    def make():
+        return create_population(
+            "NeuralUCB", env.observation_space, env.action_space,
+            population_size=1, seed=0, net_config=NET,
+            INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3, "LAMBDA": 1.0,
+                     "REG": 0.000625, "LEARN_STEP": 2},
+        )
+
+    trained, _ = train_bandits(
+        env, "bandit", "NeuralUCB", make(), ReplayBuffer(max_size=512),
+        max_steps=60, episode_steps=30, evo_steps=30, eval_steps=10,
+        eval_loop=1, checkpoint=30, checkpoint_path=ckpt,
+        overwrite_checkpoints=True, verbose=False,
+    )
+    restored, _ = train_bandits(
+        env, "bandit", "NeuralUCB", make(), ReplayBuffer(max_size=512),
+        max_steps=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    assert_restored(restored, trained)
+
+
+# --------------------------------------------------------------------------
+# Multi-agent loops
+# --------------------------------------------------------------------------
+
+def test_resume_multi_agent_off_policy_roundtrip(tmp_path):
+    env = MultiAgentJaxVecEnv(SimpleSpreadJax(n_agents=2), num_envs=2, seed=0)
+    ckpt = str(tmp_path / "maddpg.ckpt")
+
+    def make():
+        return create_population(
+            "MADDPG", env.observation_spaces, env.action_spaces,
+            agent_ids=env.agent_ids, population_size=1, seed=0, net_config=NET,
+            INIT_HP={"BATCH_SIZE": 16, "LEARN_STEP": 8},
+        )
+
+    trained, _ = train_multi_agent_off_policy(
+        env, "spread", "MADDPG", make(),
+        MultiAgentReplayBuffer(max_size=512, agent_ids=env.agent_ids),
+        max_steps=80, evo_steps=40, eval_steps=10, eval_loop=1,
+        checkpoint=40, checkpoint_path=ckpt, overwrite_checkpoints=True,
+        verbose=False,
+    )
+    restored, _ = train_multi_agent_off_policy(
+        env, "spread", "MADDPG", make(),
+        MultiAgentReplayBuffer(max_size=512, agent_ids=env.agent_ids),
+        max_steps=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    for fresh, t in zip(restored, trained):
+        assert fresh.steps[-1] == t.steps[-1] > 0
+        # ModuleDict-valued policy: compare per-agent leaves
+        net_f = getattr(fresh, fresh.registry.policy_group.eval)
+        net_t = getattr(t, t.registry.policy_group.eval)
+        for k in net_t.keys():
+            for x, y in zip(
+                jax.tree_util.tree_leaves(net_f[k].params),
+                jax.tree_util.tree_leaves(net_t[k].params),
+            ):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_resume_multi_agent_on_policy_roundtrip(tmp_path):
+    env = MultiAgentJaxVecEnv(SimpleSpreadJax(n_agents=2), num_envs=2, seed=0)
+    ckpt = str(tmp_path / "ippo.ckpt")
+
+    def make():
+        return create_population(
+            "IPPO", env.observation_spaces, env.action_spaces,
+            agent_ids=env.agent_ids, population_size=1, seed=0, net_config=NET,
+            num_envs=2, learn_step=16, batch_size=16, update_epochs=1,
+        )
+
+    trained, _ = train_multi_agent_on_policy(
+        env, "spread", "IPPO", make(),
+        max_steps=80, evo_steps=32, eval_steps=10, eval_loop=1,
+        checkpoint=32, checkpoint_path=ckpt, overwrite_checkpoints=True,
+        verbose=False,
+    )
+    restored, _ = train_multi_agent_on_policy(
+        env, "spread", "IPPO", make(),
+        max_steps=1, checkpoint_path=ckpt, resume=True, verbose=False,
+    )
+    for fresh, t in zip(restored, trained):
+        assert fresh.steps[-1] == t.steps[-1] > 0
+
+
+# --------------------------------------------------------------------------
+# LLM loops
+# --------------------------------------------------------------------------
+
+def _llm_bits():
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.utils.llm_utils import CharTokenizer
+
+    tok = CharTokenizer()
+    cfg = M.GPTConfig(vocab_size=tok.vocab_size, n_layer=1, n_head=2,
+                      d_model=32, max_seq_len=48, dtype=jnp.float32)
+    return tok, cfg
+
+
+def test_resume_llm_reasoning_roundtrip(tmp_path):
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.training.train_llm import finetune_llm_reasoning
+    from agilerl_tpu.utils.llm_utils import ReasoningGym
+
+    tok, cfg = _llm_bits()
+    rows = [{"question": f"{a}+1=", "answer": str(a + 1)} for a in range(8)]
+    env = ReasoningGym(rows[:6], rows[6:], tok,
+                       reward_fn=lambda c, a, p: float(c.startswith(str(a))),
+                       data_batch_size=2)
+    ckpt = str(tmp_path / "grpo")
+
+    def make():
+        return [GRPO(config=cfg, pad_token_id=tok.pad_token_id,
+                     eos_token_id=tok.eos_token_id, group_size=2, batch_size=4,
+                     max_output_tokens=2, index=0, seed=0)]
+
+    trained, _ = finetune_llm_reasoning(
+        make(), env, max_steps=2, evaluation_interval=2, verbose=False,
+        checkpoint_interval=2, checkpoint_path=ckpt,
+    )
+    fresh = make()
+    resumed, _ = finetune_llm_reasoning(
+        fresh, env, max_steps=1, evaluation_interval=5, verbose=False,
+        checkpoint_path=ckpt, resume=True,
+    )
+    # policy params restored before the single continued step ran
+    assert resumed[0].steps[-1] >= trained[0].steps[-1]
+
+
+def test_resume_llm_preference_roundtrip(tmp_path):
+    from agilerl_tpu.algorithms.dpo import DPO
+    from agilerl_tpu.training.train_llm import finetune_llm_preference
+    from agilerl_tpu.utils.llm_utils import PreferenceGym
+
+    tok, cfg = _llm_bits()
+    rows = [{"prompt": f"{a}+1=", "chosen": str(a + 1), "rejected": str(a)}
+            for a in range(8)]
+    env = PreferenceGym(rows[:6], rows[6:], tok, data_batch_size=4)
+    ckpt = str(tmp_path / "dpo")
+
+    def make():
+        return [DPO(config=cfg, pad_token_id=tok.pad_token_id,
+                    eos_token_id=tok.eos_token_id, lr=1e-3, index=0, seed=0)]
+
+    trained, _ = finetune_llm_preference(
+        make(), env, max_steps=2, evaluation_interval=2, verbose=False,
+        checkpoint_interval=2, checkpoint_path=ckpt,
+    )
+    fresh = make()
+    before = [np.asarray(x) for x in jax.tree_util.tree_leaves(fresh[0].lora_params)] \
+        if hasattr(fresh[0], "lora_params") else None
+    resumed, _ = finetune_llm_preference(
+        fresh, env, max_steps=1, evaluation_interval=5, verbose=False,
+        checkpoint_path=ckpt, resume=True,
+    )
+    assert resumed[0].steps[-1] >= trained[0].steps[-1]
+
+
+# --------------------------------------------------------------------------
+# wandb branch — a fake module proves the logging path executes
+# --------------------------------------------------------------------------
+
+class FakeWandb(types.ModuleType):
+    def __init__(self):
+        super().__init__("wandb")
+        self.inits = []
+        self.logged = []
+
+    def init(self, **kwargs):
+        self.inits.append(kwargs)
+        return self
+
+    def log(self, metrics, **kwargs):
+        self.logged.append(dict(metrics))
+
+    def finish(self):
+        pass
+
+
+@pytest.fixture
+def fake_wandb(monkeypatch):
+    fake = FakeWandb()
+    monkeypatch.setitem(sys.modules, "wandb", fake)
+    return fake
+
+
+def test_wandb_branch_off_policy(fake_wandb):
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    pop = _dqn_pop(env)
+    train_off_policy(
+        env, "CartPole-v1", "DQN", pop, ReplayBuffer(max_size=512),
+        max_steps=100, evo_steps=50, eval_steps=10, eval_loop=1,
+        wb=True, verbose=False,
+    )
+    assert fake_wandb.inits, "init_wandb never initialised the run"
+    assert any("eval/mean_fitness" in m for m in fake_wandb.logged)
+
+
+def test_wandb_branch_on_policy(fake_wandb):
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    pop = create_population(
+        "PPO", env.single_observation_space, env.single_action_space,
+        population_size=1, seed=0, net_config=NET,
+        num_envs=2, learn_step=16, batch_size=16, update_epochs=1,
+    )
+    train_on_policy(
+        env, "CartPole-v1", "PPO", pop,
+        max_steps=64, evo_steps=32, eval_steps=10, eval_loop=1,
+        wb=True, verbose=False,
+    )
+    assert any("eval/mean_fitness" in m for m in fake_wandb.logged)

@@ -79,3 +79,48 @@ def test_preference_loop():
         pop, env, max_steps=4, evaluation_interval=2, verbose=False,
     )
     assert all(len(f) >= 1 for f in fitnesses)
+
+
+def test_eval_sweeps_full_test_split():
+    """Fitness must be computed over the WHOLE test split, not a fixed first
+    slice (VERDICT weak #8): with 10 test rows and data_batch_size=4 the
+    reward_fn must see every test prompt during one agent.test()."""
+    seen = []
+
+    def reward_fn(completion, answer, prompt):
+        seen.append(prompt)
+        return 0.0
+
+    test_rows = [{"question": f"{i}+0=", "answer": str(i)} for i in range(10)]
+    env = ReasoningGym(reasoning_rows(8, 0), test_rows, TOK,
+                       reward_fn=reward_fn, data_batch_size=4)
+    agent = GRPO(config=CFG, pad_token_id=TOK.pad_token_id,
+                 eos_token_id=TOK.eos_token_id, group_size=2, batch_size=4,
+                 max_output_tokens=2, seed=0)
+    agent.test(env)
+    assert sorted(set(seen)) == sorted(r["question"] for r in test_rows)
+
+    # PreferenceGym eval_batches covers the whole split too
+    prefs = [{"prompt": f"{i}=", "chosen": str(i), "rejected": "x"}
+             for i in range(7)]
+    penv = PreferenceGym(prefs[:3], prefs, TOK, data_batch_size=3)
+    sizes = [b["chosen_ids"].shape[0] for b in penv.eval_batches()]
+    assert sizes == [3, 3, 1]
+
+
+def test_eval_restores_training_batch_state():
+    """agent.test() must NOT leave the gym's current batch pointing at the
+    last eval window — the next training step would score completions against
+    eval answers (review finding)."""
+    env = ReasoningGym(reasoning_rows(8, 0),
+                       [{"question": f"{i}+0=", "answer": str(i)} for i in range(5)],
+                       TOK, reward_fn=lambda c, a, p: 0.0, data_batch_size=4)
+    agent = GRPO(config=CFG, pad_token_id=TOK.pad_token_id,
+                 eos_token_id=TOK.eos_token_id, group_size=2, batch_size=4,
+                 max_output_tokens=2, seed=0)
+    train_prompts = env.reset()
+    current_before = env._current
+    prompts_before = env._current_prompts
+    agent.test(env)  # sweeps eval windows incl. a ragged final one (4+1)
+    assert env._current is current_before
+    assert env._current_prompts is prompts_before
