@@ -1,4 +1,12 @@
 from agilerl_tpu.wrappers.agent import AsyncAgentsWrapper, RSNorm, RunningMeanStd
 from agilerl_tpu.wrappers.learning import BanditEnv, Skill
+from agilerl_tpu.wrappers.make_evolvable import MakeEvolvable
 
-__all__ = ["RSNorm", "RunningMeanStd", "AsyncAgentsWrapper", "BanditEnv", "Skill"]
+__all__ = [
+    "RSNorm",
+    "RunningMeanStd",
+    "AsyncAgentsWrapper",
+    "BanditEnv",
+    "Skill",
+    "MakeEvolvable",
+]

@@ -1,11 +1,23 @@
-"""MakeEvolvable (deprecated; parity: agilerl/wrappers/make_evolvable.py:26 —
-reflects an arbitrary torch nn.Module into an evolvable clone).
+"""MakeEvolvable (parity: agilerl/wrappers/make_evolvable.py:26 — reflects an
+arbitrary torch nn.Module into an evolvable clone).
 
-The reference introspects a torch module's layer list to rebuild it as an
-evolvable net. The JAX analogue takes an (init_fn, apply_fn) pair or an
-architecture description and rebuilds it as an EvolvableMLP/EvolvableCNN. As in
-the reference, this path is DEPRECATED — prefer constructing Evolvable* modules
-directly or using DummyEvolvable for frozen nets.
+Two entry modes, matching the reference's surface:
+
+1. **Module introspection** (reference detect_architecture,
+   make_evolvable.py:307): pass a torch ``nn.Module`` plus an example
+   ``input_tensor``. Forward hooks record the Linear/Conv2d/activation/norm
+   sequence in call order; the detected architecture is rebuilt as an
+   EvolvableMLP or EvolvableCNN and — beyond the reference — the torch weights
+   are imported into the JAX params, so the evolvable clone is
+   forward-equivalent to the original network (tested to ~1e-5). torch is
+   host-side only here: it is used purely as a reflection source; compute runs
+   in JAX.
+
+2. **Architecture description** (kwargs): build an EvolvableMLP/EvolvableCNN
+   directly from sizes. Kept for callers that have no torch module.
+
+As in the reference, this wrapper is a migration aid — prefer constructing
+Evolvable* modules directly.
 """
 
 from __future__ import annotations
@@ -16,8 +28,242 @@ from typing import Any, Optional, Sequence
 import jax
 import numpy as np
 
+SUPPORTED_ACTIVATIONS = {
+    "ReLU": "ReLU",
+    "Tanh": "Tanh",
+    "Sigmoid": "Sigmoid",
+    "GELU": "GELU",
+    "ELU": "ELU",
+    "LeakyReLU": "LeakyReLU",
+    "Softsign": "Softsign",
+    "Softplus": "Softplus",
+    "PReLU": "PReLU",
+    "Identity": "Identity",
+    "Mish": "Mish",
+    "SiLU": "SiLU",
+}
+
+
+def _detect_torch_architecture(network, input_tensor):
+    """Run one forward pass with hooks and return the layer record in call
+    order (reference detect_architecture, make_evolvable.py:307)."""
+    import torch
+    import torch.nn as nn
+
+    records = []
+
+    def hook(module, args, output):
+        if isinstance(module, nn.Linear):
+            records.append(("linear", module))
+        elif isinstance(module, nn.Conv2d):
+            records.append(("conv", module))
+        elif isinstance(module, nn.LayerNorm):
+            records.append(("layernorm", module))
+        elif type(module).__name__ in SUPPORTED_ACTIVATIONS:
+            records.append(("act", module))
+        elif isinstance(module, (nn.Flatten, nn.Identity, nn.Dropout)):
+            pass
+        elif len(list(module.children())) == 0 and not isinstance(
+            module, (nn.Sequential, nn.ModuleList)
+        ):
+            records.append(("unsupported", module))
+
+    handles = [m.register_forward_hook(hook) for m in network.modules()]
+    try:
+        with torch.no_grad():
+            network(input_tensor)
+    finally:
+        for h in handles:
+            h.remove()
+    return records
+
+
+def _nhwc_permutation(c: int, h: int, w: int) -> np.ndarray:
+    """Index map from torch's flattened NCHW features to our NHWC flatten
+    order: perm[j] = the NCHW flat index that lands at NHWC flat position j."""
+    idx = np.arange(c * h * w).reshape(c, h, w)  # value = torch flat index
+    return idx.transpose(1, 2, 0).reshape(-1)  # NHWC order
+
+
+def _from_torch_module(network, input_tensor, key):
+    """Rebuild a torch module as an evolvable JAX clone with imported weights."""
+    import torch
+
+    records = _detect_torch_architecture(network, input_tensor)
+    unsupported = [type(m).__name__ for k, m in records if k == "unsupported"]
+    if unsupported:
+        raise ValueError(
+            f"MakeEvolvable cannot reflect layers {sorted(set(unsupported))}; "
+            "supported: Linear, Conv2d, LayerNorm, Flatten and standard "
+            "activations (reference supports the same families)"
+        )
+
+    convs = [m for k, m in records if k == "conv"]
+    linears = [m for k, m in records if k == "linear"]
+    if not linears:
+        raise ValueError("network must end in at least one Linear layer")
+
+    # activation between hidden layers = the activation seen BEFORE the final
+    # linear (an activation appearing only after it is the output activation,
+    # not a hidden one); Evolvable modules use ONE activation network-wide, so
+    # mixed hidden activations cannot be reflected faithfully — raise
+    last_linear_pos = max(i for i, (k, _) in enumerate(records) if k == "linear")
+    hidden_act_mods = [m for k, m in records[:last_linear_pos] if k == "act"]
+    hidden_acts = sorted({type(m).__name__ for m in hidden_act_mods})
+    if len(hidden_acts) > 1:
+        raise ValueError(
+            f"MakeEvolvable needs a single hidden activation (found "
+            f"{hidden_acts}); Evolvable modules apply one activation "
+            "network-wide"
+        )
+    hidden_act = (
+        SUPPORTED_ACTIVATIONS.get(hidden_acts[0], "ReLU") if hidden_acts else "Identity"
+    )
+    out_acts = [
+        type(m).__name__ for k, m in records[last_linear_pos + 1:] if k == "act"
+    ]
+    output_activation = SUPPORTED_ACTIVATIONS.get(out_acts[0]) if out_acts else None
+    for k, m in records:
+        # PReLU's slope is LEARNABLE in torch; our PReLU is fixed at 0.25 —
+        # anything else would silently break forward equivalence
+        if k == "act" and type(m).__name__ == "PReLU":
+            w = m.weight.detach().cpu().numpy()
+            if w.size != 1 or abs(float(w.ravel()[0]) - 0.25) > 1e-6:
+                raise ValueError(
+                    "MakeEvolvable cannot reflect PReLU with a trained/"
+                    "per-channel slope (JAX side uses a fixed 0.25 slope)"
+                )
+    norms = [m for k, m in records if k == "layernorm"]
+
+    def t2np(t, like=None, fill=0.0) -> np.ndarray:
+        if t is None:  # bias=False / affine-less layers
+            return np.full(like, fill, np.float32)
+        return t.detach().cpu().numpy().astype(np.float32)
+
+    if convs:
+        if len(linears) != 1:
+            raise ValueError(
+                "conv networks must end in exactly one Linear head to map onto "
+                "EvolvableCNN (conv stack + dense output)"
+            )
+        if norms:
+            # EvolvableCNN's layer_norm is channels-last over conv features —
+            # torch LayerNorms in a conv net don't map 1:1, and dropping them
+            # would break the forward-equivalence guarantee
+            raise ValueError(
+                "MakeEvolvable cannot reflect LayerNorm inside conv networks; "
+                "remove the norm or construct EvolvableCNN directly"
+            )
+        for m in convs:
+            kh, kw = m.kernel_size
+            if kh != kw:
+                raise ValueError("only square conv kernels are supported")
+            if m.stride[0] != m.stride[1]:
+                raise ValueError("only symmetric conv strides are supported")
+            if any(p != 0 for p in m.padding):
+                raise ValueError("only padding=0 (VALID) convs are supported")
+            if tuple(m.dilation) != (1, 1):
+                raise ValueError("only dilation=1 convs are supported")
+            if m.groups != 1:
+                raise ValueError("only groups=1 convs are supported")
+        from agilerl_tpu.modules.cnn import EvolvableCNN
+
+        n, c, h, w = input_tensor.shape
+        head = linears[0]
+        module = EvolvableCNN(
+            input_shape=(h, w, c),
+            num_outputs=head.out_features,
+            channel_size=tuple(m.out_channels for m in convs),
+            kernel_size=tuple(m.kernel_size[0] for m in convs),
+            stride_size=tuple(m.stride[0] for m in convs),
+            activation=hidden_act,
+            output_activation=output_activation,
+            layer_norm=False,  # torch norms don't map 1:1; keep exact parity
+            key=key,
+        )
+        params = module.params
+        for i, m in enumerate(convs):
+            # torch OIHW -> our HWIO
+            params[f"conv_{i}"]["kernel"] = jax.numpy.asarray(
+                t2np(m.weight).transpose(2, 3, 1, 0)
+            )
+            params[f"conv_{i}"]["bias"] = jax.numpy.asarray(
+                t2np(m.bias, like=(m.out_channels,))
+            )
+        # reorder the head's input features from NCHW-flat to NHWC-flat
+        fh, fw = _conv_stack_spatial(h, w, convs)
+        perm = _nhwc_permutation(convs[-1].out_channels, fh, fw)
+        head_w = t2np(head.weight)  # (out, in) over NCHW-flat features
+        params["output"]["kernel"] = jax.numpy.asarray(head_w[:, perm].T)
+        params["output"]["bias"] = jax.numpy.asarray(
+            t2np(head.bias, like=(head.out_features,))
+        )
+        module.load_state_dict(params)
+        return module
+
+    from agilerl_tpu.modules.mlp import EvolvableMLP
+
+    if len(linears) < 2:
+        raise ValueError("MLP networks need at least one hidden Linear + output")
+    # EvolvableMLP computes Linear -> LayerNorm -> activation; a torch net
+    # ordered differently (e.g. Linear -> act -> LayerNorm) would import
+    # cleanly but compute something else — require each norm to directly
+    # follow its Linear
+    for i, (k, m) in enumerate(records):
+        if k == "layernorm" and (i == 0 or records[i - 1][0] != "linear"):
+            raise ValueError(
+                "MakeEvolvable needs each LayerNorm directly after a Linear "
+                "(Evolvable modules compute Linear -> LayerNorm -> activation)"
+            )
+    if norms and len(norms) != len(linears) - 1:
+        # EvolvableMLP norms every hidden layer or none — a partial torch norm
+        # pattern would leave fresh (still-normalising) norm_i params in place
+        raise ValueError(
+            f"MakeEvolvable needs a LayerNorm after every hidden Linear or "
+            f"none (found {len(norms)} norms for {len(linears) - 1} hidden "
+            "layers)"
+        )
+    module = EvolvableMLP(
+        num_inputs=linears[0].in_features,
+        num_outputs=linears[-1].out_features,
+        hidden_size=tuple(m.out_features for m in linears[:-1]),
+        activation=hidden_act,
+        output_activation=output_activation,
+        layer_norm=bool(norms),
+        key=key,
+    )
+    params = module.params
+    for i, m in enumerate(linears[:-1]):
+        params[f"layer_{i}"]["kernel"] = jax.numpy.asarray(t2np(m.weight).T)
+        params[f"layer_{i}"]["bias"] = jax.numpy.asarray(
+            t2np(m.bias, like=(m.out_features,))
+        )
+    params["output"]["kernel"] = jax.numpy.asarray(t2np(linears[-1].weight).T)
+    params["output"]["bias"] = jax.numpy.asarray(
+        t2np(linears[-1].bias, like=(linears[-1].out_features,))
+    )
+    for i, m in enumerate(norms):
+        dim = (m.normalized_shape[-1],)
+        # elementwise_affine=False means scale 1 / bias 0 exactly
+        params[f"norm_{i}"]["scale"] = jax.numpy.asarray(
+            t2np(m.weight, like=dim, fill=1.0)
+        )
+        params[f"norm_{i}"]["bias"] = jax.numpy.asarray(t2np(m.bias, like=dim))
+    module.load_state_dict(params)
+    return module
+
+
+def _conv_stack_spatial(h: int, w: int, convs) -> tuple:
+    for m in convs:
+        k, s = m.kernel_size[0], m.stride[0]
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+    return h, w
+
 
 def MakeEvolvable(
+    network: Any = None,
+    input_tensor: Any = None,
     num_inputs: Optional[int] = None,
     num_outputs: Optional[int] = None,
     hidden_layers: Optional[Sequence[int]] = None,
@@ -28,15 +274,24 @@ def MakeEvolvable(
     activation: str = "ReLU",
     key: Optional[jax.Array] = None,
 ):
-    """Build an evolvable net from a plain architecture description."""
+    """Build an evolvable net by introspecting a torch module (network +
+    input_tensor) or from a plain architecture description (kwargs)."""
+    if key is None:
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    if network is not None:
+        if input_tensor is None:
+            raise ValueError(
+                "MakeEvolvable(network=...) needs an example input_tensor to "
+                "trace the architecture (reference make_evolvable.py:82)"
+            )
+        return _from_torch_module(network, input_tensor, key)
+
     warnings.warn(
-        "MakeEvolvable is deprecated (as in the reference); construct "
-        "EvolvableMLP/EvolvableCNN directly.",
+        "MakeEvolvable from an architecture description is deprecated (as in "
+        "the reference); construct EvolvableMLP/EvolvableCNN directly.",
         DeprecationWarning,
         stacklevel=2,
     )
-    if key is None:
-        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
     if input_shape is not None and channels is not None:
         from agilerl_tpu.modules.cnn import EvolvableCNN
 

@@ -1,0 +1,189 @@
+"""MakeEvolvable torch-module introspection (parity: the reference's
+tests of detect_architecture, make_evolvable.py:307): the evolvable JAX clone
+must be forward-equivalent to the reflected torch network, then mutate like
+any native Evolvable module."""
+
+import jax
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from agilerl_tpu.modules.cnn import EvolvableCNN  # noqa: E402
+from agilerl_tpu.modules.mlp import EvolvableMLP  # noqa: E402
+from agilerl_tpu.wrappers import MakeEvolvable  # noqa: E402
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_mlp_introspection_forward_equivalence():
+    torch.manual_seed(0)
+    net = nn.Sequential(
+        nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 16), nn.ReLU(), nn.Linear(16, 2)
+    )
+    x = torch.randn(8, 4)
+    module = MakeEvolvable(network=net, input_tensor=x, key=KEY)
+    assert isinstance(module, EvolvableMLP)
+    assert module.config.hidden_size == (32, 16)
+    assert module.config.activation == "ReLU"
+    assert module.config.output_activation is None
+    with torch.no_grad():
+        want = net(x).numpy()
+    got = np.asarray(module(x.numpy()))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_mlp_with_layernorm_and_output_activation():
+    torch.manual_seed(1)
+    net = nn.Sequential(
+        nn.Linear(6, 24), nn.LayerNorm(24), nn.Tanh(),
+        nn.Linear(24, 3), nn.Tanh(),
+    )
+    x = torch.randn(5, 6)
+    module = MakeEvolvable(network=net, input_tensor=x, key=KEY)
+    assert module.config.layer_norm
+    assert module.config.activation == "Tanh"
+    assert module.config.output_activation == "Tanh"
+    with torch.no_grad():
+        want = net(x).numpy()
+    np.testing.assert_allclose(np.asarray(module(x.numpy())), want, atol=1e-5)
+
+
+def test_cnn_introspection_forward_equivalence():
+    torch.manual_seed(2)
+    net = nn.Sequential(
+        nn.Conv2d(3, 8, kernel_size=3, stride=2), nn.ReLU(),
+        nn.Conv2d(8, 16, kernel_size=3, stride=1), nn.ReLU(),
+        nn.Flatten(), nn.Linear(16 * 5 * 5, 4),
+    )
+    x = torch.randn(2, 3, 15, 15)
+    module = MakeEvolvable(network=net, input_tensor=x, key=KEY)
+    assert isinstance(module, EvolvableCNN)
+    assert module.config.channel_size == (8, 16)
+    assert module.config.kernel_size == (3, 3)
+    assert module.config.stride_size == (2, 1)
+    with torch.no_grad():
+        want = net(x).numpy()
+    # our CNN takes NHWC
+    x_nhwc = x.numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(module(x_nhwc)), want, atol=1e-4)
+
+
+def test_introspected_module_still_mutates():
+    torch.manual_seed(3)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    module = MakeEvolvable(network=net, input_tensor=torch.randn(1, 4), key=KEY)
+    rng = np.random.default_rng(0)
+    module.apply_mutation("add_node", rng=rng)
+    assert module.config.hidden_size[0] > 16
+    out = module(np.zeros((2, 4), np.float32))
+    assert out.shape == (2, 2)
+
+
+def test_unsupported_layer_raises():
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1d(8), nn.Linear(8, 2))
+    with pytest.raises(ValueError, match="cannot reflect"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(2, 4), key=KEY)
+
+
+def test_missing_input_tensor_raises():
+    with pytest.raises(ValueError, match="input_tensor"):
+        MakeEvolvable(network=nn.Linear(4, 2))
+
+
+def test_description_path_still_works():
+    with pytest.warns(DeprecationWarning):
+        module = MakeEvolvable(num_inputs=4, num_outputs=2, hidden_layers=(8,), key=KEY)
+    assert isinstance(module, EvolvableMLP)
+
+
+def test_output_activation_not_promoted_to_hidden():
+    """An activation appearing only AFTER the last Linear must not be inserted
+    between hidden layers (review finding)."""
+    torch.manual_seed(4)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2), nn.Tanh())
+    x = torch.randn(3, 4)
+    module = MakeEvolvable(network=net, input_tensor=x, key=KEY)
+    assert module.config.activation == "Identity"
+    assert module.config.output_activation == "Tanh"
+    with torch.no_grad():
+        want = net(x).numpy()
+    np.testing.assert_allclose(np.asarray(module(x.numpy())), want, atol=1e-5)
+
+
+def test_bias_free_layers_import_as_zero_bias():
+    torch.manual_seed(5)
+    net = nn.Sequential(
+        nn.Linear(4, 16, bias=False), nn.ReLU(), nn.Linear(16, 2, bias=False)
+    )
+    x = torch.randn(3, 4)
+    module = MakeEvolvable(network=net, input_tensor=x, key=KEY)
+    with torch.no_grad():
+        want = net(x).numpy()
+    np.testing.assert_allclose(np.asarray(module(x.numpy())), want, atol=1e-5)
+
+
+def test_partial_layernorm_pattern_raises():
+    net = nn.Sequential(
+        nn.Linear(4, 8), nn.LayerNorm(8), nn.ReLU(),
+        nn.Linear(8, 8), nn.ReLU(),  # second hidden layer has no norm
+        nn.Linear(8, 2),
+    )
+    with pytest.raises(ValueError, match="LayerNorm after every hidden"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(2, 4), key=KEY)
+
+
+def test_layernorm_in_conv_net_raises():
+    net = nn.Sequential(
+        nn.Conv2d(3, 4, 3), nn.ReLU(), nn.Flatten(),
+        nn.LayerNorm(4 * 6 * 6), nn.Linear(4 * 6 * 6, 2),
+    )
+    with pytest.raises(ValueError, match="LayerNorm inside conv"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(2, 3, 8, 8), key=KEY)
+
+
+def test_mixed_hidden_activations_raise():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 8), nn.Tanh(),
+                        nn.Linear(8, 2))
+    with pytest.raises(ValueError, match="single hidden activation"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(2, 4), key=KEY)
+
+
+def test_norm_after_activation_raises():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.LayerNorm(8),
+                        nn.Linear(8, 2))
+    with pytest.raises(ValueError, match="directly after a Linear"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(2, 4), key=KEY)
+
+
+def test_affine_free_layernorm_imports_exactly():
+    torch.manual_seed(6)
+    net = nn.Sequential(
+        nn.Linear(4, 8), nn.LayerNorm(8, elementwise_affine=False), nn.ReLU(),
+        nn.Linear(8, 2),
+    )
+    x = torch.randn(3, 4)
+    module = MakeEvolvable(network=net, input_tensor=x, key=KEY)
+    with torch.no_grad():
+        want = net(x).numpy()
+    np.testing.assert_allclose(np.asarray(module(x.numpy())), want, atol=1e-5)
+
+
+def test_trained_prelu_slope_raises():
+    net = nn.Sequential(nn.Linear(4, 8), nn.PReLU(), nn.Linear(8, 2))
+    with torch.no_grad():
+        net[1].weight.fill_(0.1)  # trained away from the fixed 0.25
+    with pytest.raises(ValueError, match="PReLU"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(2, 4), key=KEY)
+
+
+def test_dilated_or_grouped_conv_raises():
+    net = nn.Sequential(nn.Conv2d(3, 4, 3, dilation=2), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(4 * 7 * 7, 2))
+    with pytest.raises(ValueError, match="dilation"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(1, 3, 11, 11), key=KEY)
+    net = nn.Sequential(nn.Conv2d(4, 8, 3, groups=2), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 6 * 6, 2))
+    with pytest.raises(ValueError, match="groups"):
+        MakeEvolvable(network=net, input_tensor=torch.randn(1, 4, 8, 8), key=KEY)
