@@ -1,5 +1,23 @@
-from agilerl_tpu.ops.flash_attention import flash_attention
+"""TPU Pallas kernels + the gate deciding when the framework uses them."""
+
+import os
+
+import jax
+
+
+def pallas_enabled() -> bool:
+    """True when the hot paths should route through the Pallas kernels:
+    on the TPU backend, unless AGILERL_TPU_DISABLE_PALLAS is set (safety
+    valve: some remote-compile services cannot build Mosaic kernels — the
+    XLA fallback paths are numerically identical, just less fused)."""
+    if os.environ.get("AGILERL_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+from agilerl_tpu.ops.flash_attention import flash_attention  # noqa: E402
 from agilerl_tpu.ops.fused_loss import fused_token_logprob
 from agilerl_tpu.ops.ring_attention import make_ring_attention, ring_attention
 
-__all__ = ["flash_attention", "fused_token_logprob", "ring_attention", "make_ring_attention"]
+__all__ = ["flash_attention", "fused_token_logprob", "ring_attention",
+           "make_ring_attention", "pallas_enabled"]

@@ -1,5 +1,9 @@
 """DPO benchmarking (parity: benchmarking/benchmarking_dpo.py)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import numpy as np
 
 from agilerl_tpu.algorithms.dpo import DPO

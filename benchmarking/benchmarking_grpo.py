@@ -8,6 +8,10 @@ adapters over a (dp, fsdp, tp) mesh, and reports tokens/sec/chip + MFU — the
 BASELINE.md metric (>=35% MFU target on v5p for the 7B class).
 """
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -1,6 +1,10 @@
 """Multi-agent on-policy (IPPO) benchmarking
 (parity: benchmarking/benchmarking_multi_agent_on_policy.py)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import time
 
 from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax

@@ -1,6 +1,10 @@
 """Off-policy benchmarking harness (parity: benchmarking/benchmarking_off_policy.py
 — YAML-driven evolutionary run reporting env-steps/sec)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import time
 

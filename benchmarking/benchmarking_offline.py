@@ -1,6 +1,10 @@
 """Offline CQN benchmarking (parity: benchmarking/benchmarking_offline.py):
 generates an offline dataset on demand (replaces the bundled h5 files)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from agilerl_tpu.components import ReplayBuffer
 from agilerl_tpu.hpo import Mutations, TournamentSelection
 from agilerl_tpu.training.train_offline import train_offline

@@ -1,5 +1,9 @@
 """On-policy benchmarking (parity: benchmarking/benchmarking_on_policy.py)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import argparse
 import time
 

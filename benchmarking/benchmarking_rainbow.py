@@ -1,6 +1,10 @@
 """Rainbow DQN benchmarking (parity: benchmarking/benchmarking_rainbow.py):
 PER + n-step + C51 + noisy nets on CartPole."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import time
 
 import numpy as np

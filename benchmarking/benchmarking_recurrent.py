@@ -1,6 +1,10 @@
 """Recurrent PPO benchmarking (parity: benchmarking/benchmarking_recurrent.py)
 on the memory probe env (POMDP)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import numpy as np
 
 from agilerl_tpu.algorithms.ppo import PPO

@@ -2,6 +2,10 @@
 evolutionary DQN with the EvolvableResNet image encoder on the on-device
 rendered VisualCartPole)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import time
 
 from agilerl_tpu.components import ReplayBuffer

@@ -1,5 +1,9 @@
 """SimBa-encoder benchmarking (parity: benchmarking/benchmarking_simba.py)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from agilerl_tpu.hpo import Mutations, TournamentSelection
 from agilerl_tpu.training.train_on_policy import train_on_policy
 from agilerl_tpu.utils.utils import create_population, make_vect_envs

@@ -2,6 +2,10 @@
 (parity: demos/demo_bandit.py — BanditEnv wraps a classification dataset;
 reward 1 for the correct arm)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import numpy as np
 
 from agilerl_tpu.components import ReplayBuffer

@@ -5,6 +5,10 @@ available locally; this demo uses the in-tree char-level model so it runs
 anywhere, swap `load_hf_model("Qwen/Qwen2.5-0.5B-Instruct")` in for the real
 workload)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -1,6 +1,10 @@
 """Evolutionary MADDPG on the JAX SimpleSpread env (parity:
 demos/demo_multi_agent.py over PettingZoo simple_speaker_listener)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import numpy as np
 
 from agilerl_tpu.components import MultiAgentReplayBuffer

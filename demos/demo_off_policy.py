@@ -1,6 +1,10 @@
 """Evolutionary DQN on CartPole (parity: demos/demo_off_policy.py in the
 reference — create_population -> train_off_policy with tournament+mutations)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import numpy as np
 
 from agilerl_tpu.components import ReplayBuffer

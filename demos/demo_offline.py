@@ -2,6 +2,10 @@
 (parity: demos/demo_offline.py — the bundled h5 dataset is replaced by
 on-demand collection, utils/minari_utils.collect_offline_dataset)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from agilerl_tpu.components import ReplayBuffer
 from agilerl_tpu.hpo import Mutations, TournamentSelection
 from agilerl_tpu.training.train_offline import train_offline

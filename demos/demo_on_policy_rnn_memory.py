@@ -2,6 +2,10 @@
 (parity: demos/demo_on_policy_rnn_memory.py — the cue is shown only at t=0;
 a flat PPO cannot beat chance, the LSTM-encoder PPO can)."""
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from agilerl_tpu.algorithms import PPO
 from agilerl_tpu.envs import JaxVecEnv
 from agilerl_tpu.envs.probe import MemoryEnv

@@ -5,6 +5,10 @@ Writes an XLA trace viewable in TensorBoard/Perfetto and prints StepTimer
 percentiles for the jitted EvoPPO generation step.
 """
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import jax
 import optax
 

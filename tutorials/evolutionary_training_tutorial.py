@@ -3,6 +3,10 @@
 Run: python tutorials/evolutionary_training_tutorial.py
 """
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 from agilerl_tpu.components import ReplayBuffer
 from agilerl_tpu.hpo import Mutations, TournamentSelection
 from agilerl_tpu.training.train_off_policy import train_off_policy

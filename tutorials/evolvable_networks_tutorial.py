@@ -5,6 +5,10 @@ architecture config + a params pytree. A mutation is a pure config transition;
 weights transfer slab-wise. Run: python tutorials/evolvable_networks_tutorial.py
 """
 
+# allow running directly as `python <dir>/<script>.py` from a source checkout
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 
