@@ -217,6 +217,12 @@ class IPPO(MultiAgentRLAlgorithm):
         for _ in range(n_steps):
             actions = self.get_action(obs)
             next_obs, rew, term, trunc, info = env.step(actions)
+            # dead/inactive agents arrive as NaN placeholders from the async
+            # vec env — zero them before buffering (AsyncAgentsWrapper is the
+            # NaN-aware path; the plain loop must stay finite)
+            from agilerl_tpu.vector import sanitize_ma_transition
+
+            next_obs, rew = sanitize_ma_transition(next_obs, rew)
             # time-limit bootstrapping per agent at truncation boundaries
             final = info.get("final_obs") if isinstance(info, dict) else None
             if final is not None:

@@ -10,6 +10,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from agilerl_tpu.vector import sanitize_ma_transition
 from agilerl_tpu.utils.utils import (
     init_wandb,
     print_hyperparams,
@@ -64,6 +65,9 @@ def train_multi_agent_off_policy(
             for _ in range(max(evo_steps // num_envs, 1)):
                 actions = agent.get_action(obs)
                 next_obs, reward, terminated, truncated, info = env.step(actions)
+                # dead/inactive agents arrive as NaN placeholders — zero them
+                # before they can reach the buffer (NaN Q-target poisoning)
+                next_obs, reward = sanitize_ma_transition(next_obs, reward)
                 done = {
                     a: np.asarray(terminated[a], np.float32) for a in agent_ids
                 }

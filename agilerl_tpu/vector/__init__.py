@@ -1,4 +1,4 @@
 from agilerl_tpu.vector.pz_async_vec_env import AsyncPettingZooVecEnv
-from agilerl_tpu.vector.pz_vec_env import PettingZooVecEnv
+from agilerl_tpu.vector.pz_vec_env import PettingZooVecEnv, sanitize_ma_transition
 
-__all__ = ["PettingZooVecEnv", "AsyncPettingZooVecEnv"]
+__all__ = ["PettingZooVecEnv", "AsyncPettingZooVecEnv", "sanitize_ma_transition"]

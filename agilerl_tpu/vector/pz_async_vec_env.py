@@ -88,8 +88,10 @@ def _rebuild_obs(space, leaves: List[np.ndarray]):
 
 
 def placeholder_obs(space):
-    """Zeros-shaped observation for an agent absent from a step's dicts
-    (parity: get_placeholder_value:765)."""
+    """Placeholder observation for an agent absent from a step's dicts
+    (parity: get_placeholder_value:765): NaN for float spaces — detectably
+    invalid, which is what AsyncAgentsWrapper keys inactivity on — and 0 for
+    integer spaces (NaN is unrepresentable there)."""
     from gymnasium import spaces as S
 
     if isinstance(space, S.Dict):
@@ -98,7 +100,10 @@ def placeholder_obs(space):
         return tuple(placeholder_obs(sub) for sub in space.spaces)
     if isinstance(space, S.Discrete):
         return np.zeros((), dtype=space.dtype or np.int64)
-    return np.zeros(space.shape or (), dtype=space.dtype or np.float32)
+    dtype = np.dtype(space.dtype or np.float32)
+    if np.issubdtype(dtype, np.floating):
+        return np.full(space.shape or (), np.nan, dtype=dtype)
+    return np.zeros(space.shape or (), dtype=dtype)
 
 
 def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, spaces_by_agent):
@@ -147,8 +152,11 @@ def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, spaces_by_agent
                     }
                     obs, _ = env.reset()
                 write_obs(obs)
+                # missing agents get NaN rewards (parity: get_placeholder_value
+                # :765 — NaN is detectable downstream, 0.0 is a legal reward)
                 out = (
-                    {a: float(rew.get(a, 0.0)) for a in agents},
+                    {a: float(rew[a]) if a in rew else float("nan")
+                     for a in agents},
                     {a: bool(term.get(a, False)) for a in agents},
                     {a: bool(trunc.get(a, False)) for a in agents},
                     {a: info.get(a, {}) for a in agents}

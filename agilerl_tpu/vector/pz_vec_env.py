@@ -81,3 +81,25 @@ class PettingZooVecEnv:
     def close(self):
         for e in self.envs:
             e.close()
+
+
+def sanitize_ma_transition(obs_dict, reward_dict):
+    """Replace NaN placeholder observations/rewards (dead or inactive agents —
+    the AsyncPettingZooVecEnv convention, get_placeholder_value parity) with
+    finite zeros for the STANDARD training loops, which have no inactivity
+    notion. AsyncAgentsWrapper consumers get the NaN-aware path instead;
+    without this, one dead agent would poison Q-targets for the whole team.
+    """
+
+    def clean(v):
+        if isinstance(v, dict):
+            return {k: clean(x) for k, x in v.items()}
+        if isinstance(v, tuple):
+            return tuple(clean(x) for x in v)
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating) and np.isnan(arr).any():
+            return np.nan_to_num(arr, nan=0.0)
+        return v
+
+    return ({a: clean(v) for a, v in obs_dict.items()},
+            {a: clean(v) for a, v in reward_dict.items()})

@@ -111,25 +111,101 @@ class AsyncAgentsWrapper:
 
     def __init__(self, agent):
         self.agent = agent
-        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._pending: Dict[Any, Dict[str, Any]] = {}
+
+    # -- reference-parity NaN-row machinery ----------------------------- #
+    @staticmethod
+    def _inactive_rows(value) -> Optional[np.ndarray]:
+        """Boolean [N] mask of env rows where the agent is inactive (all-NaN
+        observation — the AsyncPettingZooVecEnv placeholder; parity:
+        extract_inactive_agents, agent.py:477). None for unbatched/int obs."""
+        if isinstance(value, (dict, tuple)):
+            leaves = (list(value.values()) if isinstance(value, dict)
+                      else list(value))
+            masks = [AsyncAgentsWrapper._inactive_rows(leaf) for leaf in leaves]
+            masks = [m for m in masks if m is not None]
+            if not masks:
+                return None
+            out = masks[0]
+            for m in masks[1:]:
+                out = out & m
+            return out
+        arr = np.asarray(value)
+        if arr.ndim < 2 or not np.issubdtype(arr.dtype, np.floating):
+            return None
+        flat = arr.reshape(arr.shape[0], -1)
+        mask = np.isnan(flat).all(axis=1)
+        return mask if mask.any() else None
+
+    def extract_inactive_agents(self, obs):
+        """Split a batched observation dict into ({agent: inactive row idx},
+        obs with NaN rows zero-substituted) (parity: agent.py:477 — the
+        reference drops the rows; our algorithms take full batched dicts, so
+        rows are substituted and the resulting actions masked instead)."""
+        inactive: Dict[str, np.ndarray] = {}
+        cleaned = {}
+        for aid, value in obs.items():
+            mask = self._inactive_rows(value) if value is not None else None
+            if mask is None:
+                cleaned[aid] = value
+                continue
+            inactive[aid] = np.where(mask)[0]
+            cleaned[aid] = self._substitute_rows(value, mask)
+        return inactive, cleaned
+
+    @staticmethod
+    def _substitute_rows(value, mask):
+        if isinstance(value, dict):
+            return {k: AsyncAgentsWrapper._substitute_rows(v, mask)
+                    for k, v in value.items()}
+        if isinstance(value, tuple):
+            return tuple(AsyncAgentsWrapper._substitute_rows(v, mask)
+                         for v in value)
+        arr = np.array(value, copy=True)
+        if arr.ndim >= 1 and np.issubdtype(arr.dtype, np.floating):
+            arr[mask] = 0.0
+        return arr
 
     def get_action(self, obs, *args, **kwargs):
         active = {a: o for a, o in obs.items() if o is not None}
         if not active:
             return {a: None for a in obs}
+        # vectorized partial activity: zero-substitute NaN rows, act, then
+        # mask the placeholder rows' actions (parity: get_action, agent.py:560)
+        inactive, cleaned = self.extract_inactive_agents(active)
         # multi-agent algorithms index obs by EVERY agent id — substitute
-        # zero placeholders for inactive agents, then drop their actions
-        ref = next(iter(active.values()))
-        batch_shape = np.asarray(ref).shape[:1] if np.asarray(ref).ndim > 1 else ()
+        # zero placeholders for fully-absent agents, then drop their actions
+        ref = next(iter(cleaned.values()))
+        ref_leaf = ref if not isinstance(ref, (dict, tuple)) else (
+            next(iter(ref.values())) if isinstance(ref, dict) else ref[0]
+        )
+        batch_shape = (
+            np.asarray(ref_leaf).shape[:1] if np.asarray(ref_leaf).ndim > 1 else ()
+        )
         full = {}
         for aid in obs:
             if obs[aid] is not None:
-                full[aid] = obs[aid]
+                full[aid] = cleaned[aid]
             else:
                 space = self.agent.observation_spaces[aid]
                 full[aid] = np.zeros(batch_shape + tuple(space.shape), np.float32)
         actions = self.agent.get_action(full, *args, **kwargs)
-        return {a: (actions.get(a) if obs[a] is not None else None) for a in obs}
+        out = {}
+        for a in obs:
+            if obs[a] is None:
+                out[a] = None
+                continue
+            act = actions.get(a)
+            rows = inactive.get(a)
+            if rows is not None and act is not None and len(rows):
+                act = np.array(act, copy=True)
+                if np.issubdtype(act.dtype, np.integer):
+                    act[rows] = 0  # env discards these; 0 keeps the dtype
+                else:
+                    act = act.astype(np.float32)
+                    act[rows] = np.nan
+            out[a] = act
+        return out
 
     def record_step(self, obs, actions, rewards, dones):
         """Feed one env step; returns a list of ``(agent_id, transition)``
@@ -140,7 +216,14 @@ class AsyncAgentsWrapper:
         same agent — the buffered inter-turn one and the episode-ending action
         — and consumers key multi-agent buffers by real agent ids (advisor
         finding: synthetic '#final' keys would mis-key them).
+
+        Vectorized envs (NaN-placeholder rows from AsyncPettingZooVecEnv)
+        dispatch to ``record_step_vec``, which buffers per (agent, env index)
+        and returns ``(agent_id, env_idx, transition)`` triples.
         """
+        for aid, value in obs.items():
+            if value is not None and self._looks_batched(aid, value):
+                return self.record_step_vec(obs, actions, rewards, dones)
         completed: list = []
         for aid, r in rewards.items():
             if aid in self._pending:
@@ -172,6 +255,121 @@ class AsyncAgentsWrapper:
                     "next_obs": o,
                     "done": np.float32(1.0),
                 }))
+        return completed
+
+    def _looks_batched(self, aid, value) -> bool:
+        """Batched iff the leading axis is a batch axis over the agent's
+        observation space — NOT merely ndim>=2, which would misroute
+        unbatched image/board observations (review finding)."""
+        space = getattr(self.agent, "observation_spaces", {}).get(aid)
+        if isinstance(value, dict):
+            key = next(iter(value))
+            sub = space.spaces.get(key) if space is not None and hasattr(space, "spaces") else None
+            return self._leaf_batched(value[key], sub)
+        if isinstance(value, tuple):
+            sub = space.spaces[0] if space is not None and hasattr(space, "spaces") else None
+            return self._leaf_batched(value[0], sub)
+        return self._leaf_batched(value, space)
+
+    @staticmethod
+    def _leaf_batched(leaf, space) -> bool:
+        arr = np.asarray(leaf)
+        if space is not None and getattr(space, "shape", None) is not None:
+            return arr.ndim > len(space.shape)
+        return arr.ndim >= 2
+
+    @staticmethod
+    def _row(value, i):
+        if isinstance(value, dict):
+            return {k: AsyncAgentsWrapper._row(v, i) for k, v in value.items()}
+        if isinstance(value, tuple):
+            return tuple(AsyncAgentsWrapper._row(v, i) for v in value)
+        return np.asarray(value)[i]
+
+    def record_step_vec(self, obs, actions, rewards, dones):
+        """Per-(agent, env-row) turn buffering over a vectorized async env
+        (parity: the reference's inactive-agent handling rides NaN
+        placeholders the same way, agent.py:477/560). An agent's row is
+        inactive when its observation row is all-NaN; its action row is NaN
+        (or the 0 placeholder get_action wrote) and ignored. Rewards at
+        inactive rows are NaN per get_placeholder_value and skipped.
+
+        Returns a list of ``(agent_id, env_idx, transition)`` triples.
+        """
+        completed: list = []
+        any_done = None
+        for aid, d in dones.items():
+            if d is None:
+                continue
+            d = np.asarray(d, np.float64).reshape(-1)
+            flags = np.nan_to_num(d, nan=0.0).astype(bool)
+            any_done = flags if any_done is None else (any_done | flags)
+        for aid, r in rewards.items():
+            if r is None:
+                continue
+            r = np.asarray(r, np.float64).reshape(-1)
+            for i in range(r.shape[0]):
+                key = (aid, i)
+                if key in self._pending and not np.isnan(r[i]):
+                    self._pending[key]["reward"] += float(r[i])
+        for aid, value in obs.items():
+            if value is None:
+                continue
+            mask = self._inactive_rows(value)
+            n = np.asarray(
+                value if not isinstance(value, (dict, tuple)) else (
+                    next(iter(value.values())) if isinstance(value, dict)
+                    else value[0]
+                )
+            ).shape[0]
+            act = actions.get(aid)
+            d_val = dones.get(aid)
+            done_arr = np.asarray(
+                d_val if d_val is not None else np.zeros(n), np.float64
+            ).reshape(-1)
+            for i in range(n):
+                inactive = bool(mask[i]) if mask is not None else False
+                row_act = None if act is None else np.asarray(act)[i]
+                if row_act is not None and np.issubdtype(
+                    np.asarray(row_act).dtype, np.floating
+                ) and np.isnan(np.asarray(row_act)).all():
+                    row_act = None
+                acted_now = (not inactive) and row_act is not None
+                d = done_arr[i]
+                done = bool(d) and not np.isnan(d)
+                # the episode ending for ANY agent at this row closes every
+                # pending transition there — a dead agent's buffered step must
+                # not bootstrap into the NEXT episode after autoreset
+                if any_done is not None and any_done[i]:
+                    done = True
+                key = (aid, i)
+                pending = self._pending.get(key)
+                o_row = self._row(value, i)
+                if pending is not None and (acted_now or done):
+                    completed.append((aid, i, {
+                        "obs": pending["obs"],
+                        "action": pending["action"],
+                        "reward": np.float32(pending["reward"]),
+                        "next_obs": o_row if not inactive else pending["obs"],
+                        "done": np.float32(done),
+                    }))
+                    del self._pending[key]
+                if acted_now and not done:
+                    self._pending[key] = {
+                        "obs": o_row, "action": row_act, "reward": 0.0,
+                    }
+                elif acted_now and done:
+                    r_val = rewards.get(aid)
+                    r_now = np.asarray(
+                        r_val if r_val is not None else np.zeros(n), np.float64
+                    ).reshape(-1)[i]
+                    completed.append((aid, i, {
+                        "obs": o_row,
+                        "action": row_act,
+                        "reward": np.float32(0.0 if np.isnan(r_now) else r_now),
+                        "next_obs": o_row,
+                        "done": np.float32(1.0),
+                    }))
         return completed
 
     def reset(self):

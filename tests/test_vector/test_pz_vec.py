@@ -180,8 +180,9 @@ def test_async_dict_obs_typed_shared_memory():
 
 
 def test_async_dead_agent_placeholder():
-    """An agent absent from a step's dicts gets a zero placeholder obs and
-    reward 0 (parity: get_placeholder_value:765)."""
+    """An agent absent from a step's dicts gets a NaN placeholder obs and a
+    NaN reward — detectably invalid, as the reference's get_placeholder_value
+    :765 returns (0.0 would be a legal reward/observation)."""
     from agilerl_tpu.vector import AsyncPettingZooVecEnv
 
     env = AsyncPettingZooVecEnv([functools.partial(DyingAgentEnv, episode_len=4) for _ in range(2)])
@@ -189,8 +190,8 @@ def test_async_dead_agent_placeholder():
     obs, rew, *_ = env.step({a: np.zeros(2, np.int64) for a in env.agents})
     np.testing.assert_allclose(obs["a_1"], 1.0)  # still alive at t=1
     obs, rew, *_ = env.step({a: np.zeros(2, np.int64) for a in env.agents})
-    np.testing.assert_allclose(obs["a_1"], 0.0)  # dead -> placeholder
-    np.testing.assert_allclose(rew["a_1"], 0.0)
+    assert np.isnan(obs["a_1"]).all()  # dead -> NaN placeholder
+    assert np.isnan(rew["a_1"]).all()
     np.testing.assert_allclose(obs["a_0"], 2.0)  # survivor unaffected
     env.close()
 
@@ -235,3 +236,21 @@ def test_ma_off_policy_buffer_purity_at_boundaries():
     np.testing.assert_allclose(stored_next[:, 0], stored_obs[:, 0] + 1.0)
     assert stored_done.sum() > 0  # boundaries were crossed
     env.close()
+
+
+def test_sanitize_ma_transition_zeroes_nan_placeholders():
+    """Standard (non-wrapper) training loops must stay finite when agents die:
+    NaN placeholder obs/rewards are zeroed at the trainer boundary."""
+    from agilerl_tpu.vector import sanitize_ma_transition
+
+    obs = {"a_0": np.array([[1.0, 2.0], [np.nan, np.nan]], np.float32),
+           "a_1": {"img": np.full((2, 3), np.nan, np.float32),
+                   "flag": np.array([1, 2], np.int64)}}
+    rew = {"a_0": np.array([0.5, np.nan]), "a_1": np.float64(np.nan)}
+    clean_obs, clean_rew = sanitize_ma_transition(obs, rew)
+    np.testing.assert_array_equal(clean_obs["a_0"][1], [0.0, 0.0])
+    np.testing.assert_array_equal(clean_obs["a_0"][0], [1.0, 2.0])
+    np.testing.assert_array_equal(clean_obs["a_1"]["img"], 0.0)
+    np.testing.assert_array_equal(clean_obs["a_1"]["flag"], [1, 2])  # ints pass
+    np.testing.assert_allclose(clean_rew["a_0"], [0.5, 0.0])
+    assert clean_rew["a_1"] == 0.0

@@ -112,3 +112,75 @@ def test_async_agents_wrapper_final_transitions_use_real_agent_ids():
     assert closed_first["done"] == 1.0 and closed_final["done"] == 1.0
     np.testing.assert_array_equal(closed_first["obs"], np.ones(2, np.float32))
     np.testing.assert_array_equal(closed_final["obs"], 2 * np.ones(2, np.float32))
+
+
+def test_async_agents_wrapper_vectorized_nan_rows():
+    """Per-(agent, env-row) turn buffering over NaN-placeholder batches
+    (parity: the reference's extract_inactive_agents/get_action NaN machinery,
+    agent.py:477/560)."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.wrappers import AsyncAgentsWrapper
+
+    class StubMA:
+        observation_spaces = {"a": gspaces.Box(-1, 1, (2,)),
+                              "b": gspaces.Box(-1, 1, (2,))}
+
+        def get_action(self, obs, **kw):
+            # batched dict in, batched actions out
+            n = next(iter(obs.values())).shape[0]
+            return {a: np.arange(n, dtype=np.float32) for a in obs}
+
+    w = AsyncAgentsWrapper(StubMA())
+    nan_row = np.full(2, np.nan, np.float32)
+
+    # step 0: agent a active on both rows; b fully inactive (all-NaN)
+    obs0 = {"a": np.stack([np.ones(2), 2 * np.ones(2)]).astype(np.float32),
+            "b": np.stack([nan_row, nan_row])}
+    acts = w.get_action(obs0)
+    # b's actions are NaN placeholders, a's are real
+    assert np.isnan(acts["b"]).all() and not np.isnan(acts["a"]).any()
+    out = w.record_step(obs0, acts, {"a": np.zeros(2), "b": np.full(2, np.nan)},
+                        {"a": np.zeros(2), "b": np.zeros(2)})
+    assert out == []
+
+    # step 1: a inactive on row 0 (accumulates reward), active on row 1
+    obs1 = {"a": np.stack([nan_row, 3 * np.ones(2, np.float32)]),
+            "b": np.stack([nan_row, nan_row])}
+    acts1 = w.get_action(obs1)
+    assert np.isnan(acts1["a"][0]) and not np.isnan(acts1["a"][1])
+    out = w.record_step(obs1, acts1,
+                        {"a": np.array([0.5, 0.3]), "b": np.full(2, np.nan)},
+                        {"a": np.zeros(2), "b": np.zeros(2)})
+    closed = {(aid, i): t for aid, i, t in out}
+    # row 1 closed (a acted again); row 0 still pending
+    assert ("a", 1) in closed and ("a", 0) not in closed
+    np.testing.assert_allclose(closed[("a", 1)]["reward"], 0.3)
+    np.testing.assert_array_equal(closed[("a", 1)]["obs"], 2 * np.ones(2))
+    np.testing.assert_array_equal(closed[("a", 1)]["next_obs"], 3 * np.ones(2))
+
+    # step 2: a active again on row 0 -> closes with accumulated 0.5 + 0.7
+    obs2 = {"a": np.stack([4 * np.ones(2, np.float32), nan_row]),
+            "b": np.stack([nan_row, nan_row])}
+    acts2 = w.get_action(obs2)
+    out = w.record_step(obs2, acts2,
+                        {"a": np.array([0.7, np.nan]), "b": np.full(2, np.nan)},
+                        {"a": np.zeros(2), "b": np.zeros(2)})
+    closed = {(aid, i): t for aid, i, t in out}
+    np.testing.assert_allclose(closed[("a", 0)]["reward"], 1.2)
+    np.testing.assert_array_equal(closed[("a", 0)]["obs"], np.ones(2))
+    np.testing.assert_array_equal(closed[("a", 0)]["next_obs"], 4 * np.ones(2))
+    assert closed[("a", 0)]["done"] == 0.0
+
+    # step 3: episode ends on row 1 while a is inactive there -> its stale
+    # pending closes with done=1 (no cross-episode bootstrap after autoreset)
+    obs3 = {"a": np.stack([5 * np.ones(2, np.float32), nan_row]),
+            "b": np.stack([nan_row, 6 * np.ones(2, np.float32)])}
+    acts3 = w.get_action(obs3)
+    out = w.record_step(obs3, acts3,
+                        {"a": np.array([0.0, np.nan]), "b": np.array([np.nan, 0.0])},
+                        {"a": np.array([0.0, 1.0]), "b": np.array([0.0, 1.0])})
+    closed = {(aid, i): t for aid, i, t in out}
+    assert ("a", 1) in closed
+    assert closed[("a", 1)]["done"] == 1.0
+    np.testing.assert_array_equal(closed[("a", 1)]["obs"], 3 * np.ones(2))
