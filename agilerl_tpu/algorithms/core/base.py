@@ -349,10 +349,10 @@ class MultiAgentRLAlgorithm(EvolvableAlgorithm):
             while not done.all():
                 action = self.get_action(obs, training=False)
                 obs, reward, terminated, truncated, _ = env.step(action)
-                from agilerl_tpu.vector import sanitize_ma_transition
-
                 # NaN placeholders (dead/inactive agents) must not poison
                 # fitness sums
+                from agilerl_tpu.vector.pz_vec_env import sanitize_ma_transition
+
                 obs, reward = sanitize_ma_transition(obs, reward)
                 agg = np.zeros(num_envs, dtype=np.float64)
                 for aid in self.agent_ids:

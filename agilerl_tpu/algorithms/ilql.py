@@ -51,6 +51,10 @@ class ILQL(EvolvableAlgorithm):
         alpha: float = 0.005,  # polyak for target Q
         beta: float = 1.0,  # AWAC temperature
         cql_weight: float = 0.01,
+        cql_temp: float = 1.0,
+        double_q: bool = True,
+        dm_weight: float = 0.0,
+        dm_margin: float = 0.0,
         transition_weight: float = 0.0,
         seed: Optional[int] = None,
         **kwargs,
@@ -72,6 +76,10 @@ class ILQL(EvolvableAlgorithm):
         self.alpha = float(alpha)
         self.beta = float(beta)
         self.cql_weight = float(cql_weight)
+        self.cql_temp = float(cql_temp)
+        self.double_q = bool(double_q)
+        self.dm_weight = float(dm_weight)
+        self.dm_margin = float(dm_margin)
         self.learn_step = 1
 
         d, v = config.d_model, config.vocab_size
@@ -81,8 +89,15 @@ class ILQL(EvolvableAlgorithm):
             "v_head": L.dense_init(k2, d, 1),
             "q_head": L.dense_init(k3, d, v),
         }
+        if self.double_q:
+            # twin Q heads (parity: ilql.py double_q — min over targets damps
+            # overestimation in the expectile/AWAC targets)
+            params["q2_head"] = L.dense_init(k4, d, v)
         self.actor = _Net(config, params)
-        self.target_q = _Net(config, {"q_head": jax.tree_util.tree_map(jnp.copy, params["q_head"])})
+        tq = {"q_head": jax.tree_util.tree_map(jnp.copy, params["q_head"])}
+        if self.double_q:
+            tq["q2_head"] = jax.tree_util.tree_map(jnp.copy, params["q2_head"])
+        self.target_q = _Net(config, tq)
         self.optimizer = OptimizerWrapper(optimizer="adamw", lr=self.lr)
         self.register_network_group(NetworkGroup(eval="actor", policy=True))
         self.register_optimizer(OptimizerConfig(name="optimizer", networks=["actor"], lr="lr"))
@@ -100,12 +115,19 @@ class ILQL(EvolvableAlgorithm):
             "alpha": self.alpha,
             "beta": self.beta,
             "cql_weight": self.cql_weight,
+            "cql_temp": self.cql_temp,
+            "double_q": self.double_q,
+            "dm_weight": self.dm_weight,
+            "dm_margin": self.dm_margin,
         }
 
     # ------------------------------------------------------------------ #
     def _loss_fn(self):
         config = self.model_config
         gamma, tau, beta, cql_w = self.gamma, self.tau, self.beta, self.cql_weight
+        cql_temp = self.cql_temp
+        double_q = self.double_q
+        dm_w, dm_margin = self.dm_weight, self.dm_margin
         tx = self.optimizer.tx
 
         def heads(params, tokens, mask):
@@ -114,6 +136,9 @@ class ILQL(EvolvableAlgorithm):
             vs = L.dense_apply(params["v_head"], hidden)[..., 0]  # [B, T]
             qs = L.dense_apply(params["q_head"], hidden)  # [B, T, V]
             return logits, vs, qs, hidden
+
+        def gather_a(q, a):
+            return jnp.take_along_axis(q, a[..., None].astype(jnp.int32), axis=-1)[..., 0]
 
         @jax.jit
         def train_step(params, tq_params, opt_state, batch, key):
@@ -127,14 +152,17 @@ class ILQL(EvolvableAlgorithm):
 
             def loss(p):
                 logits, vs, qs, hidden = heads(p, tokens, batch["attention_mask"])
-                q_a = jnp.take_along_axis(
-                    qs[:, :-1], a[..., None].astype(jnp.int32), axis=-1
-                )[..., 0]  # [B, T-1]
-                # target-Q head on the SAME trunk (stop-grad trunk for target)
-                tq = L.dense_apply(tq_params["q_head"], jax.lax.stop_gradient(hidden))
-                tq_a = jnp.take_along_axis(
-                    tq[:, :-1], a[..., None].astype(jnp.int32), axis=-1
-                )[..., 0]
+                q_a = gather_a(qs[:, :-1], a)  # [B, T-1]
+                # target-Q head(s) on the SAME trunk (stop-grad trunk for target)
+                sg_hidden = jax.lax.stop_gradient(hidden)
+                tq = L.dense_apply(tq_params["q_head"], sg_hidden)
+                tq_a = gather_a(tq[:, :-1], a)
+                if double_q:
+                    qs2 = L.dense_apply(p["q2_head"], hidden)
+                    q2_a = gather_a(qs2[:, :-1], a)
+                    tq2 = L.dense_apply(tq_params["q2_head"], sg_hidden)
+                    # min over twin targets (parity: ilql.py double_q forward)
+                    tq_a = jnp.minimum(tq_a, gather_a(tq2[:, :-1], a))
                 v_next = vs[:, 1:]
                 # transition t's action is token t+1 — its reward/terminal live
                 # at index t+1 in the tokenised episode (review finding: the
@@ -142,39 +170,73 @@ class ILQL(EvolvableAlgorithm):
                 r = rewards[:, 1:]
                 nonterm = 1.0 - terminals[:, 1:]
                 td_target = jax.lax.stop_gradient(r + gamma * nonterm * v_next)
-                q_loss = jnp.sum(jnp.square(q_a - td_target) * valid) / jnp.maximum(
-                    valid.sum(), 1.0
-                )
-                # expectile V toward target-Q (IQL)
+                denom = jnp.maximum(valid.sum(), 1.0)
+                q_loss = jnp.sum(jnp.square(q_a - td_target) * valid) / denom
+                if double_q:
+                    # both heads regress to the shared target (get_q_loss:571)
+                    q_loss = q_loss + jnp.sum(
+                        jnp.square(q2_a - td_target) * valid
+                    ) / denom
+                # expectile V toward (min) target-Q (IQL; get_v_loss:556)
                 diff = jax.lax.stop_gradient(tq_a) - vs[:, :-1]
                 w = jnp.where(diff > 0, tau, 1.0 - tau)
-                v_loss = jnp.sum(w * jnp.square(diff) * valid) / jnp.maximum(valid.sum(), 1.0)
-                # CQL conservatism on Q
-                cql = jnp.sum(
-                    (jax.scipy.special.logsumexp(qs[:, :-1], axis=-1) - q_a) * valid
-                ) / jnp.maximum(valid.sum(), 1.0)
+                v_loss = jnp.sum(w * jnp.square(diff) * valid) / denom
+                # CQL conservatism: temperature-scaled cross-entropy on each
+                # head (get_cql_loss:596)
+                def cql_term(q_all, q_sel):
+                    return jnp.sum(
+                        (jax.scipy.special.logsumexp(q_all[:, :-1] / cql_temp, axis=-1)
+                         - q_sel / cql_temp) * valid
+                    ) / denom
+
+                cql = cql_term(qs, q_a)
+                if double_q:
+                    cql = cql + cql_term(qs2, q2_a)
+                # direct-method margin loss: push non-data actions at least
+                # dm_margin below the data action's Q (get_dm_loss:628)
+                def dm_term(q_all, q_sel):
+                    viol = jnp.maximum(
+                        q_all[:, :-1] - jax.lax.stop_gradient(q_sel)[..., None]
+                        + dm_margin, 0.0
+                    )
+                    return jnp.sum(jnp.square(viol).sum(axis=-1) * valid) / denom
+
+                dm = dm_term(qs, q_a)
+                if double_q:
+                    dm = dm + dm_term(qs2, q2_a)
                 # AWAC policy loss: advantage-weighted CE
                 adv = jax.lax.stop_gradient(tq_a - vs[:, :-1])
                 wts = jnp.exp(jnp.clip(beta * adv, -5.0, 5.0))
                 logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-                logp_a = jnp.take_along_axis(
-                    logp, a[..., None].astype(jnp.int32), axis=-1
-                )[..., 0]
-                pi_loss = -jnp.sum(wts * logp_a * valid) / jnp.maximum(valid.sum(), 1.0)
-                total = q_loss + v_loss + cql_w * cql + pi_loss
+                logp_a = gather_a(logp, a)
+                pi_loss = -jnp.sum(wts * logp_a * valid) / denom
+                total = q_loss + v_loss + cql_w * cql + dm_w * dm + pi_loss
                 return total, (q_loss, v_loss, cql, pi_loss)
 
             (total, aux), grads = jax.value_and_grad(loss, has_aux=True)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            # polyak target-Q head
+            # polyak target-Q head(s)
+            live = {"q_head": params["q_head"]}
+            if double_q:
+                live["q2_head"] = params["q2_head"]
             tq_params = jax.tree_util.tree_map(
                 lambda t, p: (1 - self.alpha) * t + self.alpha * p,
-                tq_params, {"q_head": params["q_head"]},
+                tq_params, live,
             )
             return params, tq_params, opt_state, total, aux
 
         return train_step
+
+    def hard_update(self) -> None:
+        """Copy the live Q head(s) into the target (parity: hard_update:1102 /
+        copy_model_to_actor_target:259)."""
+        tq = {"q_head": jax.tree_util.tree_map(jnp.copy, self.actor.params["q_head"])}
+        if self.double_q:
+            tq["q2_head"] = jax.tree_util.tree_map(
+                jnp.copy, self.actor.params["q2_head"]
+            )
+        self.target_q.params = tq
 
     def learn(self, batch: Dict[str, np.ndarray]) -> float:
         """batch from data/rl_data.RL_Dataset.sample_batch (parity: get_loss:750)."""
@@ -198,11 +260,15 @@ class ILQL(EvolvableAlgorithm):
         so sweeping it never recompiles nor hits a stale jit cache."""
         config = self.model_config
 
+        double_q = self.double_q
+
         @jax.jit
         def act(params, tokens, mask, key, q_scale):
             hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
             logits = M.logits_fn(config, params["gpt"], hidden)[:, -1]
             qs = L.dense_apply(params["q_head"], hidden)[:, -1]
+            if double_q:
+                qs = jnp.minimum(qs, L.dense_apply(params["q2_head"], hidden)[:, -1])
             vs = L.dense_apply(params["v_head"], hidden)[:, -1]
             score = jax.nn.log_softmax(logits, axis=-1) + q_scale * (qs - vs)
             return jax.random.categorical(key, score, axis=-1)
@@ -221,10 +287,14 @@ class ILQL(EvolvableAlgorithm):
         """Per-position policy scores: log pi + q_scale * (Q - V)."""
         config = self.model_config
 
+        double_q = self.double_q
+
         def scores(params, tokens, mask, q_scale):
             hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
             logits = M.logits_fn(config, params["gpt"], hidden)
             qs = L.dense_apply(params["q_head"], hidden)
+            if double_q:
+                qs = jnp.minimum(qs, L.dense_apply(params["q2_head"], hidden))
             vs = L.dense_apply(params["v_head"], hidden)
             return jax.nn.log_softmax(logits, axis=-1) + q_scale * (qs - vs)
 
@@ -405,6 +475,102 @@ class ILQL_Policy:
         return self.iql_model.generate(
             prompt_tokens, prompt_mask, mode=self.kind, **self.generation_kwargs
         )
+
+
+class ILQL_Evaluator:
+    """Rollout evaluator over a prompt-in/reward-out interface (parity:
+    agilerl/algorithms/ilql.py:2072 — the reference interacts with a language
+    env through ILQL_Policy and averages env/token rewards; here the env is
+    any object with ``eval_prompts() -> (tokens, mask)`` batches and
+    ``reward(tokens, mask) -> [B] array``, e.g. a ReasoningGym adapter)."""
+
+    def __init__(self, env, kind: str = "sample", verbose: bool = False,
+                 **generation_kwargs):
+        self.env = env
+        self.kind = kind
+        self.verbose = verbose
+        self.generation_kwargs = dict(generation_kwargs)
+        self.all_results: list = []
+
+    def evaluate(self, model: "ILQL") -> Dict[str, float]:
+        policy = ILQL_Policy(model, self.kind, **self.generation_kwargs)
+        total, n = 0.0, 0
+        for tokens, mask in self.env.eval_prompts():
+            out_tokens, out_mask = policy.act(tokens, mask)
+            rewards = np.asarray(self.env.reward(out_tokens, out_mask), np.float64)
+            self.all_results.append((np.asarray(out_tokens), rewards))
+            total += float(rewards.sum())
+            n += int(rewards.size)
+            if self.verbose:
+                print(f"ILQL_Evaluator: batch reward {rewards.mean():.3f}")
+        return {"env_reward": total / max(n, 1), "episodes": float(n)}
+
+    def dump(self) -> Dict[str, Any]:
+        return {"results": self.all_results}
+
+
+class TopAdvantageNGrams:
+    """Dataset introspection: which n-grams carry the highest learned
+    advantage (parity: agilerl/algorithms/ilql.py:2134). Feeds batches through
+    the model's target-Q/V heads and accumulates per-n-gram mean advantage —
+    the debugging lens for WHAT the Q function has decided is good text."""
+
+    def __init__(self, tokenizer=None, n_gram: int = 3, print_k: int = 10):
+        self.tokenizer = tokenizer
+        self.n_gram = int(n_gram)
+        self.print_k = int(print_k)
+        self._adv: Dict[tuple, float] = {}
+        self._count: Dict[tuple, int] = {}
+
+    def evaluate(self, model: "ILQL", batch: Dict[str, np.ndarray]) -> None:
+        config = model.model_config
+
+        def adv_fn(params, tq_params, tokens, mask):
+            hidden, _ = M.forward(config, params["gpt"], tokens, attention_mask=mask)
+            a = tokens[:, 1:]
+            tq = L.dense_apply(tq_params["q_head"], hidden)
+            tq_a = jnp.take_along_axis(
+                tq[:, :-1], a[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            if "q2_head" in tq_params:
+                tq2 = L.dense_apply(tq_params["q2_head"], hidden)
+                tq_a = jnp.minimum(tq_a, jnp.take_along_axis(
+                    tq2[:, :-1], a[..., None].astype(jnp.int32), axis=-1
+                )[..., 0])
+            vs = L.dense_apply(params["v_head"], hidden)[..., 0]
+            return tq_a - vs[:, :-1]
+
+        fn = model.jit_fn("ngram_adv", lambda: jax.jit(adv_fn))
+        tokens = np.asarray(batch["tokens"])
+        mask = np.asarray(batch["attention_mask"])
+        adv = np.asarray(fn(model.actor.params, model.target_q.params,
+                            jnp.asarray(tokens), jnp.asarray(mask)))
+        valid = (mask[:, 1:] * mask[:, :-1]).astype(bool)
+        n = self.n_gram
+        for b in range(tokens.shape[0]):
+            acts = tokens[b, 1:]
+            for start in range(acts.shape[0] - n + 1):
+                window = slice(start, start + n)
+                if not valid[b, window].all():
+                    continue
+                gram = tuple(int(t) for t in acts[window])
+                self._adv[gram] = self._adv.get(gram, 0.0) + float(adv[b, window].sum())
+                self._count[gram] = self._count.get(gram, 0) + 1
+
+    def top(self) -> list:
+        items = [
+            (self._adv[g] / self._count[g], g) for g in self._adv
+        ]
+        items.sort(reverse=True)
+        out = []
+        for mean_adv, gram in items[: self.print_k]:
+            text = (self.tokenizer.decode(list(gram))
+                    if self.tokenizer is not None else gram)
+            out.append((text, mean_adv))
+        return out
+
+    def dump(self) -> Dict[str, Any]:
+        return {"top_advantage_ngrams": self.top()}
 
 
 class BC_LM(EvolvableAlgorithm):

@@ -25,6 +25,7 @@ from agilerl_tpu.algorithms.core.registry import (
     RLParameter,
 )
 from agilerl_tpu.components.rollout_buffer import RolloutBuffer
+from agilerl_tpu.vector import sanitize_ma_transition
 from agilerl_tpu.networks import distributions as D
 from agilerl_tpu.networks.actors import StochasticActor
 from agilerl_tpu.networks.base import EvolvableNetwork
@@ -220,12 +221,11 @@ class IPPO(MultiAgentRLAlgorithm):
             # dead/inactive agents arrive as NaN placeholders from the async
             # vec env — zero them before buffering (AsyncAgentsWrapper is the
             # NaN-aware path; the plain loop must stay finite)
-            from agilerl_tpu.vector import sanitize_ma_transition
-
             next_obs, rew = sanitize_ma_transition(next_obs, rew)
             # time-limit bootstrapping per agent at truncation boundaries
             final = info.get("final_obs") if isinstance(info, dict) else None
             if final is not None:
+                final, _ = sanitize_ma_transition(final, {})
                 rew = dict(rew)
                 for aid in self.agent_ids:
                     t_arr = np.asarray(trunc[aid], bool)
@@ -235,7 +235,12 @@ class IPPO(MultiAgentRLAlgorithm):
                         v = np.asarray(EvolvableNetwork.apply(
                             self.critics[gid].config, self.critics[gid].params, o
                         )[..., 0])
-                        rew[aid] = np.asarray(rew[aid], np.float32) + self.gamma * v * t_arr
+                        # np.where, not v * t_arr: nan * False == nan, so a
+                        # NaN critic value at a dead row would re-poison the
+                        # sanitized reward (review finding)
+                        rew[aid] = np.asarray(rew[aid], np.float32) + np.where(
+                            t_arr, self.gamma * v, 0.0
+                        ).astype(np.float32)
             for gid, members in self.grouped_agents.items():
                 g_obs = np.concatenate([np.asarray(obs[a]) for a in members], axis=0)
                 g_act = np.concatenate([np.asarray(actions[a]) for a in members], axis=0)

@@ -74,6 +74,10 @@ def train_multi_agent_off_policy(
                 store_next = (
                     info.get("final_obs", next_obs) if isinstance(info, dict) else next_obs
                 )
+                if store_next is not next_obs:
+                    # final_obs is assembled from shared memory and can carry
+                    # NaN placeholder rows too (review finding)
+                    store_next, _ = sanitize_ma_transition(store_next, {})
                 memory.save_to_memory(
                     obs, actions, reward, store_next, done, is_vectorised=num_envs > 1
                 )

@@ -265,6 +265,9 @@ class AsyncPettingZooVecEnv:
         stack = lambda ds: {a: np.array([d[a] for d in ds]) for a in self.agents}  # noqa: E731
         next_obs = self._read_obs()
         info: Dict = {"env_infos": list(env_infos)}
+        # which env rows just autoreset — consumers (AsyncAgentsWrapper) use
+        # this to close stale pending transitions exactly at episode ends
+        info["autoreset"] = np.array([f is not None for f in finals], bool)
         if any(f is not None for f in finals):
             # merged per-agent final-obs batch: the true pre-reset successor
             # where an env just finished, the current obs elsewhere

@@ -115,27 +115,38 @@ class AsyncAgentsWrapper:
 
     # -- reference-parity NaN-row machinery ----------------------------- #
     @staticmethod
-    def _inactive_rows(value) -> Optional[np.ndarray]:
-        """Boolean [N] mask of env rows where the agent is inactive (all-NaN
-        observation — the AsyncPettingZooVecEnv placeholder; parity:
-        extract_inactive_agents, agent.py:477). None for unbatched/int obs."""
-        if isinstance(value, (dict, tuple)):
-            leaves = (list(value.values()) if isinstance(value, dict)
-                      else list(value))
-            masks = [AsyncAgentsWrapper._inactive_rows(leaf) for leaf in leaves]
-            masks = [m for m in masks if m is not None]
-            if not masks:
-                return None
-            out = masks[0]
-            for m in masks[1:]:
-                out = out & m
-            return out
+    def _leaf_inactive(value) -> Optional[np.ndarray]:
+        """Per-leaf all-NaN row mask; None strictly means 'cannot detect'
+        (unbatched or integer leaf). An all-False mask means 'detectably
+        active' — the distinction matters when AND-combining leaves."""
         arr = np.asarray(value)
         if arr.ndim < 2 or not np.issubdtype(arr.dtype, np.floating):
             return None
         flat = arr.reshape(arr.shape[0], -1)
-        mask = np.isnan(flat).all(axis=1)
-        return mask if mask.any() else None
+        return np.isnan(flat).all(axis=1)
+
+    @staticmethod
+    def _inactive_rows(value) -> Optional[np.ndarray]:
+        """Boolean [N] mask of env rows where the agent is inactive (all-NaN
+        observation across EVERY float leaf — the AsyncPettingZooVecEnv
+        placeholder; parity: extract_inactive_agents, agent.py:477). A single
+        all-NaN leaf (e.g. one glitched sensor) does NOT mark the row inactive
+        when another leaf carries finite data (review finding). None for
+        unbatched/int-only obs."""
+        if isinstance(value, (dict, tuple)):
+            leaves = (list(value.values()) if isinstance(value, dict)
+                      else list(value))
+            masks = [AsyncAgentsWrapper._leaf_inactive(leaf) for leaf in leaves]
+            masks = [m for m in masks if m is not None]
+        else:
+            m = AsyncAgentsWrapper._leaf_inactive(value)
+            masks = [m] if m is not None else []
+        if not masks:
+            return None
+        out = masks[0]
+        for m in masks[1:]:
+            out = out & m
+        return out if out.any() else None
 
     def extract_inactive_agents(self, obs):
         """Split a batched observation dict into ({agent: inactive row idx},
@@ -207,7 +218,7 @@ class AsyncAgentsWrapper:
             out[a] = act
         return out
 
-    def record_step(self, obs, actions, rewards, dones):
+    def record_step(self, obs, actions, rewards, dones, autoreset=None):
         """Feed one env step; returns a list of ``(agent_id, transition)``
         pairs for experiences that just closed (parity: the reference's
         inactive-agent experience buffering, agent.py:458).
@@ -223,7 +234,8 @@ class AsyncAgentsWrapper:
         """
         for aid, value in obs.items():
             if value is not None and self._looks_batched(aid, value):
-                return self.record_step_vec(obs, actions, rewards, dones)
+                return self.record_step_vec(obs, actions, rewards, dones,
+                                            autoreset=autoreset)
         completed: list = []
         for aid, r in rewards.items():
             if aid in self._pending:
@@ -286,7 +298,7 @@ class AsyncAgentsWrapper:
             return tuple(AsyncAgentsWrapper._row(v, i) for v in value)
         return np.asarray(value)[i]
 
-    def record_step_vec(self, obs, actions, rewards, dones):
+    def record_step_vec(self, obs, actions, rewards, dones, autoreset=None):
         """Per-(agent, env-row) turn buffering over a vectorized async env
         (parity: the reference's inactive-agent handling rides NaN
         placeholders the same way, agent.py:477/560). An agent's row is
@@ -294,16 +306,27 @@ class AsyncAgentsWrapper:
         (or the 0 placeholder get_action wrote) and ignored. Rewards at
         inactive rows are NaN per get_placeholder_value and skipped.
 
+        ``autoreset``: boolean [N] mask of env rows whose EPISODE just ended
+        (AsyncPettingZooVecEnv provides it as ``info["autoreset"]``). Pending
+        transitions at those rows close with done=1 so nothing bootstraps
+        into the next episode. Without it, the fallback is rows where EVERY
+        agent reports done — one agent dying mid-episode must NOT terminate
+        its teammates' in-flight transitions (review finding).
+
         Returns a list of ``(agent_id, env_idx, transition)`` triples.
         """
         completed: list = []
-        any_done = None
-        for aid, d in dones.items():
-            if d is None:
-                continue
-            d = np.asarray(d, np.float64).reshape(-1)
-            flags = np.nan_to_num(d, nan=0.0).astype(bool)
-            any_done = flags if any_done is None else (any_done | flags)
+        if autoreset is not None:
+            episode_end = np.asarray(autoreset, bool).reshape(-1)
+        else:
+            episode_end = None
+            for aid, d in dones.items():
+                if d is None:
+                    continue
+                d = np.asarray(d, np.float64).reshape(-1)
+                flags = np.nan_to_num(d, nan=0.0).astype(bool)
+                episode_end = flags if episode_end is None \
+                    else (episode_end & flags)
         for aid, r in rewards.items():
             if r is None:
                 continue
@@ -337,10 +360,10 @@ class AsyncAgentsWrapper:
                 acted_now = (not inactive) and row_act is not None
                 d = done_arr[i]
                 done = bool(d) and not np.isnan(d)
-                # the episode ending for ANY agent at this row closes every
-                # pending transition there — a dead agent's buffered step must
-                # not bootstrap into the NEXT episode after autoreset
-                if any_done is not None and any_done[i]:
+                # the EPISODE ending at this row closes every pending
+                # transition there — a dead agent's buffered step must not
+                # bootstrap into the NEXT episode after autoreset
+                if episode_end is not None and episode_end[i]:
                     done = True
                 key = (aid, i)
                 pending = self._pending.get(key)

@@ -120,3 +120,82 @@ def test_ilql_rewards_shape_q_values():
                           attention_mask=jnp.asarray(mask))
     qs = np.asarray(L.dense_apply(agent.actor.params["q_head"], hidden))[0, -1]
     assert qs[good] > qs[bad] + 0.2, (qs[good], qs[bad])
+
+
+def test_double_q_heads_and_hard_update():
+    """Twin Q heads regress to the shared TD target; targets track via polyak
+    and hard_update copies exactly (parity: ilql.py double_q / hard_update)."""
+    ds = make_dataset()
+    agent = ILQL(config=CFG, lr=1e-3, seed=0, double_q=True)
+    assert "q2_head" in agent.actor.params
+    assert "q2_head" in agent.target_q.params
+    rng = np.random.default_rng(0)
+    before_t = np.asarray(agent.target_q.params["q2_head"]["kernel"]).copy()
+    for _ in range(3):
+        loss = agent.learn(ds.sample_batch(8, rng))
+        assert np.isfinite(loss)
+    after_t = np.asarray(agent.target_q.params["q2_head"]["kernel"])
+    assert not np.array_equal(before_t, after_t)  # polyak moved the target
+    agent.hard_update()
+    np.testing.assert_array_equal(
+        np.asarray(agent.target_q.params["q_head"]["kernel"]),
+        np.asarray(agent.actor.params["q_head"]["kernel"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(agent.target_q.params["q2_head"]["kernel"]),
+        np.asarray(agent.actor.params["q2_head"]["kernel"]),
+    )
+    # single-Q config still works and has no q2 head
+    single = ILQL(config=CFG, lr=1e-3, seed=0, double_q=False)
+    assert "q2_head" not in single.actor.params
+    assert np.isfinite(single.learn(ds.sample_batch(8, rng)))
+
+
+def test_dm_loss_pushes_margin():
+    ds = make_dataset()
+    agent = ILQL(config=CFG, lr=1e-3, seed=0, dm_weight=1.0, dm_margin=0.1)
+    rng = np.random.default_rng(0)
+    loss = agent.learn(ds.sample_batch(8, rng))
+    assert np.isfinite(loss)
+
+
+def test_top_advantage_ngrams():
+    from agilerl_tpu.algorithms.ilql import TopAdvantageNGrams
+
+    ds = make_dataset()
+    agent = ILQL(config=CFG, lr=1e-3, seed=0)
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        agent.learn(ds.sample_batch(8, rng))
+    probe = TopAdvantageNGrams(tokenizer=TOK, n_gram=2, print_k=5)
+    probe.evaluate(agent, ds.sample_batch(8, rng))
+    top = probe.top()
+    assert 0 < len(top) <= 5
+    text, adv = top[0]
+    assert isinstance(text, str) and np.isfinite(adv)
+    # sorted descending by mean advantage
+    advs = [a for _, a in top]
+    assert advs == sorted(advs, reverse=True)
+
+
+def test_ilql_evaluator_reward_rollout():
+    from agilerl_tpu.algorithms.ilql import ILQL_Evaluator
+
+    agent = ILQL(config=CFG, lr=1e-3, seed=0)
+
+    class PromptEnv:
+        def eval_prompts(self):
+            seqs = [TOK.encode("3+1=") for _ in range(2)]
+            ids = np.asarray(seqs, np.int32)
+            pad = np.zeros((2, 12 - ids.shape[1]), np.int32)
+            tokens = np.concatenate([ids, pad], axis=1)
+            mask = (tokens != 0).astype(np.float32)
+            yield tokens, mask
+
+        def reward(self, tokens, mask):
+            return np.ones(tokens.shape[0], np.float32)
+
+    ev = ILQL_Evaluator(PromptEnv(), kind="greedy", max_new_tokens=2)
+    metrics = ev.evaluate(agent)
+    assert metrics["env_reward"] == 1.0 and metrics["episodes"] == 2.0
+    assert ev.dump()["results"]

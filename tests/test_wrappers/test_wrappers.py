@@ -184,3 +184,74 @@ def test_async_agents_wrapper_vectorized_nan_rows():
     assert ("a", 1) in closed
     assert closed[("a", 1)]["done"] == 1.0
     np.testing.assert_array_equal(closed[("a", 1)]["obs"], 3 * np.ones(2))
+
+
+def test_one_agent_death_does_not_close_teammates_pendings():
+    """A single agent's done must close only ITS OWN pending transition —
+    teammates keep bootstrapping (review finding: episodes run until ALL
+    agents finish). Explicit autoreset masks drive stale-pending closure."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.wrappers import AsyncAgentsWrapper
+
+    class StubMA:
+        observation_spaces = {"a": gspaces.Box(-1, 1, (2,)),
+                              "b": gspaces.Box(-1, 1, (2,))}
+
+        def get_action(self, obs, **kw):
+            n = next(iter(obs.values())).shape[0]
+            return {a: np.ones(n, np.float32) for a in obs}
+
+    w = AsyncAgentsWrapper(StubMA())
+    ones = np.ones((1, 2), np.float32)
+    obs0 = {"a": ones, "b": ones}
+    acts0 = w.get_action(obs0)
+    w.record_step(obs0, acts0, {"a": np.zeros(1), "b": np.zeros(1)},
+                  {"a": np.zeros(1), "b": np.zeros(1)})
+    # b terminates alone; a plays on — a's pending must survive
+    obs1 = {"a": 2 * ones, "b": 3 * ones}
+    acts1 = w.get_action(obs1)
+    out = w.record_step(obs1, acts1, {"a": np.zeros(1), "b": np.ones(1)},
+                        {"a": np.zeros(1), "b": np.ones(1)})
+    closed = {(aid, i) for aid, i, _ in out}
+    assert ("b", 0) in closed
+    a_closures = [t for aid, i, t in out if aid == "a" and t["done"] == 1.0]
+    # a's pending closed because it acted again, NOT as a terminal
+    a_all = [t for aid, i, t in out if aid == "a"]
+    assert all(t["done"] == 0.0 for t in a_all)
+    assert ("a", 0) in {(aid, i) for aid, i, _ in out}
+    # later: env autoresets (e.g. a finished too) -> autoreset mask closes all
+    obs2 = {"a": np.full((1, 2), np.nan, np.float32),
+            "b": np.full((1, 2), np.nan, np.float32)}
+    out = w.record_step(obs2, {"a": None, "b": None},
+                        {"a": np.full(1, np.nan), "b": np.full(1, np.nan)},
+                        {"a": np.zeros(1), "b": np.zeros(1)},
+                        autoreset=np.array([True]))
+    closed = {(aid, i): t for aid, i, t in out}
+    assert closed[("a", 0)]["done"] == 1.0
+
+
+def test_partial_nan_dict_leaf_is_still_active():
+    """One all-NaN leaf (glitched sensor) must not mark the row inactive when
+    another float leaf carries finite data (review finding)."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.wrappers import AsyncAgentsWrapper
+
+    class StubMA:
+        observation_spaces = {
+            "a": gspaces.Dict({"lidar": gspaces.Box(-1, 1, (2,)),
+                               "pos": gspaces.Box(-1, 1, (2,))}),
+        }
+
+        def get_action(self, obs, **kw):
+            n = obs["a"]["pos"].shape[0]
+            return {a: np.ones(n, np.float32) for a in obs}
+
+    w = AsyncAgentsWrapper(StubMA())
+    value = {"lidar": np.full((2, 2), np.nan, np.float32),
+             "pos": np.array([[1.0, 2.0], [np.nan, np.nan]], np.float32)}
+    mask = w._inactive_rows(value)
+    # row 0: finite pos -> active despite NaN lidar; row 1: all leaves NaN
+    assert mask is not None
+    np.testing.assert_array_equal(mask, [False, True])
