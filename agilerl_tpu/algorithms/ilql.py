@@ -193,11 +193,13 @@ class ILQL(EvolvableAlgorithm):
                 if double_q:
                     cql = cql + cql_term(qs2, q2_a)
                 # direct-method margin loss: push non-data actions at least
-                # dm_margin below the data action's Q (get_dm_loss:628)
+                # dm_margin below the data action's Q. Gradients flow through
+                # BOTH sides (get_dm_loss:628 — a stop-grad on the data Q
+                # would turn the margin into a constant downward push on
+                # demonstrated actions; review finding)
                 def dm_term(q_all, q_sel):
                     viol = jnp.maximum(
-                        q_all[:, :-1] - jax.lax.stop_gradient(q_sel)[..., None]
-                        + dm_margin, 0.0
+                        q_all[:, :-1] - q_sel[..., None] + dm_margin, 0.0
                     )
                     return jnp.sum(jnp.square(viol).sum(axis=-1) * valid) / denom
 

@@ -17,20 +17,50 @@ from agilerl_tpu.components.replay_buffer import (
 
 
 class Sampler:
-    def __init__(self, memory=None, dataset=None, per: bool = False, n_step: bool = False):
+    """Dispatches sampling by buffer type (parity: sampler.py:149,182,194).
+
+    - dataset: iterate an epoch iterator (the reference's DataLoader path)
+    - PER memory: returns ``(batch, idxs, weights)``, plus the paired n-step
+      batch at the SAME indices when ``n_step_memory`` is given — the Rainbow
+      paired-buffer contract lives HERE, not only in the training loop
+    - plain memory: uniform sample; ``idxs`` forces index-aligned gathers
+    """
+
+    def __init__(self, memory=None, dataset=None, per: bool = False,
+                 n_step: bool = False, n_step_memory=None):
         self.memory = memory
         self.dataset = dataset
+        self.n_step_memory = n_step_memory
         self.per = per or isinstance(memory, PrioritizedReplayBuffer)
-        # informational: n-step pairing is driven by the training loop's
-        # paired-buffer scheme, not by the sampler itself
-        self.n_step = n_step or isinstance(memory, MultiStepReplayBuffer)
+        self.n_step = (
+            n_step
+            or n_step_memory is not None
+            or isinstance(memory, MultiStepReplayBuffer)
+        )
         self._iter = iter(dataset) if dataset is not None else None
 
     def sample(self, batch_size: int, beta: Optional[float] = None, idxs=None, **kw):
         if self._iter is not None:
             return next(self._iter)
         if self.per:
-            return self.memory.sample(batch_size, beta=beta if beta is not None else 0.4)
+            batch, idx, weights = self.memory.sample(
+                batch_size, beta=beta if beta is not None else 0.4
+            )
+            if self.n_step_memory is not None:
+                # paired n-step batch at the SAME ring positions (parity:
+                # sampler.py:194 — the buffers are index-aligned by
+                # construction in train_off_policy)
+                return (batch, idx, weights,
+                        self.n_step_memory.sample_from_indices(idx))
+            return batch, idx, weights
         if idxs is not None:
             return self.memory.sample_from_indices(idxs)
+        if self.n_step_memory is not None:
+            # non-PER paired n-step: draw shared indices so both rings return
+            # the same transitions (review finding — silently unpaired before)
+            import numpy as np
+
+            idx = np.random.randint(0, len(self.memory), size=batch_size)
+            return (self.memory.sample_from_indices(idx), idx,
+                    self.n_step_memory.sample_from_indices(idx))
         return self.memory.sample(batch_size)

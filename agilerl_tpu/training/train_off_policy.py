@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from agilerl_tpu.components.sampler import Sampler
 from agilerl_tpu.utils.utils import (
     init_wandb,
     print_hyperparams,
@@ -112,6 +113,10 @@ def train_off_policy(
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
     wandb_run = init_wandb(config=INIT_HP) if wb else None
+    sampler = Sampler(
+        memory=memory, per=per,
+        n_step_memory=n_step_memory if n_step else None,
+    )
     num_envs = getattr(env, "num_envs", 1)
     epsilon = eps_start
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
@@ -209,19 +214,16 @@ def train_off_policy(
                     and steps % max(agent.learn_step, 1) < num_envs
                 ):
                     if per:
-                        batch, idxs, weights = memory.sample(agent.batch_size)
-                        if n_step and n_step_memory is not None:
-                            n_batch = n_step_memory.sample_from_indices(idxs)
-                            result = agent.learn((batch, idxs, weights, n_batch))
-                        else:
-                            result = agent.learn((batch, idxs, weights))
+                        sampled = sampler.sample(agent.batch_size)
+                        idxs = sampled[1]
+                        result = agent.learn(sampled)
                         new_priorities = (
                             result[1] if isinstance(result, tuple) else None
                         )
                         if new_priorities is not None:
                             memory.update_priorities(idxs, new_priorities)
                     else:
-                        agent.learn(memory.sample(agent.batch_size))
+                        agent.learn(sampler.sample(agent.batch_size))
 
             agent.steps[-1] += steps
             mean_score = float(np.mean(completed_scores)) if completed_scores else float(np.mean(scores))

@@ -40,26 +40,88 @@ class RunningMeanStd:
         )
 
 
+def build_rms(observation_space, epsilon: float = 1e-4,
+              norm_obs_keys=None):
+    """RunningMeanStd tree matching a space's structure (parity: RSNorm.
+    build_rms, agent.py:274): Dict spaces get one RMS per (selected) key,
+    Tuple spaces one per element."""
+    from gymnasium import spaces as S
+
+    if isinstance(observation_space, S.Dict):
+        items = observation_space.spaces.items()
+        if norm_obs_keys is not None:
+            items = [(k, v) for k, v in items if k in norm_obs_keys]
+        return {k: build_rms(v, epsilon) for k, v in items}
+    if isinstance(observation_space, S.Tuple):
+        return tuple(build_rms(v, epsilon) for v in observation_space.spaces)
+    if isinstance(observation_space, (S.Discrete, S.MultiDiscrete, S.MultiBinary)):
+        # categorical leaves (Discrete keys feeding one-hot encoders) must
+        # stay integer — normalising them would break downstream
+        # preprocessing. Integer BOX leaves (uint8 images) DO get normalised,
+        # as in the reference's build_rms (review finding).
+        return None
+    return RunningMeanStd(getattr(observation_space, "shape", ()) or (), epsilon)
+
+
 class RSNorm:
     """Transparent observation-normalising agent wrapper (parity: agent.py:225).
 
-    Wraps any agent: intercepts get_action/learn/test, normalising observations
-    with running statistics updated during training."""
+    Wraps any agent — single- or multi-agent, flat/Dict/Tuple observation
+    spaces — intercepting get_action/learn, normalising observations with
+    running statistics updated during training. ``norm_obs_keys`` restricts
+    which Dict keys are normalised (parity: agent.py:252)."""
 
-    def __init__(self, agent):
+    def __init__(self, agent, epsilon: float = 1e-4, norm_obs_keys=None):
         self.agent = agent
-        obs_space = getattr(agent, "observation_space", None)
-        if obs_space is not None and hasattr(obs_space, "shape") and obs_space.shape:
-            self.rms: Any = RunningMeanStd(obs_space.shape)
+        self.norm_obs_keys = norm_obs_keys
+        self.multi_agent = hasattr(agent, "observation_spaces") and isinstance(
+            getattr(agent, "observation_spaces"), dict
+        )
+        if self.multi_agent:
+            self.obs_rms: Any = {
+                aid: build_rms(space, epsilon, norm_obs_keys)
+                for aid, space in agent.observation_spaces.items()
+            }
         else:
-            self.rms = RunningMeanStd(())
+            self.obs_rms = build_rms(
+                getattr(agent, "observation_space", None), epsilon, norm_obs_keys
+            )
+
+    # back-compat: flat single-agent callers read .rms
+    @property
+    def rms(self):
+        return self.obs_rms
+
+    @staticmethod
+    def _apply(rms, obs, update: bool):
+        if rms is None:  # unnormalised leaf (integer space or unknown)
+            return obs
+        if not isinstance(rms, (dict, tuple)) and isinstance(obs, (dict, tuple)):
+            # structure mismatch (agent without a gymnasium Dict space emitting
+            # dict obs): pass through rather than crash (review finding — the
+            # pre-rewrite wrapper passed dict obs through unconditionally)
+            return obs
+        if isinstance(rms, dict):
+            out = dict(obs)
+            for k, sub in rms.items():
+                out[k] = RSNorm._apply(sub, obs[k], update)
+            return out
+        if isinstance(rms, tuple):
+            return tuple(
+                RSNorm._apply(sub, o, update) for sub, o in zip(rms, obs)
+            )
+        if update:
+            rms.update(obs)
+        return rms.normalize(obs)
 
     def _norm_obs(self, obs, update: bool = True):
-        if isinstance(obs, dict):
-            return obs  # dict spaces: pass through (per-key norm TODO parity)
-        if update:
-            self.rms.update(obs)
-        return self.rms.normalize(obs)
+        if self.multi_agent:
+            return {
+                aid: self._apply(self.obs_rms[aid], o, update)
+                if o is not None else None
+                for aid, o in obs.items()
+            }
+        return self._apply(self.obs_rms, obs, update)
 
     def get_action(self, obs, *args, training: bool = True, **kwargs):
         obs = self._norm_obs(obs, update=training)
@@ -67,10 +129,12 @@ class RSNorm:
 
     def _norm_batch(self, batch):
         batch = dict(batch)
-        if "obs" in batch and not isinstance(batch["obs"], dict):
-            batch["obs"] = self.rms.normalize(np.asarray(batch["obs"]))
-        if "next_obs" in batch and not isinstance(batch["next_obs"], dict):
-            batch["next_obs"] = self.rms.normalize(np.asarray(batch["next_obs"]))
+        for key in ("obs", "next_obs"):
+            if key in batch:
+                if self.multi_agent:
+                    batch[key] = self._norm_obs(batch[key], update=False)
+                else:
+                    batch[key] = self._apply(self.obs_rms, batch[key], update=False)
         return batch
 
     def learn(self, experiences, *args, **kwargs):
@@ -307,11 +371,15 @@ class AsyncAgentsWrapper:
         inactive rows are NaN per get_placeholder_value and skipped.
 
         ``autoreset``: boolean [N] mask of env rows whose EPISODE just ended
-        (AsyncPettingZooVecEnv provides it as ``info["autoreset"]``). Pending
-        transitions at those rows close with done=1 so nothing bootstraps
-        into the next episode. Without it, the fallback is rows where EVERY
-        agent reports done — one agent dying mid-episode must NOT terminate
-        its teammates' in-flight transitions (review finding).
+        (AsyncPettingZooVecEnv provides it as ``info["autoreset"]``) — pass it
+        for EXACT closure semantics: pending transitions close with done=1
+        precisely at autoreset rows, and one agent dying mid-episode leaves
+        its teammates' in-flight transitions open. Without the mask the
+        fallback is conservative: ANY agent's done closes all pendings at
+        that row (turn-based envs report done only for the agent that acted
+        last — an AND-of-dones would never fire and stale pendings would
+        bootstrap across the reset, which is strictly worse than the
+        occasional early closure).
 
         Returns a list of ``(agent_id, env_idx, transition)`` triples.
         """
@@ -326,7 +394,7 @@ class AsyncAgentsWrapper:
                 d = np.asarray(d, np.float64).reshape(-1)
                 flags = np.nan_to_num(d, nan=0.0).astype(bool)
                 episode_end = flags if episode_end is None \
-                    else (episode_end & flags)
+                    else (episode_end | flags)
         for aid, r in rewards.items():
             if r is None:
                 continue

@@ -45,3 +45,49 @@ def test_sampler_dataset_path():
     assert b1["obs"].shape == (4, 3)
     # consecutive draws differ (key advanced)
     assert not np.array_equal(np.asarray(b1["reward"]), np.asarray(b2["reward"]))
+
+
+def test_sampler_per_plus_nstep_paired_dispatch():
+    """The Rainbow paired-buffer contract: PER sample + n-step batch gathered
+    at the SAME ring indices (parity: sampler.py:194)."""
+    from agilerl_tpu.components import MultiStepReplayBuffer
+
+    per = PrioritizedReplayBuffer(max_size=64)
+    nstep = MultiStepReplayBuffer(max_size=64, n_step=1, gamma=0.99)
+    rng = np.random.default_rng(0)
+    for i in range(32):
+        t = {
+            "obs": np.full(3, i, np.float32),
+            "action": np.int32(i % 2),
+            "reward": np.float32(i),
+            "next_obs": rng.normal(size=3).astype(np.float32),
+            "done": np.float32(0),
+        }
+        per.add(dict(t))
+        nstep.add(dict(t))
+    s = Sampler(memory=per, n_step_memory=nstep)
+    assert s.per and s.n_step
+    batch, idxs, weights, n_batch = s.sample(8, beta=0.5)
+    # same indices -> same obs rows in both batches (obs encodes the index)
+    np.testing.assert_array_equal(
+        np.asarray(batch["obs"]), np.asarray(n_batch["obs"])
+    )
+
+
+def test_sampler_non_per_paired_nstep():
+    """Non-PER memories with a paired n-step buffer must still return
+    index-aligned batches (review finding)."""
+    from agilerl_tpu.components import MultiStepReplayBuffer
+
+    main = ReplayBuffer(max_size=64)
+    nstep = MultiStepReplayBuffer(max_size=64, n_step=1, gamma=0.99)
+    for i in range(32):
+        t = {"obs": np.full(3, i, np.float32), "action": np.int32(0),
+             "reward": np.float32(i), "next_obs": np.zeros(3, np.float32),
+             "done": np.float32(0)}
+        main.add(dict(t))
+        nstep.add(dict(t))
+    s = Sampler(memory=main, n_step_memory=nstep)
+    batch, idx, n_batch = s.sample(8)
+    np.testing.assert_array_equal(np.asarray(batch["obs"]),
+                                  np.asarray(n_batch["obs"]))

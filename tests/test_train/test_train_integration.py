@@ -379,3 +379,29 @@ def test_wandb_branch_on_policy(fake_wandb):
         wb=True, verbose=False,
     )
     assert any("eval/mean_fitness" in m for m in fake_wandb.logged)
+
+
+def test_save_elite_and_target_early_stop(tmp_path):
+    """Trainer branches: save_elite writes the elite checkpoint after
+    evolution; target fitness triggers early stop."""
+    from agilerl_tpu.hpo import Mutations, TournamentSelection
+
+    env = JaxVecEnv(CartPole(), num_envs=2, seed=0)
+    pop = _dqn_pop(env, size=2)
+    elite_path = tmp_path / "elite"
+    elite_path.mkdir()
+    pop, fitnesses = train_off_policy(
+        env, "CartPole-v1", "DQN", pop, ReplayBuffer(max_size=512),
+        max_steps=10_000, evo_steps=50, eval_steps=10, eval_loop=1,
+        tournament=TournamentSelection(2, True, 2, 1),
+        mutation=Mutations(no_mutation=1.0, architecture=0, parameters=0,
+                           activation=0, rl_hp=0, rand_seed=0),
+        save_elite=True, elite_path=str(elite_path),
+        target=0.0,  # any finite fitness beats it -> stops after 1st eval
+        verbose=False,
+    )
+    # early stop: far fewer steps than max_steps
+    assert pop[0].steps[-1] < 1000
+    assert list(elite_path.glob("*_elite.ckpt"))
+    # every member got exactly one eval before stopping
+    assert all(len(f) == 1 for f in fitnesses)

@@ -207,15 +207,17 @@ def test_one_agent_death_does_not_close_teammates_pendings():
     obs0 = {"a": ones, "b": ones}
     acts0 = w.get_action(obs0)
     w.record_step(obs0, acts0, {"a": np.zeros(1), "b": np.zeros(1)},
-                  {"a": np.zeros(1), "b": np.zeros(1)})
-    # b terminates alone; a plays on — a's pending must survive
+                  {"a": np.zeros(1), "b": np.zeros(1)},
+                  autoreset=np.array([False]))
+    # b terminates alone; a plays on — with the explicit autoreset mask
+    # (False: episode continues) a's transitions must NOT close as terminal
     obs1 = {"a": 2 * ones, "b": 3 * ones}
     acts1 = w.get_action(obs1)
     out = w.record_step(obs1, acts1, {"a": np.zeros(1), "b": np.ones(1)},
-                        {"a": np.zeros(1), "b": np.ones(1)})
+                        {"a": np.zeros(1), "b": np.ones(1)},
+                        autoreset=np.array([False]))
     closed = {(aid, i) for aid, i, _ in out}
     assert ("b", 0) in closed
-    a_closures = [t for aid, i, t in out if aid == "a" and t["done"] == 1.0]
     # a's pending closed because it acted again, NOT as a terminal
     a_all = [t for aid, i, t in out if aid == "a"]
     assert all(t["done"] == 0.0 for t in a_all)
@@ -255,3 +257,114 @@ def test_partial_nan_dict_leaf_is_still_active():
     # row 0: finite pos -> active despite NaN lidar; row 1: all leaves NaN
     assert mask is not None
     np.testing.assert_array_equal(mask, [False, True])
+
+
+def test_rsnorm_dict_and_multi_agent():
+    """RSNorm normalises Dict spaces per key (integer keys pass through) and
+    multi-agent dict-of-spaces per agent (parity: RSNorm.build_rms,
+    agent.py:274)."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.wrappers import RSNorm
+
+    class DictAgent:
+        observation_space = gspaces.Dict({
+            "x": gspaces.Box(-10, 10, (3,), np.float32),
+            "d": gspaces.Discrete(4),
+        })
+        seen = None
+
+        def get_action(self, obs, **kw):
+            self.seen = obs
+            return 0
+
+    agent = DictAgent()
+    w = RSNorm(agent)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        w.get_action({"x": rng.normal(5.0, 2.0, (8, 3)).astype(np.float32),
+                      "d": rng.integers(0, 4, (8,))})
+    # float key normalised toward zero mean, int key untouched
+    assert abs(float(np.mean(agent.seen["x"]))) < 1.5
+    assert np.issubdtype(np.asarray(agent.seen["d"]).dtype, np.integer)
+    assert w.obs_rms["x"].count > 100
+
+    class MAAgent:
+        observation_spaces = {
+            "a_0": gspaces.Box(-10, 10, (2,), np.float32),
+            "a_1": gspaces.Box(-10, 10, (2,), np.float32),
+        }
+        seen = None
+
+        def get_action(self, obs, **kw):
+            self.seen = obs
+            return {a: 0 for a in obs}
+
+    ma = MAAgent()
+    wma = RSNorm(ma)
+    for _ in range(20):
+        wma.get_action({
+            "a_0": rng.normal(3.0, 1.0, (4, 2)).astype(np.float32),
+            "a_1": rng.normal(-3.0, 1.0, (4, 2)).astype(np.float32),
+        })
+    assert abs(float(np.mean(ma.seen["a_0"]))) < 1.0
+    assert abs(float(np.mean(ma.seen["a_1"]))) < 1.0
+    # norm_obs_keys restricts which Dict keys normalise
+    class Dict2(DictAgent):
+        observation_space = gspaces.Dict({
+            "x": gspaces.Box(-10, 10, (3,), np.float32),
+            "y": gspaces.Box(-10, 10, (3,), np.float32),
+        })
+
+    a2 = Dict2()
+    w2 = RSNorm(a2, norm_obs_keys=["x"])
+    batch = {"x": np.full((4, 3), 7.0, np.float32),
+             "y": np.full((4, 3), 7.0, np.float32)}
+    for _ in range(10):
+        w2.get_action({k: v.copy() for k, v in batch.items()})
+    np.testing.assert_array_equal(a2.seen["y"], 7.0)  # untouched
+    assert float(np.max(np.abs(a2.seen["x"]))) < 7.0  # normalised
+
+
+def test_rsnorm_unknown_space_dict_obs_passes_through():
+    """Agents without a gymnasium Dict space that emit dict observations must
+    pass through unnormalised, not crash (review finding)."""
+    from agilerl_tpu.wrappers import RSNorm
+
+    class NoSpaceAgent:
+        seen = None
+
+        def get_action(self, obs, **kw):
+            self.seen = obs
+            return 0
+
+    agent = NoSpaceAgent()
+    w = RSNorm(agent)
+    obs = {"x": np.ones((2, 3), np.float32)}
+    w.get_action(obs)
+    assert agent.seen is obs  # untouched
+
+
+def test_rsnorm_normalises_uint8_image_box():
+    """Integer BOX leaves (uint8 images) DO get running-stat normalisation;
+    only categorical spaces pass through (review finding)."""
+    from gymnasium import spaces as gspaces
+
+    from agilerl_tpu.wrappers import RSNorm
+
+    class ImgAgent:
+        observation_space = gspaces.Box(0, 255, (4, 4, 1), np.uint8)
+        seen = None
+
+        def get_action(self, obs, **kw):
+            self.seen = obs
+            return 0
+
+    agent = ImgAgent()
+    w = RSNorm(agent)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        w.get_action(rng.integers(100, 160, (8, 4, 4, 1)).astype(np.uint8))
+    # normalised floats near zero mean, not raw 0-255
+    assert np.issubdtype(np.asarray(agent.seen).dtype, np.floating)
+    assert abs(float(np.mean(agent.seen))) < 2.0

@@ -1,15 +1,17 @@
-"""Multi-agent off-policy benchmarking
-(parity: benchmarking/benchmarking_multi_agent_off_policy.py)."""
+"""Tutorial — MADDPG on a cooperative multi-agent env
+(parity: tutorials/pettingzoo/maddpg.py — space_invaders/simple_speaker
+become the pure-JAX SimpleSpread so rollouts run under jit; any PettingZoo
+parallel env works via vector.PettingZooVecEnv)."""
 
-# allow running directly as `python <dir>/<script>.py` from a source checkout
+# allow running directly as `python tutorials/<dir>/<script>.py` from a source checkout
 import os as _os, sys as _sys  # noqa: E402
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
 if _os.environ.get("JAX_PLATFORMS"):  # some plugin backends ignore the env var
     import jax as _jax
 
     _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
-import time
+import numpy as np
 
 from agilerl_tpu.components import MultiAgentReplayBuffer
 from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
@@ -19,26 +21,20 @@ from agilerl_tpu.training.train_multi_agent_off_policy import (
 )
 from agilerl_tpu.utils.utils import create_population
 
-
-def main():
-    env = MultiAgentJaxVecEnv(SimpleSpreadJax(n_agents=2), num_envs=8, seed=0)
+if __name__ == "__main__":
+    env = MultiAgentJaxVecEnv(SimpleSpreadJax(n_agents=3), num_envs=8, seed=0)
     pop = create_population(
         "MADDPG", env.observation_spaces, env.action_spaces,
-        agent_ids=env.agent_ids, population_size=4,
+        agent_ids=env.agent_ids, population_size=4, seed=42,
         net_config={"latent_dim": 32, "encoder_config": {"hidden_size": (64,)}},
+        INIT_HP={"BATCH_SIZE": 64, "LEARN_STEP": 8},
     )
     memory = MultiAgentReplayBuffer(max_size=100_000, agent_ids=env.agent_ids)
-    start = time.time()
     pop, fitnesses = train_multi_agent_off_policy(
-        env, "SimpleSpread", "MADDPG", pop, memory,
-        max_steps=50_000, evo_steps=5_000,
+        env, "simple-spread", "MADDPG", pop, memory,
+        max_steps=20_000, evo_steps=2_000,
         tournament=TournamentSelection(2, True, 4, 1),
         mutation=Mutations(no_mutation=0.4, architecture=0.2, parameters=0.2,
                            activation=0.0, rl_hp=0.2),
     )
-    steps = sum(a.steps[-1] for a in pop)
-    print(f"steps/sec: {steps / (time.time() - start):.0f}")
-
-
-if __name__ == "__main__":
-    main()
+    print("best fitness:", max(max(f) for f in fitnesses))
