@@ -57,10 +57,18 @@ class Sampler:
             return self.memory.sample_from_indices(idxs)
         if self.n_step_memory is not None:
             # non-PER paired n-step: draw shared indices so both rings return
-            # the same transitions (review finding — silently unpaired before)
-            import numpy as np
+            # the same transitions, and keep the agents' 4-tuple contract
+            # (batch, idxs, weights, n_batch) with uniform IS weights.
+            # Indices come from the buffer's own PRNG key (deterministic
+            # under seeding; global np.random would not be — review finding).
+            import jax
+            import jax.numpy as jnp
 
-            idx = np.random.randint(0, len(self.memory), size=batch_size)
-            return (self.memory.sample_from_indices(idx), idx,
+            key = kw.get("key")
+            if key is None:
+                self.memory._key, key = jax.random.split(self.memory._key)
+            idx = jax.random.randint(key, (batch_size,), 0, len(self.memory))
+            weights = jnp.ones((batch_size,), jnp.float32)
+            return (self.memory.sample_from_indices(idx), idx, weights,
                     self.n_step_memory.sample_from_indices(idx))
         return self.memory.sample(batch_size)

@@ -42,6 +42,16 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
     use_flash_attention: bool = False  # Pallas kernel on the non-cached path
+    # Mixture-of-Experts (beyond reference parity — completes the ep axis of
+    # the dp/fsdp/tp/sp/ep strategy menu, SURVEY.md §2.8):
+    n_experts: int = 0  # 0 = dense FFN everywhere
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 1  # layer i is MoE iff (i + 1) % moe_every == 0
+    router_aux_weight: float = 0.01
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.n_experts > 0 and (i + 1) % self.moe_every == 0
 
     @property
     def kv_heads(self) -> int:
@@ -96,7 +106,7 @@ def init_params(key: jax.Array, config: GPTConfig) -> Params:
         "ln_f": jnp.ones((d,), jnp.float32),
     }
     for i in range(config.n_layer):
-        ks = jax.random.split(keys[i + 1], 7)
+        ks = jax.random.split(keys[i + 1], 8)
         blk = {
             "ln1": jnp.ones((d,), jnp.float32),
             "wq": _normal(ks[0], (d, nh * hd), std),
@@ -104,10 +114,17 @@ def init_params(key: jax.Array, config: GPTConfig) -> Params:
             "wv": _normal(ks[2], (d, nkv * hd), std),
             "wo": _normal(ks[3], (nh * hd, d), out_std),
             "ln2": jnp.ones((d,), jnp.float32),
-            "w_gate": _normal(ks[4], (d, f), std),
-            "w_up": _normal(ks[5], (d, f), std),
-            "w_down": _normal(ks[6], (f, d), out_std),
         }
+        if config.is_moe_layer(i):
+            E = config.n_experts
+            blk["router"] = _normal(ks[7], (d, E), std)
+            blk["w_gate"] = _normal(ks[4], (E, d, f), std)
+            blk["w_up"] = _normal(ks[5], (E, d, f), std)
+            blk["w_down"] = _normal(ks[6], (E, f, d), out_std)
+        else:
+            blk["w_gate"] = _normal(ks[4], (d, f), std)
+            blk["w_up"] = _normal(ks[5], (d, f), std)
+            blk["w_down"] = _normal(ks[6], (f, d), out_std)
         if config.qkv_bias:
             blk["bq"] = jnp.zeros((nh * hd,), jnp.float32)
             blk["bk"] = jnp.zeros((nkv * hd,), jnp.float32)
@@ -140,6 +157,15 @@ def init_lora(
         "w_up": (d, config.ff_dim),
         "w_down": (config.ff_dim, d),
     }
+    ffn_names = ("w_gate", "w_up", "w_down")
+    if config.n_experts > 0 and any(t in ffn_names for t in targets):
+        # MoE FFN weights are expert-stacked [E, ...]; the dense-shaped
+        # adapters below would silently never be consulted by the MoE branch
+        # of forward (review finding) — refuse loudly instead.
+        raise ValueError(
+            "LoRA on FFN projections is not supported for MoE layers; "
+            f"restrict targets to attention projections {LORA_TARGETS}"
+        )
     lora: Dict = {"blocks": {}}
     target_ids = {name: idx for idx, name in enumerate(sorted(dims))}
     for i in range(config.n_layer):
@@ -212,6 +238,7 @@ def forward(
     flash: Optional[bool] = None,  # override config.use_flash_attention
     # (the Pallas kernel is forward-only: keep flash OFF inside loss grads
     # until the custom-VJP lands; no-grad logprob/generate paths may enable it)
+    return_aux: bool = False,  # also return the MoE router load-balance loss
 ) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
     """Returns (hidden [B, T, D] float32, new caches). With a cache, tokens are
     appended at cache.length (all rows share a length — use left-padding for
@@ -293,23 +320,37 @@ def forward(
         h = h + attn
 
         x = _rms(h, blk["ln2"], config.rms_eps)
+        if "router" in blk:
+            from agilerl_tpu.llm.moe import moe_ffn
+
+            out2d, aux = moe_ffn(
+                x.reshape(B * T, config.d_model),
+                blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
+                top_k=config.expert_top_k,
+                capacity_factor=config.capacity_factor,
+            )
+            return h + out2d.reshape(B, T, config.d_model), new_cache, aux
         gate = _maybe_lora(x, blk["w_gate"], lora_layer, "w_gate", lora_scale, dtype)
         up = _maybe_lora(x, blk["w_up"], lora_layer, "w_up", lora_scale, dtype)
         down = _maybe_lora(
             jax.nn.silu(gate) * up, blk["w_down"], lora_layer, "w_down", lora_scale, dtype
         )
-        return h + down, new_cache
+        return h + down, new_cache, jnp.zeros((), jnp.float32)
 
+    aux_total = jnp.zeros((), jnp.float32)
     for i in range(config.n_layer):
         blk = params["blocks"][str(i)]
         lora_layer = lora["blocks"].get(str(i)) if lora is not None else None
         layer_cache = cache[str(i)] if cache is not None else None
         fn = jax.checkpoint(block_fn, static_argnums=()) if config.remat else block_fn
-        h, new_cache = fn(h, blk, layer_cache, lora_layer)
+        h, new_cache, aux = fn(h, blk, layer_cache, lora_layer)
+        aux_total = aux_total + aux
         if new_caches is not None:
             new_caches[str(i)] = new_cache
 
     h = _rms(h, params["ln_f"], config.rms_eps).astype(jnp.float32)
+    if return_aux:
+        return h, new_caches, aux_total
     return h, new_caches
 
 
@@ -325,7 +366,11 @@ def apply(
     tokens: jax.Array,
     **kw,
 ) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
-    """Full forward to logits."""
+    """Full forward to logits. With return_aux=True also returns the MoE
+    router load-balance loss: (logits, caches, aux)."""
+    if kw.get("return_aux"):
+        hidden, caches, aux = forward(config, params, tokens, **kw)
+        return logits_fn(config, params, hidden), caches, aux
     hidden, caches = forward(config, params, tokens, **kw)
     return logits_fn(config, params, hidden), caches
 

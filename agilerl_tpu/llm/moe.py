@@ -1,0 +1,83 @@
+"""Mixture-of-Experts FFN with expert parallelism (beyond reference parity —
+the reference has no MoE or expert-parallel path at all, SURVEY.md §2.8 row
+"Expert parallelism: n/a"; this completes the dp/fsdp/tp/sp/ep strategy menu).
+
+TPU-first design: dense capacity-bucketed dispatch — routing is expressed as
+one-hot einsums over static shapes ([tokens, E, C] dispatch/combine tensors),
+so the whole layer is three big MXU matmuls plus elementwise gating. No
+scatter/gather, no dynamic shapes, nothing XLA can't tile. With the stacked
+expert weights sharded ``P("ep", ...)`` and tokens sharded on the batch axis,
+GSPMD inserts the canonical all-to-all pair around the expert compute.
+
+Load balancing is the Switch-Transformer auxiliary loss
+(E * sum_e fraction_e * mean_prob_e), returned alongside the output so the
+training loss can add ``router_aux_weight * aux``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_capacity(num_tokens: int, n_experts: int, top_k: int, capacity_factor: float) -> int:
+    """Static per-expert capacity bucket size."""
+    return max(1, int(math.ceil(top_k * num_tokens / n_experts * capacity_factor)))
+
+
+def moe_ffn(
+    x: jax.Array,  # [N, d] tokens (flattened batch*seq)
+    router_w: jax.Array,  # [d, E]
+    w_gate: jax.Array,  # [E, d, f] stacked expert SwiGLU gate
+    w_up: jax.Array,  # [E, d, f]
+    w_down: jax.Array,  # [E, f, d]
+    top_k: int,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (out [N, d], aux_loss scalar float32).
+
+    Tokens overflowing an expert's capacity bucket are dropped for that expert
+    (their other top-k routes still apply; a fully-dropped token passes through
+    the residual connection unchanged — standard Switch semantics).
+    """
+    N, d = x.shape
+    E = router_w.shape[-1]
+    dtype = x.dtype
+
+    logits = (x @ router_w.astype(dtype)).astype(jnp.float32)  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [N, k]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    C = moe_capacity(N, E, top_k, capacity_factor)
+
+    # position of each (token, route) inside its expert's bucket: priority is
+    # (k-slot major, token minor) so top-1 routes win bucket slots over top-2
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [N, k, E]
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * N, E)  # k-major ordering
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # [k*N, E]
+    pos = (pos_flat * flat).sum(-1).reshape(top_k, N).T  # [N, k]
+    keep = (pos < C).astype(jnp.float32)
+
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)  # [N, k, C]
+    pos_oh = pos_oh * keep[..., None]
+    # dispatch [N, E, C]: 1 where token n occupies slot c of expert e
+    dispatch = jnp.einsum("nke,nkc->nec", onehot, pos_oh).astype(dtype)
+    # combine adds the normalised gate weight
+    combine = jnp.einsum("nke,nkc,nk->nec", onehot, pos_oh, gate_vals).astype(dtype)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)  # [E, C, d]
+    g = jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dtype))
+    u = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dtype))
+    y = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, w_down.astype(dtype))
+    out = jnp.einsum("nec,ecd->nd", combine, y)
+
+    # Switch aux loss: E * sum_e f_e * p_e over the top-1 assignment
+    top1 = onehot[:, 0, :]  # [N, E]
+    frac = top1.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = (E * jnp.sum(frac * mean_prob)).astype(jnp.float32)
+    return out, aux

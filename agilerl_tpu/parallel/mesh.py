@@ -24,12 +24,17 @@ from agilerl_tpu.llm.model import GPTConfig
 
 
 def make_mesh(
-    dp: int = 1, fsdp: int = 1, tp: int = 1, devices=None
+    dp: int = 1, fsdp: int = 1, tp: int = 1, ep: int = 1, devices=None
 ) -> Mesh:
-    """Build a (dp, fsdp, tp) mesh. dp*fsdp*tp must equal len(devices)."""
+    """Build a (dp, fsdp, tp[, ep]) mesh. Product must equal len(devices).
+    The ep axis (expert parallelism for MoE layers) is only added when > 1 so
+    existing 3-axis programs are untouched."""
     devices = devices if devices is not None else jax.devices()
-    n = dp * fsdp * tp
-    assert n == len(devices), f"mesh {dp}x{fsdp}x{tp} != {len(devices)} devices"
+    n = dp * fsdp * tp * ep
+    assert n == len(devices), f"mesh {dp}x{fsdp}x{tp}x{ep} != {len(devices)} devices"
+    if ep > 1:
+        arr = np.asarray(devices).reshape(dp, fsdp, tp, ep)
+        return Mesh(arr, axis_names=("dp", "fsdp", "tp", "ep"))
     arr = np.asarray(devices).reshape(dp, fsdp, tp)
     return Mesh(arr, axis_names=("dp", "fsdp", "tp"))
 
@@ -61,8 +66,10 @@ def make_multislice_mesh(dcn_dp: int, fsdp: int, tp: int = 1) -> Mesh:
 
 
 def gpt_param_specs(config: GPTConfig) -> Dict:
-    """PartitionSpec tree matching llm/model.init_params."""
-    block = {
+    """PartitionSpec tree matching llm/model.init_params. MoE layers shard the
+    stacked expert weights on the ep axis (one all-to-all pair per layer,
+    inserted by GSPMD around the expert einsums in llm/moe.py)."""
+    dense_block = {
         "ln1": P(),
         "wq": P("fsdp", "tp"),
         "wk": P("fsdp", "tp"),
@@ -73,9 +80,23 @@ def gpt_param_specs(config: GPTConfig) -> Dict:
         "w_up": P("fsdp", "tp"),
         "w_down": P("tp", "fsdp"),
     }
+    moe_block = {
+        **dense_block,
+        "router": P(),
+        "w_gate": P("ep", "fsdp", "tp"),
+        "w_up": P("ep", "fsdp", "tp"),
+        "w_down": P("ep", "tp", "fsdp"),
+    }
+    if config.qkv_bias:
+        bias = {"bq": P("tp"), "bk": P("tp"), "bv": P("tp")}
+        dense_block.update(bias)
+        moe_block.update(bias)
     specs = {
         "tok_emb": P("tp", "fsdp"),
-        "blocks": {str(i): dict(block) for i in range(config.n_layer)},
+        "blocks": {
+            str(i): dict(moe_block if config.is_moe_layer(i) else dense_block)
+            for i in range(config.n_layer)
+        },
         "ln_f": P(),
     }
     if not config.tie_embeddings:
@@ -133,7 +154,12 @@ def shard_like(tree: Any, template: Any, template_specs: Any, mesh: Mesh) -> Any
 
 
 def shard_params(params: Any, config: GPTConfig, mesh: Mesh) -> Any:
-    specs = gpt_param_specs(config)
+    # drop axes the mesh doesn't carry (e.g. MoE "ep" specs on a dp/fsdp/tp
+    # mesh — review finding: NamedSharding rejects unknown axis names)
+    specs = jax.tree_util.tree_map(
+        lambda s: filter_spec(s, mesh), gpt_param_specs(config),
+        is_leaf=lambda x: isinstance(x, P),
+    )
     return jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params, specs,
@@ -162,7 +188,10 @@ def make_sharded_grpo_step(agent, mesh: Mesh):
     and letting GSPMD insert collectives. (Prefer agent.to_mesh(mesh) + the
     normal learn() API; this builder returns the raw update for benchmarking.)"""
     config = agent.model_config
-    specs = gpt_param_specs(config)
+    specs = jax.tree_util.tree_map(
+        lambda s: filter_spec(s, mesh), gpt_param_specs(config),
+        is_leaf=lambda x: isinstance(x, P),
+    )
     base = jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), agent.base_params, specs
     )

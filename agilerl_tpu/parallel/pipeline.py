@@ -1,0 +1,197 @@
+"""Pipeline parallelism (GPipe-style microbatching over a "pp" mesh axis).
+
+Beyond reference parity: the reference has no pipeline parallelism
+(SURVEY.md §2.8 row "Pipeline parallelism: absent"); this completes the
+dp/fsdp/tp/sp/ep/pp strategy menu.
+
+TPU-first design: the transformer blocks are stacked into one [L, ...] pytree
+and split into S contiguous stages sharded ``P("pp", ...)``. A ``shard_map``
+program runs the classic GPipe schedule as a ``lax.scan`` over M + S - 1
+ticks: every tick each stage applies its local layers (a ``lax.scan`` over
+the stage's slice) and hands its activation to the next stage with a single
+``lax.ppermute`` hop over ICI. Because the schedule is a scan of pure ops
+(ppermute included), reverse-mode AD through the whole pipeline works out of
+the box — XLA replays the ticks backwards, giving the standard GPipe
+backward schedule without hand-written send/recv code (contrast: torch PP
+frameworks hand-schedule NCCL p2p ops).
+
+Embedding and the LM head stay replicated outside the shard_map (they are
+cheap and XLA dedupes the computation); only the block stack is staged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from agilerl_tpu.llm.model import GPTConfig, _rms, _rope
+
+Params = Any
+
+
+def stack_blocks(params: Params, config: GPTConfig) -> Params:
+    """Per-layer dicts -> one stacked [L, ...] tree. Requires homogeneous
+    blocks (dense everywhere, or MoE with moe_every == 1)."""
+    blocks = [params["blocks"][str(i)] for i in range(config.n_layer)]
+    keys0 = set(blocks[0])
+    assert all(set(b) == keys0 for b in blocks), (
+        "pipeline stages need homogeneous blocks (interleaved MoE unsupported)"
+    )
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def unstack_blocks(stacked: Params, config: GPTConfig) -> Dict[str, Params]:
+    return {
+        str(i): jax.tree_util.tree_map(lambda x: x[i], stacked)
+        for i in range(config.n_layer)
+    }
+
+
+def _block_apply(config: GPTConfig, blk: Params, h: jax.Array,
+                 mask: jax.Array, positions: jax.Array) -> jax.Array:
+    """One transformer block on [B, T, d] (no cache, no LoRA — the pipeline
+    path is for full-parameter training; mirrors llm/model.block_fn)."""
+    B, T, _ = h.shape
+    dtype = h.dtype
+    x = _rms(h, blk["ln1"], config.rms_eps)
+    q, k, v = x @ blk["wq"].astype(dtype), x @ blk["wk"].astype(dtype), x @ blk["wv"].astype(dtype)
+    if config.qkv_bias:
+        q = q + blk["bq"].astype(dtype)
+        k = k + blk["bk"].astype(dtype)
+        v = v + blk["bv"].astype(dtype)
+    q = q.reshape(B, T, config.n_head, config.head_dim)
+    k = k.reshape(B, T, config.kv_heads, config.head_dim)
+    v = v.reshape(B, T, config.kv_heads, config.head_dim)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    rep = config.n_head // config.kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qh, kh, vh = (jnp.moveaxis(a, 2, 1) for a in (q, k, v))
+    scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
+    scores = scores / math.sqrt(config.head_dim)
+    t_ids = jnp.arange(T)
+    causal = t_ids[None, None, :] <= t_ids[None, :, None]
+    full_mask = jnp.logical_and(causal, mask[:, None, :].astype(bool))
+    scores = jnp.where(full_mask[:, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+    attn = jnp.moveaxis(attn, 1, 2).reshape(B, T, config.n_head * config.head_dim)
+    h = h + attn @ blk["wo"].astype(dtype)
+    x = _rms(h, blk["ln2"], config.rms_eps)
+    gate = x @ blk["w_gate"].astype(dtype)
+    up = x @ blk["w_up"].astype(dtype)
+    return h + (jax.nn.silu(gate) * up) @ blk["w_down"].astype(dtype)
+
+
+def pipeline_hidden_fn(
+    config: GPTConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+):
+    """Build ``fn(stacked_blocks, h0, mask, positions) -> hidden`` running the
+    block stack as a GPipe pipeline over ``mesh[axis]``.
+
+    - ``stacked_blocks``: [L, ...] tree (shard with ``P(axis)`` on dim 0)
+    - ``h0``: [B, T, d] embedded inputs (replicated); B % num_microbatches == 0
+    - returns final hidden [B, T, d] (replicated)
+    """
+    S = mesh.shape[axis]
+    assert config.n_layer % S == 0, "n_layer must divide into pipeline stages"
+    M = num_microbatches
+
+    def staged(local_blocks, h0, mask, positions):
+        # local_blocks leaves: [L/S, ...] (shard_map strips the stage dim)
+        sid = jax.lax.axis_index(axis)
+        B = h0.shape[0]
+        mb = B // M
+        h_mb = h0.reshape(M, mb, *h0.shape[1:])
+        mask_mb = mask.reshape(M, mb, *mask.shape[1:])
+        pos_mb = positions.reshape(M, mb, *positions.shape[1:])
+
+        def apply_stage(h, m, p):
+            def one_layer(carry, blk):
+                return _block_apply(config, blk, carry, m, p), None
+
+            out, _ = jax.lax.scan(one_layer, h, local_blocks)
+            return out
+
+        zeros = jnp.zeros((mb,) + h0.shape[1:], h0.dtype)
+        out_buf = jnp.zeros((M, mb) + h0.shape[1:], h0.dtype)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            h_in, out_buf = carry
+            mb_idx = t - sid  # microbatch this stage handles at tick t
+            safe = jnp.clip(mb_idx, 0, M - 1)
+            # stage 0 ingests a fresh microbatch; others use the received act
+            h_cur = jnp.where(sid == 0, h_mb[jnp.clip(t, 0, M - 1)], h_in)
+            h_out = apply_stage(h_cur, mask_mb[safe], pos_mb[safe])
+            active = (mb_idx >= 0) & (mb_idx < M)
+            written = jax.lax.dynamic_update_index_in_dim(
+                out_buf, h_out, safe, axis=0
+            )
+            out_buf = jnp.where((sid == S - 1) & active, written, out_buf)
+            h_next = jax.lax.ppermute(h_out, axis, fwd_perm)
+            return (h_next, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(
+            tick, (zeros, out_buf), jnp.arange(M + S - 1)
+        )
+        # broadcast the last stage's outputs to every stage
+        out_buf = jax.lax.psum(
+            jnp.where(sid == S - 1, out_buf, jnp.zeros_like(out_buf)), axis
+        )
+        return out_buf.reshape(B, *h0.shape[1:])
+
+    # stacked blocks shard on the stage (layer-group) dim; data replicated
+    return shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
+def pipeline_apply(
+    config: GPTConfig,
+    params: Params,
+    tokens: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    attention_mask: Optional[jax.Array] = None,
+    axis: str = "pp",
+    stacked: Optional[Params] = None,
+) -> jax.Array:
+    """Full forward to logits with the block stack pipelined over ``axis``.
+
+    Pass ``stacked=stack_blocks(params, config)`` (placed with ``P(axis)``
+    NamedShardings) to avoid re-stacking per call inside jit."""
+    assert config.n_experts == 0, (
+        "pipeline_apply stages the dense block program; pp x MoE composition "
+        "is not supported yet (shard experts on ep instead)"
+    )
+    if attention_mask is None:
+        attention_mask = jnp.ones(tokens.shape, jnp.int32)
+    positions = jnp.maximum(jnp.cumsum(attention_mask, axis=-1) - 1, 0)
+    h0 = jnp.take(params["tok_emb"], tokens, axis=0).astype(config.dtype)
+    if stacked is None:
+        stacked = stack_blocks(params, config)
+    fn = pipeline_hidden_fn(config, mesh, num_microbatches, axis)
+    hidden = fn(stacked, h0, attention_mask, positions)
+    hidden = _rms(hidden, params["ln_f"], config.rms_eps).astype(jnp.float32)
+    head = params["tok_emb"].T if config.tie_embeddings else params["lm_head"]
+    return hidden @ head.astype(jnp.float32)
+
+
+def shard_stacked_blocks(stacked: Params, mesh: Mesh, axis: str = "pp") -> Params:
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), stacked)

@@ -1,0 +1,288 @@
+"""Statistical/property tests for PER, n-step folds, GAE, and segment trees
+(parity: the reference's tests/test_components sampling-distribution and
+segment-tree property tests — SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.components import (
+    MultiStepReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from agilerl_tpu.components.rollout_buffer import RolloutBuffer
+from agilerl_tpu.components.segment_tree import MinSegmentTree, SumSegmentTree
+
+
+def _fill(buf, n, reward_fn=lambda i: 1.0):
+    for i in range(n):
+        buf.add({
+            "obs": np.float32([i, 0.0]),
+            "action": np.int32(0),
+            "reward": np.float32(reward_fn(i)),
+            "next_obs": np.float32([i + 1, 0.0]),
+            "done": np.float32(0.0),
+        })
+
+
+class TestPERSampling:
+    def test_sampling_proportional_to_priority_alpha(self):
+        """Empirical sample frequency must track p^alpha / sum(p^alpha)."""
+        alpha = 1.0
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=alpha)
+        _fill(buf, 8)
+        # row i gets priority i+1
+        buf.update_priorities(np.arange(8), np.arange(1, 9, dtype=np.float32))
+        counts = np.zeros(8)
+        draws = 400
+        for s in range(draws):
+            _, idx, _ = buf.sample(16, beta=0.4, key=jax.random.PRNGKey(s))
+            np.add.at(counts, np.asarray(idx), 1)
+        emp = counts / counts.sum()
+        expected = np.arange(1, 9) / np.arange(1, 9).sum()
+        np.testing.assert_allclose(emp, expected, atol=0.02)
+
+    def test_zero_td_error_keeps_row_sampleable(self):
+        buf = PrioritizedReplayBuffer(max_size=4, alpha=0.6)
+        _fill(buf, 4)
+        buf.update_priorities(np.arange(4), np.zeros(4, np.float32))
+        _, idx, w = buf.sample(64, beta=1.0, key=jax.random.PRNGKey(0))
+        # priorities floored -> uniform sampling, weights all 1
+        assert len(np.unique(np.asarray(idx))) == 4
+        np.testing.assert_allclose(np.asarray(w), 1.0, rtol=1e-5)
+
+    def test_is_weights_global_max_normalisation(self):
+        """Weights use the buffer-wide min priority (reference
+        replay_buffer.py:398), so max weight == 1 exactly at the min-priority
+        row and every weight is in (0, 1]."""
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=1.0)
+        _fill(buf, 8)
+        buf.update_priorities(np.arange(8), np.arange(1, 9, dtype=np.float32))
+        # sample enough to almost surely include the min-priority row
+        _, idx, w = buf.sample(256, beta=1.0, key=jax.random.PRNGKey(1))
+        w = np.asarray(w)
+        assert (w > 0).all() and (w <= 1.0 + 1e-6).all()
+        min_rows = np.asarray(idx) == 0
+        if min_rows.any():
+            np.testing.assert_allclose(w[min_rows], 1.0, rtol=1e-5)
+        # beta=0 disables correction entirely
+        _, _, w0 = buf.sample(32, beta=0.0, key=jax.random.PRNGKey(2))
+        np.testing.assert_allclose(np.asarray(w0), 1.0, rtol=1e-6)
+
+    def test_priorities_update_shifts_distribution(self):
+        buf = PrioritizedReplayBuffer(max_size=8, alpha=1.0)
+        _fill(buf, 8)
+        buf.update_priorities(np.arange(8), np.float32([100, 1, 1, 1, 1, 1, 1, 1]))
+        _, idx, _ = buf.sample(512, beta=0.4, key=jax.random.PRNGKey(3))
+        frac0 = (np.asarray(idx) == 0).mean()
+        assert frac0 > 0.8  # 100/107 ~ 0.93
+
+
+class TestNStepFold:
+    def test_three_step_return_and_successor(self):
+        """n-step fold: R = r0 + g*r1 + g^2*r2, next_obs = obs_3. The fused
+        transition lands in the buffer's ring; add() returns the oldest RAW
+        transition for the paired 1-step buffer."""
+        gamma = 0.9
+        buf = MultiStepReplayBuffer(max_size=32, n_step=3, gamma=gamma)
+        rewards = [1.0, 2.0, 4.0, 8.0]
+        raws = []
+        for i, r in enumerate(rewards):
+            out = buf.add({
+                "obs": np.float32([i, 0]),
+                "action": np.int32(0),
+                "reward": np.float32(r),
+                "next_obs": np.float32([i + 1, 0]),
+                "done": np.float32(0.0),
+            })
+            if out is not None:
+                raws.append(jax.tree_util.tree_map(np.asarray, out))
+        # two full windows: [0,1,2] and [1,2,3]
+        assert len(buf) == 2
+        assert len(raws) == 2
+        # returned raws are the UNfused 1-step transitions, in order
+        np.testing.assert_allclose(raws[0]["reward"], 1.0)
+        np.testing.assert_allclose(raws[1]["reward"], 2.0)
+        fused = jax.tree_util.tree_map(
+            np.asarray, buf.sample_from_indices(np.array([0, 1]))
+        )
+        np.testing.assert_allclose(
+            fused["reward"][0], 1.0 + gamma * 2.0 + gamma**2 * 4.0, rtol=1e-6
+        )
+        np.testing.assert_allclose(fused["obs"][0], [0, 0])
+        np.testing.assert_allclose(fused["next_obs"][0], [3, 0])
+        np.testing.assert_allclose(
+            fused["reward"][1], 2.0 + gamma * 4.0 + gamma**2 * 8.0, rtol=1e-6
+        )
+
+    def test_done_truncates_fold(self):
+        """A done inside the window freezes the fold at the terminal step."""
+        gamma = 0.5
+        buf = MultiStepReplayBuffer(max_size=32, n_step=3, gamma=gamma)
+        for i, (r, d) in enumerate([(1.0, 0.0), (2.0, 1.0), (100.0, 0.0), (200.0, 0.0)]):
+            buf.add({
+                "obs": np.float32([i, 0]),
+                "action": np.int32(0),
+                "reward": np.float32(r),
+                "next_obs": np.float32([i + 1, 0]),
+                "done": np.float32(d),
+            })
+        first = jax.tree_util.tree_map(
+            np.asarray, buf.sample_from_indices(np.array([0]))
+        )
+        # reward folds only to the done: 1 + 0.5*2, successor frozen at obs_2
+        np.testing.assert_allclose(first["reward"][0], 1.0 + 0.5 * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(first["next_obs"][0], [2, 0])
+        np.testing.assert_allclose(first["done"][0], 1.0)
+
+
+class TestGAEProperties:
+    def test_gamma_zero_advantage_is_td_residual(self):
+        """With lambda arbitrary but gamma=0: A_t = r_t - V_t."""
+        buf = RolloutBuffer(capacity=4, num_envs=2, gamma=0.0, gae_lambda=0.95)
+        rng = np.random.default_rng(0)
+        rewards, values = [], []
+        for _ in range(4):
+            r = rng.normal(size=2).astype(np.float32)
+            v = rng.normal(size=2).astype(np.float32)
+            rewards.append(r)
+            values.append(v)
+            buf.add(
+                obs=np.zeros((2, 3), np.float32), action=np.zeros(2, np.int32),
+                reward=r, done=np.zeros(2, np.float32), value=v,
+                log_prob=np.zeros(2, np.float32),
+            )
+        buf.compute_returns_and_advantages(np.zeros(2, np.float32), np.zeros(2, np.float32))
+        adv = np.asarray(buf.state.advantages)
+        np.testing.assert_allclose(adv, np.stack(rewards) - np.stack(values), rtol=1e-5)
+
+    def test_lambda_one_is_discounted_return_minus_value(self):
+        gamma = 0.9
+        buf = RolloutBuffer(capacity=3, num_envs=1, gamma=gamma, gae_lambda=1.0)
+        rewards = [1.0, 2.0, 3.0]
+        values = [0.5, 0.25, 0.125]
+        for r, v in zip(rewards, values):
+            buf.add(
+                obs=np.zeros((1, 2), np.float32), action=np.zeros(1, np.int32),
+                reward=np.float32([r]), done=np.zeros(1, np.float32),
+                value=np.float32([v]), log_prob=np.zeros(1, np.float32),
+            )
+        last_v = np.float32([2.0])
+        buf.compute_returns_and_advantages(last_v, np.zeros(1, np.float32))
+        adv = np.asarray(buf.state.advantages)[:, 0]
+        # forward discounted returns with bootstrap
+        g3 = 3.0 + gamma * 2.0
+        g2 = 2.0 + gamma * g3
+        g1 = 1.0 + gamma * g2
+        np.testing.assert_allclose(adv, [g1 - 0.5, g2 - 0.25, g3 - 0.125], rtol=1e-5)
+
+    def test_done_blocks_bootstrap(self):
+        gamma = 0.9
+        buf = RolloutBuffer(capacity=2, num_envs=1, gamma=gamma, gae_lambda=1.0)
+        buf.add(obs=np.zeros((1, 2), np.float32), action=np.zeros(1, np.int32),
+                reward=np.float32([1.0]), done=np.zeros(1, np.float32),
+                value=np.float32([0.0]), log_prob=np.zeros(1, np.float32))
+        # episode ends AFTER this step's reward: done flag on the NEXT row
+        buf.add(obs=np.zeros((1, 2), np.float32), action=np.zeros(1, np.int32),
+                reward=np.float32([5.0]), done=np.float32([1.0]),
+                value=np.float32([0.0]), log_prob=np.zeros(1, np.float32))
+        buf.compute_returns_and_advantages(np.float32([100.0]), np.float32([1.0]))
+        adv = np.asarray(buf.state.advantages)[:, 0]
+        # final value 100 must NOT leak through the done boundary
+        np.testing.assert_allclose(adv[1], 5.0, rtol=1e-5)
+
+
+class TestSegmentTrees:
+    def test_sum_tree_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        tree = SumSegmentTree(16)
+        vals = rng.random(16)
+        tree[np.arange(16)] = vals
+        assert np.isclose(tree.sum(), vals.sum())
+        for lo, hi in [(0, 16), (3, 9), (5, 6), (0, 1)]:
+            assert np.isclose(tree.sum(lo, hi), vals[lo:hi].sum()), (lo, hi)
+
+    def test_min_tree_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        tree = MinSegmentTree(8)
+        vals = rng.random(8)
+        tree[np.arange(8)] = vals
+        assert np.isclose(tree.min(), vals.min())
+        assert np.isclose(tree.min(2, 6), vals[2:6].min())
+
+    def test_prefix_sum_descent_inverse_cdf(self):
+        """retrieve(s) returns the first index whose cumulative sum exceeds s
+        — the inverse-CDF used by proportional PER."""
+        tree = SumSegmentTree(8)
+        vals = np.float64([1, 2, 3, 4, 0, 0, 0, 0])
+        tree[np.arange(8)] = vals
+        cum = np.cumsum(vals)
+        for s, expect in [(0.5, 0), (1.5, 1), (2.99, 1), (3.01, 2), (5.9, 2), (6.1, 3), (9.9, 3)]:
+            assert tree.retrieve(s) == expect, (s, expect, cum)
+
+    def test_partial_updates_propagate(self):
+        tree = SumSegmentTree(8)
+        tree[np.arange(8)] = np.ones(8)
+        tree[3] = 10.0
+        assert np.isclose(tree.sum(), 17.0)
+        assert np.isclose(tree.sum(0, 4), 13.0)
+
+
+class TestSamplerPairedDispatch:
+    def test_non_per_nstep_returns_agent_contract(self):
+        """Uniform + n-step pairing must return the agents' 4-tuple
+        (batch, idxs, weights=1, n_batch) with index-aligned rows drawn from
+        the buffer's own PRNG key (review findings)."""
+        from agilerl_tpu.components.sampler import Sampler
+
+        main = ReplayBuffer(max_size=64)
+        nstep = MultiStepReplayBuffer(max_size=64, n_step=3, gamma=0.9)
+        for i in range(20):
+            tr = {
+                "obs": np.float32([i, 0]),
+                "action": np.int32(0),
+                "reward": np.float32(i),
+                "next_obs": np.float32([i + 1, 0]),
+                "done": np.float32(0.0),
+            }
+            raw = nstep.add(tr)
+            if raw is not None:
+                main.add(raw)
+        sampler = Sampler(memory=main, n_step_memory=nstep)
+        batch, idx, weights, n_batch = sampler.sample(8, key=jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(weights), 1.0)
+        # paired rows refer to the same start step in both rings
+        np.testing.assert_allclose(
+            np.asarray(batch["obs"]), np.asarray(n_batch["obs"])
+        )
+        # deterministic under an explicit key
+        batch2, idx2, _, _ = sampler.sample(8, key=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(idx), np.asarray(idx2))
+
+
+class TestUniformBufferInvariants:
+    def test_ring_overwrite(self):
+        buf = ReplayBuffer(max_size=4)
+        _fill(buf, 6, reward_fn=float)
+        assert len(buf) == 4
+        batch = buf.sample(64)
+        rewards = np.unique(np.asarray(batch["reward"]))
+        # rows 0,1 were overwritten by 4,5
+        assert set(rewards).issubset({2.0, 3.0, 4.0, 5.0})
+
+    def test_batched_add(self):
+        buf = ReplayBuffer(max_size=16)
+        buf.add(
+            {
+                "obs": np.zeros((5, 2), np.float32),
+                "action": np.zeros(5, np.int32),
+                "reward": np.arange(5, dtype=np.float32),
+                "next_obs": np.zeros((5, 2), np.float32),
+                "done": np.zeros(5, np.float32),
+            },
+            batched=True,
+        )
+        assert len(buf) == 5

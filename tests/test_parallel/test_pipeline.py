@@ -1,0 +1,156 @@
+"""Pipeline-parallelism tests (beyond reference parity: SURVEY.md §2.8 row
+"Pipeline parallelism: absent" — the GPipe shard_map program in
+parallel/pipeline.py adds it).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.parallel.pipeline import (
+    pipeline_apply,
+    shard_stacked_blocks,
+    stack_blocks,
+    unstack_blocks,
+)
+
+CFG = M.GPTConfig(
+    vocab_size=64, n_layer=4, n_head=2, d_model=32, max_seq_len=16,
+    dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def pp_mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), axis_names=("pp",))
+
+
+def test_stack_unstack_roundtrip(params):
+    stacked = stack_blocks(params, CFG)
+    assert stacked["wq"].shape[0] == CFG.n_layer
+    back = unstack_blocks(stacked, CFG)
+    for i in range(CFG.n_layer):
+        for k, v in params["blocks"][str(i)].items():
+            np.testing.assert_array_equal(np.asarray(v), np.asarray(back[str(i)][k]))
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 2), (2, 4), (1, 4)])
+def test_pipeline_matches_plain_forward(params, n_stages, n_micro):
+    mesh = pp_mesh(n_stages)
+    tokens = (jnp.arange(4 * 8).reshape(4, 8) * 5) % 64
+    want, _ = M.apply(CFG, params, tokens)
+    got = pipeline_apply(CFG, params, tokens, mesh, num_microbatches=n_micro)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_respects_padding_mask(params):
+    mesh = pp_mesh(2)
+    tokens = (jnp.arange(2 * 8).reshape(2, 8) * 3) % 64
+    mask = jnp.array([[1] * 8, [1] * 5 + [0] * 3], jnp.int32)
+    want, _ = M.apply(CFG, params, tokens, attention_mask=mask)
+    got = pipeline_apply(
+        CFG, params, tokens, mesh, num_microbatches=2, attention_mask=mask
+    )
+    # only compare valid positions
+    np.testing.assert_allclose(
+        np.asarray(got)[0], np.asarray(want)[0], rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[1, :5], np.asarray(want)[1, :5], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pipeline_gradients_match_plain(params):
+    """Reverse-mode AD through the ppermute scan == grads of the plain model
+    (the whole point: GPipe backward for free)."""
+    mesh = pp_mesh(4)
+    tokens = (jnp.arange(4 * 8).reshape(4, 8) * 7) % 64
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def plain_loss(p):
+        logits, _ = M.apply(CFG, p, tokens)
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+    def pp_loss(p):
+        logits = pipeline_apply(CFG, p, tokens, mesh, num_microbatches=2)
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+    want_l, want_g = jax.value_and_grad(plain_loss)(params)
+    got_l, got_g = jax.value_and_grad(pp_loss)(params)
+    np.testing.assert_allclose(float(got_l), float(want_l), rtol=1e-5)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(want_g)[0],
+        jax.tree_util.tree_flatten_with_path(got_g)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )
+
+
+def test_pipeline_train_step_with_sharded_stack(params):
+    """One jitted SGD step with the stacked blocks placed P("pp") — the
+    training-path usage (stack once, donate, reuse)."""
+    import optax
+
+    mesh = pp_mesh(4)
+    stacked = shard_stacked_blocks(stack_blocks(params, CFG), mesh)
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    tokens = (jnp.arange(4 * 8).reshape(4, 8) * 11) % 64
+    targets = jnp.roll(tokens, -1, axis=1)
+    opt = optax.sgd(1e-2)
+
+    def loss_fn(stacked, rest):
+        p = dict(rest)
+        logits = pipeline_apply(
+            CFG, {**p, "blocks": {}}, tokens, mesh, num_microbatches=2,
+            stacked=stacked,
+        )
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+    @jax.jit
+    def step(stacked, rest, opt_state):
+        loss, grads = jax.value_and_grad(loss_fn)(stacked, rest)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(stacked, updates), loss, opt_state
+
+    opt_state = opt.init(stacked)
+    s1, l1, opt_state = step(stacked, rest, opt_state)
+    s2, l2, _ = step(s1, rest, opt_state)
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+    assert float(l2) < float(l1)  # SGD on the same batch must descend
+
+
+def test_pipeline_qkv_bias_matches_plain():
+    """Qwen2-style attention biases must flow through the staged block
+    program too (review finding: they were silently dropped)."""
+    cfg = M.GPTConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                      dtype=jnp.float32, qkv_bias=True)
+    p = M.init_params(jax.random.PRNGKey(1), cfg)
+    # non-zero biases so a dropped bias actually changes the output
+    for blk in p["blocks"].values():
+        blk["bq"] = blk["bq"] + 0.3
+        blk["bk"] = blk["bk"] - 0.2
+        blk["bv"] = blk["bv"] + 0.1
+    tokens = (jnp.arange(2 * 8).reshape(2, 8) * 3) % 64
+    want, _ = M.apply(cfg, p, tokens)
+    got = pipeline_apply(cfg, p, tokens, pp_mesh(2), num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_rejects_moe():
+    cfg = M.GPTConfig(vocab_size=32, n_layer=2, n_head=2, d_model=16,
+                      dtype=jnp.float32, n_experts=2)
+    p = M.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(AssertionError):
+        pipeline_apply(cfg, p, jnp.zeros((2, 4), jnp.int32), pp_mesh(2))
