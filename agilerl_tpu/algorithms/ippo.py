@@ -9,6 +9,7 @@ train as extra batch rows (free MXU utilisation).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -166,8 +167,8 @@ class IPPO(MultiAgentRLAlgorithm):
         dist_cfgs = {g: self.actors[g].dist_config for g in groups}
         obs_spaces = self.observation_spaces
 
-        @jax.jit
-        def act(actor_params, critic_params, obs, key):
+        @functools.partial(jax.jit, static_argnames=("deterministic",))
+        def act(actor_params, critic_params, obs, key, deterministic=False):
             actions, logps, values = {}, {}, {}
             i = 0
             for gid, members in groups.items():
@@ -176,7 +177,10 @@ class IPPO(MultiAgentRLAlgorithm):
                     logits = EvolvableNetwork.apply(actor_cfgs[gid], actor_params[gid], o)
                     dist_extra = actor_params[gid].get("dist")
                     k = jax.random.fold_in(key, i)
-                    a = D.sample(dist_cfgs[gid], logits, k, dist_extra)
+                    if deterministic:
+                        a = D.mode(dist_cfgs[gid], logits)
+                    else:
+                        a = D.sample(dist_cfgs[gid], logits, k, dist_extra)
                     actions[aid] = a
                     logps[aid] = D.log_prob(dist_cfgs[gid], logits, a, dist_extra)
                     values[aid] = EvolvableNetwork.apply(
@@ -197,7 +201,10 @@ class IPPO(MultiAgentRLAlgorithm):
         act = self.jit_fn("act", self._act_fn)
         actor_params = {g: self.actors[g].params for g in self.actors}
         critic_params = {g: self.critics[g].params for g in self.critics}
-        actions, logps, values = act(actor_params, critic_params, obs, self.next_key())
+        actions, logps, values = act(
+            actor_params, critic_params, obs, self.next_key(),
+            deterministic=not training,
+        )
         self._cached_logps = {a: np.asarray(v) for a, v in logps.items()}
         self._cached_values = {a: np.asarray(v) for a, v in values.items()}
         out = {a: np.asarray(v) for a, v in actions.items()}

@@ -354,6 +354,52 @@ def forward(
     return h, new_caches
 
 
+def block_apply_dense(
+    config: GPTConfig,
+    blk: Params,
+    h: jax.Array,  # [B, T, d]
+    attention_mask: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T]
+) -> jax.Array:
+    """One dense transformer block as a standalone pure function — the
+    staging-friendly core used by parallel/pipeline.py's GPipe stages (no
+    cache, no LoRA, no MoE routing). Kept NEXT TO block_fn above so the
+    attention math has one home; tests/test_parallel/test_pipeline.py pins
+    parity between the two paths (incl. qkv_bias)."""
+    B, T, _ = h.shape
+    dtype = h.dtype
+    x = _rms(h, blk["ln1"], config.rms_eps)
+    q, k, v = x @ blk["wq"].astype(dtype), x @ blk["wk"].astype(dtype), x @ blk["wv"].astype(dtype)
+    if config.qkv_bias:
+        q = q + blk["bq"].astype(dtype)
+        k = k + blk["bk"].astype(dtype)
+        v = v + blk["bv"].astype(dtype)
+    q = q.reshape(B, T, config.n_head, config.head_dim)
+    k = k.reshape(B, T, config.kv_heads, config.head_dim)
+    v = v.reshape(B, T, config.kv_heads, config.head_dim)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    rep = config.n_head // config.kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qh, kh, vh = (jnp.moveaxis(a, 2, 1) for a in (q, k, v))
+    scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
+    scores = scores / math.sqrt(config.head_dim)
+    t_ids = jnp.arange(T)
+    causal = t_ids[None, None, :] <= t_ids[None, :, None]
+    full_mask = jnp.logical_and(causal, attention_mask[:, None, :].astype(bool))
+    scores = jnp.where(full_mask[:, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+    attn = jnp.moveaxis(attn, 1, 2).reshape(B, T, config.n_head * config.head_dim)
+    h = h + attn @ blk["wo"].astype(dtype)
+    x = _rms(h, blk["ln2"], config.rms_eps)
+    gate = x @ blk["w_gate"].astype(dtype)
+    up = x @ blk["w_up"].astype(dtype)
+    return h + (jax.nn.silu(gate) * up) @ blk["w_down"].astype(dtype)
+
+
 def logits_fn(config: GPTConfig, params: Params, hidden: jax.Array) -> jax.Array:
     """hidden [B, T, D] -> logits [B, T, V] (float32)."""
     head = params["tok_emb"].T if config.tie_embeddings else params["lm_head"]

@@ -36,6 +36,8 @@ class EvolvableGPT(EvolvableModule):
         max_layers: int = 12,
         min_d_model: int = 64,
         max_d_model: int = 1024,
+        min_experts: int = 2,
+        max_experts: int = 16,
         **kwargs,
     ):
         if config is None:
@@ -46,6 +48,8 @@ class EvolvableGPT(EvolvableModule):
         self.max_layers = max_layers
         self.min_d_model = min_d_model
         self.max_d_model = max_d_model
+        self.min_experts = min_experts
+        self.max_experts = max_experts
         super().__init__(config, key)
 
     @staticmethod
@@ -54,6 +58,12 @@ class EvolvableGPT(EvolvableModule):
 
     @staticmethod
     def apply(config: M.GPTConfig, params: Dict, tokens: jax.Array, **kw):
+        if kw.get("return_aux"):
+            # MoE models: surface the Switch load-balance loss so training
+            # loops can add config.router_aux_weight * aux (review finding:
+            # silently dropping it starves the router of balancing gradient)
+            logits, caches, aux = M.apply(config, params, tokens, **kw)
+            return (logits, aux) if caches is None else (logits, caches, aux)
         logits, caches = M.apply(config, params, tokens, **kw)
         return logits if caches is None else (logits, caches)
 
@@ -103,3 +113,25 @@ class EvolvableGPT(EvolvableModule):
         new_d -= new_d % cfg.n_head
         self._morph(dataclasses.replace(cfg, d_model=new_d, d_ff=None))
         return {"numb_new_nodes": numb_new_nodes}
+
+    # -- expert mutations (MoE models only; beyond reference — evolves the
+    # expert count while preserving trained experts via leading-dim slab
+    # surgery; dense models fall back to node mutations) ------------------ #
+    @mutation(MutationType.NODE)
+    def add_expert(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.n_experts == 0 or cfg.n_experts >= self.max_experts:
+            return self.add_node(rng=rng)
+        self._morph(dataclasses.replace(cfg, n_experts=cfg.n_experts + 1))
+        return {"n_experts": cfg.n_experts + 1}
+
+    @mutation(MutationType.NODE, shrink_params=True)
+    def remove_expert(self, rng: Optional[np.random.Generator] = None) -> Dict:
+        cfg = self.config
+        if cfg.n_experts == 0 or cfg.n_experts <= self.min_experts:
+            return self.add_node(rng=rng)
+        # top_k must stay <= n_experts
+        new_e = cfg.n_experts - 1
+        top_k = min(cfg.expert_top_k, new_e)
+        self._morph(dataclasses.replace(cfg, n_experts=new_e, expert_top_k=top_k))
+        return {"n_experts": new_e}

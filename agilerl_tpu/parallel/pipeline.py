@@ -21,15 +21,14 @@ cheap and XLA dedupes the computation); only the block stack is staged.
 
 from __future__ import annotations
 
-import math
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from agilerl_tpu.llm.model import GPTConfig, _rms, _rope
+from agilerl_tpu.llm.model import GPTConfig, _rms, block_apply_dense
 
 Params = Any
 
@@ -50,44 +49,6 @@ def unstack_blocks(stacked: Params, config: GPTConfig) -> Dict[str, Params]:
         str(i): jax.tree_util.tree_map(lambda x: x[i], stacked)
         for i in range(config.n_layer)
     }
-
-
-def _block_apply(config: GPTConfig, blk: Params, h: jax.Array,
-                 mask: jax.Array, positions: jax.Array) -> jax.Array:
-    """One transformer block on [B, T, d] (no cache, no LoRA — the pipeline
-    path is for full-parameter training; mirrors llm/model.block_fn)."""
-    B, T, _ = h.shape
-    dtype = h.dtype
-    x = _rms(h, blk["ln1"], config.rms_eps)
-    q, k, v = x @ blk["wq"].astype(dtype), x @ blk["wk"].astype(dtype), x @ blk["wv"].astype(dtype)
-    if config.qkv_bias:
-        q = q + blk["bq"].astype(dtype)
-        k = k + blk["bk"].astype(dtype)
-        v = v + blk["bv"].astype(dtype)
-    q = q.reshape(B, T, config.n_head, config.head_dim)
-    k = k.reshape(B, T, config.kv_heads, config.head_dim)
-    v = v.reshape(B, T, config.kv_heads, config.head_dim)
-    q = _rope(q, positions, config.rope_theta)
-    k = _rope(k, positions, config.rope_theta)
-    rep = config.n_head // config.kv_heads
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    qh, kh, vh = (jnp.moveaxis(a, 2, 1) for a in (q, k, v))
-    scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
-    scores = scores / math.sqrt(config.head_dim)
-    t_ids = jnp.arange(T)
-    causal = t_ids[None, None, :] <= t_ids[None, :, None]
-    full_mask = jnp.logical_and(causal, mask[:, None, :].astype(bool))
-    scores = jnp.where(full_mask[:, None, :, :], scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
-    attn = jnp.moveaxis(attn, 1, 2).reshape(B, T, config.n_head * config.head_dim)
-    h = h + attn @ blk["wo"].astype(dtype)
-    x = _rms(h, blk["ln2"], config.rms_eps)
-    gate = x @ blk["w_gate"].astype(dtype)
-    up = x @ blk["w_up"].astype(dtype)
-    return h + (jax.nn.silu(gate) * up) @ blk["w_down"].astype(dtype)
 
 
 def pipeline_hidden_fn(
@@ -118,7 +79,7 @@ def pipeline_hidden_fn(
 
         def apply_stage(h, m, p):
             def one_layer(carry, blk):
-                return _block_apply(config, blk, carry, m, p), None
+                return block_apply_dense(config, blk, carry, m, p), None
 
             out, _ = jax.lax.scan(one_layer, h, local_blocks)
             return out

@@ -239,6 +239,12 @@ class AsyncPettingZooVecEnv:
 
     def reset(self, seed: Optional[int] = None, options=None):
         self._assert_is_running()
+        if self._state is not AsyncState.DEFAULT:
+            # a pending step result would be mistaken for the reset ack
+            raise RuntimeError(
+                f"reset called while an async call is pending "
+                f"(state={self._state.name})"
+            )
         for i, pipe in enumerate(self._pipes):
             pipe.send(("reset", None if seed is None else seed + i))
         results = [pipe.recv() for pipe in self._pipes]
@@ -248,6 +254,13 @@ class AsyncPettingZooVecEnv:
 
     def step_async(self, actions: Dict[str, np.ndarray]) -> None:
         self._assert_is_running()
+        if self._state is not AsyncState.DEFAULT:
+            # parity: the reference raises AlreadyPendingCallError
+            # (pz_async_vec_env.py:288) instead of double-queueing commands
+            raise RuntimeError(
+                f"step_async called while an async call is pending "
+                f"(state={self._state.name})"
+            )
         for i, pipe in enumerate(self._pipes):
             act_i = {a: np.asarray(actions[a])[i] for a in self.agents}
             act_i = {
@@ -258,6 +271,14 @@ class AsyncPettingZooVecEnv:
         self._state = AsyncState.WAITING_STEP
 
     def step_wait(self):
+        self._assert_is_running()
+        if self._state is not AsyncState.WAITING_STEP:
+            # parity: NoAsyncCallError (reference :308) — without this guard
+            # the pipe.recv() below would block forever
+            raise RuntimeError(
+                "step_wait called without a pending step_async "
+                f"(state={self._state.name})"
+            )
         results = [pipe.recv() for pipe in self._pipes]
         self._raise_if_errors(results)
         self._state = AsyncState.DEFAULT

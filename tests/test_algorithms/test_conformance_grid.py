@@ -25,7 +25,6 @@ NET_AUTO = {"latent_dim": 16}
 OBS_SPACES = {
     "vec": spaces.Box(-1, 1, (6,), np.float32),
     "img": spaces.Box(0, 255, (10, 10, 3), np.uint8),
-    "discrete": spaces.Discrete(4),
     "dict": spaces.Dict(
         {
             "pos": spaces.Box(-1, 1, (4,), np.float32),
@@ -33,6 +32,12 @@ OBS_SPACES = {
         }
     ),
 }
+# the value grid additionally covers Discrete observations end-to-end
+# (one-hot preprocessing through get_action/learn/save-load); the
+# continuous/PPO grids stay on three obs families to bound suite runtime
+# on the 1-core CI box (review finding: keep algorithm-level discrete-obs
+# coverage somewhere, not only the networks encoder grid)
+VALUE_OBS_SPACES = {**OBS_SPACES, "discrete": spaces.Discrete(4)}
 
 DISC_ACT = spaces.Discrete(3)
 # asymmetric bounds exercise DeterministicActor.rescale_action
@@ -117,16 +122,16 @@ VALUE_ALGOS = {
 }
 
 
-@pytest.mark.parametrize("obs_name", list(OBS_SPACES))
+@pytest.mark.parametrize("obs_name", list(VALUE_OBS_SPACES))
 @pytest.mark.parametrize("algo", list(VALUE_ALGOS))
 class TestValueGrid:
     def _agent(self, algo, obs_name):
-        return VALUE_ALGOS[algo](OBS_SPACES[obs_name], obs_name)
+        return VALUE_ALGOS[algo](VALUE_OBS_SPACES[obs_name], obs_name)
 
     def test_get_action(self, algo, obs_name):
         agent = self._agent(algo, obs_name)
         rng = np.random.default_rng(0)
-        obs = sample_obs(OBS_SPACES[obs_name], rng, 5)
+        obs = sample_obs(VALUE_OBS_SPACES[obs_name], rng, 5)
         acts = np.asarray(agent.get_action(obs))
         assert acts.shape == (5,)
         assert acts.min() >= 0 and acts.max() < DISC_ACT.n
@@ -136,7 +141,7 @@ class TestValueGrid:
         np.testing.assert_array_equal(a1, a2)
 
     def test_learn_clone_saveload(self, algo, obs_name, tmp_path):
-        obs_space = OBS_SPACES[obs_name]
+        obs_space = VALUE_OBS_SPACES[obs_name]
         agent = self._agent(algo, obs_name)
         buf = fill_buffer(obs_space, DISC_ACT)
         for _ in range(3):
@@ -206,8 +211,15 @@ ACT_SPACES = {
 }
 
 
-@pytest.mark.parametrize("obs_name", list(OBS_SPACES))
-@pytest.mark.parametrize("act_name", list(ACT_SPACES))
+# representative cells: every obs family with discrete actions, every action
+# family on vector obs (full cross would recompile 9 extra distinct programs)
+PPO_CELLS = [
+    ("disc", "vec"), ("disc", "img"), ("disc", "dict"),
+    ("box", "vec"), ("multidisc", "vec"),
+]
+
+
+@pytest.mark.parametrize("act_name,obs_name", PPO_CELLS)
 class TestPPOGrid:
     def _agent(self, obs_name, act_name, num_envs=4, learn_step=8):
         return PPO(

@@ -191,6 +191,95 @@ def test_moe_lora_ffn_targets_rejected():
     assert "wq" in lora["blocks"]["0"]
 
 
+def test_grpo_trains_on_moe_model():
+    """GRPO composes with MoE configs out of the box: LoRA on attention, frozen
+    expert FFNs routed per token (the reference cannot do MoE at all)."""
+    from agilerl_tpu.algorithms.grpo import GRPO
+
+    cfg = M.GPTConfig(
+        vocab_size=128, n_layer=2, n_head=2, d_model=32, max_seq_len=32,
+        dtype=jnp.float32, n_experts=4, expert_top_k=2,
+    )
+    agent = GRPO(config=cfg, pad_token_id=0, eos_token_id=1, group_size=2,
+                 batch_size=4, max_output_tokens=4, seed=0)
+    rng = np.random.default_rng(0)
+    B, T = 4, 16
+    ids = jnp.asarray(rng.integers(2, 127, size=(B, T)).astype(np.int32))
+    loss_mask = np.zeros((B, T - 1), np.float32)
+    loss_mask[:, T // 2:] = 1.0
+    rewards = rng.normal(size=(B // 2, 2)).astype(np.float32)
+    loss, kl = agent.learn((ids, jnp.asarray(loss_mask), jnp.asarray(rewards)))
+    assert np.isfinite(loss) and np.isfinite(kl)
+    # generation through the KV cache with routed FFNs
+    prompt_ids = rng.integers(2, 127, size=(2, 6)).astype(np.int32)
+    comp, cmask = agent.get_action(
+        {"input_ids": prompt_ids, "attention_mask": np.ones_like(prompt_ids)}
+    )
+    assert np.asarray(comp).shape[0] == 2 * agent.group_size
+    assert np.asarray(cmask).shape == np.asarray(comp).shape
+
+
+class TestExpertMutations:
+    """EvolvableGPT add_expert/remove_expert (architecture evolution over the
+    expert count — beyond reference)."""
+
+    def _gpt(self, n_experts=4):
+        from agilerl_tpu.modules.gpt import EvolvableGPT
+
+        return EvolvableGPT(
+            vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=16,
+            dtype=jnp.float32, n_experts=n_experts, expert_top_k=2,
+            min_d_model=32, key=jax.random.PRNGKey(0),
+        )
+
+    def test_add_expert_preserves_trained_experts(self):
+        gpt = self._gpt(4)
+        old_experts = np.asarray(gpt.params["blocks"]["0"]["w_gate"])
+        gpt.add_expert()
+        assert gpt.config.n_experts == 5
+        new_experts = np.asarray(gpt.params["blocks"]["0"]["w_gate"])
+        assert new_experts.shape[0] == 5
+        np.testing.assert_allclose(new_experts[:4], old_experts, atol=1e-6)
+        logits = gpt(jnp.zeros((2, 4), jnp.int32))
+        out = logits[0] if isinstance(logits, tuple) else logits
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_remove_expert_clamps_top_k(self):
+        gpt = self._gpt(2)
+        gpt.config = __import__("dataclasses").replace(gpt.config, expert_top_k=2)
+        # at min_experts=2 removal falls back to add_node
+        d_before = gpt.config.d_model
+        gpt.remove_expert()
+        assert gpt.config.n_experts == 2
+        assert gpt.config.d_model > d_before  # fell back to add_node
+        gpt3 = self._gpt(3)
+        gpt3.remove_expert()
+        assert gpt3.config.n_experts == 2
+        assert gpt3.config.expert_top_k == 2
+
+    def test_evolvable_gpt_surfaces_aux(self):
+        """EvolvableGPT.apply(return_aux=True) must return the Switch aux loss
+        (review finding: a 2-tuple unpack crashed and training loops silently
+        lost the load-balancing gradient)."""
+        gpt = self._gpt(4)
+        logits, aux = type(gpt).apply(
+            gpt.config, gpt.params, jnp.zeros((2, 4), jnp.int32), return_aux=True
+        )
+        assert np.asarray(logits).shape == (2, 4, 64)
+        assert float(aux) > 0
+
+    def test_dense_model_falls_back(self):
+        from agilerl_tpu.modules.gpt import EvolvableGPT
+
+        gpt = EvolvableGPT(vocab_size=64, n_layer=1, n_head=2, d_model=32,
+                           max_seq_len=16, dtype=jnp.float32, min_d_model=32,
+                           key=jax.random.PRNGKey(0))
+        d = gpt.config.d_model
+        gpt.add_expert()
+        assert gpt.config.n_experts == 0
+        assert gpt.config.d_model > d
+
+
 def test_moe_capacity_static():
     assert moe_capacity(128, 8, 2, 1.0) == 32
     assert moe_capacity(100, 8, 2, 1.25) == 32  # ceil(100*2/8*1.25)

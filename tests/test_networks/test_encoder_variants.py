@@ -1,0 +1,91 @@
+"""Encoder-variant grid: simba/recurrent/resnet switches across every network
+head (parity: the reference's per-network simba/recurrent parametrisations —
+networks/base.py:182, SURVEY.md §2.2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.networks import (
+    ContinuousQNetwork,
+    DeterministicActor,
+    QNetwork,
+    StochasticActor,
+    ValueNetwork,
+)
+from agilerl_tpu.utils.spaces import preprocess_observation, sample_obs
+
+BOX = spaces.Box(-1, 1, (6,), np.float32)
+IMG = spaces.Box(0, 255, (16, 16, 3), np.uint8)
+DISC = spaces.Discrete(3)
+ACT_BOX = spaces.Box(-1, 1, (2,), np.float32)
+
+
+@pytest.mark.parametrize("net_cls,kwargs", [
+    (QNetwork, {"action_space": DISC}),
+    (ValueNetwork, {}),
+    (DeterministicActor, {"action_space": ACT_BOX}),
+])
+def test_simba_encoder_selected(key, net_cls, kwargs):
+    net = net_cls(BOX, key=key, simba=True, **kwargs)
+    assert net.config.encoder_kind == "simba"
+    obs = preprocess_observation(BOX, sample_obs(BOX, 4))
+    out = net(obs)
+    out = out[0] if isinstance(out, tuple) else out
+    assert np.isfinite(np.asarray(out)).all()
+    # simba encoders keep their block mutations available through the network
+    net.apply_mutation("encoder.add_block")
+    out2 = net(obs)
+    out2 = out2[0] if isinstance(out2, tuple) else out2
+    assert np.asarray(out2).shape == np.asarray(out).shape
+
+
+def test_resnet_encoder_selected(key):
+    net = QNetwork(IMG, DISC, key=key, resnet=True, latent_dim=16)
+    assert net.config.encoder_kind == "resnet"
+    obs = preprocess_observation(IMG, sample_obs(IMG, 2))
+    assert net(obs).shape == (2, 3)
+
+
+def test_recurrent_encoder_selected(key):
+    net = ValueNetwork(BOX, key=key, recurrent=True, latent_dim=16)
+    assert net.config.encoder_kind == "lstm"
+
+
+def test_simba_flag_ignored_for_images(key):
+    """simba is an MLP-family architecture; image spaces keep the CNN."""
+    net = QNetwork(IMG, DISC, key=key, simba=True, latent_dim=16)
+    assert net.config.encoder_kind == "cnn"
+
+
+@pytest.mark.parametrize("obs_space", [BOX, IMG])
+def test_continuous_q_encoder_variants(key, obs_space):
+    net = ContinuousQNetwork(obs_space, ACT_BOX, key=key, latent_dim=16)
+    obs = preprocess_observation(obs_space, sample_obs(obs_space, 3))
+    q = net(obs, jnp.zeros((3, 2)))
+    assert q.shape == (3,)
+    assert np.isfinite(np.asarray(q)).all()
+
+
+def test_latent_mutation_rails(key):
+    """Latent mutations clamp at min/max and never break the forward."""
+    net = QNetwork(BOX, DISC, key=key, latent_dim=16)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        net.apply_mutation(
+            str(rng.choice(["add_latent_node", "remove_latent_node"])), rng=rng
+        )
+        assert net.config.min_latent_dim <= net.config.latent_dim <= net.config.max_latent_dim
+    obs = preprocess_observation(BOX, sample_obs(BOX, 2))
+    assert net(obs).shape == (2, 3)
+
+
+def test_stochastic_actor_simba_evaluate_consistency(key):
+    actor = StochasticActor(BOX, DISC, key=key, simba=True)
+    obs = preprocess_observation(BOX, sample_obs(BOX, 5))
+    action, logp, ent = actor(obs, key=jax.random.PRNGKey(1))
+    logp2, _ = actor.evaluate_actions(obs, action)
+    np.testing.assert_allclose(np.asarray(logp), np.asarray(logp2), rtol=1e-5)

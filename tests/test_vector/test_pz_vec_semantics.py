@@ -1,0 +1,158 @@
+"""Async vec-env semantics grid: state-machine guards, seeding determinism,
+Tuple/MultiDiscrete spaces, heterogeneous per-agent spaces, close idempotence
+(parity: the reference's tests/test_vector suite, SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+
+class SpacedParallelEnv:
+    """Parallel env with per-agent heterogeneous obs spaces and a Tuple obs."""
+
+    def __init__(self, episode_len=4):
+        self.possible_agents = ["walker", "flyer"]
+        self.agents = []
+        self.episode_len = episode_len
+        self._t = 0
+        self._seed = 0
+
+    def observation_space(self, agent):
+        if agent == "walker":
+            return spaces.Tuple(
+                (spaces.Box(-1, 1, (2,), np.float32), spaces.Discrete(4))
+            )
+        return spaces.Box(0, 255, (3, 3, 1), np.uint8)
+
+    def action_space(self, agent):
+        if agent == "walker":
+            return spaces.MultiDiscrete([2, 3])
+        return spaces.Box(-1, 1, (2,), np.float32)
+
+    def _obs(self, rng):
+        return {
+            "walker": (rng.normal(size=2).astype(np.float32).clip(-1, 1),
+                       int(rng.integers(0, 4))),
+            "flyer": rng.integers(0, 255, size=(3, 3, 1)).astype(np.uint8),
+        }
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._seed = seed
+        self._rng = np.random.default_rng(self._seed)
+        self.agents = list(self.possible_agents)
+        self._t = 0
+        return self._obs(self._rng), {}
+
+    def step(self, actions):
+        assert np.asarray(actions["walker"]).shape == (2,)
+        assert np.asarray(actions["flyer"]).shape == (2,)
+        self._t += 1
+        done = self._t >= self.episode_len
+        obs = self._obs(self._rng)
+        rew = {a: float(self._t) for a in self.agents}
+        term = {a: done for a in self.agents}
+        trunc = {a: False for a in self.agents}
+        if done:
+            self.agents = []
+        return obs, rew, term, trunc, {}
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def env():
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    e = AsyncPettingZooVecEnv([SpacedParallelEnv for _ in range(2)])
+    yield e
+    e.close()
+
+
+def test_heterogeneous_tuple_and_image_obs(env):
+    obs, _ = env.reset(seed=0)
+    walker = obs["walker"]
+    assert isinstance(walker, tuple) and len(walker) == 2
+    assert walker[0].shape == (2, 2) and walker[0].dtype == np.float32
+    assert walker[1].shape == (2,)  # batched Discrete
+    assert obs["flyer"].shape == (2, 3, 3, 1) and obs["flyer"].dtype == np.uint8
+
+
+def test_multidiscrete_and_box_actions_roundtrip(env):
+    env.reset(seed=0)
+    actions = {
+        "walker": np.tile(np.int64([1, 2]), (2, 1)),
+        "flyer": np.zeros((2, 2), np.float32),
+    }
+    obs, rew, term, trunc, _ = env.step(actions)
+    assert rew["walker"].shape == (2,)
+    np.testing.assert_allclose(rew["walker"], 1.0)
+
+
+def test_seeding_is_deterministic():
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    def run(seed):
+        e = AsyncPettingZooVecEnv([SpacedParallelEnv for _ in range(2)])
+        try:
+            obs, _ = e.reset(seed=seed)
+            return np.asarray(obs["flyer"]).copy()
+        finally:
+            e.close()
+
+    a, b, c = run(7), run(7), run(8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_step_before_reset_raises(env):
+    actions = {
+        "walker": np.tile(np.int64([0, 0]), (2, 1)),
+        "flyer": np.zeros((2, 2), np.float32),
+    }
+    with pytest.raises(Exception):
+        env.step(actions)
+
+
+def test_double_step_async_raises(env):
+    env.reset(seed=0)
+    actions = {
+        "walker": np.tile(np.int64([0, 0]), (2, 1)),
+        "flyer": np.zeros((2, 2), np.float32),
+    }
+    env.step_async(actions)
+    with pytest.raises(Exception):
+        env.step_async(actions)
+    env.step_wait()
+
+
+def test_step_wait_without_async_raises(env):
+    env.reset(seed=0)
+    with pytest.raises(Exception):
+        env.step_wait()
+
+
+def test_close_idempotent():
+    from agilerl_tpu.vector import AsyncPettingZooVecEnv
+
+    e = AsyncPettingZooVecEnv([SpacedParallelEnv for _ in range(2)])
+    e.reset(seed=0)
+    e.close()
+    e.close()  # second close must be a no-op, not a crash
+
+
+def test_autoreset_continues_stepping(env):
+    env.reset(seed=0)
+    actions = {
+        "walker": np.tile(np.int64([1, 1]), (2, 1)),
+        "flyer": np.zeros((2, 2), np.float32),
+    }
+    rewards = []
+    for _ in range(9):  # across two autoreset boundaries (episode_len=4)
+        _, rew, term, trunc, _ = env.step(actions)
+        rewards.append(float(rew["walker"][0]))
+    # reward == t within each episode: 1,2,3,4 then autoreset repeats
+    assert rewards[:4] == [1.0, 2.0, 3.0, 4.0]
+    assert 1.0 in rewards[4:6]  # new episode restarted counting
