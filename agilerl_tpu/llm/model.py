@@ -26,6 +26,17 @@ import numpy as np
 Params = Any
 
 
+def use_chunked_decode() -> bool:
+    """Gate for the flash-decode cached-attention path (default ON).
+
+    AGILERL_TPU_DISABLE_CHUNKED_DECODE=1 falls back to dense-over-full-cache
+    XLA attention — the numerically-equivalent bisect path, mirroring the
+    AGILERL_TPU_DISABLE_PALLAS convention."""
+    import os
+
+    return not os.environ.get("AGILERL_TPU_DISABLE_CHUNKED_DECODE")
+
+
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
     vocab_size: int
@@ -252,6 +263,7 @@ def forward(
         positions = jnp.maximum(positions, 0)
 
     use_flash = config.use_flash_attention if flash is None else flash
+    chunked_decode = use_chunked_decode()  # read once: trace-time constant
     h = jnp.take(params["tok_emb"], tokens, axis=0).astype(dtype)
 
     new_caches: Optional[Dict[str, KVCache]] = {} if cache is not None else None
@@ -279,12 +291,15 @@ def forward(
                 layer_cache.mask, attention_mask.astype(jnp.int32), (0, start)
             )
             new_cache = KVCache(ck, cv, start + T, cm)
-            S = ck.shape[1]
-            k_all, v_all = ck, cv
-            kv_slot = jnp.arange(S)
-            # slot j visible to query t iff j <= start+t AND the slot is real
-            causal = kv_slot[None, None, :] <= (start + jnp.arange(T))[None, :, None]
-            mask = jnp.logical_and(causal, cm[:, None, :].astype(bool))
+            if not chunked_decode:
+                k_all, v_all = ck, cv
+                S = ck.shape[1]
+                kv_slot = jnp.arange(S)
+                # slot j visible to query t iff j <= start+t AND slot is real
+                causal = (
+                    kv_slot[None, None, :] <= (start + jnp.arange(T))[None, :, None]
+                )
+                mask = jnp.logical_and(causal, cm[:, None, :].astype(bool))
         else:
             new_cache = None
             k_all, v_all = k, v
@@ -293,29 +308,41 @@ def forward(
             mask = (t_ids[None, None, :] <= t_ids[None, :, None])  # [1, T, S=T]
             mask = jnp.logical_and(mask, attention_mask[:, None, :].astype(bool))
 
-        # GQA: repeat kv heads
-        rep = config.n_head // config.kv_heads
-        if rep > 1:
-            k_all = jnp.repeat(k_all, rep, axis=2)
-            v_all = jnp.repeat(v_all, rep, axis=2)
+        if layer_cache is not None and chunked_decode:
+            # flash-decode: online-softmax over KV chunks bounded by the LIVE
+            # cache length — never reads the dead cache tail, never
+            # materializes GQA-repeated K/V (ops/decode_attention.py)
+            from agilerl_tpu.ops.decode_attention import chunked_cached_attention
 
-        qh = jnp.moveaxis(q, 2, 1)  # [B, H, T, d]
-        kh = jnp.moveaxis(k_all, 2, 1)
-        vh = jnp.moveaxis(v_all, 2, 1)
-        if use_flash and layer_cache is None:
-            # Pallas flash attention (causal + padding mask, custom VJP so it
-            # also serves training losses); the cached decode path stays on
-            # XLA attention
-            from agilerl_tpu.ops.flash_attention_vjp import flash_attention_diff
-
-            attn = flash_attention_diff(qh, kh, vh, attention_mask, True)
+            attn = chunked_cached_attention(q, ck, cv, cm, start)
+            attn = attn.reshape(B, T, config.n_head * config.head_dim)
         else:
-            scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
-            scores = scores / math.sqrt(config.head_dim)
-            scores = jnp.where(mask[:, None, :, :], scores, -1e9)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-            attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
-        attn = jnp.moveaxis(attn, 1, 2).reshape(B, T, config.n_head * config.head_dim)
+            # GQA: repeat kv heads
+            rep = config.n_head // config.kv_heads
+            if rep > 1:
+                k_all = jnp.repeat(k_all, rep, axis=2)
+                v_all = jnp.repeat(v_all, rep, axis=2)
+
+            qh = jnp.moveaxis(q, 2, 1)  # [B, H, T, d]
+            kh = jnp.moveaxis(k_all, 2, 1)
+            vh = jnp.moveaxis(v_all, 2, 1)
+            if use_flash and layer_cache is None:
+                # Pallas flash attention (causal + padding mask, custom VJP so
+                # it also serves training losses)
+                from agilerl_tpu.ops.flash_attention_vjp import (
+                    flash_attention_diff,
+                )
+
+                attn = flash_attention_diff(qh, kh, vh, attention_mask, True)
+            else:
+                scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
+                scores = scores / math.sqrt(config.head_dim)
+                scores = jnp.where(mask[:, None, :, :], scores, -1e9)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+                attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+            attn = jnp.moveaxis(attn, 1, 2).reshape(
+                B, T, config.n_head * config.head_dim
+            )
         attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
         h = h + attn
 

@@ -1,0 +1,101 @@
+"""Chunked cached attention — the flash-decode path for the in-tree generate
+loop (parity goal: replace vLLM's paged decode attention,
+agilerl/algorithms/core/base.py:3101; SURVEY.md §2.9).
+
+Decode attention is HBM-bandwidth-bound, not MXU-bound: each step reads the
+whole live KV prefix once. The dense XLA path previously scored every q
+against the FULL cache allocation [B, S, Hkv, d] (S = prompt + max_new_tokens)
+and materialized a GQA-repeated copy of K/V. This op fixes both:
+
+- online-softmax accumulation over KV chunks inside a ``lax.fori_loop`` whose
+  trip count is the *dynamic* live length ``ceil((start+T)/block)`` — slots
+  beyond the live prefix are never read (a dynamic trip count is a value, not
+  a shape, so XLA compiles it once as a while loop);
+- GQA folded into the einsum (q reshaped [B,T,Hkv,rep,d]) so K/V are never
+  repeated in HBM.
+
+Numerics match the dense masked-softmax path bit-for-bit at f32 accumulation
+(tests/test_ops/test_decode_attention.py). A Pallas kernel is deliberately NOT
+used here: with BlockSpec pipelining the operand fetch for a grid step happens
+whether or not ``pl.when`` skips the compute, so a static-grid Pallas kernel
+cannot skip the dead cache tail — the dynamic-bound XLA loop can, and the
+per-chunk math (two matmuls + exp) is already fused by XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def chunked_cached_attention(
+    q: jax.Array,        # [B, T, Hq, d] RoPE'd queries (absolute pos start..start+T)
+    k_cache: jax.Array,  # [B, S, Hkv, d] cache AFTER inserting this step's K
+    v_cache: jax.Array,  # [B, S, Hkv, d]
+    valid: jax.Array,    # [B, S] 1 = slot holds a real token
+    start,               # scalar: cache length before this step
+    *,
+    block: int = 512,
+) -> jax.Array:
+    """Returns attention output [B, T, Hq, d] (same visibility rule as the
+    dense path: slot j visible to query t iff j <= start + t and valid[j])."""
+    B, T, Hq, d = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    rep = Hq // Hkv
+    block = min(block, S)
+    scale = 1.0 / math.sqrt(d)
+
+    qr = q.reshape(B, T, Hkv, rep, d)
+    t_ids = jnp.arange(T)
+
+    live = start + T  # number of potentially-visible slots
+    n_chunks = jnp.minimum(
+        (live + block - 1) // block, -(-S // block)
+    ).astype(jnp.int32)
+
+    m0 = jnp.full((B, Hkv, rep, T), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, rep, T), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, rep, T, d), jnp.float32)
+
+    def chunk_step(i, carry):
+        m, l, acc = carry
+        off = i * block
+        # when S % block != 0 the last chunk's slice is clamped to S - block
+        # (no padding — a pad would COPY the whole cache every call); the
+        # re-read slots below `off` are masked out so nothing double-counts
+        off_c = jnp.minimum(off, S - block)
+        ks = jax.lax.dynamic_slice_in_dim(k_cache, off_c, block, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v_cache, off_c, block, axis=1)
+        vm = jax.lax.dynamic_slice_in_dim(valid, off_c, block, axis=1)
+
+        scores = jnp.einsum(
+            "bthrd,bshd->bhrts", qr, ks, preferred_element_type=jnp.float32
+        ) * scale  # [B, Hkv, rep, T, BK]
+
+        slot = off_c + jnp.arange(block)
+        causal = slot[None, :] <= (start + t_ids)[:, None]          # [T, BK]
+        fresh = slot >= off                                          # [BK]
+        mask = jnp.logical_and(
+            jnp.logical_and(causal, fresh[None, :])[None, None, None],
+            vm.astype(bool)[:, None, None, None, :],
+        )
+        scores = jnp.where(mask, scores, -1e30)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhrts,bshd->bhrtd", p.astype(vs.dtype), vs,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    _, l, acc = jax.lax.fori_loop(0, n_chunks, chunk_step, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]   # [B, Hkv, rep, T, d]
+    out = jnp.moveaxis(out, 3, 1)                  # [B, T, Hkv, rep, d]
+    return out.reshape(B, T, Hq, d).astype(q.dtype)

@@ -28,8 +28,11 @@ def init_multihost(
     server; on CPU/GPU fleets pass them explicitly."""
     import jax
 
-    if jax.process_count() > 1:
+    # NB: must not touch the backend (jax.devices/process_count) before
+    # jax.distributed.initialize — is_initialized() only reads client state
+    if jax.distributed.is_initialized():
         return
+    explicit = coordinator_address is not None
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -37,8 +40,11 @@ def init_multihost(
             process_id=process_id,
         )
     except (RuntimeError, ValueError):
-        # single-process run or already initialised — both fine
-        pass
+        if explicit:
+            # caller described a concrete cluster — failing to join it is an
+            # error, not a single-process fallback
+            raise
+        # auto-detect path on a single host: fine to run single-process
 
 
 def broadcast_seed(seed: Optional[int] = None) -> int:

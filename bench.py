@@ -7,9 +7,17 @@ env-steps/sec. Prints ONE JSON line — ALWAYS, even when the TPU pool is down:
 the parent process runs the measured workload in a child with a hard timeout
 and falls back to the CPU backend (tagged "backend": "cpu") on any failure.
 
+The accelerator phase is probe-gated (VERDICT r2 weak #1): a cheap child that
+only touches `jax.devices()` + one matmul runs under a short deadline
+(BENCH_PROBE_TIMEOUT, default 120s). While the pool is down the probe loops
+across the remaining accelerator budget, so a flapping pool costs ~2 min per
+down-probe instead of the whole 1500s; the full workload launches only inside
+an up-window. On a successful accelerator run the headline JSON line also
+carries the secondary metric + on-chip kernel validation in "extra_metrics".
+
 Env knobs: BENCH_MODE=grpo for the LLM metric; BENCH_POP/ENVS/ROLLOUT/GENS and
 BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU attempt;
-BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT (seconds) for the per-attempt deadlines.
+BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
 """
 
 import json
@@ -144,15 +152,44 @@ def bench_evoppo():
     }), flush=True)
 
 
-def child_main():
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # the env var alone is NOT enough — this image's sitecustomize
-        # force-registers the axon TPU plugin and overrides it; pin the
-        # config before any backend touch. Exact match only: a fallback list
-        # like "axon,cpu" means the accelerator should still be attempted.
+def _cpu_pinned() -> bool:
+    """True iff JAX_PLATFORMS is an exact "cpu" pin. A fallback list like
+    "axon,cpu" is NOT a pin — the accelerator should still be attempted."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def _accelerator_named() -> bool:
+    """True iff JAX_PLATFORMS names a non-cpu platform (so a cpu backend
+    result means the accelerator FELL BACK, not that none is configured)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    return any(p.strip() not in ("", "cpu") for p in platforms.split(","))
+
+
+def _maybe_pin_cpu() -> None:
+    """Apply the exact-"cpu" pin via jax.config — this image's sitecustomize
+    force-registers the axon TPU plugin and the env var alone is NOT enough."""
+    if _cpu_pinned():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+
+def probe_main():
+    """Cheap accelerator liveness probe: devices + one matmul. Prints the
+    backend name on success; any hang is bounded by the parent's timeout."""
+    import jax
+    import jax.numpy as jnp
+
+    _maybe_pin_cpu()
+    devices = jax.devices()
+    assert devices
+    x = jnp.ones((128, 128))
+    (x @ x).block_until_ready()
+    print(f"PROBE_OK {jax.default_backend()}", flush=True)
+
+
+def child_main():
+    _maybe_pin_cpu()
     if os.environ.get("BENCH_MODE") == "grpo":
         bench_grpo()
     else:
@@ -164,10 +201,12 @@ def child_main():
 # --------------------------------------------------------------------------
 
 
-def _run_child(backend_env: dict, timeout_s: float):
+def _run_child(backend_env: dict, timeout_s: float, extra_env: dict | None = None):
     """Run the child bench; return (json_dict | None, error_str | None)."""
     env = dict(os.environ)
     env.update(backend_env)
+    if extra_env:
+        env.update(extra_env)
     env["BENCH_CHILD"] = "1"
     try:
         proc = subprocess.run(
@@ -188,6 +227,74 @@ def _run_child(backend_env: dict, timeout_s: float):
     return None, last_err
 
 
+def _probe_accelerator(timeout_s: float):
+    """Run the liveness probe child. Returns (status, backend):
+    ("up", name)  — accelerator live;
+    ("cpu", None) — jax resolved to the CPU backend with NO accelerator
+                    named in JAX_PLATFORMS: none is configured, skip retries
+                    (with an accelerator named — e.g. the image's
+                    JAX_PLATFORMS=axon pin or a fallback list "axon,cpu" —
+                    a cpu result or crash is a flap, reported "down");
+    ("down", None) — probe hung, crashed, or printed nothing."""
+    env = dict(os.environ)
+    env["BENCH_PROBE"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            timeout=timeout_s, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return "down", None
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("PROBE_OK"):
+            backend = line.split(None, 1)[1].strip() if " " in line else "?"
+            if backend != "cpu":
+                return "up", backend
+            # with a fallback list like "axon,cpu" a cpu result means the
+            # accelerator fell back THIS probe (a flap) — keep retrying
+            return ("down", None) if _accelerator_named() else ("cpu", None)
+    return "down", None
+
+
+def _run_kernel_validation(timeout_s: float):
+    """On-chip Pallas kernel validation; returns a summary dict or None."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarking", "tpu_kernel_validation.py")
+    if not os.path.exists(script):
+        return None
+    outdir = os.path.join(os.path.dirname(script), "..", ".tpu_results")
+    logpath = os.path.join(outdir, "kernels_bench.log")
+    try:
+        os.makedirs(outdir, exist_ok=True)
+        with open(logpath, "w") as fh:
+            proc = subprocess.run(
+                [sys.executable, script], stdout=fh, stderr=subprocess.STDOUT,
+                timeout=timeout_s, text=True,
+            )
+        ok = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return {"kernel_validation": "timeout", "log": logpath}
+    except OSError as e:
+        # never let an unwritable log dir break the ONE-JSON-line contract
+        return {"kernel_validation": "error", "error": str(e)}
+    # the script emits one JSON line per kernel check — collect them all
+    summary = []
+    try:
+        with open(logpath) as fh:
+            for line in fh:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        summary.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        pass
+    return {"kernel_validation": "ok" if ok else "failed",
+            "log": logpath, "summary": summary or None}
+
+
 def parent_main():
     mode = os.environ.get("BENCH_MODE", "evoppo")
     metric = (
@@ -197,19 +304,89 @@ def parent_main():
     errors = []
 
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
-    # exact match only — "axon,cpu" is a fallback list, not a CPU pin
-    user_forced_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    user_forced_cpu = _cpu_pinned()
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 900))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    # don't launch the full workload with less budget than compile+run needs —
+    # but an explicitly small BENCH_TPU_TIMEOUT means the operator sized the
+    # workload to fit it, so never let the minimum swallow the whole budget
+    min_workload_budget = float(os.environ.get("BENCH_MIN_WORKLOAD_BUDGET", 240))
+    min_workload_budget = min(min_workload_budget, max(30.0, tpu_timeout * 0.6))
 
     if not (force_cpu or user_forced_cpu):
-        log(f"bench parent: attempting accelerator backend (timeout {tpu_timeout:.0f}s)")
-        result, err = _run_child({}, tpu_timeout)
-        if result is not None:
-            print(json.dumps(result), flush=True)
-            return 0
-        errors.append(f"accelerator attempt: {err}")
-        log(f"bench parent: accelerator attempt failed ({err}); falling back to CPU")
+        deadline = time.monotonic() + tpu_timeout
+        probes = 0
+        pool_seen_up = False
+        log(f"bench parent: accelerator phase (budget {tpu_timeout:.0f}s, "
+            f"probe timeout {probe_timeout:.0f}s)")
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining < min(probe_timeout, 30) + min_workload_budget:
+                errors.append(
+                    "accelerator phase: budget exhausted by failed workload "
+                    f"attempts ({probes} probes)" if pool_seen_up else
+                    f"accelerator phase: pool never came up in {probes} probes "
+                    f"across {tpu_timeout:.0f}s")
+                break
+            t0 = time.monotonic()
+            status, backend = _probe_accelerator(min(probe_timeout, remaining))
+            probes += 1
+            if status == "cpu":
+                errors.append(
+                    "accelerator phase: no accelerator runtime (jax resolved "
+                    "to cpu) — skipping retries")
+                break
+            if status == "down":
+                probe_dt = time.monotonic() - t0
+                log(f"bench parent: probe {probes} down ({probe_dt:.0f}s); "
+                    f"{deadline - time.monotonic():.0f}s left")
+                # a fast failure (e.g. immediate UNAVAILABLE) shouldn't busy-spin
+                if probe_dt < 30:
+                    time.sleep(min(30, max(0, deadline - time.monotonic() - 1)))
+                continue
+            pool_seen_up = True
+            budget = deadline - time.monotonic()
+            if budget < min_workload_budget:
+                # a slow-succeeding probe ate the tail of the budget; the
+                # workload would only die mid-compile
+                errors.append(
+                    f"accelerator phase: pool up but only {budget:.0f}s left "
+                    f"(< {min_workload_budget:.0f}s workload minimum)")
+                break
+            log(f"bench parent: pool UP (backend={backend}, probe {probes}); "
+                f"launching workload (budget {budget:.0f}s)")
+            result, err = _run_child({}, budget)
+            if result is not None and result.get("backend") not in (None, "cpu"):
+                # headline landed on the accelerator — collect the secondary
+                # metric and on-chip kernel validation in the same up-window
+                extras = []
+                sec_budget = deadline - time.monotonic()
+                sec_mode = "evoppo" if mode == "grpo" else "grpo"
+                if sec_budget > min_workload_budget:
+                    log(f"bench parent: running secondary ({sec_mode}) bench")
+                    sec, sec_err = _run_child(
+                        {}, sec_budget, extra_env={"BENCH_MODE": sec_mode})
+                    if sec is not None:
+                        extras.append(sec)
+                    else:
+                        extras.append({"metric": f"secondary {sec_mode}",
+                                       "error": sec_err})
+                kv_budget = deadline - time.monotonic()
+                if kv_budget > 120:
+                    log("bench parent: running kernel validation")
+                    kv = _run_kernel_validation(kv_budget)
+                    if kv is not None:
+                        extras.append(kv)
+                if extras:
+                    result["extra_metrics"] = extras
+                print(json.dumps(result), flush=True)
+                return 0
+            err_s = err if result is None else \
+                f"child fell back to backend={result.get('backend')}"
+            errors.append(f"accelerator workload attempt: {err_s}")
+            log(f"bench parent: workload attempt failed ({err_s}); resuming probes")
+        log("bench parent: accelerator phase exhausted; falling back to CPU")
 
     log(f"bench parent: running on CPU backend (timeout {cpu_timeout:.0f}s)")
     result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
@@ -233,7 +410,9 @@ def parent_main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_CHILD") == "1":
+    if os.environ.get("BENCH_PROBE") == "1":
+        probe_main()
+    elif os.environ.get("BENCH_CHILD") == "1":
         child_main()
     else:
         sys.exit(parent_main())

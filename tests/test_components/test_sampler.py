@@ -88,6 +88,8 @@ def test_sampler_non_per_paired_nstep():
         main.add(dict(t))
         nstep.add(dict(t))
     s = Sampler(memory=main, n_step_memory=nstep)
-    batch, idx, n_batch = s.sample(8)
+    batch, idx, weights, n_batch = s.sample(8)
+    assert np.asarray(weights).shape == (8,)
+    np.testing.assert_allclose(np.asarray(weights), 1.0)
     np.testing.assert_array_equal(np.asarray(batch["obs"]),
                                   np.asarray(n_batch["obs"]))
