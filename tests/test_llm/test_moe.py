@@ -284,3 +284,44 @@ def test_moe_capacity_static():
     assert moe_capacity(128, 8, 2, 1.0) == 32
     assert moe_capacity(100, 8, 2, 1.25) == 32  # ceil(100*2/8*1.25)
     assert moe_capacity(4, 8, 1, 1.0) == 1
+
+
+def test_composed_fsdp_tp_ep_matches_unsharded():
+    """fsdp x tp x ep composition on one 8-device mesh (VERDICT r2 #7):
+    dense weights sharded fsdp/tp AND experts sharded ep in the same program;
+    forward + grads must match the single-device run."""
+    from agilerl_tpu.parallel.mesh import gpt_param_specs, make_mesh
+
+    mesh = make_mesh(dp=1, fsdp=2, tp=2, ep=2, devices=jax.devices()[:8])
+    cfg = M.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=16, max_seq_len=16,
+        dtype=jnp.float32, n_experts=2, expert_top_k=2,
+    )
+    params = M.init_params(jax.random.PRNGKey(4), cfg)
+    tokens = (jnp.arange(32).reshape(4, 8) * 5) % 64
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def loss_fn(p):
+        logits, _, aux = M.apply(cfg, p, tokens, return_aux=True)
+        lp = jax.nn.log_softmax(logits, -1)
+        ce = -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+        return ce + cfg.router_aux_weight * aux
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params)
+
+    specs = gpt_param_specs(cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs,
+    )
+    with mesh:
+        sh_loss, sh_grads = jax.jit(jax.value_and_grad(loss_fn))(sharded)
+    np.testing.assert_allclose(float(sh_loss), float(ref_loss), rtol=1e-5)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(ref_grads)[0],
+        jax.tree_util.tree_flatten_with_path(sh_grads)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )

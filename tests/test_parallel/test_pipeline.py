@@ -154,3 +154,39 @@ def test_pipeline_rejects_moe():
     p = M.init_params(jax.random.PRNGKey(0), cfg)
     with pytest.raises(AssertionError):
         pipeline_apply(cfg, p, jnp.zeros((2, 4), jnp.int32), pp_mesh(2))
+
+
+def test_pipeline_composed_with_fsdp_grad_parity(params):
+    """pp x fsdp composition (VERDICT r2 #7): stage weights additionally
+    ZeRO-sharded on the fsdp axis (all-gather in, reduce-scatter grads out)
+    with the batch sharded over the same axis — forward AND grads must match
+    the plain single-device model."""
+    devices = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, axis_names=("pp", "fsdp"))
+    tokens = (jnp.arange(8 * 8).reshape(8, 8) * 7) % 64
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def plain_loss(p):
+        logits, _ = M.apply(CFG, p, tokens)
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+    def composed_loss(p):
+        logits = pipeline_apply(
+            CFG, p, tokens, mesh, num_microbatches=2, fsdp_axis="fsdp"
+        )
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+    want_l, want_g = jax.value_and_grad(plain_loss)(params)
+    with mesh:
+        got_l, got_g = jax.jit(jax.value_and_grad(composed_loss))(params)
+    np.testing.assert_allclose(float(got_l), float(want_l), rtol=1e-5)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(want_g)[0],
+        jax.tree_util.tree_flatten_with_path(got_g)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(pa),
+        )
