@@ -1,0 +1,86 @@
+"""Golden-logit pinning of the HF checkpoint loader (VERDICT r2 #5).
+
+Unlike the in-memory conversion test (test_llm_components.TestHFConversion,
+which builds model and ground truth in the same process), these tests drive
+the REAL user path — agilerl_tpu.llm.hf.load_hf_model over an on-disk HF
+checkpoint directory (config.json + model.safetensors) — and compare against
+logits committed under tests/fixtures/, produced by the HF torch
+implementation (see tests/fixtures/make_hf_fixtures.py for provenance).
+The test does not construct its own ground truth.
+
+Parity target: the reference loads Qwen2.5-0.5B-Instruct through HF
+AutoModel (agilerl/algorithms/core/base.py:2605,
+benchmarking/benchmarking_grpo.py:25)."""
+
+import dataclasses
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "fixtures")
+# discover every committed fixture (incl. any regenerated from a real
+# checkpoint via make_hf_fixtures.py --checkpoint) — never a static list
+CASES = sorted(
+    d for d in (os.listdir(FIXTURES) if os.path.isdir(FIXTURES) else [])
+    if os.path.exists(os.path.join(FIXTURES, d, "golden_logits.npz"))
+)
+assert CASES, "no HF golden fixtures committed under tests/fixtures/"
+
+
+def _load_golden(name):
+    path = os.path.join(FIXTURES, name)
+    data = np.load(os.path.join(path, "golden_logits.npz"))
+    return path, data["token_ids"], data["logits"]
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_load_from_disk_matches_golden_logits(name):
+    pytest.importorskip("transformers")
+    from agilerl_tpu.llm.hf import load_hf_model
+    from agilerl_tpu.llm.model import apply
+
+    path, ids, golden = _load_golden(name)
+    config, params = load_hf_model(path, dtype=jnp.float32)
+    got, _ = apply(config, params, jnp.asarray(ids))
+    np.testing.assert_allclose(
+        np.asarray(got), golden, rtol=1e-4, atol=2e-4,
+        err_msg=f"{name}: jax port diverges from committed HF logits",
+    )
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_bf16_load_agrees_coarsely(name):
+    """The default bf16 storage path must still track the f32 golden logits
+    (loose tolerance — bf16 has ~3 decimal digits)."""
+    pytest.importorskip("transformers")
+    from agilerl_tpu.llm.hf import load_hf_model
+    from agilerl_tpu.llm.model import apply
+
+    import jax
+
+    path, ids, golden = _load_golden(name)
+    config, params = load_hf_model(path)  # bf16 default
+    cfg32 = dataclasses.replace(config, dtype=jnp.float32)
+    params32 = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), params)
+    got, _ = apply(cfg32, params32, jnp.asarray(ids))
+    scale = np.abs(golden).max()
+    np.testing.assert_allclose(
+        np.asarray(got) / scale, golden / scale, atol=3e-2,
+        err_msg=f"{name}: bf16-stored weights diverge beyond bf16 tolerance",
+    )
+
+
+def test_golden_fixture_provenance_present():
+    """Every committed fixture must carry its provenance record."""
+    import json
+
+    for name in CASES:
+        path = os.path.join(FIXTURES, name)
+        with open(os.path.join(path, "PROVENANCE.json")) as fh:
+            meta = json.load(fh)
+        assert meta["generator"] == "tests/fixtures/make_hf_fixtures.py"
+        assert "transformers" in meta
+        # either a seeded synthetic build or a real source checkpoint
+        assert ("seed" in meta) != ("source_checkpoint" in meta)
