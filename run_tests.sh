@@ -42,6 +42,7 @@ if [ ${#SHARDS[@]} -eq 0 ]; then
     tests/test_train
     tests/test_utils
     tests/test_vector
+    tests/test_docs
     tests/test_wrappers
   )
 fi
