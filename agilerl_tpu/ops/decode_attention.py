@@ -29,6 +29,59 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _dense_reference(q, k_cache, v_cache, valid, start):
+    """Differentiable dense formulation of the same visibility rule — used
+    only as the backward path (custom VJP): the chunked forward's
+    dynamic-trip-count while_loop is not reverse-differentiable, but its
+    output is bit-equal to this dense one, so the VJP of this function AT
+    THE SAME INPUTS is the correct gradient."""
+    B, T, Hq, d = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    rep = Hq // Hkv
+    qr = q.reshape(B, T, Hkv, rep, d)
+    scores = jnp.einsum(
+        "bthrd,bshd->bhrts", qr, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    slot = jnp.arange(S)
+    causal = slot[None, :] <= (start + jnp.arange(T))[:, None]          # [T, S]
+    mask = jnp.logical_and(
+        causal[None, None, None], valid.astype(bool)[:, None, None, None, :]
+    )
+    probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+    out = jnp.einsum(
+        "bhrts,bshd->bhrtd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.moveaxis(out, 3, 1).reshape(B, T, Hq, d).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_chunked(block: int):
+    @jax.custom_vjp
+    def f(q, k_cache, v_cache, valid, start):
+        return _chunked_impl(q, k_cache, v_cache, valid, start, block)
+
+    def fwd(q, k_cache, v_cache, valid, start):
+        return f(q, k_cache, v_cache, valid, start), (
+            q, k_cache, v_cache, valid, start,
+        )
+
+    def bwd(res, g):
+        q, k_cache, v_cache, valid, start = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _dense_reference(q_, k_, v_, valid, start),
+            q, k_cache, v_cache,
+        )
+        dq, dk, dv = vjp(g)
+        f0 = jax.dtypes.float0
+        return (dq, dk, dv,
+                np.zeros(np.shape(valid), f0), np.zeros(np.shape(start), f0))
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
@@ -42,11 +95,18 @@ def chunked_cached_attention(
     block: int = 512,
 ) -> jax.Array:
     """Returns attention output [B, T, Hq, d] (same visibility rule as the
-    dense path: slot j visible to query t iff j <= start + t and valid[j])."""
+    dense path: slot j visible to query t iff j <= start + t and valid[j]).
+    Reverse-differentiable: grads route through a dense backward (custom
+    VJP) since the dynamic-bound forward loop cannot be transposed."""
+    return _make_chunked(min(block, k_cache.shape[1]))(
+        q, k_cache, v_cache, valid, jnp.asarray(start)
+    )
+
+
+def _chunked_impl(q, k_cache, v_cache, valid, start, block):
     B, T, Hq, d = q.shape
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
     rep = Hq // Hkv
-    block = min(block, S)
     scale = 1.0 / math.sqrt(d)
 
     qr = q.reshape(B, T, Hkv, rep, d)
