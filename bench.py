@@ -40,10 +40,10 @@ def log(msg):
 # --------------------------------------------------------------------------
 
 
-def bench_grpo():
-    """Secondary bench: GRPO learn-step tokens/sec + MFU on a GPT-2-small-class
-    model (the BASELINE.md LLM metric at reduced scale for one chip)."""
-    import jax
+def grpo_learn_cell(B, T, n_layer, dtype=None, remat=False, iters=3):
+    """Time the fused GRPO learn step on a GPT-2-small-class model; the ONE
+    harness behind both the headline grpo bench and the MFU recipe sweep
+    (benchmarking/grpo_mfu_sweep.py) so their numbers stay comparable."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -51,13 +51,10 @@ def bench_grpo():
     from agilerl_tpu.llm import model as M
     from agilerl_tpu.utils.profiling import estimate_mfu
 
-    backend = jax.default_backend()
-    on_cpu = backend == "cpu"
-    B = int(os.environ.get("BENCH_GRPO_BATCH", 4 if on_cpu else 16))
-    T = int(os.environ.get("BENCH_GRPO_SEQ", 128 if on_cpu else 512))
-    n_layer = int(os.environ.get("BENCH_GRPO_LAYERS", 2 if on_cpu else 12))
+    kwargs = {} if dtype is None else {"dtype": dtype}
     cfg = M.GPTConfig(
-        vocab_size=32_000, n_layer=n_layer, n_head=12, d_model=768, max_seq_len=T,
+        vocab_size=32_000, n_layer=n_layer, n_head=12, d_model=768,
+        max_seq_len=T, remat=remat, **kwargs,
     )
     agent = GRPO(config=cfg, pad_token_id=0, eos_token_id=1, group_size=4,
                  batch_size=B, seed=0)
@@ -67,20 +64,36 @@ def bench_grpo():
     loss_mask[:, T // 2:] = 1.0
     rewards = rng.normal(size=(B // 4, 4)).astype(np.float32)
     exp = (ids, jnp.asarray(loss_mask), jnp.asarray(rewards))
-    log(f"bench_grpo: backend={backend} B={B} T={T} layers={n_layer}; compiling")
     agent.learn(exp)  # compile
     t0 = time.perf_counter()
-    iters = 3
     for _ in range(iters):
         agent.learn(exp)
     dt = (time.perf_counter() - t0) / iters
     tokens = B * T
-    mfu = estimate_mfu(cfg, tokens, dt)
+    return {
+        "tokens_per_sec": round(tokens / dt),
+        "mfu": round(estimate_mfu(cfg, tokens, dt), 4),
+        "step_seconds": round(dt, 4),
+    }
+
+
+def bench_grpo():
+    """Secondary bench: GRPO learn-step tokens/sec + MFU on a GPT-2-small-class
+    model (the BASELINE.md LLM metric at reduced scale for one chip)."""
+    import jax
+
+    backend = jax.default_backend()
+    on_cpu = backend == "cpu"
+    B = int(os.environ.get("BENCH_GRPO_BATCH", 4 if on_cpu else 16))
+    T = int(os.environ.get("BENCH_GRPO_SEQ", 128 if on_cpu else 512))
+    n_layer = int(os.environ.get("BENCH_GRPO_LAYERS", 2 if on_cpu else 12))
+    log(f"bench_grpo: backend={backend} B={B} T={T} layers={n_layer}; compiling")
+    cell = grpo_learn_cell(B, T, n_layer)
     print(json.dumps({
         "metric": f"GRPO learn-step tokens/sec (GPT2-small class, B={B} T={T})",
-        "value": round(tokens / dt),
+        "value": cell["tokens_per_sec"],
         "unit": "tokens/sec",
-        "vs_baseline": round(mfu / 0.35, 3),  # BASELINE: 35% MFU target
+        "vs_baseline": round(cell["mfu"] / 0.35, 3),  # BASELINE: 35% MFU target
         "backend": backend,
         "error": None,
     }), flush=True)

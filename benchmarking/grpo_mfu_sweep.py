@@ -1,0 +1,60 @@
+"""GRPO learn-step MFU recipe sweep (VERDICT r2 next #3: chase the 35% MFU
+baseline, `/root/reference/benchmarking/benchmarking_grpo.py:25-29`).
+
+Sweeps dtype (bf16/f32) x remat x (batch, seq) on the fused GRPO learn step
+over a GPT-2-small-class model and reports tokens/sec + MFU per cell, then
+prints the best recipe as one JSON line. Cells that OOM are recorded and
+skipped. Intended for the real chip (runs on CPU at toy scale for CI).
+
+Run: python benchmarking/grpo_mfu_sweep.py
+"""
+
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from bench import grpo_learn_cell  # the ONE harness shared with bench.py
+
+
+def main():
+    on_cpu = jax.default_backend() == "cpu"
+    n_layer = 2 if on_cpu else 12
+    shapes = [(4, 128)] if on_cpu else [(8, 512), (16, 512), (16, 1024),
+                                        (32, 1024)]
+    cells = []
+    for dtype_name, dtype in (("bf16", jnp.bfloat16), ("f32", jnp.float32)):
+        if on_cpu and dtype_name == "bf16":
+            continue  # bf16 matmuls are emulated (slow) on CPU
+        for remat in (False, True):
+            for B, T in shapes:
+                cell = {"dtype": dtype_name, "remat": remat, "B": B, "T": T}
+                try:
+                    cell.update(grpo_learn_cell(B, T, n_layer, dtype=dtype,
+                                                remat=remat))
+                except Exception as e:  # noqa: BLE001 — OOM/compile failures recorded
+                    cell["error"] = f"{type(e).__name__}: {e}"[:200]
+                cells.append(cell)
+                print(f"# {cell}", file=_sys.stderr, flush=True)
+
+    ok = [c for c in cells if "mfu" in c]
+    best = max(ok, key=lambda c: c["mfu"]) if ok else None
+    print(json.dumps({
+        "metric": "GRPO learn-step MFU sweep",
+        "backend": jax.default_backend(),
+        "n_layer": n_layer,
+        "best": best,
+        "cells": cells,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    _sys.exit(main())

@@ -1,0 +1,139 @@
+"""One-shot TPU up-window capture (VERDICT r2 next #1-3).
+
+The axon pool flaps; when it comes up the window may be short. This script
+runs EVERYTHING the round needs from one invocation, cheapest first, writing
+each artifact to .tpu_results/ as soon as it lands so a mid-run pool death
+still keeps the earlier results:
+
+  1. device probe (seconds) — bails immediately if the pool is down
+  2. Pallas kernel validation + microbench (benchmarking/tpu_kernel_validation.py)
+  3. evoppo headline bench (bench.py child, BASELINE: >=1M env-steps/sec)
+  4. GRPO learn bench with MFU (bench.py child BENCH_MODE=grpo, BASELINE: 35% MFU)
+  5. GRPO MFU profile sweep: bf16 x remat x batch, largest single-chip config
+     (writes grpo_mfu_sweep.json with the best recipe)
+
+Run: python benchmarking/tpu_up_window_playbook.py
+Then: git add .tpu_results && commit.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(REPO, ".tpu_results")
+os.makedirs(OUT, exist_ok=True)
+
+
+def log(msg):
+    print(f"[playbook {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def save(name, obj):
+    path = os.path.join(OUT, name)
+    with open(path, "w") as fh:
+        json.dump(obj, fh, indent=2)
+    log(f"wrote {path}")
+
+
+def run_child(argv, timeout, env=None, name=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(argv, env=e, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, timeout=timeout,
+                              text=True)
+        out = proc.stdout or ""
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as ex:
+        out = (ex.stdout or b"").decode() if isinstance(ex.stdout, bytes) \
+            else (ex.stdout or "")
+        rc = -1
+    dt = time.time() - t0
+    if name:
+        with open(os.path.join(OUT, name), "w") as fh:
+            fh.write(out)
+    return rc, out, dt
+
+
+def last_json(out):
+    """Last parseable JSON line of a child's merged output, or None. Never
+    raises — a truncated/misleading '{'-line must not abort later steps."""
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def probe(timeout=120):
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(REPO, "bench.py")], timeout,
+        env={"BENCH_PROBE": "1"})
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            backend = line.split()[-1]
+            return backend if backend != "cpu" else None
+    return None
+
+
+def main():
+    backend = probe()
+    if backend is None:
+        log("pool DOWN — nothing to capture")
+        return 1
+    log(f"pool UP (backend={backend})")
+    captured = {"backend": backend, "ts": time.strftime("%Y%m%dT%H%M%S")}
+
+    # 2. kernel validation (cheap, de-risks everything else)
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(HERE, "tpu_kernel_validation.py")],
+        600, name="kernels_tpu.log")
+    lines = []
+    for l in out.splitlines():
+        if l.strip().startswith("{"):
+            try:
+                lines.append(json.loads(l))
+            except json.JSONDecodeError:
+                lines.append({"unparsed": l[:200]})
+    captured["kernels"] = {"rc": rc, "seconds": round(dt), "lines": lines}
+    save("playbook_progress.json", captured)
+
+    # 3. evoppo headline
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(REPO, "bench.py")], 900,
+        env={"BENCH_CHILD": "1"}, name="bench_evoppo_tpu.log")
+    captured["evoppo"] = last_json(out)
+    save("playbook_progress.json", captured)
+
+    # 4. GRPO tokens/sec + MFU
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(REPO, "bench.py")], 900,
+        env={"BENCH_CHILD": "1", "BENCH_MODE": "grpo"},
+        name="bench_grpo_tpu.log")
+    captured["grpo"] = last_json(out)
+    save("playbook_progress.json", captured)
+
+    # 5. MFU recipe sweep — bf16/remat/batch on the GRPO learn step
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(HERE, "grpo_mfu_sweep.py")], 1800,
+        name="grpo_mfu_sweep.log")
+    captured["mfu_sweep"] = last_json(out)
+    if captured["mfu_sweep"] is not None:
+        save("grpo_mfu_sweep.json", captured["mfu_sweep"])
+    save("playbook_progress.json", captured)
+    log("playbook complete — commit .tpu_results/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
