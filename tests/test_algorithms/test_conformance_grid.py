@@ -12,6 +12,8 @@ SURVEY.md §4). Each cell checks:
 import jax
 import numpy as np
 import pytest
+
+from tests.tiering import fast_core
 from gymnasium import spaces
 
 from agilerl_tpu.algorithms import CQN, DDPG, DQN, PPO, TD3, RainbowDQN
@@ -122,7 +124,7 @@ VALUE_ALGOS = {
 }
 
 
-@pytest.mark.parametrize("obs_name", list(VALUE_OBS_SPACES))
+@pytest.mark.parametrize("obs_name", fast_core(list(VALUE_OBS_SPACES)))
 @pytest.mark.parametrize("algo", list(VALUE_ALGOS))
 class TestValueGrid:
     def _agent(self, algo, obs_name):
@@ -173,7 +175,7 @@ CONT_ALGOS = {
 }
 
 
-@pytest.mark.parametrize("obs_name", list(OBS_SPACES))
+@pytest.mark.parametrize("obs_name", fast_core(list(OBS_SPACES)))
 @pytest.mark.parametrize("algo", list(CONT_ALGOS))
 class TestContinuousGrid:
     def test_action_bounds(self, algo, obs_name):
@@ -219,7 +221,10 @@ PPO_CELLS = [
 ]
 
 
-@pytest.mark.parametrize("act_name,obs_name", PPO_CELLS)
+@pytest.mark.parametrize(
+    "act_name,obs_name",
+    fast_core(PPO_CELLS, is_fast=lambda c: c[1] == "vec"),
+)
 class TestPPOGrid:
     def _agent(self, obs_name, act_name, num_envs=4, learn_step=8):
         return PPO(

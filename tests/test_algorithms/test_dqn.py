@@ -83,6 +83,7 @@ def test_clone_and_checkpoint(tmp_path):
     assert loaded.fitness == [1.0, 2.0]
 
 
+@pytest.mark.slow
 def test_mutation_then_learn():
     """Architecture mutation must keep the agent trainable (recompile path)."""
     env = ConstantRewardEnv()

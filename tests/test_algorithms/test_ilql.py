@@ -57,6 +57,7 @@ def test_bc_lm_loss_decreases():
     assert comp.shape == (1, 4)
 
 
+@pytest.mark.slow
 def test_ilql_policy_generation_prefers_rewarded_tokens():
     """VERDICT #6: the acting policy (sample/greedy/beam over the Q/V-
     reweighted LM) must select the reward-preferred continuation after
@@ -97,6 +98,7 @@ def test_ilql_policy_generation_prefers_rewarded_tokens():
     assert (s_toks[:, P] == good).all()
 
 
+@pytest.mark.slow
 def test_ilql_rewards_shape_q_values():
     """After the token-alignment fix, Q(prompt, good_token) must rise above
     Q(prompt, bad_token) when only 'good' completions are rewarded."""

@@ -7,6 +7,8 @@ import jax
 import numpy as np
 import pytest
 
+from tests.tiering import fast_core
+
 from agilerl_tpu.algorithms import IPPO, MADDPG, MATD3
 from agilerl_tpu.components import MultiAgentReplayBuffer
 from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
@@ -47,7 +49,11 @@ def fill_ma_buffer(env, agent, n=40):
 OFF_POLICY = {"maddpg": MADDPG, "matd3": MATD3}
 
 
-@pytest.mark.parametrize("continuous", [False, True], ids=["disc", "cont"])
+@pytest.mark.parametrize(
+    "continuous",
+    fast_core([False, True], is_fast=lambda c: c is False),
+    ids=["disc", "cont"],
+)
 @pytest.mark.parametrize("algo", list(OFF_POLICY))
 class TestMAOffPolicyGrid:
     def test_learn_clone_saveload(self, algo, continuous, tmp_path):
@@ -73,7 +79,11 @@ class TestMAOffPolicyGrid:
             np.testing.assert_array_equal(np.asarray(a1[aid]), np.asarray(a3[aid]))
 
 
-@pytest.mark.parametrize("continuous", [False, True], ids=["disc", "cont"])
+@pytest.mark.parametrize(
+    "continuous",
+    fast_core([False, True], is_fast=lambda c: c is False),
+    ids=["disc", "cont"],
+)
 class TestIPPOGrid:
     def test_rollout_learn_clone(self, continuous, tmp_path):
         env = make_env(continuous)

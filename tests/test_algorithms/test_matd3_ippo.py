@@ -78,6 +78,7 @@ class TestIPPO:
 
 
 class TestMultiAgentEvolution:
+    @pytest.mark.slow
     def test_tournament_and_mutation(self):
         env = make_env()
         pop = [

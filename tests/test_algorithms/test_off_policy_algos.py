@@ -29,6 +29,7 @@ def fill_buffer(buf, continuous=False, n=128, seed=0):
 
 
 class TestRainbow:
+    @pytest.mark.slow
     def test_action_and_learn(self):
         agent = RainbowDQN(BOX, DISC, net_config=NET, v_min=0, v_max=2,
                            num_atoms=21, lr=1e-3, seed=0)
@@ -42,6 +43,7 @@ class TestRainbow:
         q = np.asarray(agent.actor(jnp.zeros((1, 4))))
         assert abs(q.mean() - 1.0) < 0.4
 
+    @pytest.mark.slow
     def test_per_priorities(self):
         agent = RainbowDQN(BOX, DISC, net_config=NET, v_min=0, v_max=2, seed=0)
         buf = PrioritizedReplayBuffer(max_size=256)

@@ -66,6 +66,7 @@ def test_clone_preserves_weights():
     assert clone.index == 3
 
 
+@pytest.mark.slow
 def test_mutation_then_learn():
     env_vec = JaxVecEnv(CartPole(), num_envs=4, seed=0)
     agent = make_agent(

@@ -11,6 +11,8 @@ For each (algorithm, mutation-class) cell:
 
 import numpy as np
 import pytest
+
+from tests.tiering import fast_core
 from gymnasium import spaces
 
 from agilerl_tpu.components import MultiAgentReplayBuffer, ReplayBuffer
@@ -75,7 +77,12 @@ def post_mutation_learn(agent, algo, continuous):
     return out[0] if isinstance(out, tuple) else out
 
 
-@pytest.mark.parametrize("mut_name", list(MUT_CLASSES))
+# fast tier (VERDICT r2 #4c): the architecture class — the one that rebuilds
+# networks and is most likely to break — runs for every algorithm in
+# `-m "not slow"`; the other four classes run in the full tier
+@pytest.mark.parametrize(
+    "mut_name", fast_core(list(MUT_CLASSES), fast=("architecture",))
+)
 @pytest.mark.parametrize("algo", list(SINGLE_AGENT))
 def test_single_agent_mutation_cell(algo, mut_name):
     act_space, continuous = SINGLE_AGENT[algo]
@@ -121,7 +128,10 @@ def test_rl_hp_bounds_and_optimizer_rebuild(mut_name):
 
 
 @pytest.mark.parametrize("algo", ["MADDPG", "MATD3"])
-@pytest.mark.parametrize("mut_name", ["architecture", "parameters", "rl_hp"])
+@pytest.mark.parametrize(
+    "mut_name",
+    fast_core(["architecture", "parameters", "rl_hp"], fast=("architecture",)),
+)
 def test_multi_agent_mutation_cell(algo, mut_name):
     from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
 

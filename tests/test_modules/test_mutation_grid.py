@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from tests.tiering import fast_core
 from gymnasium import spaces
 
 from agilerl_tpu.modules import (
@@ -141,7 +143,7 @@ def test_mutation_preserves_shape_and_weights(name, mut):
     )
 
 
-@pytest.mark.parametrize("name", MODULES)
+@pytest.mark.parametrize("name", fast_core(MODULES, fast=("mlp",)))
 def test_mutation_rails(name):
     """Hammer random mutations; bounds must hold and forward must stay valid."""
     m, x = make_module(name)
