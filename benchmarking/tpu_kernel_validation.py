@@ -114,10 +114,77 @@ def check_fused_loss():
     }))
 
 
+def sweep_flash_blocks():
+    """Block-size sweep for the flash forward (VERDICT r2 next #2): wall-clock
+    per (block_q, block_k) so the production default can be pinned per TPU
+    generation. Emits one JSON line with every cell + the fastest."""
+    from agilerl_tpu.ops.flash_attention_vjp import flash_attention_diff
+
+    B, H, T, d = 4, 8, 1024, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, H, T, d), jnp.float32)
+    k = jax.random.normal(ks[1], (B, H, T, d), jnp.float32)
+    v = jax.random.normal(ks[2], (B, H, T, d), jnp.float32)
+    on_cpu = jax.default_backend() == "cpu"
+    blocks = [128] if on_cpu else [128, 256, 512]
+    cells = []
+    for bq in blocks:
+        for bk in blocks:
+            fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention_diff(
+                q, k, v, causal=True, block_q=bq, block_k=bk))
+            try:
+                cells.append({"block_q": bq, "block_k": bk,
+                              "ms": timeit(fn, q, k, v, iters=10) * 1e3})
+            except Exception as e:  # noqa: BLE001 — tile-fit failures recorded
+                cells.append({"block_q": bq, "block_k": bk,
+                              "error": f"{type(e).__name__}: {e}"[:160]})
+    ok = [c for c in cells if "ms" in c]
+    print(json.dumps({
+        "check": "flash_block_sweep", "backend": jax.default_backend(),
+        "shape": [B, H, T, d], "cells": cells,
+        "best": min(ok, key=lambda c: c["ms"]) if ok else None,
+        "ok": bool(ok),
+    }))
+
+
+def sweep_fused_loss_blocks():
+    """Block-size sweep for the fused lm-head logprob kernel."""
+    from agilerl_tpu.ops.fused_loss import fused_token_logprob_diff
+
+    N, D, V = 2048, 768, 32_000
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    hidden = jax.random.normal(ks[0], (N, D), jnp.float32) * 0.02
+    head = jax.random.normal(ks[1], (D, V), jnp.float32) * 0.02
+    targets = jax.random.randint(ks[2], (N,), 0, V)
+    on_cpu = jax.default_backend() == "cpu"
+    grid = [(256, 1024)] if on_cpu else [
+        (128, 512), (256, 1024), (256, 2048), (512, 1024), (512, 2048),
+    ]
+    cells = []
+    for bn, bv in grid:
+        fn = jax.jit(lambda h, w, t, bn=bn, bv=bv: fused_token_logprob_diff(
+            h, w, t, block_n=bn, block_v=bv))
+        try:
+            cells.append({"block_n": bn, "block_v": bv,
+                          "ms": timeit(fn, hidden, head, targets, iters=5) * 1e3})
+        except Exception as e:  # noqa: BLE001
+            cells.append({"block_n": bn, "block_v": bv,
+                          "error": f"{type(e).__name__}: {e}"[:160]})
+    ok = [c for c in cells if "ms" in c]
+    print(json.dumps({
+        "check": "fused_loss_block_sweep", "backend": jax.default_backend(),
+        "shape": [N, D, V], "cells": cells,
+        "best": min(ok, key=lambda c: c["ms"]) if ok else None,
+        "ok": bool(ok),
+    }))
+
+
 def main():
     print(json.dumps({"devices": [str(d) for d in jax.devices()]}))
     check_flash_attention()
     check_fused_loss()
+    sweep_flash_blocks()
+    sweep_fused_loss_blocks()
 
 
 if __name__ == "__main__":

@@ -68,6 +68,12 @@ def main() -> None:
     elite, new_pop = tournament.select(pop)
     print(f"ELITE {elite.index}", flush=True)
     print(f"POP {' '.join(str(a.index) for a in new_pop)}", flush=True)
+
+    # cross-host metric mean: host 0 reports 1.0, host 1 reports 3.0 -> 2.0
+    from agilerl_tpu.utils.utils import aggregate_metrics_across_hosts
+
+    agg = aggregate_metrics_across_hosts(1.0 + 2.0 * pid)
+    print(f"AGG {agg}", flush=True)
     barrier("done")
     print("DONE", flush=True)
 

@@ -54,9 +54,11 @@ def test_two_process_seed_barrier_tournament():
 
     def decisions(out: str):
         return [ln for ln in out.splitlines()
-                if ln.startswith(("SEED", "ELITE", "POP"))]
+                if ln.startswith(("SEED", "ELITE", "POP", "AGG"))]
 
     d0, d1 = decisions(outs[0][1]), decisions(outs[1][1])
     assert d0 == d1, f"hosts diverged:\nhost0: {d0}\nhost1: {d1}"
     # host 0's proposal won the broadcast
     assert d0[0] == "SEED 1234"
+    # metric mean over hosts reporting 1.0 and 3.0
+    assert d0[-1] == "AGG 2.0"
