@@ -342,13 +342,15 @@ class MultiAgentRLAlgorithm(EvolvableAlgorithm):
         rewards = []
         num_envs = getattr(env, "num_envs", 1)
         for _ in range(loop):
-            obs, _ = env.reset()
+            obs, info = env.reset()
             done = np.zeros(num_envs, dtype=bool)
             total = np.zeros(num_envs, dtype=np.float64)
             steps = 0
             while not done.all():
-                action = self.get_action(obs, training=False)
-                obs, reward, terminated, truncated, _ = env.step(action)
+                # action masks / env-defined actions ride the info dict in
+                # masked PettingZoo games — eval must honour them too
+                action = self.get_action(obs, training=False, infos=info)
+                obs, reward, terminated, truncated, info = env.step(action)
                 # NaN placeholders (dead/inactive agents) must not poison
                 # fitness sums
                 from agilerl_tpu.vector.pz_vec_env import sanitize_ma_transition

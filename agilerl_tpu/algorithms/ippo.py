@@ -168,7 +168,8 @@ class IPPO(MultiAgentRLAlgorithm):
         obs_spaces = self.observation_spaces
 
         @functools.partial(jax.jit, static_argnames=("deterministic",))
-        def act(actor_params, critic_params, obs, key, deterministic=False):
+        def act(actor_params, critic_params, obs, key, deterministic=False,
+                masks=None, forced=None):
             actions, logps, values = {}, {}, {}
             i = 0
             for gid, members in groups.items():
@@ -176,13 +177,20 @@ class IPPO(MultiAgentRLAlgorithm):
                     o = preprocess_observation(obs_spaces[aid], obs[aid])
                     logits = EvolvableNetwork.apply(actor_cfgs[gid], actor_params[gid], o)
                     dist_extra = actor_params[gid].get("dist")
+                    mask = masks.get(aid) if masks is not None else None
                     k = jax.random.fold_in(key, i)
                     if deterministic:
-                        a = D.mode(dist_cfgs[gid], logits)
+                        a = D.mode(dist_cfgs[gid], logits, mask)
                     else:
-                        a = D.sample(dist_cfgs[gid], logits, k, dist_extra)
+                        a = D.sample(dist_cfgs[gid], logits, k, dist_extra, mask)
+                    if forced is not None and aid in forced:
+                        # env-defined actions resolve BEFORE the log-prob so
+                        # the buffer stores the executed action's likelihood
+                        f_vals, f_valid = forced[aid]
+                        a = jnp.where(f_valid, f_vals.astype(a.dtype), a)
                     actions[aid] = a
-                    logps[aid] = D.log_prob(dist_cfgs[gid], logits, a, dist_extra)
+                    logps[aid] = D.log_prob(dist_cfgs[gid], logits, a, dist_extra,
+                                            mask=mask)
                     values[aid] = EvolvableNetwork.apply(
                         critic_cfgs[gid], critic_params[gid], o
                     )[..., 0]
@@ -191,7 +199,16 @@ class IPPO(MultiAgentRLAlgorithm):
 
         return act
 
-    def get_action(self, obs: Dict[str, Any], training: bool = True, **kw):
+    def get_action(
+        self,
+        obs: Dict[str, Any],
+        training: bool = True,
+        infos: Optional[Dict[str, Any]] = None,
+        **kw,
+    ):
+        """infos may carry per-agent "action_mask" (invalid actions masked in
+        the policy distribution) and "env_defined_action" (env-dictated
+        override) — parity: IPPO.get_action + process_infos."""
         first = np.asarray(obs[self.agent_ids[0]])
         own_space = self.observation_spaces[self.agent_ids[0]]
         base_ndim = len(own_space.shape) if own_space.shape else 0
@@ -201,12 +218,34 @@ class IPPO(MultiAgentRLAlgorithm):
         act = self.jit_fn("act", self._act_fn)
         actor_params = {g: self.actors[g].params for g in self.actors}
         critic_params = {g: self.critics[g].params for g in self.critics}
+        from agilerl_tpu.utils.utils import (
+            forced_action_arrays,
+            process_ma_infos,
+        )
+
+        masks, eda = process_ma_infos(infos, self.agent_ids)
+        batch = np.asarray(obs[self.agent_ids[0]]).shape[0]
+        forced = forced_action_arrays(eda, self.agent_ids, batch)
+        if forced is not None:
+            forced = {a: (jnp.asarray(v), jnp.asarray(ok))
+                      for a, (v, ok) in forced.items()}
         actions, logps, values = act(
             actor_params, critic_params, obs, self.next_key(),
-            deterministic=not training,
+            deterministic=not training, masks=masks, forced=forced,
         )
         self._cached_logps = {a: np.asarray(v) for a, v in logps.items()}
         self._cached_values = {a: np.asarray(v) for a, v in values.items()}
+        # masks used this step (ones when absent) — buffered so learn()
+        # recomputes log-probs/entropy on the SAME masked distribution
+        self._cached_masks = {}
+        for a in self.agent_ids:
+            space = self.action_spaces[a]
+            if hasattr(space, "n"):
+                if masks is not None and masks.get(a) is not None:
+                    m = np.broadcast_to(np.asarray(masks[a]), (batch, space.n))
+                else:
+                    m = np.ones((batch, space.n), np.float32)
+                self._cached_masks[a] = np.asarray(m, np.float32)
         out = {a: np.asarray(v) for a, v in actions.items()}
         if single:
             out = {a: v[0] for a, v in out.items()}
@@ -218,13 +257,16 @@ class IPPO(MultiAgentRLAlgorithm):
         rows in that group's rollout buffer."""
         n_steps = n_steps or self.learn_step
         if self._last_obs is None:
-            obs, _ = env.reset()
+            obs, info = env.reset()
             self._last_obs = obs
+            self._last_info = info
         obs = self._last_obs
+        info = getattr(self, "_last_info", None)
         total_r = 0.0
         for _ in range(n_steps):
-            actions = self.get_action(obs)
+            actions = self.get_action(obs, infos=info)
             next_obs, rew, term, trunc, info = env.step(actions)
+            self._last_info = info
             # dead/inactive agents arrive as NaN placeholders from the async
             # vec env — zero them before buffering (AsyncAgentsWrapper is the
             # NaN-aware path; the plain loop must stay finite)
@@ -258,10 +300,16 @@ class IPPO(MultiAgentRLAlgorithm):
                 )
                 g_logp = np.concatenate([self._cached_logps[a] for a in members], axis=0)
                 g_val = np.concatenate([self._cached_values[a] for a in members], axis=0)
-                self.rollout_buffers[gid].add(
+                step = dict(
                     obs=g_obs, action=g_act, reward=g_rew, done=g_done,
                     value=g_val, log_prob=g_logp,
                 )
+                cached_masks = getattr(self, "_cached_masks", {})
+                if all(a in cached_masks for a in members):
+                    step["action_mask"] = np.concatenate(
+                        [cached_masks[a] for a in members], axis=0
+                    )
+                self.rollout_buffers[gid].add(**step)
             total_r += float(np.mean([np.mean(np.asarray(rew[a])) for a in self.agent_ids]))
             obs = next_obs
         self._last_obs = obs
@@ -283,8 +331,10 @@ class IPPO(MultiAgentRLAlgorithm):
                 obs = preprocess_observation(space, batch["obs"])
                 logits = EvolvableNetwork.apply(actor_cfg, p["actors"][gid], obs)
                 dist_extra = p["actors"][gid].get("dist")
-                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra)
-                entropy = D.entropy(dist_cfg, logits, dist_extra).mean()
+                mask = batch.get("action_mask")
+                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra,
+                                      mask=mask)
+                entropy = D.entropy(dist_cfg, logits, dist_extra, mask=mask).mean()
                 value = EvolvableNetwork.apply(critic_cfg, p["critics"][gid], obs)[..., 0]
                 adv = batch["advantages"]
                 adv = (adv - adv.mean()) / (adv.std() + 1e-8)

@@ -181,13 +181,16 @@ class MADDPG(MultiAgentRLAlgorithm):
         agent_ids = tuple(self.agent_ids)
 
         @jax.jit
-        def act(actor_params, obs, key, noise_scale):
+        def act(actor_params, obs, key, noise_scale, masks=None):
             out = {}
             for i, aid in enumerate(agent_ids):
                 o = preprocess_observation(obs_spaces[aid], obs[aid])
                 raw = EvolvableNetwork.apply(actor_cfgs[aid], actor_params[aid], o)
                 k = jax.random.fold_in(key, i)
                 if discrete[aid]:
+                    if masks is not None and masks.get(aid) is not None:
+                        # invalid-action mask from the env's info dict
+                        raw = jnp.where(masks[aid].astype(bool), raw, -1e9)
                     sampled = jnp.argmax(gumbel_softmax(raw, k), axis=-1)
                     greedy = jnp.argmax(raw, axis=-1)
                     out[aid] = jnp.where(noise_scale > 0, sampled, greedy)
@@ -201,7 +204,17 @@ class MADDPG(MultiAgentRLAlgorithm):
 
         return act
 
-    def get_action(self, obs: Dict[str, Any], training: bool = True, **kw) -> Dict[str, np.ndarray]:
+    def get_action(
+        self,
+        obs: Dict[str, Any],
+        training: bool = True,
+        infos: Optional[Dict[str, Any]] = None,
+        **kw,
+    ) -> Dict[str, np.ndarray]:
+        """infos (PettingZoo info dict) may carry per-agent "action_mask"
+        (invalid discrete actions masked before sampling) and
+        "env_defined_action" (env-dictated override) — parity:
+        MADDPG.get_action + process_infos (reference maddpg.py:414)."""
         first = np.asarray(obs[self.agent_ids[0]])
         own_space = self.observation_spaces[self.agent_ids[0]]
         base_ndim = len(own_space.shape) if hasattr(own_space, "shape") and own_space.shape else 0
@@ -211,8 +224,17 @@ class MADDPG(MultiAgentRLAlgorithm):
         act = self.jit_fn("act", self._act_fn)
         noise = jnp.float32(self.expl_noise if training else 0.0)
         actor_params = {a: self.actors[a].params for a in self.agent_ids}
-        actions = act(actor_params, obs, self.next_key(), noise)
+        from agilerl_tpu.utils.utils import (
+            apply_env_defined_actions,
+            process_ma_infos,
+        )
+
+        masks, eda = process_ma_infos(infos, self.agent_ids)
+        actions = act(actor_params, obs, self.next_key(), noise, masks)
         out = {a: np.asarray(v) for a, v in actions.items()}
+        # off-policy: the EXECUTED action is what the buffer should hold, so
+        # overriding after the policy ran is the correct semantics here
+        out = apply_env_defined_actions(eda, out)
         if single:
             out = {a: v[0] for a, v in out.items()}
         return out

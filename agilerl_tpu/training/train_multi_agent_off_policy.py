@@ -60,10 +60,12 @@ def train_multi_agent_off_policy(
 
     while np.min([agent.steps[-1] for agent in pop]) < max_steps:
         for agent in pop:
-            obs, _ = env.reset()
+            obs, info = env.reset()
             steps = 0
             for _ in range(max(evo_steps // num_envs, 1)):
-                actions = agent.get_action(obs)
+                # forward the env's info dict: action masks / env-defined
+                # actions ride it (parity: reference train_multi_agent.py)
+                actions = agent.get_action(obs, infos=info)
                 next_obs, reward, terminated, truncated, info = env.step(actions)
                 # dead/inactive agents arrive as NaN placeholders — zero them
                 # before they can reach the buffer (NaN Q-target poisoning)

@@ -221,6 +221,218 @@ def make_multi_agent_vect_envs(
     return cls(fns)
 
 
+def make_skill_vect_envs(env_name: str, skill, num_envs: int = 1):
+    """Vectorise a gym env wrapped in a curriculum Skill (parity:
+    utils/utils.py:101; the Skill wrapper lives in wrappers/learning.py)."""
+    import gymnasium as gym
+
+    return gym.vector.AsyncVectorEnv(
+        [lambda: skill(gym.make(env_name)) for _ in range(num_envs)]
+    )
+
+
+def observation_space_channels_to_first(observation_space):
+    """[H, W, C] -> [C, H, W] space transform (parity: utils/utils.py:120).
+
+    The in-tree CNN encoder is NHWC (TPU conv layout) so this is only needed
+    when interfacing with channels-first torch policies via MakeEvolvable or
+    when mirroring reference configs that set swap_channels."""
+    from gymnasium import spaces
+
+    if isinstance(observation_space, spaces.Dict):
+        return spaces.Dict(
+            {
+                k: observation_space_channels_to_first(v)
+                for k, v in observation_space.spaces.items()
+            }
+        )
+    if isinstance(observation_space, spaces.Tuple):
+        return spaces.Tuple(
+            tuple(observation_space_channels_to_first(s)
+                  for s in observation_space.spaces)
+        )
+    if isinstance(observation_space, spaces.Box) and len(observation_space.shape) == 3:
+        low = np.moveaxis(observation_space.low, -1, 0)
+        high = np.moveaxis(observation_space.high, -1, 0)
+        return spaces.Box(low=low, high=high, dtype=observation_space.dtype)
+    return observation_space
+
+
+def calculate_vectorized_scores(
+    rewards: np.ndarray,
+    terminations: np.ndarray,
+    include_unterminated: bool = False,
+    only_first_episode: bool = True,
+) -> List[float]:
+    """Segment per-env reward rows into episode scores at termination points
+    (parity: utils/utils.py:861)."""
+    episode_rewards: List[float] = []
+    num_envs = rewards.shape[0]
+    for env_index in range(num_envs):
+        term_idx = np.where(terminations[env_index] == 1)[0]
+        if len(term_idx) == 0:
+            episode_rewards.append(float(np.sum(rewards[env_index])))
+            continue
+        start = 0
+        for t in term_idx:
+            episode_rewards.append(float(np.sum(rewards[env_index, start : t + 1])))
+            start = t + 1
+            if only_first_episode:
+                break
+        if (
+            include_unterminated
+            and not only_first_episode
+            and start < rewards.shape[1]
+        ):
+            episode_rewards.append(float(np.sum(rewards[env_index, start:])))
+    return episode_rewards
+
+
+def get_env_defined_actions(info: Dict[str, Any], agents) -> Optional[Dict[str, Any]]:
+    """Per-agent env-dictated actions from a PettingZoo info dict (parity:
+    utils/utils.py:962). Returns None when no agent has one."""
+    eda = {
+        agent: info.get(agent, {}).get("env_defined_action", None)
+        for agent in agents
+    }
+    if all(v is None for v in eda.values()):
+        return None
+    return eda
+
+
+def extract_action_masks(info: Dict[str, Any], agents) -> Optional[Dict[str, Any]]:
+    """Per-agent invalid-action masks from a PettingZoo info dict (parity:
+    MultiAgentRLAlgorithm.process_infos, core/base.py). None when absent."""
+    masks = {
+        agent: info.get(agent, {}).get("action_mask", None) for agent in agents
+    }
+    if all(v is None for v in masks.values()):
+        return None
+    return masks
+
+
+def process_ma_infos(infos: Optional[Dict[str, Any]], agent_ids):
+    """One-stop extraction of (action masks, env-defined actions) from a
+    PettingZoo info dict for the MA get_action paths (parity:
+    MultiAgentRLAlgorithm.process_infos, reference maddpg.py:414).
+    Masks come back as jnp [B, n] arrays (atleast_2d) or None per agent."""
+    if not infos:
+        return None, None
+    import jax.numpy as jnp
+
+    masks = None
+    raw_masks = extract_action_masks(infos, agent_ids)
+    if raw_masks is not None:
+        masks = {
+            a: (None if raw_masks[a] is None
+                else jnp.atleast_2d(jnp.asarray(raw_masks[a])))
+            for a in agent_ids
+        }
+    return masks, get_env_defined_actions(infos, agent_ids)
+
+
+def apply_env_defined_actions(
+    eda: Optional[Dict[str, Any]], out: Dict[str, np.ndarray]
+) -> Dict[str, np.ndarray]:
+    """Overwrite policy actions with env-dictated ones, PER ROW:
+    - numpy masked array: only unmasked rows are forced;
+    - float array with NaN: non-NaN rows are forced;
+    - scalar or full array: every row.
+    (parity: apply_env_defined_actions, reference algo_utils)."""
+    if eda is None:
+        return out
+    for a, forced in eda.items():
+        if forced is None:
+            continue
+        cur = out[a]
+        if isinstance(forced, np.ma.MaskedArray):
+            keep = np.ma.getmaskarray(forced)
+            vals = np.broadcast_to(forced.filled(0), cur.shape)
+            out[a] = np.where(
+                np.broadcast_to(keep, cur.shape), cur, vals.astype(cur.dtype)
+            )
+            continue
+        forced_arr = np.asarray(forced)
+        if forced_arr.dtype.kind == "f" and np.isnan(forced_arr).any():
+            vals = np.broadcast_to(forced_arr, cur.shape)
+            out[a] = np.where(
+                np.isnan(vals), cur, np.nan_to_num(vals).astype(cur.dtype)
+            )
+            continue
+        out[a] = np.broadcast_to(forced_arr.astype(cur.dtype), cur.shape).copy()
+    return out
+
+
+def forced_action_arrays(
+    eda: Optional[Dict[str, Any]], agent_ids, batch: int
+):
+    """Normalise env-defined actions into per-agent (values [B], valid [B])
+    pairs for resolution INSIDE a policy's act function (on-policy agents
+    must compute the log-prob of the action actually executed). Same row
+    semantics as apply_env_defined_actions. None when nothing is forced."""
+    if eda is None:
+        return None
+    out = {}
+    any_forced = False
+    for a in agent_ids:
+        forced = eda.get(a)
+        if forced is None:
+            out[a] = (np.zeros(batch, np.int32), np.zeros(batch, bool))
+            continue
+        any_forced = True
+        if isinstance(forced, np.ma.MaskedArray):
+            valid = np.broadcast_to(~np.ma.getmaskarray(forced), (batch,))
+            vals = np.broadcast_to(forced.filled(0), (batch,))
+        else:
+            arr = np.asarray(forced)
+            if arr.dtype.kind == "f" and np.isnan(arr).any():
+                vals_f = np.broadcast_to(arr, (batch,))
+                valid = ~np.isnan(vals_f)
+                vals = np.nan_to_num(vals_f)
+            else:
+                vals = np.broadcast_to(arr, (batch,))
+                valid = np.ones(batch, bool)
+        out[a] = (vals.astype(np.int32).copy(), np.asarray(valid).copy())
+    return out if any_forced else None
+
+
+def gather_across_hosts(value) -> np.ndarray:
+    """All-gather a host-local scalar/array across processes, stacked on a
+    leading process axis (parity: utils/utils.py:985 gather_tensor — the
+    accelerate gather becomes a process_allgather)."""
+    arr = np.asarray(value)
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def consolidate_mutations(population: List) -> None:
+    """Cross-host mutation-consistency check (parity redesign:
+    utils/utils.py:1047 — the reference BROADCASTS rank-0's mutation choices
+    because each rank mutates independently; here every host runs the same
+    deterministic RNG so the decisions are already identical, and this
+    function VERIFIES that invariant instead, raising on divergence)."""
+    if jax.process_count() == 1:
+        return
+    import zlib
+
+    # NB: not Python hash() — str hashing is salted per-process
+    # (PYTHONHASHSEED), which would make identical decisions "diverge"
+    local = np.asarray(
+        [zlib.crc32(repr((agent.index, getattr(agent, "mut", None))).encode())
+         for agent in population],
+        np.int64,
+    )
+    gathered = gather_across_hosts(local)
+    if not (gathered == gathered[0]).all():
+        raise RuntimeError(
+            "mutation decisions diverged across hosts — the replicated-RNG "
+            f"invariant is broken (per-host digests: {gathered.tolist()})"
+        )
+
+
 def tournament_selection_and_mutation(
     population: List,
     tournament,

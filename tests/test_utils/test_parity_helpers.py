@@ -1,0 +1,197 @@
+"""Reference-parity util helpers (utils/utils.py additions: parity map
+make_skill_vect_envs:101, observation_space_channels_to_first:120,
+calculate_vectorized_scores:861, get_env_defined_actions:962,
+gather_tensor:985, consolidate_mutations:1047) + the MA action-mask /
+env-defined-action path through MADDPG and IPPO get_action."""
+
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.utils.utils import (
+    calculate_vectorized_scores,
+    consolidate_mutations,
+    extract_action_masks,
+    gather_across_hosts,
+    get_env_defined_actions,
+    observation_space_channels_to_first,
+)
+
+
+def test_channels_to_first_box_dict_tuple():
+    box = spaces.Box(0, 255, (8, 6, 3), np.uint8)
+    out = observation_space_channels_to_first(box)
+    assert out.shape == (3, 8, 6)
+    d = observation_space_channels_to_first(
+        spaces.Dict({"cam": box, "vec": spaces.Box(-1, 1, (4,))})
+    )
+    assert d["cam"].shape == (3, 8, 6) and d["vec"].shape == (4,)
+    t = observation_space_channels_to_first(spaces.Tuple((box, spaces.Discrete(3))))
+    assert t[0].shape == (3, 8, 6) and isinstance(t[1], spaces.Discrete)
+
+
+def test_calculate_vectorized_scores():
+    rewards = np.array([[1, 1, 1, 1], [2, 2, 2, 2]], np.float32)
+    terms = np.array([[0, 1, 0, 1], [0, 0, 0, 0]], np.float32)
+    # first episode only (default): env0 ends at t=1 (sum 2); env1 never
+    # terminates -> whole row (sum 8)
+    assert calculate_vectorized_scores(rewards, terms) == [2.0, 8.0]
+    # all episodes + unterminated tail
+    all_eps = calculate_vectorized_scores(
+        rewards, terms, include_unterminated=True, only_first_episode=False
+    )
+    assert all_eps == [2.0, 2.0, 8.0]
+
+
+def test_env_defined_actions_and_masks():
+    agents = ["a0", "a1"]
+    info = {"a0": {"env_defined_action": 2}, "a1": {}}
+    eda = get_env_defined_actions(info, agents)
+    assert eda == {"a0": 2, "a1": None}
+    assert get_env_defined_actions({"a0": {}, "a1": {}}, agents) is None
+    info = {"a0": {"action_mask": np.array([1, 0, 1])}, "a1": {}}
+    masks = extract_action_masks(info, agents)
+    assert masks["a1"] is None and masks["a0"].tolist() == [1, 0, 1]
+    assert extract_action_masks({"a0": {}, "a1": {}}, agents) is None
+
+
+def test_gather_and_consolidate_single_process():
+    out = gather_across_hosts(3.5)
+    assert out.shape == (1,) and float(out[0]) == 3.5
+
+    class A:
+        index, mut = 0, "lr"
+
+    consolidate_mutations([A()])  # single-process: must be a no-op
+
+
+MA_OBS = {"a0": spaces.Box(-1, 1, (4,), np.float32),
+          "a1": spaces.Box(-1, 1, (4,), np.float32)}
+MA_DISC = {"a0": spaces.Discrete(3), "a1": spaces.Discrete(3)}
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+
+def _ma_obs(batch=4):
+    return {a: np.zeros((batch, 4), np.float32) for a in MA_OBS}
+
+
+def test_maddpg_action_mask_and_env_defined_action():
+    from agilerl_tpu.algorithms.maddpg import MADDPG
+
+    agent = MADDPG(MA_OBS, MA_DISC, net_config=NET, seed=0)
+    # a0 may only pick action 1; a1 is unconstrained
+    infos = {"a0": {"action_mask": np.tile([0, 1, 0], (4, 1))}, "a1": {}}
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert (acts["a0"] == 1).all()
+    # env-defined override wins regardless of the policy
+    infos = {"a0": {"env_defined_action": 2}, "a1": {}}
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert (acts["a0"] == 2).all()
+    # no infos: unchanged legacy path
+    acts = agent.get_action(_ma_obs())
+    assert acts["a0"].shape == (4,)
+
+
+def test_ippo_action_mask_masks_distribution():
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    agent = IPPO(MA_OBS, MA_DISC, net_config=NET, seed=0)
+    infos = {"a0": {"action_mask": np.tile([0, 0, 1], (4, 1))},
+             "a1": {"action_mask": np.tile([1, 0, 0], (4, 1))}}
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert (acts["a0"] == 2).all() and (acts["a1"] == 0).all()
+    # cached log-probs come from the MASKED distribution: certain -> ~0
+    lp = agent._cached_logps
+    assert np.allclose(lp["a0"], 0.0, atol=1e-4)
+    # deterministic eval honours the mask too
+    acts = agent.get_action(_ma_obs(), training=False, infos=infos)
+    assert (acts["a0"] == 2).all()
+
+
+def test_apply_env_defined_actions_row_semantics():
+    from agilerl_tpu.utils.utils import apply_env_defined_actions
+
+    out = {"a0": np.array([0, 1, 0, 1]), "a1": np.array([2, 2, 2, 2])}
+    # NaN rows mean "not forced"; masked-array masked rows mean "not forced"
+    eda = {
+        "a0": np.array([3.0, np.nan, 3.0, np.nan]),
+        "a1": np.ma.MaskedArray([9, 9, 9, 9], mask=[False, True, True, True]),
+    }
+    res = apply_env_defined_actions(eda, dict(out))
+    assert res["a0"].tolist() == [3, 1, 3, 1]
+    assert res["a1"].tolist() == [9, 2, 2, 2]
+    # scalar forces every row; None leaves the agent untouched
+    res = apply_env_defined_actions({"a0": 2, "a1": None}, dict(out))
+    assert res["a0"].tolist() == [2, 2, 2, 2]
+    assert res["a1"].tolist() == [2, 2, 2, 2]
+
+
+def test_ippo_env_defined_action_logp_matches_executed_action():
+    """The buffer must hold the EXECUTED action's log-prob: per-row forced
+    actions resolve before the log-prob (review finding)."""
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    agent = IPPO(MA_OBS, MA_DISC, net_config=NET, seed=0)
+    # rows 0 and 2 forced to action 2 for a0; a1 free
+    infos = {"a0": {"env_defined_action": np.array([2.0, np.nan, 2.0, np.nan])},
+             "a1": {}}
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert acts["a0"][0] == 2 and acts["a0"][2] == 2
+    # cached logp must equal the policy's log-prob OF THE FORCED action
+    import jax.numpy as jnp
+
+    from agilerl_tpu.networks.base import EvolvableNetwork
+    from agilerl_tpu.networks import distributions as D
+
+    gid = agent.get_group_id("a0")
+    obs0 = np.zeros((4, 4), np.float32)
+    logits = EvolvableNetwork.apply(
+        agent.actors[gid].config, agent.actors[gid].params, jnp.asarray(obs0)
+    )
+    want = np.asarray(D.log_prob(
+        agent.actors[gid].dist_config, logits, jnp.asarray(acts["a0"]),
+        agent.actors[gid].params.get("dist"),
+    ))
+    np.testing.assert_allclose(agent._cached_logps["a0"], want, rtol=1e-5)
+
+
+def test_ippo_masked_rollout_learn_ratio_is_unbiased():
+    """With action masks, learn() must recompute log-probs on the SAME
+    masked distribution it sampled from — at epoch 0 with unchanged params
+    the PPO ratio is exactly 1, so the masked mask must ride the buffer."""
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    class MaskedTwoAgentEnv:
+        num_envs = 4
+        agents = ["a0", "a1"]
+
+        def __init__(self):
+            self.mask = {a: np.tile([1, 1, 0], (4, 1)) for a in self.agents}
+
+        def _info(self):
+            return {a: {"action_mask": self.mask[a]} for a in self.agents}
+
+        def reset(self):
+            obs = {a: np.zeros((4, 4), np.float32) for a in self.agents}
+            return obs, self._info()
+
+        def step(self, actions):
+            for a in self.agents:
+                assert (np.asarray(actions[a]) != 2).all(), "invalid action taken"
+            obs = {a: np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+                   for a in self.agents}
+            rew = {a: np.ones(4, np.float32) for a in self.agents}
+            term = {a: np.zeros(4, bool) for a in self.agents}
+            trunc = {a: np.zeros(4, bool) for a in self.agents}
+            return obs, rew, term, trunc, self._info()
+
+    agent = IPPO(MA_OBS, MA_DISC, net_config=NET, num_envs=4, learn_step=8,
+                 batch_size=8, update_epochs=1, seed=0)
+    env = MaskedTwoAgentEnv()
+    agent.collect_rollouts(env, n_steps=8)
+    gid = agent.get_group_id("a0")
+    stored = agent.rollout_buffers[gid].state.data
+    assert "action_mask" in stored, "mask must ride the rollout buffer"
+    assert (np.asarray(stored["action_mask"])[..., 2] == 0).all()
+    loss = agent.learn()
+    assert np.isfinite(loss)
