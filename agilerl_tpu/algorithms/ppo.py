@@ -194,7 +194,7 @@ class PPO(RLAlgorithm):
         recurrent = self.recurrent
 
         @jax.jit
-        def act(actor_params, critic_params, obs, key, hidden):
+        def act(actor_params, critic_params, obs, key, hidden, mask=None):
             obs = preprocess_observation(space, obs)
             if recurrent:
                 latent, new_ha = _lstm_encode(actor_cfg, actor_params, obs, hidden["actor"])
@@ -209,8 +209,8 @@ class PPO(RLAlgorithm):
                 value = EvolvableNetwork.apply(critic_cfg, critic_params, obs)[..., 0]
                 new_hidden = hidden
             dist_extra = actor_params.get("dist")
-            action = D.sample(dist_cfg, logits, key, dist_extra)
-            logp = D.log_prob(dist_cfg, logits, action, dist_extra)
+            action = D.sample(dist_cfg, logits, key, dist_extra, mask)
+            logp = D.log_prob(dist_cfg, logits, action, dist_extra, mask=mask)
             return action, logp, value, new_hidden
 
         return act
@@ -223,7 +223,10 @@ class PPO(RLAlgorithm):
         hidden: Optional[Dict] = None,
     ):
         """Host API: returns numpy action (plus logp/value via get_action_and_value)."""
-        a, _, _, _ = self.get_action_and_value(obs, hidden=hidden, deterministic=not training)
+        a, _, _, _ = self.get_action_and_value(
+            obs, hidden=hidden, deterministic=not training,
+            action_mask=action_mask,
+        )
         return a
 
     def get_action_and_value(
@@ -231,10 +234,14 @@ class PPO(RLAlgorithm):
         obs: Any,
         hidden: Optional[Dict] = None,
         deterministic: bool = False,
+        action_mask: Optional[np.ndarray] = None,
     ):
         single = not _batched(obs, self.observation_space)
         if single:
             obs = jax.tree_util.tree_map(lambda x: np.asarray(x)[None], obs)
+            if action_mask is not None:
+                action_mask = np.asarray(action_mask)[None]
+        mask = None if action_mask is None else jnp.asarray(action_mask)
         if self.recurrent and hidden is None:
             batch = jax.tree_util.tree_leaves(obs)[0].shape[0]
             if self._hidden is None or (
@@ -263,12 +270,12 @@ class PPO(RLAlgorithm):
                 logits = EvolvableMLP.apply(self.actor.config.head, self.actor.params["head"], latent)
             else:
                 logits = EvolvableNetwork.apply(self.actor.config, self.actor.params, obs_p)
-            action = D.mode(self.actor.dist_config, logits)
+            action = D.mode(self.actor.dist_config, logits, mask)
             out = (np.asarray(action), None, None, hidden)
         else:
             action, logp, value, new_hidden = act(
                 self.actor.params, self.critic.params, obs, self.next_key(),
-                hidden if hidden is not None else {},
+                hidden if hidden is not None else {}, mask,
             )
             if self.recurrent:
                 self._hidden = new_hidden
@@ -292,8 +299,10 @@ class PPO(RLAlgorithm):
                 obs = preprocess_observation(space, batch["obs"])
                 logits = EvolvableNetwork.apply(actor_cfg, p["actor"], obs)
                 dist_extra = p["actor"].get("dist")
-                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra)
-                entropy = D.entropy(dist_cfg, logits, dist_extra).mean()
+                mask = batch.get("action_mask")
+                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra,
+                                      mask=mask)
+                entropy = D.entropy(dist_cfg, logits, dist_extra, mask=mask).mean()
                 value = EvolvableNetwork.apply(critic_cfg, p["critic"], obs)[..., 0]
 
                 adv = batch["advantages"]
@@ -338,8 +347,10 @@ class PPO(RLAlgorithm):
                 )
                 values = EvolvableMLP.apply(critic_cfg.head, p["critic"]["head"], values)[..., 0]
                 dist_extra = p["actor"].get("dist")
-                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra)
-                entropy = D.entropy(dist_cfg, logits, dist_extra).mean()
+                mask = batch.get("action_mask")
+                new_logp = D.log_prob(dist_cfg, logits, batch["action"], dist_extra,
+                                      mask=mask)
+                entropy = D.entropy(dist_cfg, logits, dist_extra, mask=mask).mean()
                 adv = batch["advantages"]
                 if normalize_advantage:
                     adv = (adv - adv.mean()) / (adv.std() + 1e-8)
@@ -383,8 +394,10 @@ class PPO(RLAlgorithm):
                     obs = preprocess_observation(space, b["obs"])
                     logits = EvolvableNetwork.apply(actor_cfg, p["actor"], obs)
                     extra = p["actor"].get("dist")
-                    new_logp = D.log_prob(dist_cfg, logits, b["action"], extra)
-                    entropy = D.entropy(dist_cfg, logits, extra).mean()
+                    mask = b.get("action_mask")
+                    new_logp = D.log_prob(dist_cfg, logits, b["action"], extra,
+                                          mask=mask)
+                    entropy = D.entropy(dist_cfg, logits, extra, mask=mask).mean()
                     value = EvolvableNetwork.apply(critic_cfg, p["critic"], obs)[..., 0]
                     adv = b["advantages"]
                     if normalize_advantage:

@@ -35,19 +35,38 @@ def left_pad(
     return toks, mask
 
 
-def _sample_token(logits, key, temperature, top_k):
+def _sample_token(logits, key, temperature, top_k, top_p=None):
+    if temperature == 0.0:
+        # greedy: filters can't change the argmax
+        return jnp.argmax(logits, axis=-1)
+    # temperature applies BEFORE the nucleus filter (reference order,
+    # sampling_utils.py:107 process_logits): top_p is order-sensitive —
+    # a hotter distribution admits more tokens into the nucleus
+    logits = logits / temperature
     if top_k is not None:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e9, logits)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    return jax.random.categorical(key, logits / temperature, axis=-1)
+    if top_p is not None:
+        # nucleus sampling (parity: sampling_utils.py:92 top_p_logits):
+        # keep the smallest logit set whose probability mass reaches top_p.
+        # Sorted-descending cumulative mass EXCLUSIVE of the current token,
+        # so the token that crosses the threshold stays includable.
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        drop_sorted = cum >= top_p
+        drop = jnp.zeros_like(drop_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], sort_idx
+        ].set(drop_sorted)
+        logits = jnp.where(drop, -1e9, logits)
+    return jax.random.categorical(key, logits, axis=-1)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("config", "max_new_tokens", "temperature", "top_k", "eos_id",
-                     "pad_id", "lora_scale"),
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k",
+                     "top_p", "eos_id", "pad_id", "lora_scale"),
 )
 def generate(
     config: M.GPTConfig,
@@ -60,6 +79,7 @@ def generate(
     lora_scale: float = 2.0,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -80,7 +100,7 @@ def generate(
     # advances the model with the PREVIOUS token and samples the next — exactly
     # max_new_tokens - 1 decode forwards, none wasted on logits never sampled
     key, k0 = jax.random.split(key)
-    tok0 = _sample_token(last_logits, k0, temperature, top_k)
+    tok0 = _sample_token(last_logits, k0, temperature, top_k, top_p)
     mask0 = jnp.ones((B,), bool)
     done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
 
@@ -95,7 +115,7 @@ def generate(
         logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
         pos = pos + prev_valid.astype(pos.dtype)
         key, k_s = jax.random.split(key)
-        tok = _sample_token(logits, k_s, temperature, top_k)
+        tok = _sample_token(logits, k_s, temperature, top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(done, pad_id, tok)
         emit_mask = jnp.logical_not(done)

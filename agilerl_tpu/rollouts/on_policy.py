@@ -15,21 +15,40 @@ import numpy as np
 
 def collect_rollouts(agent, env, n_steps: Optional[int] = None) -> float:
     """Step the env `n_steps` times, storing transitions in agent.rollout_buffer.
-    Returns the mean reward collected."""
+    Returns the mean reward collected. Envs that publish "action_mask" on the
+    info dict get masked sampling, and the mask rides the buffer so learn()
+    recomputes log-probs on the same masked distribution
+    (parity: train_on_policy.py:270)."""
     n_steps = n_steps or agent.learn_step
     buf = agent.rollout_buffer
     if agent._last_obs is None:
-        obs, _ = env.reset()
+        obs, info = env.reset()
         agent._last_obs = obs
+        agent._last_info = info
         agent._last_done = np.zeros(agent.num_envs, np.float32)
         if agent.recurrent:
             agent._hidden = agent.get_initial_hidden_state()
     obs = agent._last_obs
+    info = getattr(agent, "_last_info", None)
+    # schema is fixed at the first step: if this env publishes masks, every
+    # buffered step carries one (all-ones when a step omits it)
+    masked_env = isinstance(info, dict) and info.get("action_mask") is not None
+    # fallback all-ones shape comes from the first observed mask itself, so
+    # MultiDiscrete/other masked spaces are stored too (review finding)
+    mask_shape = (
+        np.asarray(info["action_mask"]).shape[1:] if masked_env else None
+    )
     total_reward = 0.0
     for _ in range(n_steps):
         hidden_before = agent._hidden if agent.recurrent else None
-        action, logp, value, _ = agent.get_action_and_value(obs)
+        action_mask = (
+            info.get("action_mask") if masked_env and isinstance(info, dict) else None
+        )
+        action, logp, value, _ = agent.get_action_and_value(
+            obs, action_mask=action_mask
+        )
         next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
+        agent._last_info = info
         done = np.logical_or(terminated, truncated).astype(np.float32)
         # time-limit bootstrapping: truncated episodes fold gamma*V(s') into
         # the final reward so GAE (which treats done as terminal) stays
@@ -46,6 +65,12 @@ def collect_rollouts(agent, env, n_steps: Optional[int] = None) -> float:
             value=value,
             log_prob=logp,
         )
+        if masked_env:
+            step["action_mask"] = np.asarray(
+                action_mask if action_mask is not None
+                else np.ones((agent.num_envs,) + mask_shape),
+                np.float32,
+            )
         if agent.recurrent:
             step["hidden_state"] = hidden_before
             # reset hidden for envs that finished

@@ -132,7 +132,7 @@ def train_off_policy(
 
     while np.min([agent.steps[-1] for agent in pop]) < max_steps:
         for agent in pop:
-            obs, _ = env.reset()
+            obs, info = env.reset()
             prev_done = np.zeros(num_envs, dtype=bool)
             prev_transition = None
             if n_step and n_step_memory is not None:
@@ -142,7 +142,10 @@ def train_off_policy(
             completed_scores: List[float] = []
             steps = 0
             for _ in range(max(evo_steps // num_envs, 1)):
-                action = agent.get_action(obs, epsilon=epsilon)
+                # masked envs publish per-step action masks on the info dict
+                # (parity: train_off_policy.py:268)
+                action_mask = info.get("action_mask") if isinstance(info, dict) else None
+                action = agent.get_action(obs, epsilon=epsilon, action_mask=action_mask)
                 next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
                 done = np.logical_or(terminated, truncated)
                 # bootstrap target must see the TRUE successor state, not the
