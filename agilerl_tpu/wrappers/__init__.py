@@ -1,6 +1,9 @@
 from agilerl_tpu.wrappers.agent import AsyncAgentsWrapper, RSNorm, RunningMeanStd
 from agilerl_tpu.wrappers.learning import BanditEnv, Skill
 from agilerl_tpu.wrappers.make_evolvable import MakeEvolvable
+from agilerl_tpu.wrappers.pettingzoo_wrappers import (
+    PettingZooAutoResetParallelWrapper,
+)
 
 __all__ = [
     "RSNorm",
@@ -9,4 +12,5 @@ __all__ = [
     "BanditEnv",
     "Skill",
     "MakeEvolvable",
+    "PettingZooAutoResetParallelWrapper",
 ]

@@ -1,0 +1,37 @@
+"""Standalone PettingZoo parallel-env wrappers (parity:
+agilerl/wrappers/pettingzoo_wrappers.py:14 — the single-env autoreset
+wrapper users apply outside the vectorised path; the in-tree vec envs
+(vector/pz_async_vec_env.py) autoreset internally and don't need it)."""
+
+from __future__ import annotations
+
+
+class PettingZooAutoResetParallelWrapper:
+    """Reset the wrapped parallel env automatically once EVERY agent's
+    episode has ended (terminated or truncated). Everything not overridden
+    here (agents, state(), render_mode, spaces, ...) delegates to the
+    wrapped env, so the full parallel-env surface stays available."""
+
+    def __init__(self, env) -> None:
+        self.env = env
+
+    def __getattr__(self, name):
+        # only called for names NOT found on the wrapper itself
+        return getattr(self.env, name)
+
+    def reset(self, seed=None, options=None):
+        return self.env.reset(seed=seed, options=options)
+
+    def step(self, actions):
+        obs, rewards, terminations, truncations, infos = self.env.step(actions)
+        agents = set(terminations) | set(truncations)
+        if agents and all(
+            terminations.get(a, False) or truncations.get(a, False)
+            for a in agents
+        ):
+            obs, infos = self.env.reset()
+        return obs, rewards, terminations, truncations, infos
+
+    @property
+    def unwrapped(self):
+        return getattr(self.env, "unwrapped", self.env)

@@ -1,0 +1,83 @@
+"""Standalone PettingZoo autoreset wrapper (parity:
+wrappers/pettingzoo_wrappers.py:14)."""
+
+import numpy as np
+
+from agilerl_tpu.wrappers import PettingZooAutoResetParallelWrapper
+
+
+class TwoStepParallelEnv:
+    possible_agents = ["a0", "a1"]
+    metadata = {}
+
+    def __init__(self):
+        self.agents = list(self.possible_agents)
+        self.t = 0
+        self.resets = 0
+
+    def reset(self, seed=None, options=None):
+        self.t = 0
+        self.resets += 1
+        self.agents = list(self.possible_agents)
+        return ({a: np.zeros(2, np.float32) for a in self.agents},
+                {a: {} for a in self.agents})
+
+    def step(self, actions):
+        self.t += 1
+        done = self.t >= 2
+        obs = {a: np.full(2, self.t, np.float32) for a in self.agents}
+        rew = {a: 1.0 for a in self.agents}
+        term = {a: done for a in self.agents}
+        trunc = {a: False for a in self.agents}
+        return obs, rew, term, trunc, {a: {} for a in self.agents}
+
+    def observation_space(self, agent):  # pragma: no cover - surface only
+        return None
+
+    def action_space(self, agent):  # pragma: no cover - surface only
+        return None
+
+
+def test_autoreset_fires_only_when_all_agents_done():
+    env = TwoStepParallelEnv()
+    w = PettingZooAutoResetParallelWrapper(env)
+    w.reset()
+    assert env.resets == 1
+    acts = {a: 0 for a in env.possible_agents}
+    obs, _, term, _, _ = w.step(acts)          # t=1, not done: no reset
+    assert env.resets == 1 and (obs["a0"] == 1).all()
+    obs, _, term, _, _ = w.step(acts)          # t=2, all done -> auto reset
+    assert env.resets == 2
+    assert (obs["a0"] == 0).all()              # obs is the RESET observation
+    assert term["a0"]                          # flags still report the end
+
+
+def test_wrapper_delegates_full_surface():
+    env = TwoStepParallelEnv()
+    env.state = lambda: np.arange(3)
+    w = PettingZooAutoResetParallelWrapper(env)
+    # agents visible BEFORE reset; state() and arbitrary attrs delegate
+    assert w.agents == ["a0", "a1"]
+    assert (w.state() == np.arange(3)).all()
+    assert w.possible_agents == ["a0", "a1"]
+
+
+def test_truncation_only_agent_counts_toward_done():
+    env = TwoStepParallelEnv()
+
+    class TruncOnly(TwoStepParallelEnv):
+        def step(self, actions):
+            obs, rew, term, trunc, infos = super().step(actions)
+            # one agent reports ONLY via truncations
+            term = {"a0": term["a0"]}
+            trunc = {"a1": self.t >= 2}
+            return obs, rew, term, trunc, infos
+
+    env = TruncOnly()
+    w = PettingZooAutoResetParallelWrapper(env)
+    w.reset()
+    acts = {a: 0 for a in env.possible_agents}
+    w.step(acts)                       # t=1: a1 not truncated -> no reset
+    assert env.resets == 1
+    w.step(acts)                       # t=2: a0 terminated AND a1 truncated
+    assert env.resets == 2
