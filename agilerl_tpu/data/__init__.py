@@ -1,0 +1,17 @@
+from agilerl_tpu.data.language_environment import (
+    Language_Environment,
+    TextPolicy,
+    TokenPolicyAdapter,
+    interact_environment,
+)
+from agilerl_tpu.data.rl_data import Language_Observation, RL_Dataset, TokenReward
+
+__all__ = [
+    "Language_Environment",
+    "Language_Observation",
+    "RL_Dataset",
+    "TextPolicy",
+    "TokenPolicyAdapter",
+    "TokenReward",
+    "interact_environment",
+]
