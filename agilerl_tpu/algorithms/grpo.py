@@ -26,7 +26,10 @@ import optax
 from agilerl_tpu.ops import pallas_enabled
 
 from agilerl_tpu.algorithms.core.base import EvolvableAlgorithm
-from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+from agilerl_tpu.algorithms.core.optimizer import (
+    CosineLRScheduleConfig,
+    OptimizerWrapper,
+)
 from agilerl_tpu.algorithms.core.registry import (
     HyperparameterConfig,
     NetworkGroup,
@@ -92,7 +95,11 @@ class GRPO(EvolvableAlgorithm):
         update_epochs: int = 1,
         group_size: int = 8,
         temperature: float = 0.9,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
         max_output_tokens: int = 64,
+        min_output_tokens: Optional[int] = None,
+        cosine_lr_schedule_config: Optional["CosineLRScheduleConfig"] = None,
         lora_rank: int = 8,
         lora_targets: Tuple[str, ...] = ("wq", "wv"),
         lora_scale: float = 2.0,
@@ -111,7 +118,11 @@ class GRPO(EvolvableAlgorithm):
         self.update_epochs = int(update_epochs)
         self.group_size = int(group_size)
         self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
         self.max_output_tokens = int(max_output_tokens)
+        self.min_output_tokens = min_output_tokens
+        self.cosine_lr_schedule_config = cosine_lr_schedule_config
         self.lora_rank = int(lora_rank)
         self.lora_targets = tuple(lora_targets)
         self.lora_scale = float(lora_scale)
@@ -130,7 +141,8 @@ class GRPO(EvolvableAlgorithm):
             config, jax.tree_util.tree_map(jnp.copy, self.actor.params)
         )
         self.optimizer = OptimizerWrapper(
-            optimizer="adamw", lr=self.lr, max_grad_norm=self.max_grad_norm
+            optimizer="adamw", lr=self.lr, max_grad_norm=self.max_grad_norm,
+            lr_schedule=cosine_lr_schedule_config,
         )
         self.register_network_group(NetworkGroup(eval="actor", policy=True))
         self.register_optimizer(
@@ -156,7 +168,11 @@ class GRPO(EvolvableAlgorithm):
             "update_epochs": self.update_epochs,
             "group_size": self.group_size,
             "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
             "max_output_tokens": self.max_output_tokens,
+            "min_output_tokens": self.min_output_tokens,
+            "cosine_lr_schedule_config": self.cosine_lr_schedule_config,
             "lora_rank": self.lora_rank,
             "lora_targets": self.lora_targets,
             "lora_scale": self.lora_scale,
@@ -191,6 +207,8 @@ class GRPO(EvolvableAlgorithm):
             max_new_tokens=self.max_output_tokens, lora=self.actor.params,
             lora_scale=self.lora_scale,
             temperature=self.temperature if training else 0.0,
+            top_k=self.top_k, top_p=self.top_p,
+            min_new_tokens=self.min_output_tokens,
             eos_id=self.eos_token_id, pad_id=self.pad_token_id,
         )
         return np.asarray(comp), np.asarray(cmask)

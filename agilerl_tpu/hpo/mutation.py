@@ -175,7 +175,15 @@ class Mutations:
         # lr, lr_actor, lr_critic, ... — review finding)
         for cfg in agent.registry.optimizer_configs:
             if cfg.lr == name:
-                getattr(agent, cfg.name).set_lr(new_value)
+                wrapper = getattr(agent, cfg.name)
+                wrapper.set_lr(new_value)
+                if getattr(wrapper, "lr_schedule", None) is not None:
+                    # a scheduled optimizer bakes lr into tx (peak_value), so
+                    # any cached jitted update closure holds the STALE tx —
+                    # drop the cache so the next learn() rebuilds against the
+                    # new schedule (unscheduled optimizers inject lr into
+                    # opt_state and need no recompile)
+                    agent._clear_jit_cache()
         if name == "learn_step" and hasattr(agent, "rollout_buffer"):
             agent.rollout_buffer.capacity = int(new_value)
             agent.rollout_buffer.state = None

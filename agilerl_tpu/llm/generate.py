@@ -66,7 +66,8 @@ def _sample_token(logits, key, temperature, top_k, top_p=None):
 @functools.partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "temperature", "top_k",
-                     "top_p", "eos_id", "pad_id", "lora_scale"),
+                     "top_p", "eos_id", "pad_id", "lora_scale",
+                     "min_new_tokens"),
 )
 def generate(
     config: M.GPTConfig,
@@ -82,10 +83,13 @@ def generate(
     top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    min_new_tokens: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (completions [B, max_new_tokens], completion_mask [B, max_new_tokens]).
 
-    completion_mask covers tokens up to and including the first EOS."""
+    completion_mask covers tokens up to and including the first EOS.
+    min_new_tokens (parity: vllm/HF min_output_tokens) suppresses EOS for
+    the first N sampled tokens so completions have a length floor."""
     B, P = prompt.shape
     caches = M.init_caches(config, B, P + max_new_tokens)
     positions = jnp.maximum(jnp.cumsum(prompt_mask, axis=-1) - 1, 0)
@@ -99,12 +103,22 @@ def generate(
     # first token comes straight from the prefill logits; each scan step then
     # advances the model with the PREVIOUS token and samples the next — exactly
     # max_new_tokens - 1 decode forwards, none wasted on logits never sampled
+    def suppress_eos(logits, step):
+        if eos_id is None or not min_new_tokens:
+            return logits
+        return jnp.where(
+            (step < min_new_tokens)
+            & (jnp.arange(logits.shape[-1]) == eos_id)[None, :],
+            -1e9, logits,
+        )
+
     key, k0 = jax.random.split(key)
-    tok0 = _sample_token(last_logits, k0, temperature, top_k, top_p)
+    tok0 = _sample_token(suppress_eos(last_logits, 0), k0, temperature,
+                         top_k, top_p)
     mask0 = jnp.ones((B,), bool)
     done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
 
-    def step(carry, _):
+    def step(carry, i):
         caches, prev_tok, prev_valid, pos, done, key = carry
         hidden, caches = M.forward(
             config, params, prev_tok[:, None],
@@ -115,7 +129,8 @@ def generate(
         logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
         pos = pos + prev_valid.astype(pos.dtype)
         key, k_s = jax.random.split(key)
-        tok = _sample_token(logits, k_s, temperature, top_k, top_p)
+        tok = _sample_token(suppress_eos(logits, i), k_s, temperature,
+                            top_k, top_p)
         if eos_id is not None:
             tok = jnp.where(done, pad_id, tok)
         emit_mask = jnp.logical_not(done)
@@ -124,8 +139,8 @@ def generate(
         return (caches, tok, emit_mask, pos, done, key), (tok, emit_mask)
 
     (_, _, _, _, _, _), (tokens, masks) = jax.lax.scan(
-        step, (caches, tok0, mask0, pos, done0, key), None,
-        length=max_new_tokens - 1,
+        step, (caches, tok0, mask0, pos, done0, key),
+        jnp.arange(1, max_new_tokens),
     )
     tokens = jnp.concatenate([tok0[None], tokens], axis=0)
     masks = jnp.concatenate([mask0[None], masks], axis=0)

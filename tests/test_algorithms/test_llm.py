@@ -186,3 +186,65 @@ def test_grpo_gradient_direction():
         agent.learn((ids, jnp.asarray(mask), jnp.asarray(rewards)))
     assert mean_lp(pos) > before_pos + 0.03
     assert mean_lp(neg) < before_neg
+
+
+def test_grpo_sampling_knobs_and_lr_schedule():
+    """Reference-parity GRPO kwargs: top_k/top_p/min_output_tokens thread to
+    the generate loop (completions respect the length floor) and
+    cosine_lr_schedule_config builds a scheduled optimizer (grpo.py:130-142
+    reference surface)."""
+    from agilerl_tpu.algorithms.core.optimizer import CosineLRScheduleConfig
+
+    agent = make_grpo(
+        top_k=10, top_p=0.9, max_output_tokens=6, min_output_tokens=4,
+        cosine_lr_schedule_config=CosineLRScheduleConfig(
+            num_epochs=2, steps_per_epoch=4
+        ),
+    )
+    env = make_gym()
+    prompts = env.reset()
+    comp, cmask = agent.get_action(prompts)
+    # min_output_tokens: every completion has >= 4 live tokens
+    assert (np.asarray(cmask).sum(axis=1) >= 4).all()
+    # the scheduled optimizer still learns
+    ids, action_masks = env.assemble_learn_batch(comp, cmask)
+    _, rewards = env.step(comp, cmask)
+    loss, _ = agent.learn((ids, action_masks, rewards))
+    assert np.isfinite(loss)
+    # clone round-trips the new kwargs
+    c = agent.clone()
+    assert c.top_k == 10 and c.min_output_tokens == 4
+
+
+def test_grpo_lr_mutation_rebuilds_scheduled_optimizer():
+    """With a cosine schedule, lr lives in tx (peak_value), so an RL-HP lr
+    mutation must drop the cached jitted update closure — otherwise the
+    mutated agent silently trains at the old lr (review finding)."""
+    from agilerl_tpu.algorithms.core.optimizer import CosineLRScheduleConfig
+    from agilerl_tpu.hpo.mutation import Mutations
+
+    agent = make_grpo(
+        cosine_lr_schedule_config=CosineLRScheduleConfig(
+            num_epochs=1, steps_per_epoch=8
+        ),
+    )
+    env = make_gym()
+    prompts = env.reset()
+    comp, cmask = agent.get_action(prompts)
+    ids, action_masks = env.assemble_learn_batch(comp, cmask)
+    _, rewards = env.step(comp, cmask)
+    agent.learn((ids, action_masks, rewards))   # populate the jit cache
+    assert "update" in agent._jit_cache
+    mut = Mutations(no_mutation=0.0, architecture=0.0, parameters=0.0,
+                    activation=0.0, rl_hp=1.0, rand_seed=0)
+    # force an lr mutation (sample until the hp picked is lr)
+    for _ in range(20):
+        mutated = mut.rl_hyperparam_mutation(agent)
+        if mutated.mut == "lr":
+            break
+    assert mutated.mut == "lr"
+    assert "update" not in mutated._jit_cache, (
+        "stale jitted update would train at the unmutated lr"
+    )
+    loss, _ = mutated.learn((ids, action_masks, rewards))
+    assert np.isfinite(loss)
