@@ -16,7 +16,7 @@
 # Shards run concurrently up to JOBS (default: nproc, capped at 4 — each
 # pytest process compiles XLA programs and is memory/CPU hungry). On this
 # 1-core image that means sequential; measured sequential wall times:
-# full ~63 min, fast ~23 min. The fast tier still touches every algorithm,
+# full ~50-63 min, fast ~27 min. The fast tier still touches every algorithm,
 # module, loop and parallelism axis (see tests/tiering.py).
 #
 # Mirrors the reference's tiered CI (.github/workflows/*:125-239) with the
