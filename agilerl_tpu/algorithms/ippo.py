@@ -187,7 +187,22 @@ class IPPO(MultiAgentRLAlgorithm):
                         # env-defined actions resolve BEFORE the log-prob so
                         # the buffer stores the executed action's likelihood
                         f_vals, f_valid = forced[aid]
-                        a = jnp.where(f_valid, f_vals.astype(a.dtype), a)
+                        # collapse trailing unit dims so a [B, 1] force
+                        # matches a [B] action instead of silently
+                        # broadcasting to [B, B] (review finding)
+                        fv, ok = f_vals, f_valid
+                        while fv.ndim > a.ndim and fv.shape[-1] == 1:
+                            fv, ok = fv[..., 0], ok[..., 0]
+                        if fv.ndim > a.ndim:
+                            raise ValueError(
+                                f"env_defined_action for {aid!r} has shape "
+                                f"{f_vals.shape} but the action is {a.shape}"
+                            )
+                        # element-wise valid resolves per COMPONENT — same
+                        # semantics as apply_env_defined_actions
+                        ok = ok.reshape(ok.shape + (1,) * (a.ndim - ok.ndim))
+                        fv = fv.reshape(fv.shape + (1,) * (a.ndim - fv.ndim))
+                        a = jnp.where(ok, fv.astype(a.dtype), a)
                     actions[aid] = a
                     logps[aid] = D.log_prob(dist_cfgs[gid], logits, a, dist_extra,
                                             mask=mask)
@@ -237,14 +252,27 @@ class IPPO(MultiAgentRLAlgorithm):
         self._cached_values = {a: np.asarray(v) for a, v in values.items()}
         # masks used this step (ones when absent) — buffered so learn()
         # recomputes log-probs/entropy on the SAME masked distribution
+        # maskedness LATCHES the first time the env publishes any mask —
+        # mask-free envs never pay the buffering/apply_mask cost, and once
+        # latched every step caches a mask (ones fallback) so the buffer
+        # schema stays stable (the rollout buffer ones-backfills rows from
+        # before the latch)
+        if masks is not None and not getattr(self, "_ma_masked", False):
+            self._ma_masked = True
         self._cached_masks = {}
-        for a in self.agent_ids:
-            space = self.action_spaces[a]
-            if hasattr(space, "n"):
+        if getattr(self, "_ma_masked", False):
+            for a in self.agent_ids:
+                dist_cfg = self.actors[self.get_group_id(a)].dist_config
+                if dist_cfg.kind == "normal":
+                    continue  # masks are a no-op for continuous heads
+                # mask width is the head's logit width (sum(nvec) for
+                # MultiDiscrete), so rollout-time and learn-time
+                # distributions stay identical for every maskable kind
+                width = D.head_output_dim(dist_cfg)
                 if masks is not None and masks.get(a) is not None:
-                    m = np.broadcast_to(np.asarray(masks[a]), (batch, space.n))
+                    m = np.broadcast_to(np.asarray(masks[a]), (batch, width))
                 else:
-                    m = np.ones((batch, space.n), np.float32)
+                    m = np.ones((batch, width), np.float32)
                 self._cached_masks[a] = np.asarray(m, np.float32)
         out = {a: np.asarray(v) for a, v in actions.items()}
         if single:

@@ -114,14 +114,20 @@ class RolloutBuffer:
         if self.state is not None:
             self.state = self.state._replace(t=jnp.zeros((), jnp.int32))
 
+    #: backfill value per key when that key first appears AFTER the schema
+    #: was frozen (producers override — e.g. action_mask backfills with 1
+    #: because unmasked sampling ≡ all-ones mask)
+    backfill_fills = {"action_mask": 1}
+
     def add(self, **step: PyTree) -> None:
         """step keys: obs, action, reward, done, value, log_prob
         (+ hidden_state pytree when recurrent)."""
-        if self.state is None:
-            def alloc(x):
-                x = jnp.asarray(x)
-                return jnp.zeros((self.capacity,) + x.shape, x.dtype)
 
+        def alloc(x, fill=0):
+            x = jnp.asarray(x)
+            return jnp.full((self.capacity,) + x.shape, fill, x.dtype)
+
+        if self.state is None:
             data = {k: jax.tree_util.tree_map(alloc, v) for k, v in step.items()}
             self.state = RolloutState(
                 data=data,
@@ -129,6 +135,18 @@ class RolloutBuffer:
                 advantages=jnp.zeros((self.capacity, self.num_envs)),
                 returns=jnp.zeros((self.capacity, self.num_envs)),
             )
+        elif any(k not in self.state.data for k in step):
+            # schema grew after the first add (e.g. an env that only publishes
+            # action_mask on step infos, latched mid-rollout): allocate the
+            # new key, backfilling prior rows per backfill_fills
+            data = dict(self.state.data)
+            for k, v in step.items():
+                if k not in data:
+                    fill = self.backfill_fills.get(k, 0)
+                    data[k] = jax.tree_util.tree_map(
+                        lambda x, _f=fill: alloc(x, _f), v
+                    )
+            self.state = self.state._replace(data=data)
         self.state = _write_step(self.state, step)
 
     def compute_returns_and_advantages(

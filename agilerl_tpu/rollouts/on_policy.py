@@ -30,25 +30,36 @@ def collect_rollouts(agent, env, n_steps: Optional[int] = None) -> float:
             agent._hidden = agent.get_initial_hidden_state()
     obs = agent._last_obs
     info = getattr(agent, "_last_info", None)
-    # schema is fixed at the first step: if this env publishes masks, every
-    # buffered step carries one (all-ones when a step omits it)
-    masked_env = isinstance(info, dict) and info.get("action_mask") is not None
-    # fallback all-ones shape comes from the first observed mask itself, so
-    # MultiDiscrete/other masked spaces are stored too (review finding)
-    mask_shape = (
-        np.asarray(info["action_mask"]).shape[1:] if masked_env else None
-    )
+
+    # maskedness is LATCHED on the agent the first time any info carries a
+    # mask (reset info, or a step info mid-rollout) and never unlatches, so
+    # the buffer schema cannot flip between collects: once masked, every
+    # buffered step carries a mask (all-ones when a step omits it); envs that
+    # only publish masks on step infos get a ones backfill for earlier rows
+    # (review finding — schema drift between collects crashed _write_step)
+    def _latch_mask(i):
+        if not agent._masked_env and isinstance(i, dict) and i.get("action_mask") is not None:
+            agent._masked_env = True
+            agent._mask_shape = np.asarray(i["action_mask"]).shape[1:]
+
+    if not hasattr(agent, "_masked_env"):
+        agent._masked_env = False
+        agent._mask_shape = None
+    _latch_mask(info)
     total_reward = 0.0
     for _ in range(n_steps):
         hidden_before = agent._hidden if agent.recurrent else None
         action_mask = (
-            info.get("action_mask") if masked_env and isinstance(info, dict) else None
+            info.get("action_mask")
+            if agent._masked_env and isinstance(info, dict)
+            else None
         )
         action, logp, value, _ = agent.get_action_and_value(
             obs, action_mask=action_mask
         )
         next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
         agent._last_info = info
+        _latch_mask(info)
         done = np.logical_or(terminated, truncated).astype(np.float32)
         # time-limit bootstrapping: truncated episodes fold gamma*V(s') into
         # the final reward so GAE (which treats done as terminal) stays
@@ -65,10 +76,10 @@ def collect_rollouts(agent, env, n_steps: Optional[int] = None) -> float:
             value=value,
             log_prob=logp,
         )
-        if masked_env:
+        if agent._masked_env:
             step["action_mask"] = np.asarray(
                 action_mask if action_mask is not None
-                else np.ones((agent.num_envs,) + mask_shape),
+                else np.ones((agent.num_envs,) + agent._mask_shape),
                 np.float32,
             )
         if agent.recurrent:

@@ -366,34 +366,48 @@ def apply_env_defined_actions(
 def forced_action_arrays(
     eda: Optional[Dict[str, Any]], agent_ids, batch: int
 ):
-    """Normalise env-defined actions into per-agent (values [B], valid [B])
-    pairs for resolution INSIDE a policy's act function (on-policy agents
-    must compute the log-prob of the action actually executed). Same row
-    semantics as apply_env_defined_actions. None when nothing is forced."""
+    """Normalise env-defined actions into per-agent (values, valid) pairs for
+    resolution INSIDE a policy's act function (on-policy agents must compute
+    the log-prob of the action actually executed). valid is ELEMENT-WISE
+    (same shape as values) — exactly apply_env_defined_actions' semantics,
+    where a NaN/masked COMPONENT keeps the policy's component and the rest of
+    the row is still forced. None when nothing is forced."""
     if eda is None:
         return None
+
+    def row_shape(arr):
+        # [B]/[B, ...dims] pass through; scalars and bare per-row action
+        # vectors broadcast up to a leading batch axis
+        if arr.ndim == 0:
+            return (batch,)
+        if arr.shape[0] == batch:
+            return arr.shape
+        return (batch,) + arr.shape
+
     out = {}
-    any_forced = False
     for a in agent_ids:
         forced = eda.get(a)
         if forced is None:
-            out[a] = (np.zeros(batch, np.int32), np.zeros(batch, bool))
-            continue
-        any_forced = True
+            continue  # absent agents are simply not in the dict
         if isinstance(forced, np.ma.MaskedArray):
-            valid = np.broadcast_to(~np.ma.getmaskarray(forced), (batch,))
-            vals = np.broadcast_to(forced.filled(0), (batch,))
+            arr = np.asarray(forced.filled(0))
+            tgt = row_shape(arr)
+            vals = np.broadcast_to(arr, tgt).copy()
+            valid = ~np.broadcast_to(np.ma.getmaskarray(forced), tgt)
         else:
             arr = np.asarray(forced)
+            tgt = row_shape(arr)
+            vals_f = np.broadcast_to(arr, tgt)
             if arr.dtype.kind == "f" and np.isnan(arr).any():
-                vals_f = np.broadcast_to(arr, (batch,))
                 valid = ~np.isnan(vals_f)
                 vals = np.nan_to_num(vals_f)
             else:
-                vals = np.broadcast_to(arr, (batch,))
-                valid = np.ones(batch, bool)
-        out[a] = (vals.astype(np.int32).copy(), np.asarray(valid).copy())
-    return out if any_forced else None
+                vals = vals_f.copy()
+                valid = np.ones(tgt, bool)
+        # dtype is PRESERVED (continuous Box actions must not truncate to
+        # int) and so are trailing action dims (review finding)
+        out[a] = (np.asarray(vals), np.asarray(valid).copy())
+    return out if out else None
 
 
 def gather_across_hosts(value) -> np.ndarray:

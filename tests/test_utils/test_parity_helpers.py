@@ -253,3 +253,136 @@ def test_ppo_masked_collection_and_learn():
     a = agent.get_action(np.zeros((4, 3), np.float32), training=False,
                          action_mask=np.tile([0, 1], (4, 1)))
     assert (np.asarray(a) == 1).all()
+
+
+def test_mask_latch_survives_schema_flip():
+    """Review finding (r3): an env that omits action_mask in reset infos but
+    publishes it on step infos must not crash the buffer with a schema delta
+    on the next collect — maskedness latches on the agent, the buffer grows
+    the key with a ones backfill, and later collects keep buffering masks."""
+    from agilerl_tpu.algorithms.ppo import PPO
+    from agilerl_tpu.rollouts.on_policy import collect_rollouts
+
+    class FlipMaskVecEnv:
+        num_envs = 4
+
+        def reset(self):
+            return np.zeros((4, 3), np.float32), {}  # NO mask at reset
+
+        def step(self, action):
+            obs = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+            r = np.ones(4, np.float32)
+            z = np.zeros(4, bool)
+            return obs, r, z, z, {"action_mask": np.tile([1, 0], (4, 1))}
+
+    agent = PPO(spaces.Box(-1, 1, (3,), np.float32), spaces.Discrete(2),
+                net_config=NET, num_envs=4, learn_step=8, batch_size=8,
+                update_epochs=1, seed=0)
+    env = FlipMaskVecEnv()
+    collect_rollouts(agent, env, n_steps=8)
+    assert agent._masked_env, "mask latched from a step info"
+    stored = agent.rollout_buffer.state.data
+    assert "action_mask" in stored
+    m = np.asarray(stored["action_mask"])
+    # row 0 was sampled unmasked -> buffered as all-ones; later rows masked
+    assert (m[0] == 1).all()
+    assert (m[1:, :, 1] == 0).all()
+    assert np.isfinite(agent.learn())
+    # second collect: latched schema, no KeyError, masks keep riding
+    collect_rollouts(agent, env, n_steps=8)
+    assert np.isfinite(agent.learn())
+
+
+def test_forced_action_arrays_dtype_and_dims():
+    """Review finding (r3): continuous/multi-dim forced actions must keep
+    their dtype and trailing dims (no silent int32 truncation)."""
+    from agilerl_tpu.utils.utils import forced_action_arrays
+
+    eda = {"a0": np.array([[0.5, -0.5]] * 4, np.float32), "a1": None}
+    out = forced_action_arrays(eda, ["a0", "a1"], 4)
+    assert set(out) == {"a0"}  # absent agents simply aren't in the dict
+    vals, valid = out["a0"]
+    assert vals.dtype == np.float32 and vals.shape == (4, 2)
+    assert np.allclose(vals, [[0.5, -0.5]] * 4)
+    assert valid.shape == (4, 2) and valid.all()
+    # valid is ELEMENT-WISE (apply_env_defined_actions semantics): a NaN
+    # component keeps the policy's component, the rest is still forced
+    eda = {"a0": np.array([[0.5, np.nan]] + [[0.1, 0.2]] * 3, np.float32)}
+    vals, valid = forced_action_arrays(eda, ["a0"], 4)["a0"]
+    assert valid.tolist() == [[True, False]] + [[True, True]] * 3
+    # discrete path unchanged: ints stay ints
+    vals, valid = forced_action_arrays({"a0": 2}, ["a0"], 4)["a0"]
+    assert vals.shape == (4,) and (vals == 2).all() and valid.all()
+
+
+def test_ippo_forced_continuous_actions():
+    """Review finding (r3): IPPO env-defined actions over Box spaces resolve
+    with correct dtype/shape (valid broadcasts over the action dims)."""
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    box_act = {"a0": spaces.Box(-1, 1, (2,), np.float32),
+               "a1": spaces.Box(-1, 1, (2,), np.float32)}
+    agent = IPPO(MA_OBS, box_act, net_config=NET, seed=0)
+    forced = np.array([[0.5, -0.5]] * 4, np.float32)
+    infos = {"a0": {"env_defined_action": forced}, "a1": {}}
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert np.allclose(np.asarray(acts["a0"]), forced, atol=1e-6)
+    assert acts["a1"].shape == (4, 2)
+
+
+def test_ippo_multidiscrete_masks_buffered_for_learn():
+    """Review finding (r3): MultiDiscrete masks must be buffered (width =
+    head logit width, sum(nvec)) so learn() recomputes on the same masked
+    distribution it sampled from."""
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    md = {"a0": spaces.MultiDiscrete([3, 2]), "a1": spaces.MultiDiscrete([3, 2])}
+    agent = IPPO(MA_OBS, md, net_config=NET, seed=0)
+    # head widths 3 + 2: only action 2 valid in head 0, only action 0 in head 1
+    mask = np.tile([0, 0, 1, 1, 0], (4, 1)).astype(np.float32)
+    infos = {"a0": {"action_mask": mask}, "a1": {}}
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    a0 = np.asarray(acts["a0"])
+    assert (a0[:, 0] == 2).all() and (a0[:, 1] == 0).all()
+    # masks cached for BOTH agents at head width (all-ones fallback for a1)
+    assert set(agent._cached_masks) == {"a0", "a1"}
+    assert agent._cached_masks["a0"].shape == (4, 5)
+    assert (agent._cached_masks["a1"] == 1).all()
+    # fully-determined distribution -> log-prob ~ 0
+    assert np.allclose(agent._cached_logps["a0"], 0.0, atol=1e-4)
+
+
+def test_ippo_forced_column_vector_raises():
+    """A [B, 1] forced array against a scalar Discrete action must raise
+    loudly instead of silently broadcasting to [B, B] (review finding)."""
+    import pytest
+
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    agent = IPPO(MA_OBS, MA_DISC, net_config=NET, seed=0)
+    infos = {"a0": {"env_defined_action": np.array([[2], [0], [1], [2]])},
+             "a1": {}}
+    # [B,1] with a trailing unit dim collapses to [B] — valid, not an error
+    acts = agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert np.asarray(acts["a0"]).tolist() == [2, 0, 1, 2]
+    # but a genuinely mismatched trailing dim raises
+    infos = {"a0": {"env_defined_action": np.tile([1, 2, 0], (4, 1))}, "a1": {}}
+    with pytest.raises(ValueError, match="env_defined_action"):
+        agent.get_action(_ma_obs(), training=True, infos=infos)
+
+
+def test_ippo_maskfree_env_buffers_no_masks():
+    """Mask-free envs must not pay the mask-caching cost (review finding):
+    _cached_masks stays empty until the env actually publishes a mask."""
+    from agilerl_tpu.algorithms.ippo import IPPO
+
+    agent = IPPO(MA_OBS, MA_DISC, net_config=NET, seed=0)
+    agent.get_action(_ma_obs(), training=True, infos=None)
+    assert agent._cached_masks == {}
+    # first real mask latches; later mask-free steps keep a ones fallback
+    infos = {"a0": {"action_mask": np.tile([1, 0, 1], (4, 1))}, "a1": {}}
+    agent.get_action(_ma_obs(), training=True, infos=infos)
+    assert set(agent._cached_masks) == {"a0", "a1"}
+    agent.get_action(_ma_obs(), training=True, infos=None)
+    assert set(agent._cached_masks) == {"a0", "a1"}
+    assert all((m == 1).all() for m in agent._cached_masks.values())
