@@ -240,7 +240,8 @@ class IPPO(MultiAgentRLAlgorithm):
 
         masks, eda = process_ma_infos(infos, self.agent_ids)
         batch = np.asarray(obs[self.agent_ids[0]]).shape[0]
-        forced = forced_action_arrays(eda, self.agent_ids, batch)
+        forced = forced_action_arrays(eda, self.agent_ids, batch,
+                                      self.action_spaces)
         if forced is not None:
             forced = {a: (jnp.asarray(v), jnp.asarray(ok))
                       for a, (v, ok) in forced.items()}

@@ -41,6 +41,18 @@ def transformer_flops_per_token(config) -> float:
     return 6.0 * n_params + 12.0 * L * config.max_seq_len * d
 
 
+def peak_flops_per_device(device=None) -> Optional[float]:
+    """Peak bf16 FLOPs/s for the device's chip generation; None when the
+    backend has no well-defined peak (CPU)."""
+    device = device or jax.devices()[0]
+    if device.platform != "tpu":
+        return None  # CPU/GPU/unknown: no peak table -> no fabricated MFU
+    return {
+        "tpu v4": 275e12, "tpu v5": 197e12, "tpu v5 lite": 197e12,
+        "tpu v5p": 459e12, "tpu v6e": 918e12, "tpu v6 lite": 918e12,
+    }.get(device.device_kind.lower(), 197e12)
+
+
 def estimate_mfu(
     config,
     tokens_per_step: int,
@@ -51,13 +63,31 @@ def estimate_mfu(
 
     peak_flops defaults per detected TPU generation (bf16)."""
     if peak_flops is None:
-        kind = jax.devices()[0].device_kind.lower()
-        peak_flops = {
-            "tpu v4": 275e12, "tpu v5": 197e12, "tpu v5 lite": 197e12,
-            "tpu v5p": 459e12, "tpu v6e": 918e12, "tpu v6 lite": 918e12,
-        }.get(kind, 197e12)
+        peak_flops = peak_flops_per_device() or 197e12
     flops = transformer_flops_per_token(config) * tokens_per_step
     return flops / (step_time_s * peak_flops)
+
+
+def achieved_flops_metrics(
+    lowered, calls: int, elapsed_s: float
+) -> Dict[str, Any]:
+    """Achieved FLOPs/s (and MFU where the chip has a defined peak) for a
+    lowered jitted program, using XLA's own cost analysis — no hand model.
+    Returns {} when the analysis is unavailable."""
+    try:
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+    except Exception:
+        return {}
+    if flops <= 0 or elapsed_s <= 0:
+        return {}
+    achieved = flops * calls / elapsed_s
+    out: Dict[str, Any] = {"achieved_tflops_per_sec": round(achieved / 1e12, 4)}
+    peak = peak_flops_per_device()
+    out["mfu"] = round(achieved / peak, 4) if peak else None
+    return out
 
 
 class StepTimer:

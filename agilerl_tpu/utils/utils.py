@@ -364,20 +364,36 @@ def apply_env_defined_actions(
 
 
 def forced_action_arrays(
-    eda: Optional[Dict[str, Any]], agent_ids, batch: int
+    eda: Optional[Dict[str, Any]], agent_ids, batch: int, action_spaces=None
 ):
     """Normalise env-defined actions into per-agent (values, valid) pairs for
     resolution INSIDE a policy's act function (on-policy agents must compute
     the log-prob of the action actually executed). valid is ELEMENT-WISE
     (same shape as values) — exactly apply_env_defined_actions' semantics,
     where a NaN/masked COMPONENT keeps the policy's component and the rest of
-    the row is still forced. None when nothing is forced."""
+    the row is still forced. None when nothing is forced.
+
+    action_spaces (optional per-agent dict) disambiguates a bare 1-D action
+    vector whose length happens to equal batch: with the space known, the
+    target shape is always (batch,) + the space's action dims."""
     if eda is None:
         return None
+    from gymnasium import spaces as S
 
-    def row_shape(arr):
-        # [B]/[B, ...dims] pass through; scalars and bare per-row action
-        # vectors broadcast up to a leading batch axis
+    def space_trailing(space):
+        if space is None:
+            return None
+        if isinstance(space, S.MultiDiscrete):
+            return (len(space.nvec),)
+        if isinstance(space, (S.Box, S.MultiBinary)):
+            return tuple(space.shape)
+        return ()  # Discrete: scalar action per row
+
+    def row_shape(arr, trailing):
+        if trailing is not None:
+            return (batch,) + trailing
+        # no space info: [B]/[B, ...dims] pass through; scalars and bare
+        # per-row action vectors broadcast up to a leading batch axis
         if arr.ndim == 0:
             return (batch,)
         if arr.shape[0] == batch:
@@ -389,24 +405,36 @@ def forced_action_arrays(
         forced = eda.get(a)
         if forced is None:
             continue  # absent agents are simply not in the dict
+        trailing = space_trailing(
+            action_spaces.get(a) if action_spaces else None
+        )
         if isinstance(forced, np.ma.MaskedArray):
             arr = np.asarray(forced.filled(0))
-            tgt = row_shape(arr)
-            vals = np.broadcast_to(arr, tgt).copy()
-            valid = ~np.broadcast_to(np.ma.getmaskarray(forced), tgt)
+            invalid = np.ma.getmaskarray(forced)
         else:
             arr = np.asarray(forced)
-            tgt = row_shape(arr)
-            vals_f = np.broadcast_to(arr, tgt)
-            if arr.dtype.kind == "f" and np.isnan(arr).any():
-                valid = ~np.isnan(vals_f)
-                vals = np.nan_to_num(vals_f)
-            else:
-                vals = vals_f.copy()
-                valid = np.ones(tgt, bool)
+            invalid = (
+                np.isnan(arr) if arr.dtype.kind == "f"
+                else np.zeros(arr.shape, bool)
+            )
+        tgt = row_shape(arr, trailing)
+        # a [B, 1] column vector against a scalar-per-row target collapses
+        # its trailing unit dims instead of failing the broadcast
+        while arr.ndim > len(tgt) and arr.shape[-1] == 1:
+            arr, invalid = arr[..., 0], invalid[..., 0]
+        try:
+            vals = np.broadcast_to(arr, tgt).copy()
+        except ValueError:
+            raise ValueError(
+                f"env_defined_action for {a!r} has shape "
+                f"{np.asarray(forced).shape}, incompatible with the action "
+                f"target shape {tgt}"
+            ) from None
+        if vals.dtype.kind == "f":
+            vals = np.nan_to_num(vals)
         # dtype is PRESERVED (continuous Box actions must not truncate to
         # int) and so are trailing action dims (review finding)
-        out[a] = (np.asarray(vals), np.asarray(valid).copy())
+        out[a] = (vals, (~np.broadcast_to(invalid, tgt)).copy())
     return out if out else None
 
 

@@ -157,6 +157,14 @@ def bench_evoppo():
     env_steps = pop_size * num_envs * rollout_len * generations
     sps = env_steps / dt
     baseline = 1_000_000.0  # BASELINE.md: >=1M env-steps/sec aggregate
+    # achieved-FLOPs utilisation of the whole generation program (rollout +
+    # GAE + PPO epochs + evolution) from XLA's own cost analysis — BASELINE
+    # reports dual metrics (steps/s AND utilisation), so do we (VERDICT r3 #8)
+    from agilerl_tpu.utils.profiling import achieved_flops_metrics
+
+    flops_metrics = achieved_flops_metrics(
+        gen.lower(pop, jax.random.PRNGKey(0)), generations, dt
+    )
     print(json.dumps({
         "metric": f"evo-PPO pop={pop_size} aggregate env-steps/sec (single chip)",
         "value": round(sps),
@@ -164,6 +172,7 @@ def bench_evoppo():
         "vs_baseline": round(sps / baseline, 3),
         "backend": backend,
         "error": None,
+        **flops_metrics,
     }), flush=True)
 
 
@@ -310,6 +319,29 @@ def _run_kernel_validation(timeout_s: float):
             "log": logpath, "summary": summary or None}
 
 
+def _playbook_captured(mode: str):
+    """A TPU headline previously captured by the up-window playbook
+    (.tpu_results/playbook_progress.json), or None. Preferred over a fresh
+    CPU fallback so an early up-window isn't lost when the pool is down at
+    bench time (VERDICT r3 #1); a 'provenance' field marks the re-emit."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".tpu_results", "playbook_progress.json")
+    try:
+        with open(path) as fh:
+            captured = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    result = captured.get("grpo" if mode == "grpo" else "evoppo")
+    if (isinstance(result, dict) and "value" in result
+            and result.get("backend") not in (None, "cpu")):
+        result = dict(result)
+        result["provenance"] = (
+            f"playbook-captured {captured.get('ts', 'unknown-time')}"
+        )
+        return result
+    return None
+
+
 def parent_main():
     mode = os.environ.get("BENCH_MODE", "evoppo")
     metric = (
@@ -402,6 +434,18 @@ def parent_main():
             errors.append(f"accelerator workload attempt: {err_s}")
             log(f"bench parent: workload attempt failed ({err_s}); resuming probes")
         log("bench parent: accelerator phase exhausted; falling back to CPU")
+
+    if (not (force_cpu or user_forced_cpu)
+            and os.environ.get("BENCH_IGNORE_CAPTURED") != "1"):
+        captured = _playbook_captured(mode)
+        if captured is not None:
+            if errors:
+                captured["error"] = "; ".join(
+                    errors + ["re-emitting playbook-captured TPU result"])
+            log(f"bench parent: re-emitting playbook-captured TPU result "
+                f"({captured['provenance']})")
+            print(json.dumps(captured), flush=True)
+            return 0
 
     log(f"bench parent: running on CPU backend (timeout {cpu_timeout:.0f}s)")
     result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)

@@ -386,3 +386,23 @@ def test_ippo_maskfree_env_buffers_no_masks():
     agent.get_action(_ma_obs(), training=True, infos=None)
     assert set(agent._cached_masks) == {"a0", "a1"}
     assert all((m == 1).all() for m in agent._cached_masks.values())
+
+
+def test_forced_action_arrays_space_disambiguation():
+    """With action_spaces supplied, a bare action vector whose length equals
+    batch is still one action FOR EVERY ROW — matching
+    apply_env_defined_actions' broadcast (review finding)."""
+    from agilerl_tpu.utils.utils import forced_action_arrays
+
+    md = {"a0": spaces.MultiDiscrete([3, 2])}
+    # len([1, 0]) == batch == 2: ambiguous without the space
+    vals, valid = forced_action_arrays(
+        {"a0": np.array([1, 0])}, ["a0"], 2, md
+    )["a0"]
+    assert vals.shape == (2, 2) and vals.tolist() == [[1, 0], [1, 0]]
+    assert valid.all()
+    # incompatible shapes raise loudly, naming the agent
+    with pytest.raises(ValueError, match="env_defined_action"):
+        forced_action_arrays(
+            {"a0": np.tile([1, 2, 0], (2, 1))}, ["a0"], 2, md
+        )
