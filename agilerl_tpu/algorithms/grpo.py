@@ -76,6 +76,31 @@ class _LoraNet:
         self.params = params
 
 
+def make_update_fn(config, tx, lora_scale: float, use_flash: bool):
+    """The production GRPO update as a pure function of (base, lora,
+    opt_state, batch, clip, beta). Base params ride as an ARGUMENT (not a
+    closure) so AOT tooling can lower the exact training step from abstract
+    ShapeDtypeStructs without materialising the weights — the 7B dress
+    rehearsal (benchmarking/grpo_7b_plan.py) lowers this very function."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def update(base, lora, opt_state, batch, clip, beta):
+        def loss_fn(lo):
+            lp = M.token_logprobs(
+                config, base, batch["tokens"], attention_mask=batch["mask"],
+                lora=lo, lora_scale=lora_scale, flash=use_flash,
+                use_pallas=use_flash,
+            )
+            return _grpo_loss_core(lp, batch, clip, beta)
+
+        (loss, kl), grads = jax.value_and_grad(loss_fn, has_aux=True)(lora)
+        updates, opt_state = tx.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return lora, opt_state, loss, kl
+
+    return update
+
+
 class GRPO(EvolvableAlgorithm):
     supports_activation_mutation = False
 
@@ -238,30 +263,18 @@ class GRPO(EvolvableAlgorithm):
         return logprobs
 
     def _update_fn(self):
-        config = self.model_config
         base = self.base_params
-        scale = self.lora_scale
-        tx = self.optimizer.tx
         # both Pallas kernels carry custom VJPs (flash_attention_vjp.py,
         # fused_loss.py), so the TRAINING loss runs fully fused on TPU
-        use_flash = pallas_enabled()
+        update = make_update_fn(
+            self.model_config, self.optimizer.tx, self.lora_scale,
+            use_flash=pallas_enabled(),
+        )
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def update(lora, opt_state, batch, clip, beta):
-            def loss_fn(lo):
-                lp = M.token_logprobs(
-                    config, base, batch["tokens"], attention_mask=batch["mask"],
-                    lora=lo, lora_scale=scale, flash=use_flash,
-                    use_pallas=use_flash,
-                )
-                return _grpo_loss_core(lp, batch, clip, beta)
+        def bound(lora, opt_state, batch, clip, beta):
+            return update(base, lora, opt_state, batch, clip, beta)
 
-            (loss, kl), grads = jax.value_and_grad(loss_fn, has_aux=True)(lora)
-            updates, opt_state = tx.update(grads, opt_state, lora)
-            lora = optax.apply_updates(lora, updates)
-            return lora, opt_state, loss, kl
-
-        return update
+        return bound
 
     # -- sequence-parallel (long-context) variants ---------------------- #
     def _require_sp_mesh(self):

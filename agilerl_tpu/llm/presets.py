@@ -1,0 +1,67 @@
+"""Named model presets for the flagship GRPO stack.
+
+The reference reads arbitrary HF checkpoints (its 7B headline workload is a
+Llama-class model served through vLLM + DeepSpeed,
+/root/reference/agilerl/algorithms/core/base.py:3101); here the equivalent
+"flagship" sizes are first-class GPTConfig presets so benchmarks, the 7B
+dress rehearsal (benchmarking/grpo_7b_plan.py) and tests all agree on dims.
+
+Dims match the public architectures exactly (so an HF checkpoint of the same
+family loads straight into the preset via llm/hf.load_hf_model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from agilerl_tpu.llm.model import GPTConfig
+
+# dims: (vocab, n_layer, n_head, n_kv_head, d_model, d_ff, max_seq_len)
+_PRESETS: Dict[str, Dict[str, Any]] = {
+    # GPT-2 small — the single-chip bench model (bench.py grpo_learn_cell)
+    "gpt2-small": dict(
+        vocab_size=50_257, n_layer=12, n_head=12, n_kv_head=12, d_model=768,
+        d_ff=3_072, max_seq_len=1_024, rope_theta=10_000.0,
+    ),
+    # Llama-2-7B: MHA (no GQA), 4k context
+    "llama2-7b": dict(
+        vocab_size=32_000, n_layer=32, n_head=32, n_kv_head=32, d_model=4_096,
+        d_ff=11_008, max_seq_len=4_096, rope_theta=10_000.0,
+        tie_embeddings=False,
+    ),
+    # Llama-3-8B: GQA 8 kv-heads, 128k vocab — the BASELINE.md 7B-class
+    # target model for the >=35% MFU goal
+    "llama3-8b": dict(
+        vocab_size=128_256, n_layer=32, n_head=32, n_kv_head=8, d_model=4_096,
+        d_ff=14_336, max_seq_len=8_192, rope_theta=500_000.0,
+        tie_embeddings=False,
+    ),
+    # Qwen2-7B: GQA 4 kv-heads, attention biases
+    "qwen2-7b": dict(
+        vocab_size=152_064, n_layer=28, n_head=28, n_kv_head=4, d_model=3_584,
+        d_ff=18_944, max_seq_len=32_768, rope_theta=1_000_000.0,
+        tie_embeddings=False, qkv_bias=True,
+    ),
+}
+
+
+def preset_names():
+    return sorted(_PRESETS)
+
+
+def preset(name: str, **overrides: Any) -> GPTConfig:
+    """Build a GPTConfig for a named architecture. Overrides win — e.g.
+    ``preset("llama3-8b", max_seq_len=1024, remat=True)`` for a training
+    config with a shorter context and per-block rematerialisation.
+
+    Defaults bf16 + remat + flash attention: the TPU training recipe."""
+    if name not in _PRESETS:
+        raise KeyError(f"unknown preset {name!r}; available: {preset_names()}")
+    kw: Dict[str, Any] = dict(_PRESETS[name])
+    kw.setdefault("dtype", jnp.bfloat16)
+    kw.setdefault("remat", True)
+    kw.setdefault("use_flash_attention", True)
+    kw.update(overrides)
+    return GPTConfig(**kw)

@@ -1,0 +1,161 @@
+"""Per-chip HBM budgeting for sharded GRPO training (the 7B dress rehearsal).
+
+The reference leans on DeepSpeed's memory estimator + vLLM's
+gpu_memory_utilization knob (/root/reference/agilerl/algorithms/core/base.py:
+2081, 3101) to fit 7B training on accelerators; the TPU equivalent is a
+static budget over the GSPMD shardings in parallel/mesh.gpt_param_specs —
+every term below mirrors how that spec tree actually shards the tensors.
+
+All sizes come from jax.eval_shape over the REAL init functions (no weights
+materialised), so the budget can't drift from the model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from agilerl_tpu.llm import model as M
+
+HBM_PER_CHIP = {
+    # usable HBM per chip (GiB) by generation
+    "v4": 32, "v5e": 16, "v5p": 95, "v6e": 32,
+}
+
+GIB = 1024 ** 3
+
+
+def _tree_bytes(tree) -> int:
+    return sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def param_counts(config: M.GPTConfig, lora_rank: int = 8,
+                 lora_targets=("wq", "wv")) -> Dict[str, int]:
+    """Exact parameter counts/bytes via eval_shape on the real initialisers."""
+    base = jax.eval_shape(lambda k: M.init_params(k, config),
+                          jax.random.PRNGKey(0))
+    lora = jax.eval_shape(
+        lambda k: M.init_lora(k, config, lora_rank, lora_targets),
+        jax.random.PRNGKey(0),
+    )
+    n_base = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(base))
+    n_lora = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(lora))
+    # A/B split: they shard on DIFFERENT mesh axes (lora_specs: A on fsdp,
+    # B on tp), so the per-chip budget needs them separately
+    a_bytes = b_bytes = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(lora):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        if name == "A":
+            a_bytes += nbytes
+        elif name == "B":
+            b_bytes += nbytes
+    return {
+        "base_params": n_base,
+        "base_bytes": _tree_bytes(base),
+        "lora_params": n_lora,
+        "lora_bytes": _tree_bytes(lora),
+        "lora_a_bytes": a_bytes,
+        "lora_b_bytes": b_bytes,
+    }
+
+
+def grpo_hbm_budget(
+    config: M.GPTConfig,
+    fsdp: int,
+    tp: int,
+    batch_global: int,
+    seq_len: int,
+    lora_rank: int = 8,
+    lora_targets=("wq", "wv"),
+    gen_batch_global: Optional[int] = None,
+    gen_total_len: Optional[int] = None,
+    logit_chunk: int = 512,
+) -> Dict[str, Any]:
+    """Per-chip HBM budget (bytes) for the sharded GRPO step on an
+    (fsdp, tp) mesh, batch sharded over fsdp, per-block remat.
+
+    Terms (matching parallel/mesh.gpt_param_specs shardings):
+    - base weights: bf16, matmul weights sharded over fsdp x tp
+    - LoRA adapter: fp32 A/B (each sharded over one axis -> /fsdp) + AdamW
+      moments (2x fp32) + transient grad (1x)
+    - activation checkpoints: per-block remat stores the L block INPUTS,
+      [B_local, T, d] bf16 each (residual stream is tp-replicated)
+    - within-block recompute peak: the largest single-block working set
+      during backward (QKV + flash-attn workspace + SwiGLU gate/up, /tp)
+    - lm-head loss chunk: the fused/chunked loss never materialises
+      [B, T, V] — only [B_local, chunk, V/tp] plus its bwd double-buffer
+    - KV cache (generation phase): 2 x L x [B_local, P+N, kv_heads, hd] bf16,
+      kv heads sharded over tp (GQA floor: at least 1 head per chip)
+    """
+    counts = param_counts(config, lora_rank, lora_targets)
+    d, L, T = config.d_model, config.n_layer, seq_len
+    B_local = max(batch_global // fsdp, 1)
+    bf16 = 2
+
+    base_per_chip = counts["base_bytes"] / (fsdp * tp)
+    # param + 2 AdamW moments + transient grad = 4x; A shards over fsdp,
+    # B over tp (lora_specs), replicated leaves (none today) would be full
+    other = counts["lora_bytes"] - counts["lora_a_bytes"] - counts["lora_b_bytes"]
+    lora_state = 4 * (counts["lora_a_bytes"] / fsdp
+                      + counts["lora_b_bytes"] / tp + other)
+    # remat checkpoints: block inputs only
+    ckpt = L * B_local * T * d * bf16
+    # one block's live working set (recomputed in backward): qkv + attn out +
+    # swiglu gate/up/down intermediates, head/ff dims sharded over tp
+    qkv = B_local * T * (config.n_head + 2 * config.kv_heads) * config.head_dim * bf16 / tp
+    ffn = B_local * T * config.ff_dim * 2 * bf16 / tp  # gate + up
+    block_peak = (qkv + ffn + 2 * B_local * T * d * bf16) * 2  # x2 bwd residency
+    # chunked lm-head loss: logits chunk + bwd double buffer, vocab / tp
+    head_chunk = 2 * B_local * logit_chunk * config.vocab_size * 4 / tp
+    budget = {
+        "base_weights": base_per_chip,
+        "lora_adapter_state": lora_state,
+        "remat_checkpoints": ckpt,
+        "block_recompute_peak": block_peak,
+        "lm_head_loss_chunk": head_chunk,
+    }
+    if gen_batch_global and gen_total_len:
+        Bg = max(gen_batch_global // fsdp, 1)
+        kv_heads_local = max(config.kv_heads // tp, 1)
+        budget["kv_cache_generation"] = (
+            2 * L * Bg * gen_total_len * kv_heads_local * config.head_dim * bf16
+        )
+    budget["total"] = sum(budget.values())
+    budget["meta"] = {
+        "counts": counts, "fsdp": fsdp, "tp": tp, "batch_global": batch_global,
+        "batch_local": B_local, "seq_len": T,
+    }
+    return budget
+
+
+def render_budget_md(budget: Dict[str, Any],
+                     hbm_gib: float = HBM_PER_CHIP["v5p"]) -> str:
+    """Markdown table of a grpo_hbm_budget result against a chip's HBM."""
+    meta = budget["meta"]
+    lines = [
+        f"| term | per-chip GiB |",
+        f"|---|---|",
+    ]
+    for k, v in budget.items():
+        if k in ("total", "meta"):
+            continue
+        lines.append(f"| {k.replace('_', ' ')} | {v / GIB:.2f} |")
+    total = budget["total"] / GIB
+    lines.append(f"| **total** | **{total:.2f}** |")
+    lines.append(
+        f"| HBM per chip | {hbm_gib:.0f} "
+        f"({'fits, ' + format(hbm_gib - total, '.1f') + ' GiB headroom' if total < hbm_gib else 'OVER BUDGET'}) |"
+    )
+    header = (
+        f"mesh fsdp={meta['fsdp']} x tp={meta['tp']}, "
+        f"global batch {meta['batch_global']} (local {meta['batch_local']}), "
+        f"seq {meta['seq_len']}, "
+        f"base params {meta['counts']['base_params'] / 1e9:.2f}B"
+    )
+    return header + "\n\n" + "\n".join(lines)

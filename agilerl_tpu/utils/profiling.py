@@ -41,16 +41,21 @@ def transformer_flops_per_token(config) -> float:
     return 6.0 * n_params + 12.0 * L * config.max_seq_len * d
 
 
+#: peak bf16 FLOPs/s per chip by generation — the ONE table (hbm_budget and
+#: the 7B plan read it too)
+PEAK_BF16_FLOPS = {
+    "tpu v4": 275e12, "tpu v5": 197e12, "tpu v5 lite": 197e12,
+    "tpu v5p": 459e12, "tpu v6e": 918e12, "tpu v6 lite": 918e12,
+}
+
+
 def peak_flops_per_device(device=None) -> Optional[float]:
     """Peak bf16 FLOPs/s for the device's chip generation; None when the
     backend has no well-defined peak (CPU)."""
     device = device or jax.devices()[0]
     if device.platform != "tpu":
         return None  # CPU/GPU/unknown: no peak table -> no fabricated MFU
-    return {
-        "tpu v4": 275e12, "tpu v5": 197e12, "tpu v5 lite": 197e12,
-        "tpu v5p": 459e12, "tpu v6e": 918e12, "tpu v6 lite": 918e12,
-    }.get(device.device_kind.lower(), 197e12)
+    return PEAK_BF16_FLOPS.get(device.device_kind.lower(), 197e12)
 
 
 def estimate_mfu(

@@ -1,0 +1,307 @@
+"""7B GRPO dress rehearsal (VERDICT r3 next #2): prove the full-scale sharded
+program BUILDS before any TPU up-window, and commit the HBM/MFU plan.
+
+What it does — entirely from abstract shapes (no 7B weights materialised):
+1. builds the llama3-8b preset (the BASELINE.md 7B-class target);
+2. builds a v5p-64-topology mesh (fsdp=16 x tp=4) out of 64 virtual CPU
+   devices;
+3. AOT-lowers the PRODUCTION GRPO update (algorithms/grpo.make_update_fn —
+   the same function learn() runs) over ShapeDtypeStructs carrying the real
+   GSPMD shardings, and reports XLA's FLOPs for the step;
+4. AOT-lowers the generation program (llm/generate.generate) the same way;
+5. emits the per-chip HBM budget table + projected tokens/sec / MFU
+   scenarios into benchmarking/grpo_7b_plan.md.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=64 JAX_PLATFORMS=cpu \
+          python benchmarking/grpo_7b_plan.py [--compile] [--devices N]
+The test tier runs it via tests/test_parallel/test_7b_aot.py.
+
+Flash-attention/fused-loss Pallas kernels are OFF in this rehearsal (they
+lower only for a real TPU target; benchmarking/tpu_kernel_validation.py
+covers them on-chip) — the lowered program is the XLA-attention + chunked
+loss path, which shares every sharding decision with the flash path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _force_cpu(n_devices: int) -> None:
+    """All knobs must land BEFORE the first backend touch — JAX reads them
+    only at CPU-client creation (jax/_src/xla_bridge.py), so fixing them
+    after jax.devices() is dead code."""
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m and int(m.group(1)) < n_devices:
+        flags = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+        )
+        os.environ["XLA_FLAGS"] = flags
+    elif not m:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} virtual devices, got {len(jax.devices())} — the "
+        "backend was initialised before this guard could set the device count"
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=64,
+                    help="v5p-64 topology by default")
+    ap.add_argument("--tp", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64,
+                    help="global train batch (B*G rows)")
+    ap.add_argument("--seq", type=int, default=2048,
+                    help="train sequence length (prompt+completion)")
+    ap.add_argument("--prompt", type=int, default=1024)
+    ap.add_argument("--new-tokens", type=int, default=512)
+    ap.add_argument("--preset", default="llama3-8b")
+    ap.add_argument("--compile", action="store_true",
+                    help="also run the XLA compile (GSPMD partitioning) — "
+                         "slower but the strongest no-chip proof")
+    ap.add_argument("--write-md", default=None,
+                    help="write the plan markdown here (default: "
+                         "benchmarking/grpo_7b_plan.md when run as a script)")
+    args = ap.parse_args(argv)
+
+    _force_cpu(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from agilerl_tpu.algorithms.grpo import make_update_fn
+    from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+    from agilerl_tpu.llm import model as Mod
+    from agilerl_tpu.llm.generate import generate
+    from agilerl_tpu.llm.presets import preset
+    from agilerl_tpu.parallel.mesh import (
+        filter_spec, gpt_param_specs, lora_specs, make_mesh,
+    )
+    from agilerl_tpu.utils.hbm_budget import (
+        GIB, grpo_hbm_budget, render_budget_md,
+    )
+
+    fsdp = args.devices // args.tp
+    mesh = make_mesh(dp=1, fsdp=fsdp, tp=args.tp,
+                     devices=jax.devices()[: args.devices])
+    cfg = preset(args.preset, max_seq_len=args.seq, use_flash_attention=False)
+    B, T = args.batch, args.seq
+    lora_rank = 16
+    report = {"preset": args.preset, "mesh": f"fsdp{fsdp}xtp{args.tp}",
+              "devices": args.devices, "batch": B, "seq": T}
+
+    def abstract(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.ShapeDtypeStruct(
+                l.shape, l.dtype,
+                sharding=NamedSharding(mesh, filter_spec(s, mesh)),
+            ),
+            tree, specs, is_leaf=lambda x: isinstance(x, P),
+        )
+
+    # ---- abstract param/optimizer trees with the REAL shardings ----------
+    base_shapes = jax.eval_shape(lambda k: Mod.init_params(k, cfg),
+                                 jax.random.PRNGKey(0))
+    lora_shapes = jax.eval_shape(
+        lambda k: Mod.init_lora(k, cfg, lora_rank), jax.random.PRNGKey(0))
+    base_abs = abstract(base_shapes, gpt_param_specs(cfg))
+    lspecs = lora_specs(lora_shapes)
+    lora_abs = abstract(lora_shapes, lspecs)
+
+    opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
+    opt_shapes = jax.eval_shape(opt.tx.init, lora_shapes)
+    shape_to_spec = {}
+    jax.tree_util.tree_map(
+        lambda s, l: shape_to_spec.setdefault(l.shape, s), lspecs, lora_shapes)
+    opt_abs = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(
+            l.shape, l.dtype,
+            sharding=NamedSharding(
+                mesh, filter_spec(shape_to_spec.get(l.shape, P()), mesh)),
+        ),
+        opt_shapes,
+    )
+
+    bspec = NamedSharding(mesh, P(("dp", "fsdp")))
+    batch_abs = {
+        "tokens": jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=bspec),
+        "mask": jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=bspec),
+        "loss_mask": jax.ShapeDtypeStruct((B, T - 1), jnp.float32, sharding=bspec),
+        "old_lp": jax.ShapeDtypeStruct((B, T - 1), jnp.float32, sharding=bspec),
+        "ref_lp": jax.ShapeDtypeStruct((B, T - 1), jnp.float32, sharding=bspec),
+        "advantage": jax.ShapeDtypeStruct((B,), jnp.float32, sharding=bspec),
+    }
+    scalar = jax.ShapeDtypeStruct((), jnp.float32)
+
+    # ---- 1. lower the production train step ------------------------------
+    update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=False)
+    t0 = time.time()
+    with mesh:
+        lowered = update.lower(base_abs, lora_abs, opt_abs, batch_abs,
+                               scalar, scalar)
+    report["train_lower_seconds"] = round(time.time() - t0, 1)
+    cost = lowered.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    train_flops = float(cost.get("flops", 0.0))
+    report["train_step_pflops"] = round(train_flops / 1e15, 2)
+    hlo = lowered.as_text()
+    # Shardy emits sdy.sharding; the legacy GSPMD pipeline mhlo.sharding
+    n_shardings = hlo.count("sdy.sharding") + hlo.count("mhlo.sharding")
+    assert n_shardings > 0, "lowered module carries no sharding annotations"
+    report["train_sharding_annotations"] = n_shardings
+
+    if args.compile:
+        t0 = time.time()
+        compiled = lowered.compile()
+        report["train_compile_seconds"] = round(time.time() - t0, 1)
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            report["xla_output_bytes_per_chip_gib"] = round(
+                getattr(mem, "output_size_in_bytes", 0) / GIB, 2)
+
+    # ---- 2. lower the generation program ---------------------------------
+    gen_B = 32
+    prompt_abs = jax.ShapeDtypeStruct((gen_B, args.prompt), jnp.int32,
+                                      sharding=bspec)
+    pmask_abs = jax.ShapeDtypeStruct((gen_B, args.prompt), jnp.int32,
+                                     sharding=bspec)
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    t0 = time.time()
+    with mesh:
+        gen_lowered = generate.lower(
+            cfg, base_abs, prompt_abs, pmask_abs, key_abs,
+            max_new_tokens=args.new_tokens, lora=lora_abs,
+            temperature=0.9, eos_id=2, pad_id=0,
+        )
+    report["generate_lower_seconds"] = round(time.time() - t0, 1)
+    gcost = gen_lowered.cost_analysis()
+    if isinstance(gcost, (list, tuple)):
+        gcost = gcost[0] if gcost else {}
+    report["generate_pflops"] = round(float(gcost.get("flops", 0.0)) / 1e15, 2)
+    if args.compile:
+        t0 = time.time()
+        gen_lowered.compile()
+        report["generate_compile_seconds"] = round(time.time() - t0, 1)
+
+    # ---- 3. HBM budget + MFU projection ----------------------------------
+    budget = grpo_hbm_budget(
+        cfg, fsdp=fsdp, tp=args.tp, batch_global=B, seq_len=T,
+        lora_rank=lora_rank, gen_batch_global=gen_B,
+        gen_total_len=args.prompt + args.new_tokens,
+    )
+    report["hbm_total_gib_per_chip"] = round(budget["total"] / GIB, 2)
+    n_base = budget["meta"]["counts"]["base_params"]
+    report["base_params_b"] = round(n_base / 1e9, 2)
+
+    from agilerl_tpu.utils.profiling import PEAK_BF16_FLOPS
+
+    v5p_peak = PEAK_BF16_FLOPS["tpu v5p"]
+    tokens_per_step = B * T
+    scenarios = {}
+    for mfu in (0.25, 0.35, 0.45):
+        agg = v5p_peak * args.devices * mfu
+        step_s = train_flops / agg if train_flops else float("nan")
+        scenarios[f"mfu_{int(mfu * 100)}"] = {
+            "step_seconds": round(step_s, 3),
+            "tokens_per_sec": round(tokens_per_step / step_s) if step_s == step_s else None,
+        }
+    report["projections_v5p64"] = scenarios
+
+    md_path = args.write_md
+    if md_path is None and __name__ == "__main__":
+        md_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "grpo_7b_plan.md")
+    if md_path:
+        with open(md_path, "w") as fh:
+            fh.write(_render_md(report, budget, render_budget_md))
+        print(f"wrote {md_path}", file=sys.stderr)
+
+    print(json.dumps(report), flush=True)
+    return report
+
+
+def _render_md(report, budget, render_budget_md):
+    from agilerl_tpu.utils.hbm_budget import HBM_PER_CHIP
+
+    scen = report["projections_v5p64"]
+    lines = [
+        "# 7B GRPO plan — v5p-64 dress rehearsal",
+        "",
+        f"Model: **{report['preset']}** ({report['base_params_b']}B params), "
+        f"mesh **{report['mesh']}** ({report['devices']} chips), "
+        f"batch {report['batch']} x seq {report['seq']}.",
+        "",
+        "Generated by `benchmarking/grpo_7b_plan.py` — the production GRPO "
+        "update (`algorithms/grpo.make_update_fn`, the exact function "
+        "`learn()` runs) and the generation program were AOT-lowered from "
+        "abstract shapes carrying the real GSPMD shardings "
+        f"({report['train_sharding_annotations']} sharding annotations in "
+        "the train StableHLO). Re-run with `--compile` for the full GSPMD "
+        "partitioning proof.",
+        "",
+        "## Program cost (XLA cost analysis)",
+        "",
+        f"- train step: **{report['train_step_pflops']} PFLOPs** "
+        f"(lowered in {report['train_lower_seconds']}s)",
+        f"- generation ({report['batch']} rows): "
+        f"{report['generate_pflops']} PFLOPs "
+        f"(lowered in {report['generate_lower_seconds']}s)",
+    ]
+    if "train_compile_seconds" in report:
+        lines.append(f"- XLA compile (64-way GSPMD partitioning): "
+                     f"{report['train_compile_seconds']}s train, "
+                     f"{report.get('generate_compile_seconds', '—')}s generate")
+    lines += [
+        "",
+        f"## Per-chip HBM budget (v5p: {HBM_PER_CHIP['v5p']} GiB)",
+        "",
+        render_budget_md(budget, hbm_gib=HBM_PER_CHIP["v5p"]),
+        "",
+        "## Throughput projections (v5p-64, bf16 peak 459 TFLOP/s/chip)",
+        "",
+        "| scenario | step time | tokens/sec |",
+        "|---|---|---|",
+    ]
+    for name, s in scen.items():
+        lines.append(f"| {name.replace('_', ' ')}% | {s['step_seconds']}s "
+                     f"| {s['tokens_per_sec']:,} |")
+    lines += [
+        "",
+        "BASELINE.md target: >=35% MFU on the 7B-class GRPO workload. The "
+        "35% row is the go/no-go line for the first real up-window; the "
+        "recipe knobs (bf16, per-block remat, flash attention, fused loss, "
+        "chunked decode) are already wired and the best single-chip recipe "
+        "comes from `benchmarking/grpo_mfu_sweep.py`.",
+        "",
+        "An 8B model leaves most of a v5p-64's HBM idle: the headroom above "
+        "funds a much larger local batch (and/or longer sequences) — raise "
+        "`--batch` until remat checkpoints approach the headroom; bigger "
+        "per-chip matmuls are the main MFU lever once the kernels are on.",
+        "",
+        "Flash-attention/fused-loss Pallas kernels are excluded from the "
+        "no-chip lowering (TPU-only lowering); they share all sharding "
+        "decisions with the lowered XLA path and are validated on-chip by "
+        "`benchmarking/tpu_kernel_validation.py`.",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    main()

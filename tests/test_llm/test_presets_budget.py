@@ -1,0 +1,37 @@
+"""Model presets + HBM budget (the 7B dress-rehearsal support surface)."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.llm.presets import preset, preset_names
+from agilerl_tpu.utils.hbm_budget import GIB, grpo_hbm_budget, render_budget_md
+
+
+def test_preset_names_and_dims():
+    assert {"llama3-8b", "llama2-7b", "qwen2-7b", "gpt2-small"} <= set(preset_names())
+    cfg = preset("llama3-8b")
+    assert (cfg.d_model, cfg.n_layer, cfg.n_head, cfg.kv_heads) == (4096, 32, 32, 8)
+    assert cfg.vocab_size == 128_256 and cfg.remat
+    with pytest.raises(KeyError):
+        preset("nope-13b")
+    # overrides win
+    assert preset("llama2-7b", max_seq_len=1024).max_seq_len == 1024
+
+
+def test_param_count_matches_published_size():
+    from agilerl_tpu.utils.hbm_budget import param_counts
+
+    counts = param_counts(preset("llama3-8b"))
+    assert 7.9e9 < counts["base_params"] < 8.1e9  # Llama-3-8B ~8.03B
+
+
+def test_budget_fits_v5p_and_renders():
+    cfg = preset("llama3-8b", max_seq_len=2048)
+    b = grpo_hbm_budget(cfg, fsdp=16, tp=4, batch_global=64, seq_len=2048,
+                        gen_batch_global=32, gen_total_len=1536)
+    assert 0 < b["total"] < 95 * GIB
+    md = render_budget_md(b, hbm_gib=95.0)
+    assert "fits" in md and "base weights" in md
+    # sharding the mesh more must not increase per-chip weights
+    b2 = grpo_hbm_budget(cfg, fsdp=32, tp=4, batch_global=64, seq_len=2048)
+    assert b2["base_weights"] < b["base_weights"]

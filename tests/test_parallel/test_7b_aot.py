@@ -1,0 +1,54 @@
+"""7B AOT dress rehearsal (VERDICT r3 next #2): the full-scale llama3-8b GRPO
+train step + generation must LOWER (and, slow tier, COMPILE through 64-way
+GSPMD partitioning) from abstract shapes — proving the production program
+builds for a v5p-64 topology with zero TPU chips and zero weights
+materialised. Ref workload: /root/reference/agilerl/algorithms/core/base.py:3101
+(vLLM+DeepSpeed 7B serving/training glue, replaced by one sharded program)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SCRIPT = os.path.join(REPO, "benchmarking", "grpo_7b_plan.py")
+
+
+def _run_plan(extra_args, timeout):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, *extra_args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        timeout=timeout, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def test_7b_train_and_generate_lower_on_v5p64_topology():
+    """Lower-only: fast proof that the sharded 8B program builds."""
+    report = _run_plan([], timeout=420)
+    assert report["base_params_b"] > 7.5, "not a 7B-class model"
+    assert report["mesh"] == "fsdp16xtp4" and report["devices"] == 64
+    assert report["train_sharding_annotations"] > 100, (
+        "train StableHLO carries no real sharding annotations"
+    )
+    assert report["train_step_pflops"] > 1.0
+    assert report["generate_pflops"] > 0.05
+    # the committed plan's budget must fit the chip
+    assert report["hbm_total_gib_per_chip"] < 95.0
+
+
+@pytest.mark.slow
+def test_7b_train_step_compiles_through_gspmd():
+    """Full XLA compile: 64-way GSPMD partitioning of the production update
+    must succeed (the strongest no-chip proof; ~2 min on one core)."""
+    report = _run_plan(["--compile"], timeout=560)
+    assert report["train_compile_seconds"] > 0
+    assert report["generate_compile_seconds"] > 0

@@ -58,8 +58,10 @@ def test_gpt_param_placement_and_sharded_learn():
     with mesh:
         loss, _ = agent.learn((ids, jnp.asarray(loss_mask), jnp.asarray(rewards)))
     assert np.isfinite(loss)
-    # adapter state must still be sharded after the update
-    assert agent.actor.params["blocks"]["0"]["wq"]["A"].sharding.spec == P("fsdp", None)
+    # adapter state must still be sharded after the update (compare
+    # semantically: trailing-None spec normalisation may differ)
+    a_sh = agent.actor.params["blocks"]["0"]["wq"]["A"].sharding
+    assert a_sh.is_equivalent_to(NamedSharding(mesh, P("fsdp", None)), ndim=2)
 
 
 def test_grpo_sequence_parallel_learn_matches_dense():
