@@ -16,6 +16,7 @@ TPU-first deltas vs the reference:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -129,6 +130,7 @@ class GRPO(EvolvableAlgorithm):
         lora_targets: Tuple[str, ...] = ("wq", "wv"),
         lora_scale: float = 2.0,
         sequence_parallel_axis: Optional[str] = None,
+        bucketed_decode: bool = True,
         **kwargs,
     ):
         super().__init__(index=index, hp_config=hp_config or default_hp_config(), **kwargs)
@@ -154,6 +156,15 @@ class GRPO(EvolvableAlgorithm):
         # long-context: shard the SEQUENCE over this mesh axis (ring attention)
         # — requires to_mesh() with a mesh containing the axis before learn()
         self.sequence_parallel_axis = sequence_parallel_axis
+        # ragged generation with a bounded compile set (llm/serving.py — the
+        # vLLM continuous-batching role); kill switch for exact-RNG parity
+        # with the dense path
+        self.bucketed_decode = bool(bucketed_decode) and os.environ.get(
+            "AGILERL_TPU_DISABLE_BUCKETED_DECODE", ""
+        ).strip().lower() not in ("1", "true", "yes")
+        self._bucketed_gen = None
+        self._bucketed_gen_knobs = None
+        self.last_generation_info = None
 
         if base_params is None:
             base_params = M.init_params(self.next_key(), config)
@@ -202,6 +213,7 @@ class GRPO(EvolvableAlgorithm):
             "lora_targets": self.lora_targets,
             "lora_scale": self.lora_scale,
             "sequence_parallel_axis": self.sequence_parallel_axis,
+            "bucketed_decode": self.bucketed_decode,
         }
 
     def _on_clone(self, parent) -> None:
@@ -217,18 +229,58 @@ class GRPO(EvolvableAlgorithm):
             self._reference_epoch = epoch
 
     # ------------------------------------------------------------------ #
+    def _get_bucketed_generator(self):
+        """Lazily build (and rebuild on knob change) the bounded-compile
+        ragged generator (llm/serving.py)."""
+        from agilerl_tpu.llm.serving import BucketedGenerator
+
+        knobs = (self.max_output_tokens, self.temperature, self.top_k,
+                 self.top_p, self.min_output_tokens, self.eos_token_id,
+                 self.pad_token_id, self.lora_scale)
+        if self._bucketed_gen is None or self._bucketed_gen_knobs != knobs:
+            self._bucketed_gen = BucketedGenerator(
+                self.model_config,
+                max_new_tokens=self.max_output_tokens,
+                pad_id=self.pad_token_id, eos_id=self.eos_token_id,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, min_new_tokens=self.min_output_tokens,
+                lora_scale=self.lora_scale,
+            )
+            self._bucketed_gen_knobs = knobs
+        return self._bucketed_gen
+
     def get_action(self, prompts: Dict[str, np.ndarray], training: bool = True):
         """Generate group_size completions per prompt
         (parity: grpo.py:259; the vLLM wake/swap/gather dance collapses into one
         jitted generate call). prompts: {"input_ids": [B, P], "attention_mask"}.
-        Returns (completion_ids [B*G, N], completion_mask [B*G, N])."""
-        ids = jnp.asarray(prompts["input_ids"])
-        mask = jnp.asarray(prompts["attention_mask"])
+        Returns (completion_ids [B*G, N], completion_mask [B*G, N]).
+
+        With ``bucketed_decode`` (default), ragged prompt batches route
+        through llm/serving.BucketedGenerator: compile count is bounded by
+        the bucket grid instead of one program per (B, P), and decode stops
+        within one chunk of every row hitting EOS (the vLLM continuous-
+        batching role). Telemetry lands in ``last_generation_info``."""
+        ids_np = np.asarray(prompts["input_ids"])
+        mask_np = np.asarray(prompts["attention_mask"])
         g = self.group_size if training else 1
-        ids = jnp.repeat(ids, g, axis=0)
-        mask = jnp.repeat(mask, g, axis=0)
+        ids_np = np.repeat(ids_np, g, axis=0)
+        mask_np = np.repeat(mask_np, g, axis=0)
+        if self.bucketed_decode:
+            gen = self._get_bucketed_generator()
+            longest = int(mask_np.sum(axis=1).max()) if mask_np.size else 0
+            if gen.fits(ids_np.shape[0], longest):
+                seqs = [row[m.astype(bool)]
+                        for row, m in zip(ids_np, mask_np)]
+                comp, cmask, self.last_generation_info = gen.generate(
+                    seqs, self.next_key(), self.base_params,
+                    lora=self.actor.params, greedy=not training,
+                )
+                return comp, cmask
+            # too many rows / too long for the bucket grid: dense path
+        self.last_generation_info = None  # no stale bucketed telemetry
         comp, cmask = generate(
-            self.model_config, self.base_params, ids, mask, self.next_key(),
+            self.model_config, self.base_params, jnp.asarray(ids_np),
+            jnp.asarray(mask_np), self.next_key(),
             max_new_tokens=self.max_output_tokens, lora=self.actor.params,
             lora_scale=self.lora_scale,
             temperature=self.temperature if training else 0.0,

@@ -1,5 +1,6 @@
 from agilerl_tpu.llm import model
 from agilerl_tpu.llm.generate import generate, left_pad
+from agilerl_tpu.llm.serving import BucketedGenerator
 from agilerl_tpu.llm.model import GPTConfig, init_lora, init_params, merge_lora
 
-__all__ = ["model", "generate", "left_pad", "GPTConfig", "init_params", "init_lora", "merge_lora"]
+__all__ = ["model", "generate", "left_pad", "BucketedGenerator", "GPTConfig", "init_params", "init_lora", "merge_lora"]

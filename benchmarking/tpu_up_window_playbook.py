@@ -131,6 +131,13 @@ def main():
     if captured["mfu_sweep"] is not None:
         save("grpo_mfu_sweep.json", captured["mfu_sweep"])
     save("playbook_progress.json", captured)
+
+    # 6. bucketed vs dense ragged decode (compile amortisation + early exit)
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(HERE, "bucketed_decode_bench.py")], 900,
+        name="bucketed_decode_tpu.log")
+    captured["bucketed_decode"] = last_json(out)
+    save("playbook_progress.json", captured)
     log("playbook complete — commit .tpu_results/")
     return 0
 
