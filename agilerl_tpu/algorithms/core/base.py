@@ -13,6 +13,7 @@ traced arguments; lr lives inside the optax state).
 
 from __future__ import annotations
 
+import enum
 import pickle
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -279,10 +280,20 @@ def _net_pairs(a, b):
         yield a, b
 
 
+class MultiAgentSetup(enum.Enum):
+    """Observation-space structure of a multi-agent problem
+    (parity: base.py:1482 get_setup)."""
+
+    HOMOGENEOUS = "homogeneous"  # all agents share one observation space
+    MIXED = "mixed"  # agents group into >1 space classes
+    HETEROGENEOUS = "heterogeneous"  # every agent's space differs
+
+
 class MultiAgentRLAlgorithm(EvolvableAlgorithm):
     """Multi-agent RL base (parity: base.py:1304 — agent-id grouping by prefix
     get_group_id:1767, homogeneous-group assertion :1416, MultiAgentSetup
-    classification get_setup:1482, shared-reward helpers :1776,1838)."""
+    classification get_setup:1482, per-group net-config builder
+    build_net_config:1606, shared-reward helpers :1776,1838)."""
 
     def __init__(self, observation_spaces, action_spaces, agent_ids=None, **kwargs):
         super().__init__(**kwargs)
@@ -314,6 +325,70 @@ class MultiAgentRLAlgorithm(EvolvableAlgorithm):
                 f"Agents in group {gid!r} must share observation/action spaces"
             )
         return groups
+
+    # -- setup classification + per-group configs (parity: :1482, :1606) -- #
+    @property
+    def unique_observation_spaces(self) -> Dict[str, Any]:
+        """One representative observation space per distinct space signature,
+        keyed by the first group carrying it."""
+        seen: Dict[str, Any] = {}
+        for gid, members in self.grouped_agents.items():
+            sig = str(self.observation_spaces[members[0]])
+            if sig not in {str(v) for v in seen.values()}:
+                seen[gid] = self.observation_spaces[members[0]]
+        return seen
+
+    def get_setup(self) -> MultiAgentSetup:
+        """Classify the problem by observation-space structure
+        (parity: base.py:1482)."""
+        n_unique = len({str(s) for s in self.observation_spaces.values()})
+        if n_unique == 1:
+            return MultiAgentSetup.HOMOGENEOUS
+        if n_unique < self.n_agents:
+            return MultiAgentSetup.MIXED
+        return MultiAgentSetup.HETEROGENEOUS
+
+    def build_net_config(
+        self, net_config: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-agent net config from one user dict (parity: base.py:1606).
+
+        ``net_config`` may be keyed by agent id or group id (per-group
+        overrides for MIXED/HETEROGENEOUS setups), or be a single flat
+        config applied everywhere. In the flat case the encoder_config is
+        FILTERED per agent to the keys its space's encoder family accepts —
+        e.g. {"hidden_size": ...} reaches the vector agents' MLPs but not an
+        image group's CNN — so one config serves a mixed population."""
+        from agilerl_tpu.networks.base import filter_encoder_config
+
+        net_config = dict(net_config or {})
+        id_keys = {
+            k for k in net_config
+            if k in self.agent_ids or k in self.grouped_agents
+        }
+        # flat keys act as DEFAULTS underneath any per-agent/group override
+        # (so {"latent_dim": ..., "cam_0": {...}} keeps the defaults for the
+        # other agents instead of silently dropping them — review finding)
+        flat = {k: v for k, v in net_config.items() if k not in id_keys}
+        out: Dict[str, Dict[str, Any]] = {}
+        for aid in self.agent_ids:
+            override = net_config.get(aid)
+            if override is None:
+                override = net_config.get(self.get_group_id(aid), {})
+            cfg = {**flat, **override}
+            if cfg.get("encoder_config") and "encoder_config" not in override:
+                # flat encoder config across a mixed population: keep only
+                # the keys this agent's encoder family accepts (an explicit
+                # per-agent/group override is trusted as-is)
+                cfg["encoder_config"] = filter_encoder_config(
+                    self.observation_spaces[aid], cfg["encoder_config"],
+                    latent_dim=int(cfg.get("latent_dim", 32)),
+                    simba=bool(cfg.get("simba", False)),
+                    recurrent=bool(cfg.get("recurrent", False)),
+                    resnet=bool(cfg.get("resnet", False)),
+                )
+            out[aid] = cfg
+        return out
 
     def preprocess_observation(self, obs: Dict[str, Any]) -> Dict[str, Any]:
         return {

@@ -265,6 +265,10 @@ class GRPO(EvolvableAlgorithm):
         g = self.group_size if training else 1
         ids_np = np.repeat(ids_np, g, axis=0)
         mask_np = np.repeat(mask_np, g, axis=0)
+        if ids_np.shape[0] == 0:
+            N = self.max_output_tokens
+            self.last_generation_info = None
+            return np.zeros((0, N), np.int32), np.zeros((0, N), np.int32)
         if self.bucketed_decode:
             gen = self._get_bucketed_generator()
             longest = int(mask_np.sum(axis=1).max()) if mask_np.size else 0

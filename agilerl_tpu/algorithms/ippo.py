@@ -83,18 +83,22 @@ class IPPO(MultiAgentRLAlgorithm):
         self.num_envs = int(num_envs)
         self.net_config = dict(net_config or {})
 
-        # one actor/critic per GROUP (homogeneous agents share; parity: ippo.py)
+        # one actor/critic per GROUP (homogeneous agents share; parity:
+        # ippo.py); MIXED setups get per-group configs with the encoder
+        # family matched to each group's space (parity: base.py:1606)
+        per_agent_cfg = self.build_net_config(self.net_config)
         self.actors: Dict[str, StochasticActor] = {}
         self.critics: Dict[str, ValueNetwork] = {}
         self.rollout_buffers: Dict[str, RolloutBuffer] = {}
         for gid, members in self.grouped_agents.items():
             rep = members[0]
+            g_cfg = per_agent_cfg[rep]
             self.actors[gid] = StochasticActor(
                 self.observation_spaces[rep], self.action_spaces[rep],
-                key=self.next_key(), **self.net_config,
+                key=self.next_key(), **g_cfg,
             )
             self.critics[gid] = ValueNetwork(
-                self.observation_spaces[rep], key=self.next_key(), **self.net_config
+                self.observation_spaces[rep], key=self.next_key(), **g_cfg
             )
             # one buffer per agent-slot: stacked as extra env rows
             self.rollout_buffers[gid] = RolloutBuffer(

@@ -26,7 +26,7 @@ from agilerl_tpu.algorithms.core.registry import (
     OptimizerConfig,
     RLParameter,
 )
-from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.networks.base import EvolvableNetwork, filter_encoder_config
 from agilerl_tpu.utils.spaces import action_dim, obs_dim, preprocess_observation
 
 
@@ -112,19 +112,35 @@ class MADDPG(MultiAgentRLAlgorithm):
         total_act = sum(self.action_dims.values())
         critic_space = spaces.Box(-np.inf, np.inf, (total_obs + total_act,), np.float32)
 
+        # per-agent configs: MIXED/HETEROGENEOUS setups get the right encoder
+        # family per space, with per-agent/group overrides honoured
+        # (parity: base.py:1606 build_net_config)
+        per_agent_cfg = self.build_net_config(self.net_config)
         self.actors: Dict[str, EvolvableNetwork] = {}
         self.critics: Dict[str, EvolvableNetwork] = {}
         for aid in self.agent_ids:
-            head_cfg = dict(self.net_config.get("head_config", {}))
+            a_cfg = per_agent_cfg[aid]
+            head_cfg = dict(a_cfg.get("head_config", {}))
             if not self.discrete[aid]:
                 head_cfg["output_activation"] = "Tanh"
-            actor_kwargs = {**self.net_config, "head_config": head_cfg}
+            actor_kwargs = {**a_cfg, "head_config": head_cfg}
             self.actors[aid] = EvolvableNetwork(
                 self.observation_spaces[aid], num_outputs=self.action_dims[aid],
                 key=self.next_key(), **actor_kwargs,
             )
+            # the centralised critic always sees the flat obs+action vector —
+            # filter its encoder_config against the family the user's flags
+            # actually select for a vector space (simba/recurrent included)
+            critic_kwargs = dict(a_cfg)
+            critic_kwargs["encoder_config"] = filter_encoder_config(
+                critic_space, a_cfg.get("encoder_config"),
+                latent_dim=int(a_cfg.get("latent_dim", 32)),
+                simba=bool(a_cfg.get("simba", False)),
+                recurrent=bool(a_cfg.get("recurrent", False)),
+                resnet=bool(a_cfg.get("resnet", False)),
+            )
             self.critics[aid] = EvolvableNetwork(
-                critic_space, num_outputs=1, key=self.next_key(), **self.net_config
+                critic_space, num_outputs=1, key=self.next_key(), **critic_kwargs
             )
         self.actor_targets = {aid: self.actors[aid].clone() for aid in self.agent_ids}
         self.critic_targets = {aid: self.critics[aid].clone() for aid in self.agent_ids}

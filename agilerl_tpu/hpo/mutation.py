@@ -88,42 +88,57 @@ class Mutations:
 
     # ------------------------------------------------------------------ #
     def architecture_mutate(self, agent):
-        """Sample one mutation method on the policy net; replay the same method
-        on every other eval net so architectures stay aligned
-        (parity: mutation.py:829 single-agent; :887 multi-agent — the reference
-        searches for an 'analogous mutation' per sub-agent, here the identical
-        method+seed is replayed across every member which keeps groups exactly
-        homogeneous)."""
+        """Sample one mutation method on the policy net; apply it (or an
+        ANALOGOUS method when encoder families differ — a CNN group's
+        ``encoder.add_channel`` lands as ``encoder.add_node`` on a vector
+        group's MLP) to every evolvable eval net, TRANSACTIONALLY: any
+        failure rolls the whole agent back to its pre-mutation architecture
+        instead of leaving sibling nets diverged
+        (parity: mutation.py:829 single-agent, :887 multi-agent analogous
+        search :1163; rollback replaces the reference's warn-and-continue)."""
         policy_group = agent.registry.policy_group
         policy = getattr(agent, policy_group.eval)
         sample_net = (
             next(iter(policy.values())) if isinstance(policy, dict) else policy
         )
         method = sample_net.sample_mutation_method(self.new_layer_prob, self.rng)
+        kind = (
+            sample_net.mutation_method_kind(method)
+            if hasattr(sample_net, "mutation_method_kind") else None
+        )
         # apply with a shared numpy seed so magnitudes align across nets
         seed = int(self.rng.integers(0, 2**31 - 1))
-        for group in agent.registry.groups:
-            net = getattr(agent, group.eval)
-            for sub in (net.values() if isinstance(net, dict) else [net]):
-                if hasattr(sub, "apply_mutation") and _has_method(sub, method):
-                    try:
-                        sub.apply_mutation(method, rng=np.random.default_rng(seed))
-                    except Exception as e:
-                        # surface sibling-mutation failures instead of silently
-                        # diverging architectures (review finding)
-                        import warnings
-
-                        warnings.warn(
-                            f"mutation {method!r} failed on {group.eval} "
-                            f"({type(sub).__name__}): {e!r} — network left "
-                            f"unmutated",
-                            RuntimeWarning,
-                            stacklevel=2,
+        snapshot = _snapshot_networks(agent)
+        try:
+            for group in agent.registry.groups:
+                net = getattr(agent, group.eval)
+                for sub in (net.values() if isinstance(net, dict) else [net]):
+                    if not hasattr(sub, "apply_mutation"):
+                        continue  # non-evolvable net: nothing to align
+                    resolved = _resolve_method(sub, method, kind)
+                    if resolved is None:
+                        raise MutationError(
+                            f"no analogous mutation for {method!r} on "
+                            f"{type(sub).__name__} in {group.eval!r}"
                         )
-        self._reinit_shared(agent)
-        agent.reinit_optimizers()
-        agent.mutation_hook()
-        agent.mut = method
+                    sub.apply_mutation(resolved, rng=np.random.default_rng(seed))
+            self._reinit_shared(agent)
+            agent.reinit_optimizers()
+            agent.mutation_hook()
+            agent.mut = method
+        except Exception as e:
+            _restore_networks(agent, snapshot)
+            agent.reinit_optimizers()
+            agent.mutation_hook()
+            agent.mut = "None"
+            import warnings
+
+            warnings.warn(
+                f"architecture mutation {method!r} rolled back "
+                f"(agent unchanged): {e!r}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return agent
 
     # ------------------------------------------------------------------ #
@@ -208,10 +223,69 @@ class Mutations:
                     s.params = jax.tree_util.tree_map(jnp.copy, e.params)
 
 
-def _has_method(net, method: str) -> bool:
-    if "." in method:
-        return hasattr(net, "apply_mutation")
-    return hasattr(net, method) or hasattr(net, "apply_mutation")
+class MutationError(RuntimeError):
+    """Architecture mutation could not be applied coherently across the
+    agent's networks (parity: hpo/mutation.py MutationError)."""
+
+
+def _resolve_method(net, method: str, kind: Optional[str]) -> Optional[str]:
+    """Exact-or-analogous mutation method for `net`; nets that expose
+    resolve_mutation_method (EvolvableNetwork family) do semantic matching,
+    other evolvables fall back to exact-name support."""
+    resolver = getattr(net, "resolve_mutation_method", None)
+    if resolver is not None:
+        return resolver(method, kind)
+    # generic evolvable (e.g. GPT/BERT modules): exact match when listed,
+    # else same-direction method within the same namespace
+    methods = getattr(net, "mutation_methods", None)
+    avail = list(methods()) if callable(methods) else None
+    if avail is None:
+        return method if hasattr(net, "apply_mutation") else None
+    if method in avail:
+        return method
+    scope = method.split(".", 1)[0] if "." in method else ""
+    bottom = method.rsplit(".", 1)[-1]
+    direction = bottom.split("_", 1)[0]
+    candidates = [
+        m for m in avail
+        if (m.split(".", 1)[0] if "." in m else "") == scope
+        and m.rsplit(".", 1)[-1].split("_", 1)[0] == direction
+    ]
+    return candidates[0] if candidates else None
+
+
+def _snapshot_networks(agent):
+    """(config, params, mutation bookkeeping) refs for every eval + shared
+    net — params leaves are immutable jax arrays, so storing container copies
+    is a full logical snapshot."""
+    snap = []
+    names = set()
+    for group in agent.registry.groups:
+        names.add(group.eval)
+        names.update(group.shared_names())
+    for name in names:
+        net = getattr(agent, name)
+        for sub in (net.values() if isinstance(net, dict) else [net]):
+            if hasattr(sub, "params"):
+                snap.append((
+                    sub,
+                    getattr(sub, "config", None),
+                    jax.tree_util.tree_map(lambda x: x, sub.params),
+                    getattr(sub, "last_mutation_attr", None),
+                    getattr(sub, "last_mutation", None),
+                ))
+    return snap
+
+
+def _restore_networks(agent, snapshot) -> None:
+    for sub, config, params, lma, lm in snapshot:
+        if config is not None:
+            sub.config = config
+        sub.params = params
+        if hasattr(sub, "last_mutation_attr"):
+            sub.last_mutation_attr = lma
+        if hasattr(sub, "last_mutation"):
+            sub.last_mutation = lm
 
 
 def _gaussian_mutate(params: Any, key: jax.Array, sd: float, frac: float = 0.1) -> Any:

@@ -63,6 +63,77 @@ def _sample_token(logits, key, temperature, top_k, top_p=None):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def _suppress_eos(logits, step, eos_id, min_new_tokens):
+    """EOS logit floor for the first min_new_tokens sampled tokens
+    (parity: vllm/HF min_output_tokens)."""
+    if eos_id is None or not min_new_tokens:
+        return logits
+    return jnp.where(
+        (step < min_new_tokens)
+        & (jnp.arange(logits.shape[-1]) == eos_id)[None, :],
+        -1e9, logits,
+    )
+
+
+def prefill_head(config, params, prompt, prompt_mask, caches, key, *,
+                 lora, lora_scale, temperature, top_k, top_p, eos_id,
+                 pad_id, min_new_tokens, row_valid=None):
+    """Prompt forward + first sampled token. Returns the decode carry and
+    the first (token, emit_mask) pair. row_valid marks real rows (bucket
+    padding rows are born done); None means every row is real.
+
+    SHARED between generate() and llm/serving.BucketedGenerator so the two
+    paths cannot drift (review finding)."""
+    B = prompt.shape[0]
+    positions = jnp.maximum(jnp.cumsum(prompt_mask, axis=-1) - 1, 0)
+    hidden, caches = M.forward(
+        config, params, prompt, attention_mask=prompt_mask,
+        positions=positions, cache=caches, lora=lora, lora_scale=lora_scale,
+    )
+    last_logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
+    pos = prompt_mask.sum(axis=-1)
+    key, k0 = jax.random.split(key)
+    tok0 = _sample_token(
+        _suppress_eos(last_logits, 0, eos_id, min_new_tokens), k0,
+        temperature, top_k, top_p,
+    )
+    if row_valid is None:
+        row_valid = jnp.ones((B,), bool)
+    tok0 = jnp.where(row_valid, tok0, pad_id)
+    done0 = ~row_valid
+    if eos_id is not None:
+        done0 = done0 | (tok0 == eos_id)
+    return (caches, tok0, row_valid, pos, done0, key), (tok0, row_valid)
+
+
+def decode_step(config, params, carry, i, *, lora, lora_scale, temperature,
+                top_k, top_p, eos_id, pad_id, min_new_tokens):
+    """One decode step: advance with the previous token, sample the next.
+    `i` is the ABSOLUTE sampled-token index (drives min_new_tokens).
+
+    SHARED between generate()'s scan and the bucketed decode chunks."""
+    caches, prev_tok, prev_valid, pos, done, key = carry
+    hidden, caches = M.forward(
+        config, params, prev_tok[:, None],
+        attention_mask=prev_valid.astype(jnp.int32)[:, None],
+        positions=pos[:, None], cache=caches, lora=lora,
+        lora_scale=lora_scale,
+    )
+    logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
+    pos = pos + prev_valid.astype(pos.dtype)
+    key, k_s = jax.random.split(key)
+    tok = _sample_token(
+        _suppress_eos(logits, i, eos_id, min_new_tokens), k_s,
+        temperature, top_k, top_p,
+    )
+    if eos_id is not None:
+        tok = jnp.where(done, pad_id, tok)
+    emit = jnp.logical_not(done)
+    if eos_id is not None:
+        done = jnp.logical_or(done, tok == eos_id)
+    return (caches, tok, emit, pos, done, key), (tok, emit)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "temperature", "top_k",
@@ -92,55 +163,23 @@ def generate(
     the first N sampled tokens so completions have a length floor."""
     B, P = prompt.shape
     caches = M.init_caches(config, B, P + max_new_tokens)
-    positions = jnp.maximum(jnp.cumsum(prompt_mask, axis=-1) - 1, 0)
-    hidden, caches = M.forward(
-        config, params, prompt, attention_mask=prompt_mask, positions=positions,
-        cache=caches, lora=lora, lora_scale=lora_scale,
+    knobs = dict(
+        lora=lora, lora_scale=lora_scale, temperature=temperature,
+        top_k=top_k, top_p=top_p, eos_id=eos_id, pad_id=pad_id,
+        min_new_tokens=min_new_tokens,
     )
-    last_logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]  # [B, V]
-    pos = prompt_mask.sum(axis=-1)  # next position per row
-
     # first token comes straight from the prefill logits; each scan step then
     # advances the model with the PREVIOUS token and samples the next — exactly
     # max_new_tokens - 1 decode forwards, none wasted on logits never sampled
-    def suppress_eos(logits, step):
-        if eos_id is None or not min_new_tokens:
-            return logits
-        return jnp.where(
-            (step < min_new_tokens)
-            & (jnp.arange(logits.shape[-1]) == eos_id)[None, :],
-            -1e9, logits,
-        )
-
-    key, k0 = jax.random.split(key)
-    tok0 = _sample_token(suppress_eos(last_logits, 0), k0, temperature,
-                         top_k, top_p)
-    mask0 = jnp.ones((B,), bool)
-    done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((B,), bool)
+    carry, (tok0, mask0) = prefill_head(
+        config, params, prompt, prompt_mask, caches, key, **knobs
+    )
 
     def step(carry, i):
-        caches, prev_tok, prev_valid, pos, done, key = carry
-        hidden, caches = M.forward(
-            config, params, prev_tok[:, None],
-            attention_mask=prev_valid.astype(jnp.int32)[:, None],
-            positions=pos[:, None], cache=caches, lora=lora,
-            lora_scale=lora_scale,
-        )
-        logits = M.logits_fn(config, params, hidden[:, -1:, :])[:, 0, :]
-        pos = pos + prev_valid.astype(pos.dtype)
-        key, k_s = jax.random.split(key)
-        tok = _sample_token(suppress_eos(logits, i), k_s, temperature,
-                            top_k, top_p)
-        if eos_id is not None:
-            tok = jnp.where(done, pad_id, tok)
-        emit_mask = jnp.logical_not(done)
-        if eos_id is not None:
-            done = jnp.logical_or(done, tok == eos_id)
-        return (caches, tok, emit_mask, pos, done, key), (tok, emit_mask)
+        return decode_step(config, params, carry, i, **knobs)
 
-    (_, _, _, _, _, _), (tokens, masks) = jax.lax.scan(
-        step, (caches, tok0, mask0, pos, done0, key),
-        jnp.arange(1, max_new_tokens),
+    _, (tokens, masks) = jax.lax.scan(
+        step, carry, jnp.arange(1, max_new_tokens)
     )
     tokens = jnp.concatenate([tok0[None], tokens], axis=0)
     masks = jnp.concatenate([mask0[None], masks], axis=0)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from agilerl_tpu.llm import model as M
-from agilerl_tpu.llm.generate import _sample_token, left_pad
+from agilerl_tpu.llm.generate import decode_step, left_pad, prefill_head
 
 
 def _round_up(n: int, buckets: Sequence[int]) -> int:
@@ -68,7 +68,9 @@ class BucketedGenerator:
         self.eos_id = eos_id
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.row_buckets = tuple(sorted(row_buckets))
-        self.decode_chunk = int(decode_chunk)
+        # a chunk larger than the whole budget would waste decode forwards
+        # past max_new_tokens (review finding)
+        self.decode_chunk = min(int(decode_chunk), int(max_new_tokens))
         # cache length is static per prompt bucket: bucket + whole chunks
         self.n_chunks = -(-int(max_new_tokens) // self.decode_chunk)
         self.max_new_tokens = int(max_new_tokens)
@@ -85,69 +87,32 @@ class BucketedGenerator:
         # attributes): one prefill + one decode program per signature
         self._compiled_signatures = set()
 
-    # -- compiled pieces ---------------------------------------------------
-    def _sample(self, logits, key, greedy):
-        return _sample_token(
-            logits, key, 0.0 if greedy else self.temperature,
-            self.top_k, self.top_p,
-        )
-
-    def _suppress_eos(self, logits, step):
-        if self.eos_id is None or not self.min_new_tokens:
-            return logits
-        return jnp.where(
-            (step < self.min_new_tokens)
-            & (jnp.arange(logits.shape[-1]) == self.eos_id)[None, :],
-            -1e9, logits,
+    # -- compiled pieces (the SHARED generate.py prefill/decode maths — the
+    # two paths cannot drift, review finding) -----------------------------
+    def _knobs(self, greedy: bool, lora) -> Dict[str, Any]:
+        return dict(
+            lora=lora, lora_scale=self.lora_scale,
+            temperature=0.0 if greedy else self.temperature,
+            top_k=self.top_k, top_p=self.top_p, eos_id=self.eos_id,
+            pad_id=self.pad_id, min_new_tokens=self.min_new_tokens,
         )
 
     def _prefill_impl(self, params, lora, prompt, prompt_mask, row_valid,
                       key, greedy=False):
-        """Prompt forward + first sampled token (same maths as
-        generate.generate's head, llm/generate.py:93-119)."""
         B, P = prompt.shape
         caches = M.init_caches(
             self.config, B, P + self.n_chunks * self.decode_chunk)
-        positions = jnp.maximum(jnp.cumsum(prompt_mask, axis=-1) - 1, 0)
-        hidden, caches = M.forward(
-            self.config, params, prompt, attention_mask=prompt_mask,
-            positions=positions, cache=caches, lora=lora,
-            lora_scale=self.lora_scale,
+        return prefill_head(
+            self.config, params, prompt, prompt_mask, caches, key,
+            row_valid=row_valid, **self._knobs(greedy, lora),
         )
-        last_logits = M.logits_fn(self.config, params, hidden[:, -1:, :])[:, 0, :]
-        pos = prompt_mask.sum(axis=-1)
-        key, k0 = jax.random.split(key)
-        tok0 = self._sample(self._suppress_eos(last_logits, 0), k0, greedy)
-        # padding rows are born done so they never delay the early exit
-        done0 = ~row_valid
-        if self.eos_id is not None:
-            tok0 = jnp.where(row_valid, tok0, self.pad_id)
-            done0 = done0 | (tok0 == self.eos_id)
-        emit0 = row_valid
-        return (caches, tok0, emit0, pos, done0, key), (tok0, emit0)
 
     def _decode_impl(self, params, lora, carry, start_step, greedy=False):
-        """One fixed-size decode chunk (scan of generate.generate's step,
-        llm/generate.py:121-139), restartable via the carry."""
+        """One fixed-size decode chunk, restartable via the carry."""
+        knobs = self._knobs(greedy, lora)
 
         def step(carry, i):
-            caches, prev_tok, prev_valid, pos, done, key = carry
-            hidden, caches = M.forward(
-                self.config, params, prev_tok[:, None],
-                attention_mask=prev_valid.astype(jnp.int32)[:, None],
-                positions=pos[:, None], cache=caches, lora=lora,
-                lora_scale=self.lora_scale,
-            )
-            logits = M.logits_fn(self.config, params, hidden[:, -1:, :])[:, 0, :]
-            pos = pos + prev_valid.astype(pos.dtype)
-            key, k_s = jax.random.split(key)
-            tok = self._sample(self._suppress_eos(logits, i), k_s, greedy)
-            if self.eos_id is not None:
-                tok = jnp.where(done, self.pad_id, tok)
-            emit = jnp.logical_not(done)
-            if self.eos_id is not None:
-                done = jnp.logical_or(done, tok == self.eos_id)
-            return (caches, tok, emit, pos, done, key), (tok, emit)
+            return decode_step(self.config, params, carry, i, **knobs)
 
         carry, (toks, emits) = jax.lax.scan(
             step, carry, start_step + jnp.arange(self.decode_chunk))
@@ -215,8 +180,8 @@ class BucketedGenerator:
     def fits(self, n_rows: int, longest_prompt: int) -> bool:
         """Whether a batch can be served inside the bucket grid (callers
         fall back to dense generation otherwise)."""
-        return (n_rows <= self.row_buckets[-1]
-                and longest_prompt <= self.prompt_buckets[-1])
+        return (0 < n_rows <= self.row_buckets[-1]
+                and 0 < longest_prompt <= self.prompt_buckets[-1])
 
     @property
     def compiled_programs(self) -> int:

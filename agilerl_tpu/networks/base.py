@@ -98,6 +98,27 @@ def default_encoder_config(
     return "mlp", MLPConfig(num_inputs=dim, num_outputs=latent_dim, **encoder_config)
 
 
+def filter_encoder_config(
+    observation_space: Any,
+    encoder_config: Optional[dict],
+    latent_dim: int = 32,
+    simba: bool = False,
+    recurrent: bool = False,
+    resnet: bool = False,
+) -> dict:
+    """Keep only the encoder_config keys the space's encoder family accepts
+    (one flat user config can then serve a MIXED population: hidden_size
+    reaches the MLP groups, channel_size the CNN groups, ...)."""
+    encoder_config = dict(encoder_config or {})
+    if not encoder_config:
+        return encoder_config
+    _, probe = default_encoder_config(
+        observation_space, latent_dim, simba, recurrent, resnet
+    )
+    valid = {f.name for f in dataclasses.fields(type(probe))}
+    return {k: v for k, v in encoder_config.items() if k in valid}
+
+
 @dataclasses.dataclass(frozen=True)
 class NetworkConfig:
     encoder_kind: str
@@ -182,6 +203,56 @@ class EvolvableNetwork:
         names += [f"encoder.{n}" for n in enc_cls.get_mutation_methods()]
         names += [f"head.{n}" for n in EvolvableMLP.get_mutation_methods()]
         return names
+
+    def mutation_method_kind(self, name: str) -> Optional[str]:
+        """"layer" | "node" classification of a namespaced mutation method
+        (drives analogous-mutation search across differing encoder families,
+        parity: hpo/mutation.py:1163 _find_analogous_mutation)."""
+        if name in ("add_latent_node", "remove_latent_node"):
+            return "node"
+        if "." not in name:
+            return None
+        scope, bottom = name.split(".", 1)
+        cls = (
+            ENCODER_TYPES[self.config.encoder_kind]
+            if scope == "encoder" else EvolvableMLP
+        )
+        if bottom in cls.layer_mutation_methods():
+            return "layer"
+        if bottom in cls.node_mutation_methods():
+            return "node"
+        return None
+
+    def resolve_mutation_method(
+        self, name: str, kind: Optional[str] = None
+    ) -> Optional[str]:
+        """Exact method if this net supports it, else an ANALOGOUS one: same
+        scope (encoder/head), same kind (layer/node), same direction
+        (add/remove/...) — so a CNN policy's ``encoder.add_channel`` lands as
+        ``encoder.add_node`` on a sibling MLP group instead of failing
+        (parity: hpo/mutation.py:1163; ref matches by bottom-level name, here
+        by semantic class since encoder families differ by design)."""
+        methods = self.mutation_methods()
+        if name in methods:
+            return name
+        if "." not in name:
+            return None
+        scope, bottom = name.split(".", 1)
+        cls = (
+            ENCODER_TYPES[self.config.encoder_kind]
+            if scope == "encoder" else EvolvableMLP
+        )
+        if kind == "layer":
+            pool = cls.layer_mutation_methods()
+        elif kind == "node":
+            pool = cls.node_mutation_methods()
+        else:
+            pool = list(cls.get_mutation_methods())
+        if not pool:
+            return None
+        direction = bottom.split("_", 1)[0]
+        same_dir = [m for m in pool if m.split("_", 1)[0] == direction]
+        return f"{scope}.{(same_dir or pool)[0]}"
 
     def sample_mutation_method(
         self, new_layer_prob: float = 0.2, rng: Optional[np.random.Generator] = None
