@@ -359,33 +359,61 @@ class MultiAgentRLAlgorithm(EvolvableAlgorithm):
         FILTERED per agent to the keys its space's encoder family accepts —
         e.g. {"hidden_size": ...} reaches the vector agents' MLPs but not an
         image group's CNN — so one config serves a mixed population."""
-        from agilerl_tpu.networks.base import filter_encoder_config
+        out: Dict[str, Dict[str, Any]] = {}
+        for aid in self.agent_ids:
+            cfg, override = self._merged_net_config(net_config, aid)
+            if cfg.get("encoder_config") and "encoder_config" not in override:
+                # flat encoder config across a mixed population: keep only
+                # the keys this agent's encoder family accepts (an explicit
+                # per-agent/group override is trusted as-is)
+                cfg["encoder_config"] = self._filter_for_space(
+                    cfg, self.observation_spaces[aid]
+                )
+            out[aid] = cfg
+        return out
 
+    def _merged_net_config(self, net_config, aid):
+        """(flat-defaults ∪ per-agent/group override, the override) for one
+        agent — flat keys survive underneath keyed overrides (review
+        finding: keyed mode must not discard defaults)."""
         net_config = dict(net_config or {})
         id_keys = {
             k for k in net_config
             if k in self.agent_ids or k in self.grouped_agents
         }
-        # flat keys act as DEFAULTS underneath any per-agent/group override
-        # (so {"latent_dim": ..., "cam_0": {...}} keeps the defaults for the
-        # other agents instead of silently dropping them — review finding)
         flat = {k: v for k, v in net_config.items() if k not in id_keys}
+        override = net_config.get(aid)
+        if override is None:
+            override = net_config.get(self.get_group_id(aid), {})
+        return {**flat, **override}, override
+
+    @staticmethod
+    def _filter_for_space(cfg: Dict[str, Any], space) -> Dict[str, Any]:
+        from agilerl_tpu.networks.base import filter_encoder_config
+
+        return filter_encoder_config(
+            space, cfg.get("encoder_config"),
+            latent_dim=int(cfg.get("latent_dim", 32)),
+            simba=bool(cfg.get("simba", False)),
+            recurrent=bool(cfg.get("recurrent", False)),
+            resnet=bool(cfg.get("resnet", False)),
+        )
+
+    def build_critic_config(
+        self, critic_space, net_config: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-agent config for a CENTRALISED critic observing
+        ``critic_space`` (the flat joint obs+action vector in MADDPG/MATD3).
+        Filters the user's ORIGINAL encoder_config — not the per-agent
+        filtered one, which would have already dropped the vector-family
+        keys for image agents (review finding) — against the critic space's
+        encoder family."""
         out: Dict[str, Dict[str, Any]] = {}
         for aid in self.agent_ids:
-            override = net_config.get(aid)
-            if override is None:
-                override = net_config.get(self.get_group_id(aid), {})
-            cfg = {**flat, **override}
-            if cfg.get("encoder_config") and "encoder_config" not in override:
-                # flat encoder config across a mixed population: keep only
-                # the keys this agent's encoder family accepts (an explicit
-                # per-agent/group override is trusted as-is)
-                cfg["encoder_config"] = filter_encoder_config(
-                    self.observation_spaces[aid], cfg["encoder_config"],
-                    latent_dim=int(cfg.get("latent_dim", 32)),
-                    simba=bool(cfg.get("simba", False)),
-                    recurrent=bool(cfg.get("recurrent", False)),
-                    resnet=bool(cfg.get("resnet", False)),
+            cfg, _ = self._merged_net_config(net_config, aid)
+            if cfg.get("encoder_config"):
+                cfg["encoder_config"] = self._filter_for_space(
+                    cfg, critic_space
                 )
             out[aid] = cfg
         return out

@@ -26,7 +26,7 @@ from agilerl_tpu.algorithms.core.registry import (
     OptimizerConfig,
     RLParameter,
 )
-from agilerl_tpu.networks.base import EvolvableNetwork, filter_encoder_config
+from agilerl_tpu.networks.base import EvolvableNetwork
 from agilerl_tpu.utils.spaces import action_dim, obs_dim, preprocess_observation
 
 
@@ -116,6 +116,9 @@ class MADDPG(MultiAgentRLAlgorithm):
         # family per space, with per-agent/group overrides honoured
         # (parity: base.py:1606 build_net_config)
         per_agent_cfg = self.build_net_config(self.net_config)
+        # centralised critics see the flat joint vector: their configs come
+        # from the ORIGINAL user encoder_config filtered against that space
+        per_critic_cfg = self.build_critic_config(critic_space, self.net_config)
         self.actors: Dict[str, EvolvableNetwork] = {}
         self.critics: Dict[str, EvolvableNetwork] = {}
         for aid in self.agent_ids:
@@ -128,19 +131,9 @@ class MADDPG(MultiAgentRLAlgorithm):
                 self.observation_spaces[aid], num_outputs=self.action_dims[aid],
                 key=self.next_key(), **actor_kwargs,
             )
-            # the centralised critic always sees the flat obs+action vector —
-            # filter its encoder_config against the family the user's flags
-            # actually select for a vector space (simba/recurrent included)
-            critic_kwargs = dict(a_cfg)
-            critic_kwargs["encoder_config"] = filter_encoder_config(
-                critic_space, a_cfg.get("encoder_config"),
-                latent_dim=int(a_cfg.get("latent_dim", 32)),
-                simba=bool(a_cfg.get("simba", False)),
-                recurrent=bool(a_cfg.get("recurrent", False)),
-                resnet=bool(a_cfg.get("resnet", False)),
-            )
             self.critics[aid] = EvolvableNetwork(
-                critic_space, num_outputs=1, key=self.next_key(), **critic_kwargs
+                critic_space, num_outputs=1, key=self.next_key(),
+                **per_critic_cfg[aid],
             )
         self.actor_targets = {aid: self.actors[aid].clone() for aid in self.agent_ids}
         self.critic_targets = {aid: self.critics[aid].clone() for aid in self.agent_ids}

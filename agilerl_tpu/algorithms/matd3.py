@@ -16,7 +16,7 @@ from gymnasium import spaces
 from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
 from agilerl_tpu.algorithms.core.registry import NetworkGroup, OptimizerConfig
 from agilerl_tpu.algorithms.maddpg import MADDPG
-from agilerl_tpu.networks.base import EvolvableNetwork, filter_encoder_config
+from agilerl_tpu.networks.base import EvolvableNetwork
 from agilerl_tpu.utils.spaces import obs_dim, preprocess_observation
 
 
@@ -32,21 +32,14 @@ class MATD3(MADDPG):
         total_obs = sum(obs_dim(self.observation_spaces[a]) for a in self.agent_ids)
         total_act = sum(self.action_dims.values())
         critic_space = spaces.Box(-np.inf, np.inf, (total_obs + total_act,), np.float32)
-        per_agent_cfg = self.build_net_config(self.net_config)
-        self.critic_2s = {}
-        for aid in self.agent_ids:
-            a_cfg = per_agent_cfg[aid]
-            c_kwargs = dict(a_cfg)
-            c_kwargs["encoder_config"] = filter_encoder_config(
-                critic_space, a_cfg.get("encoder_config"),
-                latent_dim=int(a_cfg.get("latent_dim", 32)),
-                simba=bool(a_cfg.get("simba", False)),
-                recurrent=bool(a_cfg.get("recurrent", False)),
-                resnet=bool(a_cfg.get("resnet", False)),
+        per_critic_cfg = self.build_critic_config(critic_space, self.net_config)
+        self.critic_2s = {
+            aid: EvolvableNetwork(
+                critic_space, num_outputs=1, key=self.next_key(),
+                **per_critic_cfg[aid],
             )
-            self.critic_2s[aid] = EvolvableNetwork(
-                critic_space, num_outputs=1, key=self.next_key(), **c_kwargs
-            )
+            for aid in self.agent_ids
+        }
         self.critic_2_targets = {a: self.critic_2s[a].clone() for a in self.agent_ids}
         self.critic_2_optimizers = OptimizerWrapper(optimizer="adam", lr=self.lr_critic)
         self.register_network_group(

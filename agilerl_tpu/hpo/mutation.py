@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from agilerl_tpu.networks.base import EvolvableNetwork
 
 
 class Mutations:
@@ -117,10 +116,11 @@ class Mutations:
                         continue  # non-evolvable net: nothing to align
                     resolved = _resolve_method(sub, method, kind)
                     if resolved is None:
-                        raise MutationError(
-                            f"no analogous mutation for {method!r} on "
-                            f"{type(sub).__name__} in {group.eval!r}"
-                        )
+                        # no analogous structural change exists on this net
+                        # (e.g. CNN-only change_kernel vs an MLP sibling):
+                        # a deliberate no-op, NOT a failure — the method
+                        # doesn't alter the sibling's interface
+                        continue
                     sub.apply_mutation(resolved, rng=np.random.default_rng(seed))
             self._reinit_shared(agent)
             agent.reinit_optimizers()

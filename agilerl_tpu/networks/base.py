@@ -248,11 +248,13 @@ class EvolvableNetwork:
             pool = cls.node_mutation_methods()
         else:
             pool = list(cls.get_mutation_methods())
-        if not pool:
-            return None
         direction = bottom.split("_", 1)[0]
         same_dir = [m for m in pool if m.split("_", 1)[0] == direction]
-        return f"{scope}.{(same_dir or pool)[0]}"
+        # no same-direction analog (e.g. a CNN-only change_kernel against an
+        # MLP): None = "no analogous structural change" — callers leave the
+        # net untouched rather than substitute a differently-directed
+        # mutation that would skew the search (review finding)
+        return f"{scope}.{same_dir[0]}" if same_dir else None
 
     def sample_mutation_method(
         self, new_layer_prob: float = 0.2, rng: Optional[np.random.Generator] = None

@@ -39,16 +39,77 @@ def save_h5_dataset(path: Union[str, Path], dataset: Dict[str, np.ndarray]) -> N
             f.create_dataset(k, data=np.asarray(v))
 
 
-def minari_to_agile_dataset(dataset_id: str, **kwargs) -> Dict[str, np.ndarray]:
-    """Convert a Minari dataset (parity: minari_utils.py:74). Gated: raises a
-    clear error when minari isn't installed."""
+def read_minari_h5(path: Union[str, Path]) -> Dict[str, np.ndarray]:
+    """Vendored reader for the Minari on-disk HDF5 layout — one
+    ``episode_<i>`` group per episode carrying observations/actions/rewards/
+    terminations(/truncations) arrays, observations one row longer than the
+    rest. Runs without the minari package, so the ingestion path is testable
+    against a committed fixture (parity: minari_utils.py:74 — the reference
+    delegates to minari.load_dataset, which reads exactly this layout)."""
+    import h5py
+
+    obs, act, rew, next_obs, term = [], [], [], [], []
+    with h5py.File(path, "r") as f:
+        names = sorted(
+            (k for k in f.keys() if k.startswith("episode_")),
+            key=lambda s: int(s.rsplit("_", 1)[1]),
+        )
+        if not names:
+            raise ValueError(f"{path}: no episode_<i> groups — not a minari file")
+        for name in names:
+            g = f[name]
+            o = np.asarray(g["observations"])
+            obs.append(o[:-1])
+            next_obs.append(o[1:])
+            act.append(np.asarray(g["actions"]))
+            rew.append(np.asarray(g["rewards"]))
+            term.append(np.asarray(g["terminations"]))
+    return {
+        "observations": np.concatenate(obs),
+        "actions": np.concatenate(act),
+        "rewards": np.concatenate(rew).astype(np.float32),
+        "next_observations": np.concatenate(next_obs),
+        "terminals": np.concatenate(term).astype(np.float32),
+    }
+
+
+def _resolve_minari_path(dataset_id: str, data_dir=None) -> Optional[Path]:
+    """Locate a dataset's main_data.hdf5: a direct file path, or the
+    standard ~/.minari/datasets/<id>/data/main_data.hdf5 tree."""
+    import os
+
+    direct = Path(dataset_id)
+    if direct.is_file():
+        return direct
+    root = Path(
+        data_dir
+        or os.environ.get("MINARI_DATASETS_PATH",
+                          Path.home() / ".minari" / "datasets")
+    )
+    candidate = root / dataset_id / "data" / "main_data.hdf5"
+    return candidate if candidate.is_file() else None
+
+
+def minari_to_agile_dataset(
+    dataset_id: str, data_dir=None, **kwargs
+) -> Dict[str, np.ndarray]:
+    """Convert a Minari dataset (parity: minari_utils.py:111). An on-disk
+    dataset (a direct path to main_data.hdf5, or the standard tree under
+    data_dir/MINARI_DATASETS_PATH) is read by the vendored reader whether or
+    not the minari package is installed; a bare dataset id with no local
+    file goes through minari.load_dataset."""
+    path = _resolve_minari_path(dataset_id, data_dir)
+    if path is not None:
+        return read_minari_h5(path)
     try:
         import minari  # type: ignore
-    except ImportError as e:  # pragma: no cover
-        raise ImportError(
-            "minari is not installed in this environment; load offline data "
-            "with load_h5_dataset or generate it with collect_offline_dataset"
-        ) from e
+    except ImportError:
+        raise FileNotFoundError(
+            f"minari is not installed and no on-disk dataset found for "
+            f"{dataset_id!r}; pass a path to a main_data.hdf5, set "
+            "MINARI_DATASETS_PATH, load h5 data with load_h5_dataset, "
+            "or generate data with collect_offline_dataset"
+        )
     ds = minari.load_dataset(dataset_id)
     obs, act, rew, next_obs, term = [], [], [], [], []
     for ep in ds.iterate_episodes():
@@ -64,6 +125,25 @@ def minari_to_agile_dataset(dataset_id: str, **kwargs) -> Dict[str, np.ndarray]:
         "next_observations": np.concatenate(next_obs),
         "terminals": np.concatenate(term).astype(np.float32),
     }
+
+
+def minari_to_agile_buffer(
+    dataset_id: str, memory, data_dir=None
+) -> Any:
+    """Fill a replay buffer from a Minari dataset
+    (parity: minari_utils.py:74 minari_to_agile_buffer)."""
+    ds = minari_to_agile_dataset(dataset_id, data_dir=data_dir)
+    memory.add(
+        {
+            "obs": ds["observations"],
+            "action": ds["actions"],
+            "reward": ds["rewards"],
+            "next_obs": ds["next_observations"],
+            "done": ds["terminals"],
+        },
+        batched=True,
+    )
+    return memory
 
 
 def collect_offline_dataset(
