@@ -42,6 +42,7 @@ def finetune_llm_reasoning(
     accelerator=None,
     checkpoint_interval: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
+    overwrite_checkpoints: bool = True,
     max_steps: int = 200,
     evo_steps: Optional[int] = None,
     tournament=None,
@@ -98,7 +99,7 @@ def finetune_llm_reasoning(
                 break
         if checkpoint_interval is not None and checkpoint_path is not None:
             if step % checkpoint_interval == 0:
-                save_population_checkpoint(pop, checkpoint_path)
+                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
 
     return pop, pop_fitnesses
 
@@ -107,12 +108,14 @@ def finetune_llm_preference(
     pop: List,
     env,
     INIT_HP: Optional[Dict] = None,
+    max_reward: Optional[float] = None,
     wb: bool = False,
     evaluation_interval: int = 10,
     verbose: bool = True,
     accelerator=None,
     checkpoint_interval: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
+    overwrite_checkpoints: bool = True,
     max_steps: int = 200,
     tournament=None,
     mutation=None,
@@ -152,8 +155,10 @@ def finetune_llm_preference(
                     pop, tournament, mutation, language_model=True,
                     elite_path=elite_path, save_elite=save_elite,
                 )
+            if max_reward is not None and np.max(fitnesses) >= max_reward:
+                break
         if checkpoint_interval is not None and checkpoint_path is not None:
             if step % checkpoint_interval == 0:
-                save_population_checkpoint(pop, checkpoint_path)
+                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
 
     return pop, pop_fitnesses
