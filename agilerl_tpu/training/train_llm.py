@@ -42,7 +42,7 @@ def finetune_llm_reasoning(
     accelerator=None,
     checkpoint_interval: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
-    overwrite_checkpoints: bool = True,
+    overwrite_checkpoints: bool = False,
     max_steps: int = 200,
     evo_steps: Optional[int] = None,
     tournament=None,
@@ -95,11 +95,16 @@ def finetune_llm_reasoning(
                     pop, tournament, mutation, language_model=True,
                     elite_path=elite_path, save_elite=save_elite,
                 )
-            if max_reward is not None and np.max(fitnesses) >= max_reward:
-                break
+            # stop AFTER the checkpoint block below so the state that
+            # reached the target is the state on disk (review finding)
+            stop = max_reward is not None and np.max(fitnesses) >= max_reward
+        else:
+            stop = False
         if checkpoint_interval is not None and checkpoint_path is not None:
-            if step % checkpoint_interval == 0:
+            if stop or step % checkpoint_interval == 0:
                 save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+        if stop:
+            break
 
     return pop, pop_fitnesses
 
@@ -115,7 +120,7 @@ def finetune_llm_preference(
     accelerator=None,
     checkpoint_interval: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
-    overwrite_checkpoints: bool = True,
+    overwrite_checkpoints: bool = False,
     max_steps: int = 200,
     tournament=None,
     mutation=None,
@@ -155,10 +160,13 @@ def finetune_llm_preference(
                     pop, tournament, mutation, language_model=True,
                     elite_path=elite_path, save_elite=save_elite,
                 )
-            if max_reward is not None and np.max(fitnesses) >= max_reward:
-                break
+            stop = max_reward is not None and np.max(fitnesses) >= max_reward
+        else:
+            stop = False
         if checkpoint_interval is not None and checkpoint_path is not None:
-            if step % checkpoint_interval == 0:
+            if stop or step % checkpoint_interval == 0:
                 save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+        if stop:
+            break
 
     return pop, pop_fitnesses

@@ -287,6 +287,7 @@ def test_resume_llm_reasoning_roundtrip(tmp_path):
     trained, _ = finetune_llm_reasoning(
         make(), env, max_steps=2, evaluation_interval=2, verbose=False,
         checkpoint_interval=2, checkpoint_path=ckpt,
+        overwrite_checkpoints=True,
     )
     fresh = make()
     resumed, _ = finetune_llm_reasoning(
@@ -315,6 +316,7 @@ def test_resume_llm_preference_roundtrip(tmp_path):
     trained, _ = finetune_llm_preference(
         make(), env, max_steps=2, evaluation_interval=2, verbose=False,
         checkpoint_interval=2, checkpoint_path=ckpt,
+        overwrite_checkpoints=True,
     )
     fresh = make()
     before = [np.asarray(x) for x in jax.tree_util.tree_leaves(fresh[0].lora_params)] \
