@@ -39,3 +39,17 @@ def key():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _xla_cache_hygiene():
+    """Drop jit caches (and their live XLA CPU executables) after every test
+    module. The monolithic single-process run historically segfaulted inside
+    XLA's backend_compile_and_load after several hundred accumulated
+    compilations (see NOTES_ROUND4.md: not OOM, not fd/map/thread
+    exhaustion, axon plugin exonerated — compiling even a trivial program
+    crashes once enough varied executables are live). Bounding the live
+    executable set per module keeps the monolith viable; the sharded
+    run_tests.sh remains the canonical gate."""
+    yield
+    jax.clear_caches()
