@@ -1,11 +1,15 @@
 #!/bin/bash
 # Canonical test invocation for this repo (VERDICT r2 weak #2 / next #4).
 #
-# A single-process run of all ~550 tests segfaults at ~75% inside XLA's
-# backend_compile_and_load after several hundred accumulated in-process
-# compilations (axon-plugin/XLA-CPU issue, not OOM and not any one test —
-# the crashing test passes in isolation). The fix is process isolation:
-# run each top-level tests/ directory in a FRESH python process.
+# A single-process run of the full suite used to segfault at 55-75% inside
+# XLA's backend_compile_and_load once several hundred varied executables were
+# live in-process (stock XLA:CPU — the axon plugin was experimentally
+# exonerated; not OOM/fd/map/thread exhaustion; the crashing test passes in
+# isolation). Root-caused + fixed in round 4: tests/conftest.py drops jit
+# caches per module (autouse clear_caches fixture), and the monolith now
+# passes end-to-end (676 tests, ~62 min). Sharding each tests/ directory
+# into a fresh process remains the canonical gate (faster under JOBS>1 and
+# immune to any future cross-module state).
 #
 # Usage:
 #   bash run_tests.sh            # full suite, sharded (exit 0 == all green)
