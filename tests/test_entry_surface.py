@@ -100,3 +100,25 @@ def test_benchmarking_off_policy_distributed_tiny():
     from benchmarking.benchmarking_off_policy_distributed import main
 
     main(generations=1, members_per_device=1)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8_devices():
+    """The driver's multi-chip validation surface (__graft_entry__.
+    dryrun_multichip) must keep working: full sharded GRPO step + sp/ep/pp
+    axes + composed-mesh grad-parity cells on 8 virtual CPU devices.
+    Run in a subprocess — it force-configures the backend/device count."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1700,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "dryrun_multichip OK on 8 devices" in proc.stdout
