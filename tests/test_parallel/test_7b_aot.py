@@ -52,3 +52,17 @@ def test_7b_train_step_compiles_through_gspmd():
     report = _run_plan(["--compile"], timeout=560)
     assert report["train_compile_seconds"] > 0
     assert report["generate_compile_seconds"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("preset_name,tp", [("llama2-7b", 4), ("qwen2-7b", 4)])
+def test_other_7b_presets_lower(preset_name, tp):
+    """The other flagship presets lower through the same sharded program
+    (vocab/head dims must divide the tp axis)."""
+    report = _run_plan(["--preset", preset_name, "--tp", str(tp),
+                        "--batch", "32", "--seq", "1024",
+                        "--prompt", "512", "--new-tokens", "128"],
+                       timeout=420)
+    assert report["base_params_b"] > 6.0
+    assert report["train_sharding_annotations"] > 100
+    assert report["hbm_total_gib_per_chip"] < 95.0
