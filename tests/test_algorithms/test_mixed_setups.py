@@ -221,3 +221,30 @@ def test_build_net_config_flat_defaults_with_override():
     assert cfgs["cam_0"]["latent_dim"] == 16
     assert cfgs["cam_0"]["encoder_config"]["channel_size"] == (4,)
     assert agent.actors["scout_0"].config.encoder.hidden_size == (48,)
+
+
+def test_matd3_mixed_builds_and_learns():
+    """MATD3's twin critics go through build_critic_config too — mixed
+    populations must construct and learn (the critic_2s are built in the
+    MATD3 subclass, a separate code path from MADDPG's critics)."""
+    from agilerl_tpu.algorithms.matd3 import MATD3
+
+    agent = MATD3(MIXED_OBS, MIXED_ACT, net_config=NET, seed=0)
+    assert agent.get_setup() is MultiAgentSetup.MIXED
+    assert agent.actors["cam_0"].config.encoder_kind == "cnn"
+    # every critic tier sees the flat joint vector
+    for aid in agent.agent_ids:
+        assert agent.critics[aid].config.encoder_kind == "mlp"
+        assert agent.critic_2s[aid].config.encoder_kind == "mlp"
+    rng = np.random.default_rng(0)
+    loss = agent.learn(_mixed_batch(rng, agent.agent_ids, MIXED_OBS))
+    assert np.isfinite(loss)
+    # and architecture-mutates without divergence warnings
+    muts = Mutations(architecture=1.0, no_mutation=0.0, parameters=0.0,
+                     activation=0.0, rl_hp=0.0, rand_seed=5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        agent = muts.architecture_mutate(agent)
+    assert agent.mut != "None"
+    assert np.isfinite(agent.learn(
+        _mixed_batch(rng, agent.agent_ids, MIXED_OBS)))

@@ -134,3 +134,36 @@ def test_sharded_generate():
         comp, cmask = generate(cfg, params, toks, mask, jax.random.PRNGKey(1),
                                max_new_tokens=8, temperature=0.0)
     assert comp.shape == (4, 8)
+
+
+def test_bucketed_generation_with_sharded_params():
+    """BucketedGenerator must serve from GSPMD-sharded params (the GRPO
+    rollout path after to_mesh): greedy output matches the unsharded run."""
+    from agilerl_tpu.llm.serving import BucketedGenerator
+
+    mesh = make_mesh(dp=1, fsdp=4, tp=2)
+    cfg = M.GPTConfig(vocab_size=128, n_layer=2, n_head=4, n_kv_head=2,
+                      d_model=64, max_seq_len=128, dtype=jnp.float32)
+    params = M.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    seqs = [rng.integers(2, 127, size=rng.integers(4, 16)).astype(np.int32)
+            for _ in range(5)]
+    gen = BucketedGenerator(cfg, max_new_tokens=8, pad_id=0, eos_id=None,
+                            prompt_buckets=(16,), row_buckets=(8,),
+                            decode_chunk=8)
+    ref, ref_mask, _ = gen.generate(seqs, jax.random.PRNGKey(1), params,
+                                    greedy=True)
+
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, gpt_param_specs(cfg),
+    )
+    gen2 = BucketedGenerator(cfg, max_new_tokens=8, pad_id=0, eos_id=None,
+                             prompt_buckets=(16,), row_buckets=(8,),
+                             decode_chunk=8)
+    with mesh:
+        out, out_mask, info = gen2.generate(seqs, jax.random.PRNGKey(1),
+                                            sharded, greedy=True)
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out_mask, ref_mask)
+    assert info["compiled_programs"] == 2
