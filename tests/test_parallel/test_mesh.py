@@ -158,12 +158,10 @@ def test_bucketed_generation_with_sharded_params():
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params, gpt_param_specs(cfg),
     )
-    gen2 = BucketedGenerator(cfg, max_new_tokens=8, pad_id=0, eos_id=None,
-                             prompt_buckets=(16,), row_buckets=(8,),
-                             decode_chunk=8)
     with mesh:
-        out, out_mask, info = gen2.generate(seqs, jax.random.PRNGKey(1),
-                                            sharded, greedy=True)
+        out, out_mask, info = gen.generate(seqs, jax.random.PRNGKey(1),
+                                           sharded, greedy=True)
     np.testing.assert_array_equal(out, ref)
     np.testing.assert_array_equal(out_mask, ref_mask)
+    # same bucket pair -> the signature set must not grow for sharded params
     assert info["compiled_programs"] == 2
