@@ -71,6 +71,7 @@ def grpo_hbm_budget(
     tp: int,
     batch_global: int,
     seq_len: int,
+    dp: int = 1,
     lora_rank: int = 8,
     lora_targets=("wq", "wv"),
     gen_batch_global: Optional[int] = None,
@@ -95,7 +96,9 @@ def grpo_hbm_budget(
     """
     counts = param_counts(config, lora_rank, lora_targets)
     d, L, T = config.d_model, config.n_layer, seq_len
-    B_local = max(batch_global // fsdp, 1)
+    # batch shards over BOTH data axes (dp, fsdp); weights are replicated
+    # over dp (each dp slice holds the fsdp x tp shard)
+    B_local = max(batch_global // (dp * fsdp), 1)
     bf16 = 2
 
     base_per_chip = counts["base_bytes"] / (fsdp * tp)
@@ -121,15 +124,15 @@ def grpo_hbm_budget(
         "lm_head_loss_chunk": head_chunk,
     }
     if gen_batch_global and gen_total_len:
-        Bg = max(gen_batch_global // fsdp, 1)
+        Bg = max(gen_batch_global // (dp * fsdp), 1)
         kv_heads_local = max(config.kv_heads // tp, 1)
         budget["kv_cache_generation"] = (
             2 * L * Bg * gen_total_len * kv_heads_local * config.head_dim * bf16
         )
     budget["total"] = sum(budget.values())
     budget["meta"] = {
-        "counts": counts, "fsdp": fsdp, "tp": tp, "batch_global": batch_global,
-        "batch_local": B_local, "seq_len": T,
+        "counts": counts, "dp": dp, "fsdp": fsdp, "tp": tp,
+        "batch_global": batch_global, "batch_local": B_local, "seq_len": T,
     }
     return budget
 
@@ -152,8 +155,9 @@ def render_budget_md(budget: Dict[str, Any],
         f"| HBM per chip | {hbm_gib:.0f} "
         f"({'fits, ' + format(hbm_gib - total, '.1f') + ' GiB headroom' if total < hbm_gib else 'OVER BUDGET'}) |"
     )
+    dp_part = f"dp={meta['dp']} x " if meta.get("dp", 1) > 1 else ""
     header = (
-        f"mesh fsdp={meta['fsdp']} x tp={meta['tp']}, "
+        f"mesh {dp_part}fsdp={meta['fsdp']} x tp={meta['tp']}, "
         f"global batch {meta['batch_global']} (local {meta['batch_local']}), "
         f"seq {meta['seq_len']}, "
         f"base params {meta['counts']['base_params'] / 1e9:.2f}B"

@@ -1,0 +1,111 @@
+"""EvoPPO pod dress rehearsal — the classic-stack counterpart of
+benchmarking/grpo_7b_plan.py.
+
+BASELINE.md's classic headline (evo-PPO pop=64, >=1M env-steps/sec) has only
+ever compiled single-chip; this proves the POD program — one member per
+device over a 64-wide "pop" axis, fitness + winner-params all-gathered over
+ICI inside shard_map (`parallel/population.py make_pod_generation`) — builds
+for a 64-chip topology with zero chips: AOT-lower (and with --compile, fully
+GSPMD-partition) the whole-generation program from abstract member states.
+
+Run:  python benchmarking/evoppo_pod_plan.py [--devices 64] [--compile]
+Test: tests/test_parallel/test_7b_aot.py::test_evoppo_pod_plan_lowers
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=64)
+    ap.add_argument("--num-envs", type=int, default=128,
+                    help="envs per member (BASELINE workload: 128)")
+    ap.add_argument("--rollout", type=int, default=64)
+    ap.add_argument("--compile", action="store_true")
+    args = ap.parse_args(argv)
+
+    from benchmarking.grpo_7b_plan import _force_cpu
+
+    _force_cpu(args.devices)
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from agilerl_tpu.envs import CartPole
+    from agilerl_tpu.modules.mlp import MLPConfig
+    from agilerl_tpu.networks import distributions as D
+    from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+    from agilerl_tpu.parallel.population import EvoPPO
+
+    env = CartPole()
+    kind, enc = default_encoder_config(
+        env.observation_space, latent_dim=64, encoder_config={"hidden_size": (64,)}
+    )
+    actor_cfg = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=64, num_outputs=2, hidden_size=(64,)),
+        latent_dim=64,
+    )
+    critic_cfg = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=64, num_outputs=1, hidden_size=(64,)),
+        latent_dim=64,
+    )
+    evo = EvoPPO(
+        env, actor_cfg, critic_cfg,
+        D.dist_config_from_space(env.action_space), optax.adam(3e-4),
+        num_envs=args.num_envs, rollout_len=args.rollout,
+        update_epochs=1, num_minibatches=4,
+    )
+    devices = jax.devices()[: args.devices]
+    mesh = Mesh(np.asarray(devices), axis_names=("pop",))
+    gen = evo.make_pod_generation(mesh)
+
+    # abstract population: one member per device, leaves sharded on "pop"
+    pop_shapes = jax.eval_shape(
+        lambda k: evo.init_population(k, args.devices), jax.random.PRNGKey(0)
+    )
+    sharding = NamedSharding(mesh, P("pop"))
+    pop_abs = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sharding),
+        pop_shapes,
+    )
+    key_abs = jax.ShapeDtypeStruct((2,), jax.numpy.uint32)
+
+    report = {"devices": args.devices, "pop": args.devices,
+              "num_envs": args.num_envs, "rollout": args.rollout}
+    t0 = time.time()
+    with mesh:
+        lowered = gen.lower(pop_abs, key_abs)
+    report["lower_seconds"] = round(time.time() - t0, 1)
+    cost = lowered.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    report["generation_gflops"] = round(float(cost.get("flops", 0.0)) / 1e9, 1)
+    hlo = lowered.as_text()
+    report["sharding_annotations"] = (
+        hlo.count("sdy.sharding") + hlo.count("mhlo.sharding")
+    )
+    assert report["sharding_annotations"] > 0
+    report["env_steps_per_generation"] = (
+        args.devices * args.num_envs * args.rollout
+    )
+    if args.compile:
+        t0 = time.time()
+        lowered.compile()
+        report["compile_seconds"] = round(time.time() - t0, 1)
+    print(json.dumps(report), flush=True)
+    return report
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __import__("os").path.dirname(
+        __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+    main()

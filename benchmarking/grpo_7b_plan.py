@@ -63,6 +63,10 @@ def main(argv=None):
     ap.add_argument("--devices", type=int, default=64,
                     help="v5p-64 topology by default")
     ap.add_argument("--tp", type=int, default=4)
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel axis (the DCN axis in a multi-slice "
+                         "deployment: gradients all-reduce once per step "
+                         "over it while fsdp/tp collectives stay on ICI)")
     ap.add_argument("--batch", type=int, default=64,
                     help="global train batch (B*G rows)")
     ap.add_argument("--seq", type=int, default=2048,
@@ -97,13 +101,15 @@ def main(argv=None):
         GIB, grpo_hbm_budget, render_budget_md,
     )
 
-    fsdp = args.devices // args.tp
-    mesh = make_mesh(dp=1, fsdp=fsdp, tp=args.tp,
+    fsdp = args.devices // (args.tp * args.dp)
+    mesh = make_mesh(dp=args.dp, fsdp=fsdp, tp=args.tp,
                      devices=jax.devices()[: args.devices])
     cfg = preset(args.preset, max_seq_len=args.seq, use_flash_attention=False)
     B, T = args.batch, args.seq
+    mesh_name = (f"dp{args.dp}x" if args.dp > 1 else "") + \
+        f"fsdp{fsdp}xtp{args.tp}"
     lora_rank = 16
-    report = {"preset": args.preset, "mesh": f"fsdp{fsdp}xtp{args.tp}",
+    report = {"preset": args.preset, "mesh": mesh_name,
               "devices": args.devices, "batch": B, "seq": T}
 
     def abstract(tree, specs):
@@ -202,7 +208,7 @@ def main(argv=None):
 
     # ---- 3. HBM budget + MFU projection ----------------------------------
     budget = grpo_hbm_budget(
-        cfg, fsdp=fsdp, tp=args.tp, batch_global=B, seq_len=T,
+        cfg, fsdp=fsdp, tp=args.tp, dp=args.dp, batch_global=B, seq_len=T,
         lora_rank=lora_rank, gen_batch_global=gen_B,
         gen_total_len=args.prompt + args.new_tokens,
     )

@@ -66,3 +66,37 @@ def test_other_7b_presets_lower(preset_name, tp):
     assert report["base_params_b"] > 6.0
     assert report["train_sharding_annotations"] > 100
     assert report["hbm_total_gib_per_chip"] < 95.0
+
+
+@pytest.mark.slow
+def test_7b_lowering_with_data_parallel_axis():
+    """dp>1 (the DCN axis of a multi-slice deployment) lowers too: the
+    LoRA gradients all-reduce over dp while fsdp/tp stay intra-slice."""
+    report = _run_plan(["--dp", "2", "--tp", "4", "--batch", "64",
+                        "--seq", "1024", "--prompt", "512",
+                        "--new-tokens", "128"], timeout=420)
+    assert report["mesh"] == "dp2xfsdp8xtp4"
+    assert report["train_sharding_annotations"] > 100
+    assert report["hbm_total_gib_per_chip"] < 95.0
+
+
+def test_evoppo_pod_plan_lowers_and_compiles():
+    """The classic-stack pod dress rehearsal: the whole-generation EvoPPO
+    program (pop=64, one member per device, ICI all-gathers inside
+    shard_map) must lower AND compile for a 64-device topology
+    (BASELINE: evo-PPO pop=64 >= 1M env-steps/s)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarking",
+                                      "evoppo_pod_plan.py"), "--compile"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        timeout=560, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["sharding_annotations"] > 0
+    assert report["compile_seconds"] > 0
+    assert report["env_steps_per_generation"] == 64 * 128 * 64
