@@ -184,6 +184,7 @@ def main(argv=None):
 
     # ---- 2. lower the generation program ---------------------------------
     gen_B = 32
+    report["gen_rows"] = gen_B
     prompt_abs = jax.ShapeDtypeStruct((gen_B, args.prompt), jnp.int32,
                                       sharding=bspec)
     pmask_abs = jax.ShapeDtypeStruct((gen_B, args.prompt), jnp.int32,
@@ -266,7 +267,7 @@ def _render_md(report, budget, render_budget_md):
         "",
         f"- train step: **{report['train_step_pflops']} PFLOPs** "
         f"(lowered in {report['train_lower_seconds']}s)",
-        f"- generation ({report['batch']} rows): "
+        f"- generation ({report['gen_rows']} rows): "
         f"{report['generate_pflops']} PFLOPs "
         f"(lowered in {report['generate_lower_seconds']}s)",
     ]

@@ -16,13 +16,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 SCRIPT = os.path.join(REPO, "benchmarking", "grpo_7b_plan.py")
 
 
-def _run_plan(extra_args, timeout):
+def _run_plan(extra_args, timeout, script=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
-        [sys.executable, SCRIPT, *extra_args],
+        [sys.executable, script or SCRIPT, *extra_args],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         timeout=timeout, text=True, cwd=REPO,
     )
@@ -80,23 +80,16 @@ def test_7b_lowering_with_data_parallel_axis():
     assert report["hbm_total_gib_per_chip"] < 95.0
 
 
+@pytest.mark.slow
 def test_evoppo_pod_plan_lowers_and_compiles():
     """The classic-stack pod dress rehearsal: the whole-generation EvoPPO
     program (pop=64, one member per device, ICI all-gathers inside
     shard_map) must lower AND compile for a 64-device topology
     (BASELINE: evo-PPO pop=64 >= 1M env-steps/s)."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "benchmarking",
-                                      "evoppo_pod_plan.py"), "--compile"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        timeout=560, text=True, cwd=REPO,
+    report = _run_plan(
+        ["--compile"], timeout=560,
+        script=os.path.join(REPO, "benchmarking", "evoppo_pod_plan.py"),
     )
-    assert proc.returncode == 0, proc.stderr[-3000:]
-    report = json.loads(proc.stdout.strip().splitlines()[-1])
     assert report["sharding_annotations"] > 0
     assert report["compile_seconds"] > 0
     assert report["env_steps_per_generation"] == 64 * 128 * 64
