@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from agilerl_tpu.ops.kernel_mode import resolve_interpret
+
 try:
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -61,7 +63,7 @@ def _make_kernel(
                 mask = jnp.logical_and(mask, k_ids <= q_ids)
             if mask_ref is not None:
                 # padding mask for this kv block: [1, BK] -> broadcast rows
-                mask = jnp.logical_and(mask, mask_ref[0][None, :] > 0)
+                mask = jnp.logical_and(mask, mask_ref[0] > 0)
             scores = jnp.where(mask, scores, -1e30)
 
             m_old = m_ref[:]
@@ -102,8 +104,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     B, H, T, d = q.shape
     scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, T)
@@ -132,9 +133,17 @@ def flash_attention(
     ]
     args = [qf, kf, vf]
     if with_mask:
+        # [B, 1, Tp] with a (1, 1, block_k) block: the mask rides the lane
+        # dimension (its natural broadcast orientation against [BQ, BK]
+        # scores) AND satisfies Mosaic's block rule — the last two block
+        # dims (1, block_k) match/divide the array dims (1, Tp). A 2-D
+        # (B, Tp) array with (1, block_k) blocks is rejected by the TPU
+        # lowering whenever B > 1 (caught by the AOT compile harness,
+        # benchmarking/tpu_aot_compile.py; interpret mode never sees it).
         mp = jnp.pad(padding_mask.astype(jnp.int32), ((0, 0), (0, pad_t)))
+        mp = mp.reshape(B, 1, Tp)
         in_specs.append(
-            pl.BlockSpec((1, block_k), lambda b, i, j, H=H: (b // H, j))
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, H=H: (b // H, 0, j))
         )
         args.append(mp)
     out = pl.pallas_call(

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from agilerl_tpu.ops.kernel_mode import resolve_interpret
+
 try:
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -62,7 +64,7 @@ def _fwd_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
             if causal:
                 mask = jnp.logical_and(mask, k_ids <= q_ids)
             if pm_ref is not None:
-                mask = jnp.logical_and(mask, pm_ref[0][None, :] > 0)
+                mask = jnp.logical_and(mask, pm_ref[0] > 0)
             s = jnp.where(mask, s, -1e30)
             m_old = m_ref[:]
             m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
@@ -84,7 +86,7 @@ def _fwd_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
         @pl.when(kj == nk - 1)
         def _done():
             out_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(out_ref.dtype)
-            lse_ref[0] = (m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+            lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
     return kernel
 
@@ -114,10 +116,10 @@ def _dq_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
             if causal:
                 mask = jnp.logical_and(mask, k_ids <= q_ids)
             if pm_ref is not None:
-                mask = jnp.logical_and(mask, pm_ref[0][None, :] > 0)
-            p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+                mask = jnp.logical_and(mask, pm_ref[0] > 0)
+            p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
             dov = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # [BQ, BK]
-            ds = p * (dov - dd_ref[0][:, None])
+            ds = p * (dov - dd_ref[0])
             acc_ref[:] = acc_ref[:] + jnp.dot(
                 ds.astype(k.dtype), k, preferred_element_type=jnp.float32
             ) * scale
@@ -163,13 +165,13 @@ def _dkv_kernel(scale, causal, block_q, block_k, seq_len, with_mask):
             if causal:
                 mask = jnp.logical_and(mask, k_ids <= q_ids)
             if pm_ref is not None:
-                mask = jnp.logical_and(mask, pm_ref[0][None, :] > 0)
-            p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+                mask = jnp.logical_and(mask, pm_ref[0] > 0)
+            p = jnp.where(mask, jnp.exp(s - lse_ref[0]), 0.0)
             dv_acc[:] = dv_acc[:] + jnp.dot(
                 p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
             )
             dov = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-            ds = p * (dov - dd_ref[0][:, None])
+            ds = p * (dov - dd_ref[0])
             dk_acc[:] = dk_acc[:] + jnp.dot(
                 ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32
             ) * scale
@@ -224,8 +226,7 @@ def _prep(q, T, block_q, block_k):
 
 
 def _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret):
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     if pltpu is None:  # pragma: no cover
         raise RuntimeError("pallas tpu module unavailable")
     B, H, T, d = q.shape
@@ -243,8 +244,16 @@ def _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret):
     ]
     args = [qf, kf, vf]
     if with_mask:
+        # mask rides lanes as [B, 1, Tp] / lse rides sublanes as
+        # [bh, Tp, 1]: both satisfy Mosaic's last-two-dims block rule in
+        # their natural broadcast orientation (no in-kernel transposes).
+        # 2-D (rows, Tp) aux arrays with (1, block) blocks fail the TPU
+        # lowering whenever rows > 1 — caught by the AOT harness
+        # (benchmarking/tpu_aot_compile.py), invisible to interpret mode.
         mp = jnp.pad(padding_mask.astype(jnp.int32), ((0, 0), (0, pad)))
-        in_specs.append(pl.BlockSpec((1, block_k), lambda b, i, j, H=H: (b // H, j)))
+        mp = mp.reshape(B, 1, Tp)
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, H=H: (b // H, 0, j)))
         args.append(mp)
     grid = (B * H, Tp // block_q, Tp // block_k)
     out, lse = pl.pallas_call(
@@ -253,11 +262,11 @@ def _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tp, d), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tp, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -277,8 +286,7 @@ def _fwd_rule(q, k, v, padding_mask, causal, block_q, block_k, interpret):
 
 def _bwd_rule(causal, block_q, block_k, interpret, res, do):
     q, k, v, padding_mask, out, lse = res
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     B, H, T, d = q.shape
     scale = 1.0 / math.sqrt(d)
     block_q, block_k, pad = _prep(q, T, block_q, block_k)
@@ -288,25 +296,27 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, do):
     kf = _pad_t(k, pad).reshape(bh, Tp, d)
     vf = _pad_t(v, pad).reshape(bh, Tp, d)
     dof = _pad_t(do, pad).reshape(bh, Tp, d)
-    # D_i = rowsum(dO * O); lse already [bh, Tp]
+    # D_i = rowsum(dO * O); lse already [bh, Tp, 1] (sublane-oriented)
     dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pad))).reshape(bh, Tp)
+    dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pad))).reshape(bh, Tp, 1)
     with_mask = padding_mask is not None
     mask_args = []
     if with_mask:
-        mask_args = [jnp.pad(padding_mask.astype(jnp.int32), ((0, 0), (0, pad)))]
+        mask_args = [jnp.pad(
+            padding_mask.astype(jnp.int32), ((0, 0), (0, pad))
+        ).reshape(B, 1, Tp)]
 
     common_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # q by qi
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # k by kj
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),  # v by kj
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),  # do by qi
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),  # lse by qi
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),  # dd by qi
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # lse by qi
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),  # dd by qi
     ]
     if with_mask:
         common_specs.append(
-            pl.BlockSpec((1, block_k), lambda b, i, j, H=H: (b // H, j))
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, H=H: (b // H, 0, j))
         )
     dq = pl.pallas_call(
         _dq_kernel(scale, causal, block_q, block_k, T, with_mask),
@@ -323,12 +333,12 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, do):
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
     ]
     if with_mask:
         dkv_specs.append(
-            pl.BlockSpec((1, block_k), lambda b, j, i, H=H: (b // H, j))
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i, H=H: (b // H, 0, j))
         )
     dk, dv = pl.pallas_call(
         _dkv_kernel(scale, causal, block_q, block_k, T, with_mask),

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from agilerl_tpu.ops.kernel_mode import resolve_interpret
+
 try:
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -153,8 +155,7 @@ def _pad_inputs(hidden, head, targets, block_n, block_v):
 
 
 def _fwd_call(hidden, head, targets, temperature, block_n, block_v, interpret):
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     N, D = hidden.shape
     V = head.shape[1]
     h, w, t, block_n, block_v = _pad_inputs(hidden, head, targets, block_n, block_v)
@@ -229,8 +230,7 @@ def _diff_fwd(hidden, head, targets, temperature, block_n, block_v, interpret):
 
 def _diff_bwd(temperature, block_n, block_v, interpret, res, g):
     hidden, head, targets, lse = res
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     N, D = hidden.shape
     V = head.shape[1]
     h, w, t, block_n, block_v = _pad_inputs(hidden, head, targets, block_n, block_v)

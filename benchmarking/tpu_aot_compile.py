@@ -1,0 +1,406 @@
+"""Compile-only TPU AOT validation of the Pallas kernels and the fused GRPO
+step (VERDICT r4 next #1b): prove Mosaic lowering, VMEM/block-shape validity,
+and the real TPU compiler's memory layout WITHOUT a chip.
+
+How: libtpu (in-image, pip `libtpu`) exposes PJRT compile-only device
+topologies — ``jax.experimental.topologies.get_topology_desc("v5p:2x2x1",
+platform="tpu")`` loads the real TPU compiler and returns compile-only
+devices. ``jax.jit(...).lower(abstract args with topology shardings)
+.compile()`` then runs the full XLA:TPU + Mosaic pipeline (the same one a
+real v5p would run) and yields cost/memory analysis plus a serializable
+executable. No TPU hardware is touched; the axon pool can stay down.
+
+Validated targets (each records compile seconds, XLA cost analysis, per-chip
+memory stats, and a sha256 fingerprint of the serialized TPU executable):
+
+- ``fused_loss_fwd`` / ``fused_loss_grad`` — the Liger-role Pallas kernel
+  (ops/fused_loss.py; parity ref: liger fused losses at
+  agilerl/algorithms/grpo.py:558) at llama3-8b lm-head dims (D=4096,
+  V=128256), forward and custom-VJP backward (dH + dW kernels).
+- ``flash_fwd`` / ``flash_grad`` — Pallas flash attention fwd
+  (ops/flash_attention.py) and its custom VJP (ops/flash_attention_vjp.py)
+  at llama3 head dims (H=32, d=128, T=2048).
+- ``decode_chunk`` — one BucketedGenerator decode chunk (llm/serving.py, the
+  vLLM-role path, ref core/base.py:3101) for the llama3-8b preset.
+- ``grpo_step_small`` — the PRODUCTION fused GRPO update
+  (algorithms/grpo.make_update_fn with flash + fused-loss Pallas kernels ON)
+  compiled natively for one v5p core.
+- ``grpo_7b_gspmd`` — the 7B GRPO update GSPMD-partitioned by the REAL TPU
+  compiler for a v5p 4x4x4 (64-chip) topology, fsdp16xtp4; its
+  memory_analysis is the hardware-grade per-chip HBM number for
+  benchmarking/grpo_7b_plan.md.
+- ``grpo_7b_flash`` — same, with the Pallas kernels ON under GSPMD
+  (outcome recorded either way; pallas_call under GSPMD partitioning is the
+  open question this target answers).
+
+Run:  python benchmarking/tpu_aot_compile.py [--targets a,b,...] [--quick]
+Writes benchmarking/tpu_aot_report.{json,md}. The test tier runs tiny dims
+via tests/test_ops/test_tpu_aot.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import hashlib
+import json
+import os
+import sys
+import time
+import traceback
+
+
+def _force_cpu_default() -> None:
+    # The default backend stays CPU (the axon plugin must not dial the dead
+    # pool — see memory: JAX_PLATFORMS env alone does not override the
+    # sitecustomize registration); the TPU compiler is reached only through
+    # the compile-only topology below.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _fingerprint(compiled) -> str:
+    """sha256 of the serialized TPU executable (fallback: optimized HLO)."""
+    try:
+        raw = compiled.runtime_executable().serialize()
+    except Exception:
+        raw = compiled.as_text().encode()
+    return hashlib.sha256(raw).hexdigest()
+
+
+def _record(compiled, lowered, t_lower, t_compile, topology, n_devices):
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    rec = {
+        "ok": True,
+        "topology": topology,
+        "n_devices": n_devices,
+        "lower_seconds": round(t_lower, 1),
+        "compile_seconds": round(t_compile, 1),
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "fingerprint_sha256": _fingerprint(compiled),
+    }
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        rec.update(
+            generated_code_bytes=int(mem.generated_code_size_in_bytes),
+            argument_bytes=int(mem.argument_size_in_bytes),
+            output_bytes=int(mem.output_size_in_bytes),
+            temp_bytes=int(mem.temp_size_in_bytes),
+        )
+    return rec
+
+
+def _compile(fn, args, topology, n_devices, kwargs=None):
+    t0 = time.time()
+    lowered = fn.lower(*args, **(kwargs or {}))
+    t_lower = time.time() - t0
+    t0 = time.time()
+    compiled = lowered.compile()
+    t_compile = time.time() - t0
+    return _record(compiled, lowered, t_lower, t_compile, topology, n_devices)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--targets", default=None,
+                    help="comma list (default: all)")
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink dims for a fast smoke pass")
+    ap.add_argument("--topology", default="v5p:2x2x1",
+                    help="single-core targets compile for devices[0] of this")
+    ap.add_argument("--pod", default="v5p:4x4x4",
+                    help="64-chip topology for the GSPMD targets")
+    ap.add_argument("--write", default=None,
+                    help="report path prefix (default benchmarking/tpu_aot_report)")
+    args = ap.parse_args(argv)
+
+    _force_cpu_default()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P, SingleDeviceSharding
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from agilerl_tpu.ops.kernel_mode import native_kernels
+
+    report = {"libtpu": True, "targets": {}}
+    try:
+        topo = topologies.get_topology_desc(args.topology, platform="tpu")
+    except Exception as e:  # no libtpu / unsupported — record and bail
+        report["libtpu"] = False
+        report["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(report))
+        return report
+    dev0 = topo.devices[0]
+    s1 = SingleDeviceSharding(dev0)
+    report["device_kind"] = dev0.device_kind
+
+    want = set(args.targets.split(",")) if args.targets else None
+
+    def run(name, builder):
+        if want is not None and name not in want:
+            return
+        print(f"[aot] {name} ...", file=sys.stderr, flush=True)
+        try:
+            with native_kernels():
+                report["targets"][name] = builder()
+            print(f"[aot] {name} ok "
+                  f"({report['targets'][name]['compile_seconds']}s compile)",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            report["targets"][name] = {
+                "ok": False,
+                "error": f"{type(e).__name__}: {str(e)[:2000]}",
+                "traceback": traceback.format_exc()[-4000:],
+            }
+            print(f"[aot] {name} FAILED: {type(e).__name__}: {str(e)[:200]}",
+                  file=sys.stderr, flush=True)
+
+    # ---- kernel micro-targets (llama3-8b dims) --------------------------
+    from agilerl_tpu.ops.fused_loss import (
+        fused_token_logprob, fused_token_logprob_diff,
+    )
+    from agilerl_tpu.ops.flash_attention import flash_attention
+    from agilerl_tpu.ops.flash_attention_vjp import flash_attention_diff
+
+    N, D, V = (256, 512, 4096) if args.quick else (2048, 4096, 128256)
+    B, H, T, hd = (2, 4, 256, 128) if args.quick else (4, 32, 2048, 128)
+
+    def fused_fwd():
+        h = jax.ShapeDtypeStruct((N, D), jnp.bfloat16, sharding=s1)
+        w = jax.ShapeDtypeStruct((D, V), jnp.bfloat16, sharding=s1)
+        t = jax.ShapeDtypeStruct((N,), jnp.int32, sharding=s1)
+        fn = jax.jit(functools.partial(fused_token_logprob, interpret=False))
+        return _compile(fn, (h, w, t), args.topology, 1)
+
+    def fused_grad():
+        h = jax.ShapeDtypeStruct((N, D), jnp.bfloat16, sharding=s1)
+        w = jax.ShapeDtypeStruct((D, V), jnp.bfloat16, sharding=s1)
+        t = jax.ShapeDtypeStruct((N,), jnp.int32, sharding=s1)
+
+        def loss(hh, ww, tt):
+            return fused_token_logprob_diff(hh, ww, tt, 1.0).sum()
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        return _compile(fn, (h, w, t), args.topology, 1)
+
+    def flash_fwd():
+        q = jax.ShapeDtypeStruct((B, H, T, hd), jnp.bfloat16, sharding=s1)
+        m = jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=s1)
+        fn = jax.jit(functools.partial(
+            flash_attention, causal=True, interpret=False))
+        return _compile(fn, (q, q, q, m), args.topology, 1)
+
+    def flash_grad():
+        q = jax.ShapeDtypeStruct((B, H, T, hd), jnp.bfloat16, sharding=s1)
+        m = jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=s1)
+
+        def loss(qq, kk, vv, mm):
+            return flash_attention_diff(
+                qq, kk, vv, mm, interpret=False).astype(jnp.float32).sum()
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return _compile(fn, (q, q, q, m), args.topology, 1)
+
+    run("fused_loss_fwd", fused_fwd)
+    run("fused_loss_grad", fused_grad)
+    run("flash_fwd", flash_fwd)
+    run("flash_grad", flash_grad)
+
+    # ---- decode chunk (the vLLM-role serving path) ----------------------
+    from agilerl_tpu.llm import model as Mod
+    from agilerl_tpu.llm.presets import preset
+    from agilerl_tpu.llm.serving import BucketedGenerator
+
+    def decode_chunk():
+        cfg = preset("llama3-8b" if not args.quick else "llama3-8b",
+                     max_seq_len=2048, use_flash_attention=False)
+        if args.quick:
+            cfg = Mod.GPTConfig(
+                vocab_size=1024, n_layer=2, n_head=4, n_kv_head=2,
+                d_model=128, d_ff=256, max_seq_len=512)
+        gen = BucketedGenerator(cfg, max_new_tokens=64, decode_chunk=32,
+                                eos_id=2)
+        rows, pb = (8, 64) if args.quick else (32, 1024)
+        params_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s1),
+            jax.eval_shape(lambda k: Mod.init_params(k, cfg),
+                           jax.random.PRNGKey(0)))
+        carry_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s1),
+            jax.eval_shape(
+                lambda p: gen._prefill_impl(
+                    p, None,
+                    jnp.zeros((rows, pb), jnp.int32),
+                    jnp.zeros((rows, pb), jnp.int32),
+                    jnp.zeros((rows,), bool),
+                    jax.random.PRNGKey(0)),
+                params_abs)[0])
+        step_abs = jax.ShapeDtypeStruct((), jnp.int32, sharding=s1)
+        return _compile(gen._decode, (params_abs, None, carry_abs, step_abs),
+                        args.topology, 1)
+
+    run("decode_chunk", decode_chunk)
+
+    # ---- fused GRPO step, single core, Pallas kernels ON ----------------
+    from agilerl_tpu.algorithms.grpo import make_update_fn
+    from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+
+    def grpo_step_small():
+        cfg = Mod.GPTConfig(
+            vocab_size=32768, n_layer=4, n_head=8, n_kv_head=4,
+            d_model=512, d_ff=1408, max_seq_len=512,
+            use_flash_attention=True)
+        if args.quick:
+            cfg = Mod.GPTConfig(
+                vocab_size=1024, n_layer=2, n_head=4, n_kv_head=2,
+                d_model=256, d_ff=512, max_seq_len=256,
+                use_flash_attention=True)
+        Bt, Tt = (2, 128) if args.quick else (8, 512)
+        opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
+        base_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s1),
+            jax.eval_shape(lambda k: Mod.init_params(k, cfg),
+                           jax.random.PRNGKey(0)))
+        lora_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s1),
+            jax.eval_shape(lambda k: Mod.init_lora(k, cfg, 8),
+                           jax.random.PRNGKey(0)))
+        opt_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s1),
+            jax.eval_shape(
+                opt.tx.init,
+                jax.eval_shape(lambda k: Mod.init_lora(k, cfg, 8),
+                               jax.random.PRNGKey(0))))
+        batch_abs = {
+            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=s1),
+            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=s1),
+            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=s1),
+            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=s1),
+            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=s1),
+            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32, sharding=s1),
+        }
+        scalar = jax.ShapeDtypeStruct((), jnp.float32, sharding=s1)
+        update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=True)
+        return _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
+                                 scalar, scalar), args.topology, 1)
+
+    run("grpo_step_small", grpo_step_small)
+
+    # ---- 7B GSPMD for the v5p pod topology ------------------------------
+    from agilerl_tpu.parallel.mesh import (
+        filter_spec, gpt_param_specs, lora_specs, make_mesh,
+    )
+    from jax.sharding import Mesh
+
+    def _pod_target(use_flash: bool):
+        ptopo = topologies.get_topology_desc(args.pod, platform="tpu")
+        n = len(ptopo.devices)
+        tp = 4 if n % 4 == 0 else 1
+        fsdp = n // tp
+        mesh = make_mesh(dp=1, fsdp=fsdp, tp=tp, devices=list(ptopo.devices))
+        cfg = preset("llama3-8b", max_seq_len=2048,
+                     use_flash_attention=use_flash)
+        Bt, Tt = (16, 512) if args.quick else (64, 2048)
+
+        def abstract(tree, specs):
+            return jax.tree_util.tree_map(
+                lambda l, sp: jax.ShapeDtypeStruct(
+                    l.shape, l.dtype,
+                    sharding=NamedSharding(mesh, filter_spec(sp, mesh))),
+                tree, specs, is_leaf=lambda x: isinstance(x, P))
+
+        base_shapes = jax.eval_shape(lambda k: Mod.init_params(k, cfg),
+                                     jax.random.PRNGKey(0))
+        lora_shapes = jax.eval_shape(lambda k: Mod.init_lora(k, cfg, 16),
+                                     jax.random.PRNGKey(0))
+        base_abs = abstract(base_shapes, gpt_param_specs(cfg))
+        lspecs = lora_specs(lora_shapes)
+        lora_abs = abstract(lora_shapes, lspecs)
+        opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
+        opt_shapes = jax.eval_shape(opt.tx.init, lora_shapes)
+        shape_to_spec = {}
+        jax.tree_util.tree_map(
+            lambda sp, l: shape_to_spec.setdefault(l.shape, sp),
+            lspecs, lora_shapes)
+        opt_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(
+                l.shape, l.dtype,
+                sharding=NamedSharding(
+                    mesh, filter_spec(shape_to_spec.get(l.shape, P()), mesh))),
+            opt_shapes)
+        bspec = NamedSharding(mesh, P(("dp", "fsdp")))
+        batch_abs = {
+            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
+            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
+            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
+            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
+            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
+            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32, sharding=bspec),
+        }
+        scalar = jax.ShapeDtypeStruct((), jnp.float32)
+        update = make_update_fn(cfg, opt.tx, lora_scale=2.0,
+                                use_flash=use_flash)
+        with mesh:
+            rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
+                                    scalar, scalar), args.pod, n)
+        rec["mesh"] = f"fsdp{fsdp}xtp{tp}"
+        rec["batch"], rec["seq"] = Bt, Tt
+        return rec
+
+    run("grpo_7b_gspmd", lambda: _pod_target(use_flash=False))
+    run("grpo_7b_flash", lambda: _pod_target(use_flash=True))
+
+    prefix = args.write or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tpu_aot_report")
+    with open(prefix + ".json", "w") as fh:
+        json.dump(report, fh, indent=1)
+    with open(prefix + ".md", "w") as fh:
+        fh.write(_render_md(report))
+    print(json.dumps({k: (v if k != "targets" else {
+        n: {kk: r.get(kk) for kk in ("ok", "compile_seconds", "flops",
+                                     "temp_bytes", "error")}
+        for n, r in v.items()}) for k, v in report.items()}))
+    return report
+
+
+def _render_md(report):
+    lines = [
+        "# TPU AOT compile report (compile-only topology, no chip)",
+        "",
+        f"Device kind: **{report.get('device_kind', '?')}** — real XLA:TPU + "
+        "Mosaic pipeline via libtpu's compile-only PJRT topology "
+        "(`benchmarking/tpu_aot_compile.py`). Every `ok` row below is a "
+        "TPU-backend-compiled executable: Mosaic lowering, VMEM fit, and "
+        "block-shape validity are hardware-compiler-verified even with the "
+        "TPU pool down.",
+        "",
+        "| target | topology | ok | compile s | GFLOPs | temp MiB | fingerprint |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name, r in report.get("targets", {}).items():
+        if r.get("ok"):
+            lines.append(
+                f"| {name} | {r['topology']} ({r['n_devices']}d) | yes | "
+                f"{r['compile_seconds']} | {r['flops'] / 1e9:.1f} | "
+                f"{r.get('temp_bytes', 0) / 2**20:.1f} | "
+                f"`{r['fingerprint_sha256'][:16]}` |")
+        else:
+            lines.append(f"| {name} | — | **no** | — | — | — | "
+                         f"{r.get('error', '')[:80]} |")
+    lines += [
+        "",
+        "Fingerprints are sha256 of the serialized TPU executable "
+        "(fallback: optimized HLO text).",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    main()
