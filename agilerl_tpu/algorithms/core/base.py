@@ -332,9 +332,11 @@ class MultiAgentRLAlgorithm(EvolvableAlgorithm):
         """One representative observation space per distinct space signature,
         keyed by the first group carrying it."""
         seen: Dict[str, Any] = {}
+        sigs: set = set()
         for gid, members in self.grouped_agents.items():
             sig = str(self.observation_spaces[members[0]])
-            if sig not in {str(v) for v in seen.values()}:
+            if sig not in sigs:
+                sigs.add(sig)
                 seen[gid] = self.observation_spaces[members[0]]
         return seen
 

@@ -108,6 +108,13 @@ class Mutations:
         # apply with a shared numpy seed so magnitudes align across nets
         seed = int(self.rng.integers(0, 2**31 - 1))
         snapshot = _snapshot_networks(agent)
+        # opt states are immutable pytrees: keeping the references is a full
+        # snapshot, and restoring them (instead of reinit) preserves the Adam
+        # moments so a rolled-back mutation is truly a no-op (ADVICE r4)
+        opt_snapshot = [
+            (cfg.name, getattr(agent, cfg.name).opt_state)
+            for cfg in agent.registry.optimizer_configs
+        ]
         try:
             for group in agent.registry.groups:
                 net = getattr(agent, group.eval)
@@ -128,7 +135,8 @@ class Mutations:
             agent.mut = method
         except Exception as e:
             _restore_networks(agent, snapshot)
-            agent.reinit_optimizers()
+            for opt_name, opt_state in opt_snapshot:
+                getattr(agent, opt_name).opt_state = opt_state
             agent.mutation_hook()
             agent.mut = "None"
             import warnings

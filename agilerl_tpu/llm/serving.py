@@ -83,9 +83,6 @@ class BucketedGenerator:
             self._prefill_impl, static_argnames=("greedy",))
         self._decode = jax.jit(
             self._decode_impl, static_argnames=("greedy",))
-        # compile accounting by shape signature (no reliance on private jit
-        # attributes): one prefill + one decode program per signature
-        self._compiled_signatures = set()
 
     # -- compiled pieces (the SHARED generate.py prefill/decode maths — the
     # two paths cannot drift, review finding) -----------------------------
@@ -131,11 +128,19 @@ class BucketedGenerator:
         (completions [B, max_new_tokens], mask, info) trimmed back to the
         true row count; info reports bucketing + early-exit telemetry."""
         B = len(sequences)
+        if B == 0:
+            raise ValueError(
+                "BucketedGenerator.generate got an empty sequence list; "
+                "callers should gate batches with fits(n_rows, longest)")
         longest = max(len(s) for s in sequences)
+        if not self.fits(B, longest):
+            raise ValueError(
+                f"batch of {B} rows / longest prompt {longest} exceeds the "
+                f"bucket grid (row_buckets<= {self.row_buckets[-1]}, "
+                f"prompt_buckets<= {self.prompt_buckets[-1]}); check "
+                "fits() and fall back to the dense generate path")
         Pb = _round_up(longest, self.prompt_buckets)
         Bb = _round_up(B, self.row_buckets)
-        self._compiled_signatures.add(("prefill", Bb, Pb, bool(greedy)))
-        self._compiled_signatures.add(("decode", Bb, Pb, bool(greedy)))
         toks, mask = left_pad(sequences, self.pad_id, Pb)
         if Bb > B:
             toks = np.concatenate(
@@ -186,6 +191,18 @@ class BucketedGenerator:
     @property
     def compiled_programs(self) -> int:
         """Total compiled (prefill + decode) program count — the bounded
-        compile set the bucketing exists to guarantee. Tracked by shape
-        signature, matching jit's cache key for these call patterns."""
-        return len(self._compiled_signatures)
+        compile set the bucketing exists to guarantee. Read from the jit
+        caches themselves (VERDICT r4 #4: the previous self-inserted shape
+        signatures asserted a proxy — a regression that retraced per call,
+        e.g. an accidentally-traced knob, would have passed unnoticed; the
+        measured cache size cannot lie). Notes: the count reflects LIVE
+        programs (``jax.clear_caches()`` restarts it), a change of input
+        sharding/dtype is honestly a new program, and an early-exit batch
+        that never reached decode counts only its prefill. ``_cache_size``
+        is private jax API (pinned 0.9.0); the getattr guard turns a future
+        rename into a sentinel instead of crashing generate()."""
+        sizes = [getattr(fn, "_cache_size", None)
+                 for fn in (self._prefill, self._decode)]
+        if None in sizes:  # pragma: no cover - future-jax fallback
+            return -1
+        return sum(s() for s in sizes)

@@ -141,15 +141,70 @@ def _make_dw_kernel(vocab_size: int, inv_temp: float):
     return kernel
 
 
-def _pad_inputs(hidden, head, targets, block_n, block_v):
+# Scoped VMEM budget for one double-buffered grid step. The hardware limit
+# is 16 MiB (XLA's scoped-vmem cap for custom calls — the AOT harness
+# surfaced a 32.9 MiB allocation at llama3-8b dims, RESOURCE_EXHAUSTED);
+# 11 MiB leaves slack for the [BN, BV] f32 softmax intermediates.
+_VMEM_BUDGET = 11 << 20
+
+
+def _fit_blocks(block_n, block_v, D, isz_h, isz_w, kind):
+    """Pick the largest (block_n, block_v) tile whose grid-step VMEM
+    footprint fits: double-buffered operand blocks (each in its OWN input
+    dtype — an f32 head over bf16 hidden must not be undercounted) plus the
+    kernel's f32 accumulator/output blocks (dh: [BN, D]; dw: [D, BV]).
+
+    Candidates are Mosaic-aligned (sublane blocks snap to multiples of 8
+    with floor 8, lane blocks to multiples of 128 with floor 128 — naive
+    halving can land on 96-lane or 6-sublane blocks the TPU lowering
+    rejects), and the search maximises tile area instead of shrinking one
+    dimension to its floor first (for dh the f32 accumulator scales with
+    block_n, so grinding block_v down buys nothing), tie-breaking toward a
+    wider lane dimension."""
+
+    def est(bn, bv):
+        ins = 2 * (bn * D * isz_h + D * bv * isz_w)
+        if kind == "dh":
+            return ins + 4 * bn * D * 3  # f32 acc + double-buffered out
+        if kind == "dw":
+            return ins + 4 * D * bv * 3
+        return ins
+
+    def candidates(top, align, floor):
+        out, v = [top], top
+        while v > floor:
+            v = max(floor, (v // 2) // align * align)
+            out.append(v)
+        return out
+
+    best = None
+    for bn in candidates(block_n, 8, 8):
+        for bv in candidates(block_v, 128, 128):
+            if est(bn, bv) <= _VMEM_BUDGET:
+                key = (bn * bv, bv)
+                if best is None or key > best[0]:
+                    best = (key, bn, bv)
+    if best is None:  # nothing fits — floor blocks are the best effort
+        return min(block_n, 8), min(block_v, 128)
+    return best[1], best[2]
+
+
+def _pad_inputs(hidden, head, targets, block_n, block_v, kind="fwd"):
+    """Pad to block multiples WITHOUT changing dtype: the MXU consumes bf16
+    natively (f32 accumulation via preferred_element_type), and upcasting
+    the [D, BV] head block to f32 doubled its VMEM footprint — the direct
+    cause of the scoped-vmem overflow at real vocab dims."""
     N, D = hidden.shape
     V = head.shape[1]
     block_n = min(block_n, max(8, N))
     block_v = min(block_v, V + (-V) % 128)
+    block_n, block_v = _fit_blocks(
+        block_n, block_v, D, hidden.dtype.itemsize, head.dtype.itemsize,
+        kind)
     pad_n = (-N) % block_n
     pad_v = (-V) % block_v
-    h = jnp.pad(hidden.astype(jnp.float32), ((0, pad_n), (0, 0)))
-    w = jnp.pad(head.astype(jnp.float32), ((0, 0), (0, pad_v)))
+    h = jnp.pad(hidden, ((0, pad_n), (0, 0)))
+    w = jnp.pad(head, ((0, 0), (0, pad_v)))
     t = jnp.pad(targets.astype(jnp.int32), (0, pad_n))[:, None]
     return h, w, t, block_n, block_v
 
@@ -233,48 +288,59 @@ def _diff_bwd(temperature, block_n, block_v, interpret, res, g):
     interpret = resolve_interpret(interpret)
     N, D = hidden.shape
     V = head.shape[1]
-    h, w, t, block_n, block_v = _pad_inputs(hidden, head, targets, block_n, block_v)
-    lse_p = jnp.pad(lse.astype(jnp.float32), (0, h.shape[0] - N))[:, None]
-    # padded rows must contribute nothing: zero their upstream grad (their
-    # recomputed p over the padded head is garbage otherwise)
-    g_p = jnp.pad(g.astype(jnp.float32), (0, h.shape[0] - N))[:, None]
-    ni = h.shape[0] // block_n
-    nv = w.shape[1] // block_v
     inv_temp = 1.0 / temperature
 
+    def pad_aux(rows):
+        # padded rows must contribute nothing: zero their upstream grad
+        # (their recomputed p over the padded head is garbage otherwise)
+        lse_p = jnp.pad(lse.astype(jnp.float32), (0, rows - N))[:, None]
+        g_p = jnp.pad(g.astype(jnp.float32), (0, rows - N))[:, None]
+        return lse_p, g_p
+
+    # the two bwd kernels carry different f32 accumulator blocks (dh:
+    # [BN, D], dw: [D, BV]) — fit their VMEM budgets independently
+    h, w, t, bn_h, bv_h = _pad_inputs(hidden, head, targets,
+                                      block_n, block_v, "dh")
+    lse_p, g_p = pad_aux(h.shape[0])
     row_specs = [
-        pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
-        pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
-        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-        pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((bn_h, D), lambda i, j: (i, 0)),
+        pl.BlockSpec((D, bv_h), lambda i, j: (0, j)),
+        pl.BlockSpec((bn_h, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((bn_h, 1), lambda i, j: (i, 0)),
+        pl.BlockSpec((bn_h, 1), lambda i, j: (i, 0)),
     ]
     dh = pl.pallas_call(
         _make_dh_kernel(V, inv_temp),
-        grid=(ni, nv),
+        grid=(h.shape[0] // bn_h, w.shape[1] // bv_h),
         in_specs=row_specs,
-        out_specs=pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+        out_specs=pl.BlockSpec((bn_h, D), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((h.shape[0], D), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((block_n, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bn_h, D), jnp.float32)],
         interpret=interpret,
     )(h, w, t, lse_p, g_p)
 
+    h2, w2, t2, bn_w, bv_w = _pad_inputs(hidden, head, targets,
+                                         block_n, block_v, "dw")
+    if (bn_w, bv_w) != (bn_h, bv_h):
+        lse_p, g_p = pad_aux(h2.shape[0])
+    else:
+        h2, w2, t2 = h, w, t
     col_specs = [
-        pl.BlockSpec((block_n, D), lambda j, i: (i, 0)),
-        pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
-        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
-        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
-        pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((bn_w, D), lambda j, i: (i, 0)),
+        pl.BlockSpec((D, bv_w), lambda j, i: (0, j)),
+        pl.BlockSpec((bn_w, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((bn_w, 1), lambda j, i: (i, 0)),
+        pl.BlockSpec((bn_w, 1), lambda j, i: (i, 0)),
     ]
     dw = pl.pallas_call(
         _make_dw_kernel(V, inv_temp),
-        grid=(nv, ni),
+        grid=(w2.shape[1] // bv_w, h2.shape[0] // bn_w),
         in_specs=col_specs,
-        out_specs=pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((D, w.shape[1]), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((D, block_v), jnp.float32)],
+        out_specs=pl.BlockSpec((D, bv_w), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((D, w2.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((D, bv_w), jnp.float32)],
         interpret=interpret,
-    )(h, w, t, lse_p, g_p)
+    )(h2, w2, t2, lse_p, g_p)
 
     dhidden = dh[:N].astype(hidden.dtype)
     dhead = dw[:, :V].astype(head.dtype)

@@ -335,8 +335,13 @@ def _playbook_captured(mode: str):
     if (isinstance(result, dict) and "value" in result
             and result.get("backend") not in (None, "cpu")):
         result = dict(result)
+        # stamp the CAPTURING commit so a stale result can't be read as a
+        # fresh HEAD measurement (ADVICE r4): distinct key + provenance text
+        cap_commit = captured.get("commit") or "unknown-commit"
+        result["captured_at_commit"] = cap_commit
         result["provenance"] = (
-            f"playbook-captured {captured.get('ts', 'unknown-time')}"
+            f"playbook-captured {captured.get('ts', 'unknown-time')} "
+            f"at commit {cap_commit} (may predate HEAD)"
         )
         return result
     return None

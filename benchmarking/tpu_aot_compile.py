@@ -56,6 +56,9 @@ def _force_cpu_default() -> None:
     # sitecustomize registration); the TPU compiler is reached only through
     # the compile-only topology below.
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # compile-only topologies never touch devices: skip libtpu's
+    # multi-process lockfile so concurrent compiles don't collide
+    os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
     import jax
 
     jax.config.update("jax_platforms", "cpu")

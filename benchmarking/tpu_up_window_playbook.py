@@ -92,7 +92,14 @@ def main():
         log("pool DOWN — nothing to capture")
         return 1
     log(f"pool UP (backend={backend})")
-    captured = {"backend": backend, "ts": time.strftime("%Y%m%dT%H%M%S")}
+    try:
+        commit = subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=30).stdout.strip() or None
+    except Exception:
+        commit = None
+    captured = {"backend": backend, "ts": time.strftime("%Y%m%dT%H%M%S"),
+                "commit": commit}
 
     # 2. kernel validation (cheap, de-risks everything else)
     rc, out, dt = run_child(

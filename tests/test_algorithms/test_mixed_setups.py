@@ -8,6 +8,7 @@ rollback that replaces the reference's warn-and-continue.
 
 import warnings
 
+import jax
 import numpy as np
 import pytest
 from gymnasium import spaces
@@ -158,8 +159,18 @@ def test_ippo_mixed_collect_learn_mutate():
 
 def test_architecture_mutation_rolls_back_atomically():
     """A failure mid-mutation must leave the agent EXACTLY as before (no
-    sibling divergence), set mut='None', and warn once."""
+    sibling divergence), set mut='None', warn once — and preserve the
+    optimizer moments (ADVICE r4: reinit after rollback silently reset the
+    Adam dynamics even though the restored params matched the old state)."""
     agent = MADDPG(MIXED_OBS, MIXED_ACT, net_config=NET, seed=0)
+    # accumulate non-trivial Adam moments before the failed mutation
+    agent.learn(_mixed_batch(np.random.default_rng(0), agent.agent_ids,
+                             MIXED_OBS))
+    before_opt = {
+        cfg.name: jax.tree_util.tree_map(
+            np.asarray, getattr(agent, cfg.name).opt_state)
+        for cfg in agent.registry.optimizer_configs
+    }
     before_cfgs = {a: agent.actors[a].config for a in agent.agent_ids}
     before_params = {
         a: np.asarray(
@@ -197,6 +208,12 @@ def test_architecture_mutation_rolls_back_atomically():
     }
     for a in agent.agent_ids:
         np.testing.assert_array_equal(before_params[a], after_params[a])
+    # optimizer moments survived the rollback (a true no-op, not a reinit)
+    for cfg in agent.registry.optimizer_configs:
+        after = jax.tree_util.tree_map(
+            np.asarray, getattr(agent, cfg.name).opt_state)
+        jax.tree_util.tree_map(
+            np.testing.assert_array_equal, before_opt[cfg.name], after)
     # and the rolled-back agent still works
     assert np.isfinite(agent.learn(
         _mixed_batch(np.random.default_rng(1), agent.agent_ids, MIXED_OBS)))
