@@ -77,12 +77,24 @@ class _LoraNet:
         self.params = params
 
 
-def make_update_fn(config, tx, lora_scale: float, use_flash: bool):
+def make_update_fn(config, tx, lora_scale: float, use_flash: bool,
+                   use_fused_loss: Optional[bool] = None):
     """The production GRPO update as a pure function of (base, lora,
     opt_state, batch, clip, beta). Base params ride as an ARGUMENT (not a
     closure) so AOT tooling can lower the exact training step from abstract
     ShapeDtypeStructs without materialising the weights — the 7B dress
-    rehearsal (benchmarking/grpo_7b_plan.py) lowers this very function."""
+    rehearsal (benchmarking/grpo_7b_plan.py) lowers this very function.
+
+    ``use_fused_loss`` (default: follow ``use_flash``) routes the lm-head
+    loss through the fused Pallas kernel. Keep it OFF for tp-sharded pod
+    training: with the lm head sharded over tp, the log-softmax over vocab
+    is a cross-shard reduction, and XLA's chunked sharded-matmul + psum path
+    IS the right distributed algorithm — the fused kernel's win is the
+    single-chip / serving hot path (flash attention, by contrast, is
+    embarrassingly parallel over (batch, heads) and stays Pallas at any
+    scale via its custom partitioning, ops/flash_attention_vjp.py)."""
+    if use_fused_loss is None:
+        use_fused_loss = use_flash
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def update(base, lora, opt_state, batch, clip, beta):
@@ -90,7 +102,7 @@ def make_update_fn(config, tx, lora_scale: float, use_flash: bool):
             lp = M.token_logprobs(
                 config, base, batch["tokens"], attention_mask=batch["mask"],
                 lora=lo, lora_scale=lora_scale, flash=use_flash,
-                use_pallas=use_flash,
+                use_pallas=use_fused_loss,
             )
             return _grpo_loss_core(lp, batch, clip, beta)
 

@@ -53,6 +53,13 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
     use_flash_attention: bool = False  # Pallas kernel on the non-cached path
+    # ((batch axes...), (head axes...)) mesh-axis names: wrap the flash
+    # kernel in an explicit shard_map over the active mesh — the
+    # AOT-compatible pod-scale route (Mosaic kernels can't be GSPMD
+    # auto-partitioned, and custom_partitioning's python callback is absent
+    # from compile-only PJRT clients). None = plain call (single chip, or
+    # runtime GSPMD via the kernel's custom partitioning).
+    flash_shard_axes: Any = None
     # Mixture-of-Experts (beyond reference parity — completes the ep axis of
     # the dp/fsdp/tp/sp/ep strategy menu, SURVEY.md §2.8):
     n_experts: int = 0  # 0 = dense FFN everywhere
@@ -333,7 +340,26 @@ def forward(
                     flash_attention_diff,
                 )
 
-                attn = flash_attention_diff(qh, kh, vh, attention_mask, True)
+                smesh = _flash_mesh(config)
+                if smesh is not None:
+                    from jax import shard_map
+                    from jax.sharding import PartitionSpec as P
+
+                    bax, hax = config.flash_shard_axes
+                    bspec = _axes_in_mesh(bax, smesh)
+                    hspec = _axes_in_mesh(hax, smesh)
+                    qspec = P(bspec, hspec, None, None)
+                    attn = shard_map(
+                        lambda qq, kk, vv, mm: flash_attention_diff(
+                            qq, kk, vv, mm, True, spmd=False),
+                        mesh=smesh,
+                        in_specs=(qspec, qspec, qspec, P(bspec, None)),
+                        out_specs=qspec,
+                        check_vma=False,
+                    )(qh, kh, vh, attention_mask)
+                else:
+                    attn = flash_attention_diff(qh, kh, vh, attention_mask,
+                                                True)
             else:
                 scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
                 scores = scores / math.sqrt(config.head_dim)
@@ -379,6 +405,34 @@ def forward(
     if return_aux:
         return h, new_caches, aux_total
     return h, new_caches
+
+
+def _flash_mesh(config: GPTConfig):
+    """The active mesh for the flash shard_map wrap, or None. Reads the
+    `with mesh:` trace-time context (the pattern every sharded program in
+    this repo uses for lowering) and falls back to the abstract mesh."""
+    if config.flash_shard_axes is None:
+        return None
+    from jax._src import mesh as _mesh_lib
+
+    m = _mesh_lib.thread_resources.env.physical_mesh
+    if m is not None and not m.empty:
+        return m
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and am.axis_names:
+        return am
+    return None
+
+
+def _axes_in_mesh(axes, mesh):
+    """Filter requested mesh-axis names to those present (and >1) in the
+    mesh; returns None (replicated) when nothing survives."""
+    if axes is None:
+        return None
+    axes = axes if isinstance(axes, tuple) else (axes,)
+    kept = tuple(a for a in axes
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    return kept if kept else None
 
 
 def block_apply_dense(

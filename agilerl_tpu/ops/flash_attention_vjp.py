@@ -201,7 +201,7 @@ def _pad_t(x, pad):
     return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_diff(
     q: jax.Array,  # [B, H, T, d]
     k: jax.Array,
@@ -211,8 +211,17 @@ def flash_attention_diff(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    spmd: bool = True,
 ) -> jax.Array:
-    out, _ = _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret)
+    """``spmd=True`` (default) routes through the custom_partitioning
+    wrappers so plain-GSPMD callers shard over (batch, heads) at runtime;
+    pass ``spmd=False`` when calling from inside an explicit shard_map
+    (e.g. model.py's ``flash_shard_axes`` path — the AOT-compatible route:
+    custom_partitioning needs a runtime python callback that compile-only
+    PJRT clients don't host, 'Custom emitter for CustomSPMDPartitioning
+    not found')."""
+    out, _ = _fwd_rule(q, k, v, padding_mask, causal, block_q, block_k,
+                       interpret, spmd)
     return out
 
 
@@ -276,16 +285,122 @@ def _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(*args)
     out4 = out.reshape(B, H, Tp, d)[:, :, :T, :]
-    return out4, (q, k, v, padding_mask, out4, lse)
+    # lse rides as [B, H, Tp, 1] so the GSPMD partitioning rule can map its
+    # leading dims 1:1 onto q's (batch, heads) axes
+    return out4, lse.reshape(B, H, Tp, 1)
 
 
-def _fwd_rule(q, k, v, padding_mask, causal, block_q, block_k, interpret):
-    out, res = _fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret)
-    return out, res
+# --------------------------------------------------------------------------- #
+# GSPMD partitioning (custom_partitioning + Shardy sharding rules)
+#
+# Mosaic kernels cannot be auto-partitioned ("wrap the call in a shard_map" —
+# surfaced by benchmarking/tpu_aot_compile.py's grpo_7b_flash target). The
+# TPU-native answer for the production fsdp x tp mesh: attention is
+# embarrassingly parallel over (batch, heads) once GQA heads are repeated, so
+# we declare exactly that — b and h shard freely, sequence and head_dim are
+# need_replication factors (Shardy inserts the all-gathers if a caller hands
+# in sp-sharded operands) — and lower the SAME pallas kernels per shard.
+# --------------------------------------------------------------------------- #
 
 
-def _bwd_rule(causal, block_q, block_k, interpret, res, do):
+def _keep_dims(mesh, info, keep):
+    """NamedSharding that keeps only `keep` dims of an operand's sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ndim = len(info.shape)
+    spec = getattr(info.sharding, "spec", None)
+    parts = list(spec) if spec is not None else []
+    parts = parts + [None] * (ndim - len(parts))
+    parts = [p if i in keep else None for i, p in enumerate(parts)]
+    return NamedSharding(mesh, P(*parts))
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_fwd(causal, block_q, block_k, interpret, with_mask):
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    def impl(*args):
+        q, k, v = args[:3]
+        mask = args[3] if with_mask else None
+        return _fwd(q, k, v, mask, causal, block_q, block_k, interpret)
+
+    fn = custom_partitioning(impl)
+    arg_keep = [(0, 1), (0, 1), (0, 1)] + ([(0,)] if with_mask else [])
+    res_keep = [(0, 1), (0, 1)]
+
+    def partition(mesh, arg_infos, result_infos):
+        arg_sh = tuple(_keep_dims(mesh, a, k)
+                       for a, k in zip(arg_infos, arg_keep))
+        res_sh = tuple(_keep_dims(mesh, r, k)
+                       for r, k in zip(result_infos, res_keep))
+        return mesh, impl, res_sh, arg_sh
+
+    rule = ("b h t d, b h t d, b h t d" + (", b t" if with_mask else "")
+            + " -> b h t d, b h p u")
+    fn.def_partition(partition=partition, sharding_rule=rule,
+                     need_replication_factors=("t", "d", "p", "u"))
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_bwd(causal, block_q, block_k, interpret, with_mask):
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    def impl(*args):
+        q, k, v, do, out, lse = args[:6]
+        mask = args[6] if with_mask else None
+        return _bwd_arrays(q, k, v, do, out, lse, mask, causal, block_q,
+                           block_k, interpret)
+
+    fn = custom_partitioning(impl)
+    arg_keep = [(0, 1)] * 6 + ([(0,)] if with_mask else [])
+    res_keep = [(0, 1)] * 3
+
+    def partition(mesh, arg_infos, result_infos):
+        arg_sh = tuple(_keep_dims(mesh, a, k)
+                       for a, k in zip(arg_infos, arg_keep))
+        res_sh = tuple(_keep_dims(mesh, r, k)
+                       for r, k in zip(result_infos, res_keep))
+        return mesh, impl, res_sh, arg_sh
+
+    rule = ("b h t d, b h t d, b h t d, b h t d, b h t d, b h p u"
+            + (", b t" if with_mask else "")
+            + " -> b h t d, b h t d, b h t d")
+    fn.def_partition(partition=partition, sharding_rule=rule,
+                     need_replication_factors=("t", "d", "p", "u"))
+    return fn
+
+
+def _fwd_rule(q, k, v, padding_mask, causal, block_q, block_k, interpret,
+              spmd=True):
+    concrete = resolve_interpret(interpret)
+    with_mask = padding_mask is not None
+    if spmd:
+        args = (q, k, v) + ((padding_mask,) if with_mask else ())
+        out, lse = _partitioned_fwd(causal, block_q, block_k, concrete,
+                                    with_mask)(*args)
+    else:
+        out, lse = _fwd(q, k, v, padding_mask, causal, block_q, block_k,
+                        concrete)
+    return out, (q, k, v, padding_mask, out, lse)
+
+
+def _bwd_rule(causal, block_q, block_k, interpret, spmd, res, do):
     q, k, v, padding_mask, out, lse = res
+    concrete = resolve_interpret(interpret)
+    with_mask = padding_mask is not None
+    if spmd:
+        args = (q, k, v, do, out, lse) + ((padding_mask,) if with_mask else ())
+        dq, dk, dv = _partitioned_bwd(causal, block_q, block_k, concrete,
+                                      with_mask)(*args)
+    else:
+        dq, dk, dv = _bwd_arrays(q, k, v, do, out, lse, padding_mask,
+                                 causal, block_q, block_k, concrete)
+    return dq, dk, dv, None
+
+
+def _bwd_arrays(q, k, v, do, out, lse, padding_mask, causal, block_q,
+                block_k, interpret):
     interpret = resolve_interpret(interpret)
     B, H, T, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -296,7 +411,8 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, do):
     kf = _pad_t(k, pad).reshape(bh, Tp, d)
     vf = _pad_t(v, pad).reshape(bh, Tp, d)
     dof = _pad_t(do, pad).reshape(bh, Tp, d)
-    # D_i = rowsum(dO * O); lse already [bh, Tp, 1] (sublane-oriented)
+    lse = lse.reshape(bh, Tp, 1)  # arrives [B, H, Tp, 1] (partition layout)
+    # D_i = rowsum(dO * O); dd sublane-oriented like lse
     dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pad))).reshape(bh, Tp, 1)
     with_mask = padding_mask is not None
@@ -360,7 +476,7 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, do):
     )(qf, kf, vf, dof, lse, dd, *mask_args)
 
     unpad = lambda x: x.reshape(B, H, Tp, d)[:, :, :T, :]  # noqa: E731
-    return unpad(dq), unpad(dk), unpad(dv), None
+    return unpad(dq), unpad(dk), unpad(dv)
 
 
 flash_attention_diff.defvjp(_fwd_rule, _bwd_rule)

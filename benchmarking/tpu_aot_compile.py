@@ -309,7 +309,9 @@ def main(argv=None):
         fsdp = n // tp
         mesh = make_mesh(dp=1, fsdp=fsdp, tp=tp, devices=list(ptopo.devices))
         cfg = preset("llama3-8b", max_seq_len=2048,
-                     use_flash_attention=use_flash)
+                     use_flash_attention=use_flash,
+                     flash_shard_axes=((("dp", "fsdp"), "tp")
+                                       if use_flash else None))
         Bt, Tt = (16, 512) if args.quick else (64, 2048)
 
         def abstract(tree, specs):
@@ -348,8 +350,11 @@ def main(argv=None):
             "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32, sharding=bspec),
         }
         scalar = jax.ShapeDtypeStruct((), jnp.float32)
+        # flash attention stays Pallas at pod scale (custom partitioning over
+        # batch x heads); the lm-head loss deliberately uses XLA's chunked
+        # tp-sharded path — see make_update_fn's use_fused_loss note
         update = make_update_fn(cfg, opt.tx, lora_scale=2.0,
-                                use_flash=use_flash)
+                                use_flash=use_flash, use_fused_loss=False)
         with mesh:
             rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
                                     scalar, scalar), args.pod, n)

@@ -1,0 +1,111 @@
+"""Multi-shard, full-width HF checkpoint import stress (VERDICT r4 next #7):
+derisk the first real-weights run without egress by pushing a
+multi-gigabyte, multi-file safetensors checkpoint with REAL llama3-8b row
+dims (d_model 4096, d_ff 14336, vocab 128256, GQA 32/8 — only the layer
+count is reduced) through the exact user path: transformers sharded load ->
+llm/hf.py conversion -> GSPMD fsdp x tp sharding -> forward.
+
+The full 32-layer 8.03B run lives in benchmarking/hf_import_7b_stress.py
+(committed report: benchmarking/hf_import_7b_report.json).
+
+Ref: the reference loads its GRPO flagship through HF AutoModel
+(agilerl/algorithms/core/base.py:2605)."""
+
+import dataclasses
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def sharded_ckpt(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    tmp = tmp_path_factory.mktemp("llama3_fullwidth")
+    cfg = LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=2, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=1024, rope_theta=500000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(torch.bfloat16)
+    # 1 GiB shards force a genuinely multi-file checkpoint (~1.5B params ->
+    # ~3 GiB bf16 -> >= 3 shards + index)
+    model.save_pretrained(str(tmp), max_shard_size="1GB",
+                          safe_serialization=True)
+
+    ids = np.arange(1, 9)[None, :]
+    with torch.no_grad():
+        ref = model.to(torch.float32)(torch.tensor(ids)).logits.numpy()
+    del model
+    return str(tmp), ids, ref
+
+
+def test_checkpoint_is_genuinely_multishard(sharded_ckpt):
+    path, _, _ = sharded_ckpt
+    shards = glob.glob(os.path.join(path, "model-*.safetensors"))
+    assert len(shards) >= 2, sorted(os.listdir(path))
+    assert os.path.exists(os.path.join(path, "model.safetensors.index.json"))
+
+
+def test_import_matches_torch_at_bf16_tolerance(sharded_ckpt):
+    from agilerl_tpu.llm.hf import load_hf_model
+    from agilerl_tpu.llm.model import apply
+
+    path, ids, ref = sharded_ckpt
+    config, params = load_hf_model(path)  # bf16 storage default
+    assert config.d_model == 4096 and config.vocab_size == 128256
+    assert config.n_head == 32 and config.kv_heads == 8
+
+    cfg32 = dataclasses.replace(config, dtype=jnp.float32)
+    params32 = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), params)
+    got, _ = apply(cfg32, params32, jnp.asarray(ids))
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(
+        np.asarray(got) / scale, ref / scale, atol=3e-2,
+        err_msg="full-width sharded import diverges from the torch reference"
+    )
+
+
+def test_imported_params_serve_under_fsdp_tp_mesh(sharded_ckpt):
+    """The converted checkpoint must actually shard and run under the
+    production fsdp x tp mesh — the layout the 7B plan trains in."""
+    from jax.sharding import NamedSharding
+
+    from agilerl_tpu.llm.hf import load_hf_model
+    from agilerl_tpu.llm.model import apply
+    from agilerl_tpu.parallel.mesh import (
+        filter_spec, gpt_param_specs, make_mesh,
+    )
+
+    path, ids, ref = sharded_ckpt
+    config, params = load_hf_model(path)
+    mesh = make_mesh(dp=1, fsdp=4, tp=2)
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(
+            leaf, NamedSharding(mesh, filter_spec(spec, mesh))),
+        params, gpt_param_specs(config),
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+    # at least the big matmul weights must be genuinely distributed
+    wq = sharded["blocks"]["0"]["wq"]
+    assert len({s.device for s in wq.addressable_shards}) > 1, (
+        "wq is not actually sharded across devices")
+
+    with mesh:
+        got = jax.jit(lambda p, t: apply(config, p, t)[0])(
+            sharded, jnp.asarray(ids))
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(
+        np.asarray(got).astype(np.float32) / scale, ref / scale, atol=4e-2,
+        err_msg="GSPMD-sharded forward diverges from the torch reference")

@@ -169,3 +169,49 @@ def test_bucketed_generation_with_sharded_params():
     # count is 4, and a production rollout loop that always serves from
     # sharded params stays at 2 (asserted by the bounded-compile test)
     assert info["compiled_programs"] == 4
+
+
+def test_flash_shard_axes_matches_dense_attention_grad():
+    """The pod-scale flash route (explicit shard_map over (batch, heads) —
+    the AOT-compatible path that compiles the 7B flash step for a v5p
+    topology, see benchmarking/tpu_aot_compile.py grpo_7b_flash) must match
+    the dense-attention forward AND gradient on the same sharded inputs."""
+    import dataclasses
+
+    mesh = make_mesh(dp=1, fsdp=4, tp=2)
+    base_cfg = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+                           d_model=64, max_seq_len=64, dtype=jnp.float32)
+    flash_cfg = dataclasses.replace(
+        base_cfg, use_flash_attention=True,
+        flash_shard_axes=(("dp", "fsdp"), "tp"))
+    params = M.init_params(jax.random.PRNGKey(0), base_cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(
+            leaf, NamedSharding(mesh, spec)),
+        params, gpt_param_specs(base_cfg),
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(2, 95, size=(8, 32)).astype(np.int32))
+    mask = jnp.ones((8, 32), jnp.int32)
+    bspec = NamedSharding(mesh, P(("dp", "fsdp")))
+    toks = jax.device_put(toks, bspec)
+    mask = jax.device_put(mask, bspec)
+
+    def loss(cfg):
+        def fn(p, t, m):
+            lp = M.token_logprobs(cfg, p, t, attention_mask=m)
+            return lp.mean()
+        return fn
+
+    with mesh:
+        l_dense, g_dense = jax.jit(
+            jax.value_and_grad(loss(base_cfg)))(sharded, toks, mask)
+        l_flash, g_flash = jax.jit(
+            jax.value_and_grad(loss(flash_cfg)))(sharded, toks, mask)
+    np.testing.assert_allclose(float(l_dense), float(l_flash),
+                               rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(g_dense),
+                    jax.tree_util.tree_leaves(g_flash)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
