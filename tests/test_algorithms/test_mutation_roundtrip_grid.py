@@ -302,3 +302,137 @@ def test_ippo_save_load_clone_mutate_learn_chain(continuous, tmp_path):
     mutated.save_checkpoint(p2)
     reloaded = IPPO.load(p2)
     _ma_same_policy(mutated, reloaded, env)
+
+
+# --------------------------------------------------------------------------- #
+# E: LLM algorithms (GRPO / DPO / ILQL / BC_LM) chains
+# --------------------------------------------------------------------------- #
+
+
+def _params_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _llm_cfg():
+    import jax.numpy as jnp
+
+    from agilerl_tpu.llm.model import GPTConfig
+    from agilerl_tpu.utils.llm_utils import CharTokenizer
+
+    tok = CharTokenizer()
+    return tok, GPTConfig(vocab_size=tok.vocab_size, n_layer=2, n_head=4,
+                          n_kv_head=2, d_model=64, max_seq_len=64,
+                          dtype=jnp.float32)
+
+
+def _grpo_batch(agent, tok):
+    from agilerl_tpu.utils.llm_utils import ReasoningGym
+
+    rng = np.random.default_rng(0)
+    rows = [{"question": f"{int(a)}+{int(b)}=", "answer": str(int(a + b))}
+            for a, b in rng.integers(0, 5, (24, 2))]
+    env = ReasoningGym(rows, rows[:8], tok,
+                       reward_fn=lambda c, a, p: float(c.strip().startswith(a)),
+                       data_batch_size=4)
+    prompts = env.reset()
+    comp, cmask = agent.get_action(prompts)
+    ids, action_masks = env.assemble_learn_batch(comp, cmask)
+    _, rewards = env.step(comp, cmask)
+    return (ids, action_masks, rewards)
+
+
+def test_grpo_save_load_clone_mutate_learn_chain(tmp_path):
+    from agilerl_tpu.algorithms.grpo import GRPO
+
+    tok, cfg = _llm_cfg()
+    agent = GRPO(config=cfg, pad_token_id=tok.pad_token_id,
+                 eos_token_id=tok.eos_token_id, group_size=4, batch_size=8,
+                 max_output_tokens=4, lr=1e-3, seed=0)
+    batch = _grpo_batch(agent, tok)
+    assert np.isfinite(agent.learn(batch)[0])
+
+    p = tmp_path / "grpo.ckpt"
+    agent.save_checkpoint(p)
+    loaded = GRPO.load(p)
+    _params_equal(agent.actor.params, loaded.actor.params)
+
+    clone = loaded.clone(index=2)
+    mutated = make_muts(rl_hp=1.0).rl_hyperparam_mutation(clone)
+    assert mutated.mut is not None
+    assert np.isfinite(mutated.learn(batch)[0])
+    # the pre-mutation lineage is untouched
+    _params_equal(agent.actor.params, loaded.actor.params)
+
+    p2 = tmp_path / "grpo2.ckpt"
+    mutated.save_checkpoint(p2)
+    reloaded = GRPO.load(p2)
+    _params_equal(mutated.actor.params, reloaded.actor.params)
+
+
+def test_dpo_save_load_clone_mutate_learn_chain(tmp_path):
+    from agilerl_tpu.algorithms.dpo import DPO
+    from agilerl_tpu.utils.llm_utils import PreferenceGym
+
+    tok, cfg = _llm_cfg()
+    rng = np.random.default_rng(0)
+    rows = [{"prompt": f"{int(a)}+1=", "chosen": str(int(a) + 1),
+             "rejected": str(int(a))} for a in rng.integers(0, 5, 16)]
+    env = PreferenceGym(rows, rows[:8], tok, data_batch_size=8)
+    batch = env.reset()
+    agent = DPO(config=cfg, pad_token_id=tok.pad_token_id,
+                eos_token_id=tok.eos_token_id, lr=5e-3, beta=0.5, seed=0)
+    assert np.isfinite(agent.learn(batch)[0])
+
+    p = tmp_path / "dpo.ckpt"
+    agent.save_checkpoint(p)
+    loaded = DPO.load(p)
+    _params_equal(agent.actor.params, loaded.actor.params)
+
+    mutated = make_muts(rl_hp=1.0).rl_hyperparam_mutation(loaded.clone(index=1))
+    assert mutated.mut is not None
+    assert np.isfinite(mutated.learn(batch)[0])
+
+    p2 = tmp_path / "dpo2.ckpt"
+    mutated.save_checkpoint(p2)
+    _params_equal(mutated.actor.params, DPO.load(p2).actor.params)
+
+
+@pytest.mark.parametrize("algo_name", ["ilql", "bc_lm"])
+def test_legacy_language_rl_chain(algo_name, tmp_path):
+    from agilerl_tpu.algorithms.ilql import BC_LM, ILQL
+    from agilerl_tpu.data.rl_data import Language_Observation, RL_Dataset
+    from agilerl_tpu.utils.llm_utils import CharTokenizer
+
+    tok = CharTokenizer()
+    import jax.numpy as jnp
+
+    from agilerl_tpu.llm.model import GPTConfig
+
+    cfg = GPTConfig(vocab_size=tok.vocab_size, n_layer=2, n_head=4,
+                    d_model=64, max_seq_len=32, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    obs = []
+    for _ in range(24):
+        a = int(rng.integers(0, 5))
+        good = rng.random() < 0.5
+        obs.append(Language_Observation(sequence=[
+            (f"{a}+1=", None),
+            (str(a + 1) if good else str(a), 1.0 if good else -1.0)]))
+    ds = RL_Dataset(obs, tok, max_len=8)
+    cls = ILQL if algo_name == "ilql" else BC_LM
+    agent = cls(config=cfg, lr=1e-3, seed=0)
+    assert np.isfinite(agent.learn(ds.sample_batch(8, rng)))
+
+    p = tmp_path / f"{algo_name}.ckpt"
+    agent.save_checkpoint(p)
+    loaded = cls.load(p)
+    _params_equal(agent.actor.params, loaded.actor.params)
+
+    mutated = make_muts(rl_hp=1.0).rl_hyperparam_mutation(loaded.clone(index=3))
+    assert mutated.mut is not None
+    assert np.isfinite(mutated.learn(ds.sample_batch(8, rng)))
+
+    p2 = tmp_path / f"{algo_name}2.ckpt"
+    mutated.save_checkpoint(p2)
+    _params_equal(mutated.actor.params, cls.load(p2).actor.params)
