@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, NamedTuple, Tuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -215,7 +217,7 @@ class EvoDQN:
         return pop._replace(params=new_params, target=new_target, opt_state=new_opt)
 
     def make_vmap_generation(self) -> Callable:
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def generation(pop: DQNMemberState, key: jax.Array):
             pop, fitness = jax.vmap(self.member_iteration)(pop)
             pop = self.evolve(pop, fitness, key)
@@ -262,4 +264,4 @@ class EvoDQN:
                 check_vma=False,
             )(pop, key)
 
-        return jax.jit(gen)
+        return jax.jit(gen, donate_argnums=(0,))
