@@ -330,6 +330,40 @@ def _run_kernel_validation(timeout_s: float):
             "log": logpath, "summary": summary or None}
 
 
+def _tpu_aot_summary():
+    """Compact summary of the committed compile-only TPU AOT report — every
+    program here was compiled by the real XLA:TPU + Mosaic pipeline (no
+    chip; libtpu topologies), so a CPU-fallback bench line still records
+    hardware-compiler evidence for the kernel + 7B tier."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarking", "tpu_aot_report.json")
+    try:
+        with open(path) as fh:
+            rep = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    targets = rep.get("targets", {})
+    if not targets:
+        return None
+    ok = [n for n, t in targets.items() if t.get("ok")]
+    out = {
+        "device_kind": rep.get("device_kind"),
+        "targets_ok": f"{len(ok)}/{len(targets)}",
+        "ok": sorted(ok),
+    }
+    pod = targets.get("grpo_7b_flash") or targets.get("grpo_7b_gspmd")
+    if pod and pod.get("ok"):
+        out["pod_7b"] = {
+            "topology": pod.get("topology"),
+            "mesh": pod.get("mesh"),
+            "compile_seconds": pod.get("compile_seconds"),
+            "pflops_per_step": round(
+                pod.get("flops", 0.0) * pod.get("n_devices", 0) / 1e15, 2),
+            "fingerprint": (pod.get("fingerprint_sha256") or "")[:16],
+        }
+    return out
+
+
 def _playbook_captured(mode: str):
     """A TPU headline previously captured by the up-window playbook
     (.tpu_results/playbook_progress.json), or None. Preferred over a fresh
@@ -468,6 +502,12 @@ def parent_main():
     if result is not None:
         if errors:
             result["error"] = "; ".join(errors)
+        aot = _tpu_aot_summary()
+        if aot is not None:
+            # even when the pool is down, the record carries the REAL TPU
+            # compiler's verdict on our programs (compile-only topologies,
+            # benchmarking/tpu_aot_compile.py)
+            result["tpu_aot_compile"] = aot
         print(json.dumps(result), flush=True)
         return 0
     errors.append(f"cpu attempt: {err}")
