@@ -60,6 +60,13 @@ class GPTConfig:
     # from compile-only PJRT clients). None = plain call (single chip, or
     # runtime GSPMD via the kernel's custom partitioning).
     flash_shard_axes: Any = None
+    # (batch axes...) for the fused lm-head loss kernel: rows shard over
+    # these axes inside a shard_map, the head stays replicated per shard,
+    # and shard_map's transpose psums the dW cotangent automatically. The
+    # right mode for fsdp-only meshes; on tp-sharded pods prefer the
+    # chunked XLA loss (make_update_fn use_fused_loss=False) — a
+    # vocab-sharded softmax is XLA's game.
+    fused_loss_shard_axes: Any = None
     # Mixture-of-Experts (beyond reference parity — completes the ep axis of
     # the dp/fsdp/tp/sp/ep strategy menu, SURVEY.md §2.8):
     n_experts: int = 0  # 0 = dense FFN everywhere
@@ -407,11 +414,11 @@ def forward(
     return h, new_caches
 
 
-def _flash_mesh(config: GPTConfig):
-    """The active mesh for the flash shard_map wrap, or None. Reads the
+def _shard_mesh(axes):
+    """The active mesh for a kernel shard_map wrap, or None. Reads the
     `with mesh:` trace-time context (the pattern every sharded program in
     this repo uses for lowering) and falls back to the abstract mesh."""
-    if config.flash_shard_axes is None:
+    if axes is None:
         return None
     from jax._src import mesh as _mesh_lib
 
@@ -422,6 +429,10 @@ def _flash_mesh(config: GPTConfig):
     if am is not None and am.axis_names:
         return am
     return None
+
+
+def _flash_mesh(config: GPTConfig):
+    return _shard_mesh(config.flash_shard_axes)
 
 
 def _axes_in_mesh(axes, mesh):
@@ -543,7 +554,29 @@ def token_logprobs(
         B, T, D = hidden.shape
         flat_h = hidden[:, :-1].reshape(-1, D)
         flat_t = tokens[:, 1:].reshape(-1)
-        lp = fused_token_logprob_diff(flat_h, head, flat_t, temperature)
+        smesh = _shard_mesh(getattr(config, "fused_loss_shard_axes", None))
+        bspec = (_axes_in_mesh(config.fused_loss_shard_axes, smesh)
+                 if smesh is not None else None)
+        if bspec is not None:
+            n_shards = int(np.prod([smesh.shape[a] for a in bspec]))
+            if flat_h.shape[0] % n_shards:
+                bspec = None  # rows don't tile the axes: plain call
+        if bspec is not None:
+            # rows shard over the batch axes; the replicated head's dW
+            # cotangent is psummed by shard_map's transpose rule
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            lp = shard_map(
+                lambda hh, ww, tt: fused_token_logprob_diff(
+                    hh, ww, tt, temperature),
+                mesh=smesh,
+                in_specs=(P(bspec, None), P(None, None), P(bspec)),
+                out_specs=P(bspec),
+                check_vma=False,
+            )(flat_h, head, flat_t)
+        else:
+            lp = fused_token_logprob_diff(flat_h, head, flat_t, temperature)
         return lp.reshape(B, T - 1)
     hidden = hidden[:, :-1]  # predict next token
     targets = tokens[:, 1:]

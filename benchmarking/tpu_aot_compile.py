@@ -365,6 +365,61 @@ def main(argv=None):
     run("grpo_7b_gspmd", lambda: _pod_target(use_flash=False))
     run("grpo_7b_flash", lambda: _pod_target(use_flash=True))
 
+    # fsdp-only mesh with the FULL Pallas tier on: flash (shard_map over
+    # batch x heads) AND the row-sharded fused loss (shard_map over batch,
+    # dW cotangent psummed by the transpose) — the single-slice recipe
+    def grpo_fsdp_fused():
+        n = len(topo.devices)
+        mesh = make_mesh(dp=1, fsdp=n, tp=1, devices=list(topo.devices))
+        cfg = Mod.GPTConfig(
+            vocab_size=32768, n_layer=4, n_head=8, n_kv_head=4,
+            d_model=512, d_ff=1408, max_seq_len=512,
+            use_flash_attention=True,
+            flash_shard_axes=(("dp", "fsdp"), "tp"),
+            fused_loss_shard_axes=("dp", "fsdp"))
+        Bt, Tt = (n, 128) if args.quick else (2 * n, 512)
+        opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
+
+        def abstract(shapes, specs=None):
+            if specs is None:
+                return jax.tree_util.tree_map(
+                    lambda l: jax.ShapeDtypeStruct(
+                        l.shape, l.dtype,
+                        sharding=NamedSharding(mesh, P())), shapes)
+            return jax.tree_util.tree_map(
+                lambda l, sp: jax.ShapeDtypeStruct(
+                    l.shape, l.dtype,
+                    sharding=NamedSharding(mesh, filter_spec(sp, mesh))),
+                shapes, specs, is_leaf=lambda x: isinstance(x, P))
+
+        base_shapes = jax.eval_shape(lambda k: Mod.init_params(k, cfg),
+                                     jax.random.PRNGKey(0))
+        lora_shapes = jax.eval_shape(lambda k: Mod.init_lora(k, cfg, 8),
+                                     jax.random.PRNGKey(0))
+        base_abs = abstract(base_shapes, gpt_param_specs(cfg))
+        lora_abs = abstract(lora_shapes, lora_specs(lora_shapes))
+        opt_abs = abstract(jax.eval_shape(opt.tx.init, lora_shapes))
+        bspec = NamedSharding(mesh, P(("dp", "fsdp")))
+        batch_abs = {
+            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
+            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
+            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
+            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
+            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
+            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32, sharding=bspec),
+        }
+        scalar = jax.ShapeDtypeStruct((), jnp.float32)
+        update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=True,
+                                use_fused_loss=True)
+        with mesh:
+            rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
+                                    scalar, scalar), args.topology, n)
+        rec["mesh"] = f"fsdp{n}"
+        rec["batch"], rec["seq"] = Bt, Tt
+        return rec
+
+    run("grpo_fsdp_fused", grpo_fsdp_fused)
+
     prefix = args.write or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tpu_aot_report")
     with open(prefix + ".json", "w") as fh:
