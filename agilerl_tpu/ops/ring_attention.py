@@ -64,18 +64,21 @@ def ring_attention(
             jnp.broadcast_to(m_blk[:, None, :].astype(bool), (B, T, T))
             if m_blk is not None else None
         )
-        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, pad_mask, scale)
         if causal:
-            diag_mask = intra_causal[None, :, :]
-            if pad_mask is not None:
-                diag_mask = jnp.logical_and(diag_mask, pad_mask)
-            o_diag, m_diag, l_diag = _block_attn(q, k_blk, v_blk, diag_mask, scale)
+            # select the MASK per ring offset (diagonal block: causal-within;
+            # earlier block: full) instead of computing the block attention
+            # twice and selecting outputs — halves every causal ring step
             same = src_idx == my_idx
             after = src_idx > my_idx
-            o_b = jnp.where(same, o_diag, o_b)
-            m_b = jnp.where(same, m_diag, m_b)
-            l_b = jnp.where(same, l_diag, l_b)
-            # mask out blocks from the future entirely
+            eff_mask = jnp.where(same, intra_causal[None, :, :], True)
+            if pad_mask is not None:
+                eff_mask = jnp.logical_and(eff_mask, pad_mask)
+        else:
+            eff_mask = pad_mask
+        o_b, m_b, l_b = _block_attn(q, k_blk, v_blk, eff_mask, scale)
+        if causal:
+            # future blocks contribute nothing — explicit overrides (an
+            # all-masked score block would otherwise yield p=1 rows)
             m_b = jnp.where(after, -1e30, m_b)
             l_b = jnp.where(after, 0.0, l_b)
             o_b = jnp.where(after, 0.0, o_b)
