@@ -399,8 +399,52 @@ def _bwd_rule(causal, block_q, block_k, interpret, spmd, res, do):
     return dq, dk, dv, None
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_with_lse(
+    q: jax.Array,  # [B, H, T, d]
+    k: jax.Array,
+    v: jax.Array,
+    padding_mask: Optional[jax.Array] = None,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning (out [B,H,T,d], lse [B,H,T]) — BOTH
+    differentiable. The lse output is what lets callers merge partial
+    attentions online (ring attention's per-block path, ops/ring_attention
+    .py): o = sum_b o_b * exp(lse_b - lse_total). The backward folds the
+    lse cotangent into the FlashAttention-2 dd term: dS gains p * dlse,
+    and since dS = p * (dOV^T - dd), that is exactly dd -> dd - dlse.
+    Direct (non-custom_partitioning) kernels: built for use INSIDE
+    shard_map."""
+    out, lse4 = _fwd(q, k, v, padding_mask, causal, block_q, block_k,
+                     resolve_interpret(interpret))
+    T = q.shape[2]
+    return out, lse4[:, :, :T, 0]
+
+
+def _with_lse_fwd(q, k, v, padding_mask, causal, block_q, block_k, interpret):
+    out, lse4 = _fwd(q, k, v, padding_mask, causal, block_q, block_k,
+                     resolve_interpret(interpret))
+    T = q.shape[2]
+    return (out, lse4[:, :, :T, 0]), (q, k, v, padding_mask, out, lse4)
+
+
+def _with_lse_bwd(causal, block_q, block_k, interpret, res, cts):
+    q, k, v, padding_mask, out, lse4 = res
+    do, dlse = cts
+    dq, dk, dv = _bwd_arrays(q, k, v, do, out, lse4, padding_mask, causal,
+                             block_q, block_k, resolve_interpret(interpret),
+                             dlse=dlse)
+    return dq, dk, dv, None
+
+
+flash_attention_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)
+
+
 def _bwd_arrays(q, k, v, do, out, lse, padding_mask, causal, block_q,
-                block_k, interpret):
+                block_k, interpret, dlse=None):
     interpret = resolve_interpret(interpret)
     B, H, T, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -412,8 +456,11 @@ def _bwd_arrays(q, k, v, do, out, lse, padding_mask, causal, block_q,
     vf = _pad_t(v, pad).reshape(bh, Tp, d)
     dof = _pad_t(do, pad).reshape(bh, Tp, d)
     lse = lse.reshape(bh, Tp, 1)  # arrives [B, H, Tp, 1] (partition layout)
-    # D_i = rowsum(dO * O); dd sublane-oriented like lse
+    # D_i = rowsum(dO * O); dd sublane-oriented like lse. An lse cotangent
+    # (flash_attention_with_lse) enters as dS += p * dlse == dd -= dlse.
     dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        dd = dd - dlse.astype(jnp.float32)
     dd = jnp.pad(dd, ((0, 0), (0, 0), (0, pad))).reshape(bh, Tp, 1)
     with_mask = padding_mask is not None
     mask_args = []

@@ -38,6 +38,66 @@ def _block_attn(q, k, v, mask, scale):
     return o, jnp.moveaxis(m, 1, 2), jnp.moveaxis(l, 1, 2)  # m,l -> [B, Tq, H]
 
 
+def _ring_flash(q, k, v, axis_name, causal, kv_mask, block_q, block_k):
+    """Ring attention with the Pallas flash kernel as the per-block engine:
+    the [T_local, T_local] score matrix never materialises in HBM (online
+    softmax in VMEM), so per-chip attention memory is O(block^2) instead of
+    O(T_local^2). Each ring offset picks the right kernel via lax.switch
+    (earlier block: full attention; diagonal: causal; future: skip), and
+    partial results merge by logsumexp — flash_attention_with_lse's lse
+    output is differentiable, so this path serves training too."""
+    from agilerl_tpu.ops.flash_attention_vjp import flash_attention_with_lse
+
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    qh = jnp.moveaxis(q, 2, 1)  # [B, H, T, d]
+
+    def step(carry, i):
+        k_blk, v_blk, m_blk, o_acc, lse_acc = carry
+        src_idx = (my_idx - i) % p_size
+        kh = jnp.moveaxis(k_blk, 2, 1)
+        vh = jnp.moveaxis(v_blk, 2, 1)
+
+        def past(_):
+            return flash_attention_with_lse(
+                qh, kh, vh, m_blk, False, block_q, block_k)
+
+        def diag(_):
+            return flash_attention_with_lse(
+                qh, kh, vh, m_blk, True, block_q, block_k)
+
+        def future(_):
+            return (jnp.zeros_like(qh),
+                    jnp.zeros(qh.shape[:3], jnp.float32) - 1e30)
+
+        if causal:
+            idx = (jnp.where(src_idx == my_idx, 1, 0)
+                   + jnp.where(src_idx > my_idx, 2, 0))
+            o_b, lse_b = lax.switch(idx, [past, diag, future], None)
+        else:
+            o_b, lse_b = past(None)
+
+        # merge normalized partials by logsumexp weight
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_b = jnp.exp(lse_b - lse_new)[..., None]
+        o_new = o_acc * w_acc + o_b.astype(o_acc.dtype) * w_b
+
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        m_next = (
+            lax.ppermute(m_blk, axis_name, perm) if m_blk is not None else None
+        )
+        return (k_next, v_next, m_next, o_new, lse_new), None
+
+    o0 = qh.astype(jnp.float32) * 0.0
+    lse0 = jnp.sum(o0, axis=-1) - 1e30
+    (_, _, _, o, _), _ = lax.scan(
+        step, (k, v, kv_mask, o0, lse0), jnp.arange(p_size))
+    return jnp.moveaxis(o, 1, 2).astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,  # [B, T_local, H, d] — local sequence shard
     k: jax.Array,
@@ -46,8 +106,16 @@ def ring_attention(
     causal: bool = True,
     kv_mask: Optional[jax.Array] = None,  # [B, T_local] 1 = real token; the
     # mask ROTATES around the ring with its k/v block (ragged/right-padded seqs)
+    use_flash: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
 ) -> jax.Array:
-    """Call INSIDE shard_map with q/k/v sharded on the sequence axis."""
+    """Call INSIDE shard_map with q/k/v sharded on the sequence axis.
+    ``use_flash=True`` swaps the per-block engine for the Pallas flash
+    kernel (O(block^2) VMEM instead of O(T_local^2) HBM scores)."""
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, kv_mask,
+                           block_q, block_k)
     p_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     B, T, H, d = q.shape
@@ -111,26 +179,30 @@ def ring_attention(
 
 
 def make_ring_attention(
-    mesh: Mesh, axis_name: str = "sp", causal: bool = True, with_mask: bool = False
+    mesh: Mesh, axis_name: str = "sp", causal: bool = True,
+    with_mask: bool = False, use_flash: bool = False,
 ):
     """Wrap ring_attention in shard_map: takes [B, T, H, d] arrays sharded on T
     (+ an optional [B, T] kv padding mask when with_mask=True)."""
 
     spec = P(None, axis_name, None, None)
     mspec = P(None, axis_name)
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                           use_flash=use_flash)
     if with_mask:
-        fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
-
         def wrapped(q, k, v, m):
             return fn(q, k, v, kv_mask=m)
 
         return jax.jit(
             shard_map(wrapped, mesh=mesh, in_specs=(spec, spec, spec, mspec),
-                      out_specs=spec)
+                      out_specs=spec, check_vma=False)
         )
-    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    # check_vma=False: pallas_call out_shapes carry no vma annotations (the
+    # flash per-block engine); collective correctness is covered by the
+    # dense-reference parity tests
     return jax.jit(
-        shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                  check_vma=False)
     )
 
 

@@ -420,6 +420,29 @@ def main(argv=None):
 
     run("grpo_fsdp_fused", grpo_fsdp_fused)
 
+    # ring attention with the Pallas per-block engine over an sp axis:
+    # shard_map + ppermute + flash_attention_with_lse compile for TPU
+    def ring_flash():
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from agilerl_tpu.ops.ring_attention import make_ring_attention
+
+        n = len(topo.devices)
+        mesh = Mesh(np.array(topo.devices), ("sp",))
+        B, T, Hh, dd = (2, 64 * n, 4, 64) if args.quick else (4, 512 * n, 8, 128)
+        ring = make_ring_attention(mesh, causal=True, use_flash=True)
+        spec = NamedSharding(mesh, P(None, "sp", None, None))
+        x = jax.ShapeDtypeStruct((B, T, Hh, dd), jnp.bfloat16, sharding=spec)
+
+        def loss(q, k, v):
+            return (ring(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        with mesh:
+            return _compile(jax.jit(jax.grad(loss, argnums=(0, 1, 2))),
+                            (x, x, x), args.topology, n)
+
+    run("ring_flash", ring_flash)
+
     prefix = args.write or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tpu_aot_report")
     with open(prefix + ".json", "w") as fh:
