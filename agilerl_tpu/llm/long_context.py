@@ -48,9 +48,14 @@ def _block_sp(config: GPTConfig, blk, lora_layer, h, positions, axis_name, lora_
     if rep > 1:
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    # use_flash_attention routes the per-block engine through the Pallas
+    # flash kernel (flash_attention_with_lse + logsumexp merge): the
+    # [T_local, T_local] scores never hit HBM, which is the memory ceiling
+    # for long-context sp training
     attn = ring_attention(
         q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
         axis_name=axis_name, causal=True,
+        use_flash=config.use_flash_attention,
     ).astype(dtype)
     attn = attn.reshape(B, T, config.n_head * config.head_dim)
     h = h + _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
