@@ -21,6 +21,10 @@ import os
 import sys
 import time
 
+# invoked by absolute path from the playbook: sys.path[0] is benchmarking/,
+# not the repo root, so the package import needs an explicit root insert
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main():
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":

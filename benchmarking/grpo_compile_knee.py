@@ -1,0 +1,67 @@
+"""Diagnose the GRPO learn-step compile knee on the live chip.
+
+The first on-chip window showed bench_grpo's 12-layer compile exceeding the
+900s playbook deadline while EvoPPO compiled in 35s. Hypotheses:
+  (a) unrolled layer loop => HLO size ~ n_layer => compile ~ n_layer;
+  (b) the Pallas fused loss embedded in the full backward graph;
+  (c) something pathological independent of both.
+
+For each cell: time the FIRST agent.learn call (compiles the logprob program
+and the update program, then executes) and a SECOND call (execute only);
+compile cost ~= first - second. One JSON line per cell, flushed immediately,
+so a timeout still keeps earlier cells.
+
+Run: python benchmarking/grpo_compile_knee.py [cells...]
+  cell syntax: <n_layer>:<fused 0|1>   e.g.  2:1 2:0 4:1
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.llm import model as M
+
+    cells = sys.argv[1:] or ["2:1", "2:0", "4:1"]
+    B, T = 16, 512
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 31_000, size=(B, T)).astype(np.int32))
+    loss_mask = np.zeros((B, T - 1), np.float32)
+    loss_mask[:, T // 2:] = 1.0
+    rewards = rng.normal(size=(B // 4, 4)).astype(np.float32)
+    exp = (ids, jnp.asarray(loss_mask), jnp.asarray(rewards))
+
+    for cell in cells:
+        n_layer, fused = (int(x) for x in cell.split(":"))
+        cfg = M.GPTConfig(
+            vocab_size=32_000, n_layer=n_layer, n_head=12, d_model=768,
+            max_seq_len=T, use_fused_loss=bool(fused),
+        )
+        agent = GRPO(config=cfg, pad_token_id=0, eos_token_id=1,
+                     group_size=4, batch_size=B, seed=0)
+        t0 = time.perf_counter()
+        agent.learn(exp)
+        first_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        agent.learn(exp)
+        second_s = time.perf_counter() - t0
+        print(json.dumps({
+            "n_layer": n_layer, "fused_loss": bool(fused), "B": B, "T": T,
+            "first_learn_s": round(first_s, 1),
+            "second_learn_s": round(second_s, 2),
+            "compile_s_approx": round(first_s - second_s, 1),
+            "backend": jax.default_backend(),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
