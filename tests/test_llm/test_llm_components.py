@@ -69,6 +69,84 @@ class TestLoRA:
         )
 
 
+class TestScanLayers:
+    """scan-over-layers (model.py _scannable/forward): the non-cached paths
+    roll the layer stack into one lax.scan — HLO and TPU compile time become
+    ~constant in n_layer (measured via compile-only AOT: 12-layer GRPO update
+    83.5s unrolled vs 48.6s scanned, stablehlo halved). These pin that the
+    rolled program is the same function as the unrolled one."""
+
+    def _unrolled(self, monkeypatch, fn):
+        monkeypatch.setenv("AGILERL_TPU_DISABLE_SCAN_LAYERS", "1")
+        out = fn()
+        monkeypatch.delenv("AGILERL_TPU_DISABLE_SCAN_LAYERS")
+        return out
+
+    def test_forward_parity(self, monkeypatch):
+        cfg = dataclasses.replace(CFG, n_layer=3)
+        params = M.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.arange(1, 17)[None] % 64
+        scanned, _ = M.apply(cfg, params, toks)
+        unrolled, _ = self._unrolled(
+            monkeypatch, lambda: M.apply(cfg, params, toks))
+        np.testing.assert_allclose(
+            np.asarray(scanned), np.asarray(unrolled), atol=1e-5)
+
+    def test_lora_grad_parity(self, monkeypatch):
+        cfg = dataclasses.replace(CFG, n_layer=3)
+        params = M.init_params(jax.random.PRNGKey(0), cfg)
+        lora = M.init_lora(jax.random.PRNGKey(1), cfg, rank=4)
+        toks = jnp.arange(1, 17)[None] % 64
+
+        def loss(lo):
+            h, _ = M.forward(cfg, params, toks, lora=lo)
+            return jnp.sum(h * h)
+
+        g_scan = jax.grad(loss)(lora)
+        g_unroll = self._unrolled(monkeypatch, lambda: jax.grad(loss)(lora))
+        for a, b in zip(jax.tree_util.tree_leaves(g_scan),
+                        jax.tree_util.tree_leaves(g_unroll)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_remat_scan_grad_runs(self):
+        cfg = dataclasses.replace(CFG, n_layer=3, remat=True)
+        params = M.init_params(jax.random.PRNGKey(0), cfg)
+        lora = M.init_lora(jax.random.PRNGKey(1), cfg, rank=4)
+        toks = jnp.arange(1, 17)[None] % 64
+        g = jax.grad(
+            lambda lo: jnp.sum(M.forward(cfg, params, toks, lora=lo)[0] ** 2)
+        )(lora)
+        assert all(bool(jnp.isfinite(x).all())
+                   for x in jax.tree_util.tree_leaves(g))
+
+    def test_moe_uniform_scans_interleaved_falls_back(self, monkeypatch):
+        # uniform MoE stack: scannable, parity vs unrolled
+        cfg = dataclasses.replace(CFG, n_layer=2, n_experts=4)
+        params = M.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.arange(1, 17)[None] % 64
+        h1, _, aux1 = M.forward(cfg, params, toks, return_aux=True)
+        h2, _, aux2 = self._unrolled(
+            monkeypatch, lambda: M.forward(cfg, params, toks, return_aux=True))
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), atol=1e-5)
+        np.testing.assert_allclose(float(aux1), float(aux2), atol=1e-6)
+        # interleaved dense/MoE: _scannable must refuse (structures differ)
+        icfg = dataclasses.replace(CFG, n_layer=2, n_experts=4, moe_every=2)
+        ip = M.init_params(jax.random.PRNGKey(0), icfg)
+        blocks = [ip["blocks"][str(i)] for i in range(2)]
+        assert not M._scannable(icfg, blocks, [None, None])
+        h3, _ = M.forward(icfg, ip, toks)  # and forward still works
+        assert h3.shape == (1, 16, 64)
+
+    def test_cached_decode_path_unchanged(self):
+        # cache != None must keep the unrolled per-layer cache dict
+        params = M.init_params(jax.random.PRNGKey(0), CFG)
+        cache = M.init_caches(CFG, 1, 32)
+        toks = jnp.arange(1, 9)[None]
+        h, new_caches = M.forward(CFG, params, toks, cache=cache)
+        assert set(new_caches) == {"0", "1"}
+        assert int(new_caches["0"].length) == 8
+
+
 class TestTokenizerAndGym:
     def test_char_tokenizer_roundtrip(self):
         tok = CharTokenizer()
