@@ -73,7 +73,8 @@ def _fingerprint(compiled) -> str:
     return hashlib.sha256(raw).hexdigest()
 
 
-def _record(compiled, lowered, t_lower, t_compile, topology, n_devices):
+def _record(compiled, lowered, t_lower, t_compile, topology, n_devices,
+            analytic_flops=None):
     cost = compiled.cost_analysis() or {}
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
@@ -83,10 +84,16 @@ def _record(compiled, lowered, t_lower, t_compile, topology, n_devices):
         "n_devices": n_devices,
         "lower_seconds": round(t_lower, 1),
         "compile_seconds": round(t_compile, 1),
+        # XLA cost analysis counts a lax.scan body ONCE: with
+        # scan-over-layers (llm/model.py) this under-reports model targets
+        # ~n_layer-fold. flops_analytic (PaLM-style 6N+attention accounting,
+        # utils/profiling.py) is the faithful per-step total for those.
         "flops": float(cost.get("flops", 0.0)),
         "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
         "fingerprint_sha256": _fingerprint(compiled),
     }
+    if analytic_flops is not None:
+        rec["flops_analytic"] = float(analytic_flops)
     mem = compiled.memory_analysis()
     if mem is not None:
         rec.update(
@@ -98,14 +105,15 @@ def _record(compiled, lowered, t_lower, t_compile, topology, n_devices):
     return rec
 
 
-def _compile(fn, args, topology, n_devices, kwargs=None):
+def _compile(fn, args, topology, n_devices, kwargs=None, analytic_flops=None):
     t0 = time.time()
     lowered = fn.lower(*args, **(kwargs or {}))
     t_lower = time.time() - t0
     t0 = time.time()
     compiled = lowered.compile()
     t_compile = time.time() - t0
-    return _record(compiled, lowered, t_lower, t_compile, topology, n_devices)
+    return _record(compiled, lowered, t_lower, t_compile, topology, n_devices,
+                   analytic_flops=analytic_flops)
 
 
 def main(argv=None):
@@ -291,8 +299,11 @@ def main(argv=None):
         }
         scalar = jax.ShapeDtypeStruct((), jnp.float32, sharding=s1)
         update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=True)
+        from agilerl_tpu.utils.profiling import transformer_flops_per_token
         return _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
-                                 scalar, scalar), args.topology, 1)
+                                 scalar, scalar), args.topology, 1,
+                        analytic_flops=(transformer_flops_per_token(cfg)
+                                        * Bt * Tt))
 
     run("grpo_step_small", grpo_step_small)
 
@@ -355,9 +366,12 @@ def main(argv=None):
         # tp-sharded path — see make_update_fn's use_fused_loss note
         update = make_update_fn(cfg, opt.tx, lora_scale=2.0,
                                 use_flash=use_flash, use_fused_loss=False)
+        from agilerl_tpu.utils.profiling import transformer_flops_per_token
         with mesh:
             rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
-                                    scalar, scalar), args.pod, n)
+                                    scalar, scalar), args.pod, n,
+                           analytic_flops=(transformer_flops_per_token(cfg)
+                                           * Bt * Tt))
         rec["mesh"] = f"fsdp{fsdp}xtp{tp}"
         rec["batch"], rec["seq"] = Bt, Tt
         return rec
@@ -411,9 +425,12 @@ def main(argv=None):
         scalar = jax.ShapeDtypeStruct((), jnp.float32)
         update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=True,
                                 use_fused_loss=True)
+        from agilerl_tpu.utils.profiling import transformer_flops_per_token
         with mesh:
             rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
-                                    scalar, scalar), args.topology, n)
+                                    scalar, scalar), args.topology, n,
+                           analytic_flops=(transformer_flops_per_token(cfg)
+                                           * Bt * Tt))
         rec["mesh"] = f"fsdp{n}"
         rec["batch"], rec["seq"] = Bt, Tt
         return rec
