@@ -133,7 +133,8 @@ def _async_worker(index, env_fn, pipe, parent_pipe, shm, agents, spaces_by_agent
         while True:
             cmd, data = pipe.recv()
             if cmd == "reset":
-                obs, info = env.reset(seed=data)
+                seed, options = data
+                obs, info = env.reset(seed=seed, options=options)
                 write_obs(obs)
                 pipe.send((({a: info.get(a, {}) for a in agents}
                             if isinstance(info, dict) else {}), True))
@@ -246,7 +247,8 @@ class AsyncPettingZooVecEnv:
                 f"(state={self._state.name})"
             )
         for i, pipe in enumerate(self._pipes):
-            pipe.send(("reset", None if seed is None else seed + i))
+            pipe.send(("reset",
+                       (None if seed is None else seed + i, options)))
         results = [pipe.recv() for pipe in self._pipes]
         self._raise_if_errors(results)
         infos = [r for r, ok in results]

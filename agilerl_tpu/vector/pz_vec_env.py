@@ -32,16 +32,39 @@ class PettingZooVecEnv:
     def action_space(self, agent: str):
         return self.action_spaces[agent]
 
+    def _stack_obs(self, obs_list):
+        """Stack per-env obs dicts leaf-wise so Dict/Tuple spaces keep their
+        structure and every leaf keeps its own dtype (uint8 images, bool
+        flags) — flat np.stack over dicts yields object arrays. Missing
+        agents get NaN/zero placeholders (same convention as the async
+        worker's write_obs)."""
+        from agilerl_tpu.vector.pz_async_vec_env import (
+            _obs_leaves, _rebuild_obs, _space_leaves, placeholder_obs,
+        )
+
+        out = {}
+        for a in self.agents:
+            space = self.observation_spaces[a]
+            rows = [
+                _obs_leaves(space, o[a]) if isinstance(o, dict) and a in o
+                and o[a] is not None else _obs_leaves(space, placeholder_obs(space))
+                for o in obs_list
+            ]
+            leaves = [
+                np.stack([np.asarray(r[li], dtype).reshape(shape)
+                          for r in rows])
+                for li, (key, dtype, shape) in enumerate(_space_leaves(space))
+            ]
+            out[a] = _rebuild_obs(space, leaves)
+        return out
+
     def reset(self, seed: Optional[int] = None, options=None):
         obs_list, info_list = [], []
         for i, e in enumerate(self.envs):
             obs, info = e.reset(seed=None if seed is None else seed + i, options=options)
             obs_list.append(obs)
             info_list.append(info)
-        stacked = {
-            a: np.stack([o.get(a) for o in obs_list]) for a in self.agents
-        }
-        return stacked, {}
+        return self._stack_obs(obs_list), {}
 
     def step_async(self, actions: Dict[str, np.ndarray]) -> None:
         self._actions = actions
@@ -72,7 +95,8 @@ class PettingZooVecEnv:
                 for a in self.agents
             }
 
-        return stack(obs_l), stack(rew_l), stack(term_l, False), stack(trunc_l, False), {}
+        return (self._stack_obs(obs_l), stack(rew_l), stack(term_l, False),
+                stack(trunc_l, False), {})
 
     def step(self, actions):
         self.step_async(actions)
