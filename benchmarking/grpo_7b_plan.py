@@ -455,25 +455,37 @@ def _render_scenarios_md(results, aot):
         and aot.get("n_devices") == rep["devices"]
     )
     if aot_matches:
-        # The AOT harness compiles the PRODUCTION (scan-over-layers) program,
-        # whose cost analysis counts the layer-scan body once; its
-        # flops_analytic field (PaLM 6N+attention accounting) is the faithful
-        # per-step total to compare against this document's unrolled-lowering
-        # cost analysis — two independent accountings of the same step.
+        # The AOT harness compiles the PRODUCTION (scan-over-layers) program.
+        # Its raw cost analysis counts the layer-scan body once, so the
+        # strict cost-analysis-vs-cost-analysis verdict only applies when
+        # the AOT record carries no flops_analytic (pre-scan reports). With
+        # a scanned program, state both accountings transparently instead of
+        # fabricating an equality check across different definitions:
+        # this document's number (XLA cost analysis of the unrolled
+        # lowering) is the canonical per-step figure; the PaLM-style 6N
+        # analytic accounting is a deliberately coarser upper accounting.
         if aot.get("flops_analytic"):
-            measured_pflops = aot["flops_analytic"] / 1e15
-            basis = "analytic 6N accounting of the compiled scan program"
+            analytic_pflops = aot["flops_analytic"] / 1e15
+            flops_line = (
+                f"- **{rep['train_step_pflops']} PFLOPs/step** (canonical: "
+                "XLA cost analysis of the unrolled lowering); the PaLM-style "
+                f"6N analytic accounting of the same config gives "
+                f"{analytic_pflops:.2f} PFLOPs — a coarser upper accounting, "
+                "quoted for scale, not equality")
         else:
             measured_pflops = aot["flops"] * aot["n_devices"] / 1e15
-            basis = (f"cost analysis, {aot['flops'] / 1e12:.1f} TFLOPs/chip "
-                     f"x {aot['n_devices']}")
-        delta_pct = abs(measured_pflops - rep["train_step_pflops"]) / max(
-            rep["train_step_pflops"], 1e-9) * 100
-        verdict = (
-            f"agreement within {delta_pct:.1f}% (fusion-level differences)"
-            if delta_pct <= 5 else
-            f"**DISAGREEMENT of {delta_pct:.1f}% — investigate before "
-            "trusting either number**")
+            delta_pct = abs(measured_pflops - rep["train_step_pflops"]) / max(
+                rep["train_step_pflops"], 1e-9) * 100
+            verdict = (
+                f"agreement within {delta_pct:.1f}% (fusion-level "
+                "differences)" if delta_pct <= 5 else
+                f"**DISAGREEMENT of {delta_pct:.1f}% — investigate before "
+                "trusting either number**")
+            flops_line = (
+                f"- measured cost analysis: **{measured_pflops:.2f} "
+                f"PFLOPs/step** ({aot['flops'] / 1e12:.1f} TFLOPs/chip x "
+                f"{aot['n_devices']}) vs {rep['train_step_pflops']} PFLOPs "
+                f"from the CPU-backend lowering — {verdict}")
         lines += [
             "## Cross-check: real TPU compiler (compile-only v5p topology)",
             "",
@@ -483,9 +495,7 @@ def _render_scenarios_md(results, aot):
             f"`{aot['topology']}` topology ({aot['n_devices']} chips, no "
             "hardware attached):",
             "",
-            f"- **{measured_pflops:.2f} PFLOPs/step** ({basis}) "
-            f"vs {rep['train_step_pflops']} PFLOPs from the CPU-backend "
-            f"unrolled lowering — {verdict}",
+            flops_line,
             f"- per-chip XLA temp allocation: "
             f"{aot.get('temp_bytes', 0) / 2**30:.1f} GiB "
             "(hardware-grade; the budget table above is the analytic bound)",
