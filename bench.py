@@ -357,13 +357,17 @@ def _tpu_aot_summary():
         # per-step total: XLA cost analysis counts the layer-scan body once
         if pod.get("flops_analytic"):
             pflops = pod["flops_analytic"] / 1e15
+            accounting = "analytic-6N (scan program; canonical cost-analysis"\
+                " figure in benchmarking/grpo_7b_plan.md)"
         else:
             pflops = pod.get("flops", 0.0) * pod.get("n_devices", 0) / 1e15
+            accounting = "xla-cost-analysis"
         out["pod_7b"] = {
             "topology": pod.get("topology"),
             "mesh": pod.get("mesh"),
             "compile_seconds": pod.get("compile_seconds"),
             "pflops_per_step": round(pflops, 2),
+            "accounting": accounting,
             "fingerprint": (pod.get("fingerprint_sha256") or "")[:16],
         }
     return out
