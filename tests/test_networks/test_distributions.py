@@ -1,0 +1,231 @@
+"""Numeric parity for the action-distribution layer (parity:
+agilerl/networks/distributions.py — EvolvableDistribution:110, apply_mask:239).
+
+The reference builds on torch.distributions; here torch is the independent
+oracle: log_prob / entropy for every family are pinned against
+torch.distributions closed forms on shared random inputs, masking is checked
+both statistically (masked actions never sampled) and analytically (masked
+log-softmax == renormalised over the valid set), and the tanh-squashed Normal
+is compared against torch's TransformedDistribution(TanhTransform).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.distributions as tdist
+
+from agilerl_tpu.networks.distributions import (
+    DistConfig,
+    dist_config_from_space,
+    entropy,
+    extra_params,
+    log_prob,
+    mode,
+    sample,
+)
+from gymnasium import spaces
+
+KEY = jax.random.PRNGKey(0)
+RTOL = 1e-5
+ATOL = 1e-5
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+class TestCategorical:
+    CFG = DistConfig(kind="categorical", action_dim=5)
+
+    def test_log_prob_matches_torch(self):
+        logits = _rand((7, 5))
+        actions = np.array([0, 1, 2, 3, 4, 0, 3])
+        ours = log_prob(self.CFG, jnp.asarray(logits), jnp.asarray(actions))
+        ref = tdist.Categorical(logits=torch.tensor(logits)).log_prob(
+            torch.tensor(actions)
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+    def test_entropy_matches_torch(self):
+        logits = _rand((7, 5))
+        ours = entropy(self.CFG, jnp.asarray(logits))
+        ref = tdist.Categorical(logits=torch.tensor(logits)).entropy()
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+    def test_sample_frequencies_match_probs(self):
+        logits = jnp.asarray([[2.0, 0.0, -1.0, 0.5, 1.0]])
+        n = 20_000
+        acts = sample(
+            self.CFG, jnp.broadcast_to(logits, (n, 5)), KEY
+        )
+        freqs = np.bincount(np.asarray(acts), minlength=5) / n
+        probs = np.asarray(jax.nn.softmax(logits[0]))
+        np.testing.assert_allclose(freqs, probs, atol=0.02)
+
+    def test_mask_blocks_sampling_and_renormalises(self):
+        logits = _rand((4, 5))
+        m = np.array([1, 0, 1, 0, 1], np.float32)
+        acts = sample(
+            self.CFG, jnp.asarray(np.tile(logits, (500, 1))), KEY,
+            mask=jnp.asarray(np.tile(m, (2000, 1))),
+        )
+        assert not np.isin(np.asarray(acts), [1, 3]).any()
+        # masked log_prob == log-softmax renormalised over the valid subset
+        ours = log_prob(
+            self.CFG, jnp.asarray(logits), jnp.zeros((4,), jnp.int32),
+            mask=jnp.asarray(np.tile(m, (4, 1))),
+        )
+        valid = logits[:, m.astype(bool)]
+        ref = valid[:, 0] - np.log(np.exp(valid).sum(axis=1))
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-4)
+
+    def test_mode_is_argmax_respecting_mask(self):
+        logits = jnp.asarray([[5.0, 10.0, 1.0]])
+        cfg = DistConfig(kind="categorical", action_dim=3)
+        assert int(mode(cfg, logits)[0]) == 1
+        assert int(mode(cfg, logits, mask=jnp.asarray([[1.0, 0.0, 1.0]]))[0]) == 0
+
+
+class TestMultiDiscrete:
+    CFG = DistConfig(kind="multidiscrete", action_dim=9, nvec=(2, 3, 4))
+
+    def test_log_prob_is_sum_of_branches(self):
+        logits = _rand((6, 9))
+        actions = np.stack(
+            [np.random.default_rng(i).integers(0, n, 6) for i, n in enumerate((2, 3, 4))],
+            axis=-1,
+        )
+        ours = log_prob(self.CFG, jnp.asarray(logits), jnp.asarray(actions))
+        ref = np.zeros(6)
+        for i, (s, n) in enumerate(((0, 2), (2, 3), (5, 4))):
+            ref += (
+                tdist.Categorical(logits=torch.tensor(logits[:, s : s + n]))
+                .log_prob(torch.tensor(actions[:, i]))
+                .numpy()
+            )
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=RTOL, atol=ATOL)
+
+    def test_entropy_is_sum_of_branches(self):
+        logits = _rand((6, 9))
+        ours = entropy(self.CFG, jnp.asarray(logits))
+        ref = sum(
+            tdist.Categorical(logits=torch.tensor(logits[:, s : s + n])).entropy().numpy()
+            for s, n in ((0, 2), (2, 3), (5, 4))
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=RTOL, atol=ATOL)
+
+    def test_samples_within_ranges(self):
+        acts = np.asarray(sample(self.CFG, jnp.asarray(_rand((1000, 9))), KEY))
+        assert acts.shape == (1000, 3)
+        for i, n in enumerate((2, 3, 4)):
+            assert acts[:, i].min() >= 0 and acts[:, i].max() < n
+
+
+class TestBernoulli:
+    CFG = DistConfig(kind="bernoulli", action_dim=4)
+
+    def test_log_prob_matches_torch(self):
+        logits = _rand((5, 4))
+        actions = (np.random.default_rng(1).random((5, 4)) < 0.5).astype(np.float32)
+        ours = log_prob(self.CFG, jnp.asarray(logits), jnp.asarray(actions))
+        ref = (
+            tdist.Bernoulli(logits=torch.tensor(logits))
+            .log_prob(torch.tensor(actions))
+            .sum(-1)
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+    def test_entropy_matches_torch(self):
+        logits = _rand((5, 4))
+        ours = entropy(self.CFG, jnp.asarray(logits))
+        ref = tdist.Bernoulli(logits=torch.tensor(logits)).entropy().sum(-1)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=RTOL, atol=ATOL)
+
+    def test_mode_thresholds_at_zero(self):
+        logits = jnp.asarray([[-1.0, 0.5, 3.0, -0.1]])
+        np.testing.assert_array_equal(np.asarray(mode(self.CFG, logits))[0], [0, 1, 1, 0])
+
+
+class TestNormal:
+    CFG = DistConfig(kind="normal", action_dim=3, log_std_init=-0.3)
+
+    def _extra(self):
+        return {k: jnp.asarray(v) for k, v in extra_params(self.CFG).items()}
+
+    def test_log_prob_matches_torch_diag_normal(self):
+        mean = _rand((8, 3))
+        actions = _rand((8, 3), seed=2)
+        extra = self._extra()
+        ours = log_prob(
+            self.CFG, jnp.asarray(mean), jnp.asarray(actions), dist_extra=extra
+        )
+        std = np.exp(np.asarray(extra["log_std"]))
+        ref = (
+            tdist.Normal(torch.tensor(mean), torch.tensor(std))
+            .log_prob(torch.tensor(actions))
+            .sum(-1)
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_entropy_matches_torch(self):
+        mean = _rand((8, 3))
+        extra = self._extra()
+        ours = entropy(self.CFG, jnp.asarray(mean), dist_extra=extra)
+        std = np.exp(np.asarray(extra["log_std"]))
+        ref = (
+            tdist.Normal(torch.tensor(mean), torch.tensor(np.tile(std, (8, 1))))
+            .entropy()
+            .sum(-1)
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_sample_statistics(self):
+        mean = jnp.asarray([[0.5, -1.0, 2.0]])
+        extra = self._extra()
+        acts = np.asarray(
+            sample(self.CFG, jnp.broadcast_to(mean, (50_000, 3)), KEY, dist_extra=extra)
+        )
+        np.testing.assert_allclose(acts.mean(0), np.asarray(mean)[0], atol=0.02)
+        np.testing.assert_allclose(
+            acts.std(0), np.exp(np.asarray(extra["log_std"])), atol=0.02
+        )
+
+    def test_squashed_log_prob_matches_torch_tanh_transform(self):
+        cfg = DistConfig(kind="normal", action_dim=3, log_std_init=-0.3, squash=True)
+        mean = _rand((8, 3))
+        extra = {k: jnp.asarray(v) for k, v in extra_params(cfg).items()}
+        u = _rand((8, 3), seed=3)
+        a = np.tanh(u).astype(np.float32)
+        ours = log_prob(cfg, jnp.asarray(mean), jnp.asarray(a), dist_extra=extra)
+        std = np.exp(np.asarray(extra["log_std"]))
+        base = tdist.Normal(torch.tensor(mean), torch.tensor(np.tile(std, (8, 1))))
+        ref = tdist.TransformedDistribution(
+            base, [tdist.transforms.TanhTransform(cache_size=1)]
+        ).log_prob(torch.tensor(a)).sum(-1)
+        # both sides guard atanh/log with small epsilons — keep tolerance loose
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-3, atol=1e-3)
+
+    def test_squash_bounds_samples_and_mode(self):
+        cfg = DistConfig(kind="normal", action_dim=2, log_std_init=0.5, squash=True)
+        extra = {k: jnp.asarray(v) for k, v in extra_params(cfg).items()}
+        mean = jnp.asarray(np.full((1000, 2), 3.0, np.float32))
+        acts = np.asarray(sample(cfg, mean, KEY, dist_extra=extra))
+        assert (np.abs(acts) <= 1.0).all()
+        assert (np.abs(np.asarray(mode(cfg, mean))) < 1.0).all()
+
+
+class TestSpaceMapping:
+    @pytest.mark.parametrize(
+        "space,kind,dim",
+        [
+            (spaces.Discrete(6), "categorical", 6),
+            (spaces.MultiDiscrete([2, 3]), "multidiscrete", 5),
+            (spaces.MultiBinary(4), "bernoulli", 4),
+            (spaces.Box(-1, 1, (3,)), "normal", 3),
+        ],
+    )
+    def test_config_from_space(self, space, kind, dim):
+        cfg = dist_config_from_space(space)
+        assert cfg.kind == kind and cfg.action_dim == dim
