@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-5 second-window manual capture: cheap kernel/XLA probes FIRST (the
+# service compiles standalone kernels fine), the heavy unrolled-decode bench
+# after, and the GRPO compile-poison bisection last (a wedged compile can
+# poison the service for hours — see NOTES_ROUND5 item 10).
+set -u
+cd "$(dirname "$0")"
+mkdir -p .tpu_results
+
+probe() {
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.default_backend() != "cpu"
+x = jnp.ones((256, 256), jnp.bfloat16)
+jax.jit(lambda a: a @ a)(x).block_until_ready()
+EOF
+}
+
+stage() {  # stage <artifact> <timeout_s> <cmd...>
+  local artifact="$1" tmo="$2"; shift 2
+  if [ -s ".tpu_results/$artifact" ]; then return 0; fi
+  echo "[capture2 $(date -u +%H:%M:%S)] stage $artifact: $*"
+  timeout "$tmo" "$@" > ".tpu_results/.$artifact.tmp" 2>&1
+  local rc=$?
+  if [ "$rc" -eq 0 ]; then
+    # only a SUCCESSFUL run installs the artifact (a failure log would
+    # satisfy the [-s] resume guard and block retries forever)
+    mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact" 2>/dev/null
+  else
+    mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact.failed" 2>/dev/null
+  fi
+  echo "[capture2 $(date -u +%H:%M:%S)] stage $artifact rc=$rc"
+  if ! probe; then
+    echo "[capture2 $(date -u +%H:%M:%S)] service wedged after $artifact — waiting"
+    until probe; do sleep 300; done
+    echo "[capture2 $(date -u +%H:%M:%S)] service recovered"
+  fi
+}
+
+until probe; do
+  echo "[capture2 $(date -u +%H:%M:%S)] pool down"
+  sleep 300
+done
+echo "[capture2 $(date -u +%H:%M:%S)] pool UP"
+
+# -- cheap, proven-shape captures first --------------------------------------
+stage followup_flash.log 1200 python benchmarking/tpu_followup.py flash
+stage followup_fused_llama.log 1200 python benchmarking/tpu_followup.py fused_llama
+stage followup_paged_kv.log 900 python benchmarking/tpu_followup.py paged_kv
+
+# -- the decode bench (unrolled cached path; depth reduced for this service) --
+stage bucketed_decode_l4.log 1500 env BENCH_DECODE_LAYERS=4 python benchmarking/bucketed_decode_bench.py
+
+# -- GRPO compile-poison bisection (2-layer cells, fresh process each) --------
+stage grpo_probe_noplas.log 600 env AGILERL_TPU_DISABLE_PALLAS=1 python benchmarking/grpo_compile_probe.py 2
+stage grpo_probe_noscan.log 600 env AGILERL_TPU_DISABLE_SCAN_LAYERS=1 python benchmarking/grpo_compile_probe.py 2
+stage grpo_probe_default.log 600 python benchmarking/grpo_compile_probe.py 2
+
+echo "[capture2 $(date -u +%H:%M:%S)] queue COMPLETE — inspect grpo probes before the full bench"

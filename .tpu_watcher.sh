@@ -25,7 +25,13 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
   echo "[watcher $(date -u +%H:%M:%S)] stage $artifact: $*"
   timeout "$tmo" "$@" > ".tpu_results/.$artifact.tmp" 2>&1
   local rc=$?
-  mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact" 2>/dev/null
+  if [ "$rc" -eq 0 ]; then
+    # only a SUCCESSFUL run installs the artifact (a failure log would
+    # satisfy the [-s] resume guard and block retries forever)
+    mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact" 2>/dev/null
+  else
+    mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact.failed" 2>/dev/null
+  fi
   echo "[watcher $(date -u +%H:%M:%S)] stage $artifact rc=$rc"
   # after every stage, re-probe: a wedged service should stop the queue
   probe || return 1

@@ -390,11 +390,16 @@ def _playbook_captured(mode: str):
             and result.get("backend") not in (None, "cpu")):
         result = dict(result)
         # stamp the CAPTURING commit so a stale result can't be read as a
-        # fresh HEAD measurement (ADVICE r4): distinct key + provenance text
-        cap_commit = captured.get("commit") or "unknown-commit"
+        # fresh HEAD measurement (ADVICE r4): distinct key + provenance text.
+        # watcher-folded captures (benchmarking/fold_tpu_captures.py) carry
+        # their own per-result stamps; playbook captures use the file-level one
+        cap_commit = (result.get("captured_at_commit")
+                      or captured.get("commit") or "unknown-commit")
+        cap_ts = (result.get("captured_at_ts")
+                  or captured.get("ts", "unknown-time"))
         result["captured_at_commit"] = cap_commit
         result["provenance"] = (
-            f"playbook-captured {captured.get('ts', 'unknown-time')} "
+            f"playbook-captured {cap_ts} "
             f"at commit {cap_commit} (may predate HEAD)"
         )
         return result

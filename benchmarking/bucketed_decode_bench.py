@@ -40,9 +40,14 @@ def main():
     from agilerl_tpu.llm.serving import BucketedGenerator
 
     on_cpu = jax.default_backend() == "cpu"
+    # BENCH_DECODE_LAYERS: the cached decode path compiles UNROLLED (scan
+    # needs a uniform stacked pytree; the per-layer cache is dict-keyed), so
+    # depth directly scales remote-compile cost — tunable for compile-service
+    # constrained up-windows (round-5 live capture)
     cfg = M.GPTConfig(
         vocab_size=32_000,
-        n_layer=2 if on_cpu else 12,
+        n_layer=int(os.environ.get("BENCH_DECODE_LAYERS",
+                                   2 if on_cpu else 12)),
         n_head=12, n_kv_head=4, d_model=768,
         max_seq_len=2048, dtype=jnp.float32 if on_cpu else jnp.bfloat16,
     )

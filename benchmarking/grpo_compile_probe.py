@@ -1,0 +1,65 @@
+"""Isolate what the axon remote-compile service chokes on in the GRPO step.
+
+Context (round-5 live windows): the SAME 12-layer fused GRPO update that the
+local compile-only XLA:TPU pipeline builds in ~49s (scan-over-layers,
+benchmarking/tpu_aot_compile.py) hangs the tunnelled compile service for
+>40 min, while the evoppo population program (35s) and the standalone Pallas
+kernels (55s incl. grads) compile fine on the same service. This probe
+compiles ONE small GRPO learn cell under an externally-chosen combination of
+kill switches so the poison can be bisected with fresh processes and tight
+timeouts:
+
+  AGILERL_TPU_DISABLE_PALLAS=1       -> pure-XLA program (no Mosaic)
+  AGILERL_TPU_DISABLE_SCAN_LAYERS=1  -> unrolled layer loop
+
+Run: timeout 300 [ENV...] python benchmarking/grpo_compile_probe.py [n_layer]
+Prints one JSON line: {"n_layer", "pallas", "scan", "compile_seconds"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n_layer = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.llm import model as M
+
+    B, T = 4, 256
+    cfg = M.GPTConfig(vocab_size=32_000, n_layer=n_layer, n_head=12,
+                      d_model=768, max_seq_len=T)
+    agent = GRPO(config=cfg, pad_token_id=0, eos_token_id=1, group_size=4,
+                 batch_size=B, seed=0)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 31_000, size=(B, T)).astype(np.int32))
+    loss_mask = np.zeros((B, T - 1), np.float32)
+    loss_mask[:, T // 2:] = 1.0
+    rewards = rng.normal(size=(B // 4, 4)).astype(np.float32)
+    exp = (ids, jnp.asarray(loss_mask), jnp.asarray(rewards))
+    t0 = time.time()
+    agent.learn(exp)  # first call: trace + compile dominates
+    compile_s = time.time() - t0
+    t0 = time.time()
+    agent.learn(exp)
+    step_s = time.time() - t0
+    out = {
+        "n_layer": n_layer,
+        "backend": jax.default_backend(),
+        "pallas": not os.environ.get("AGILERL_TPU_DISABLE_PALLAS"),
+        "scan": not os.environ.get("AGILERL_TPU_DISABLE_SCAN_LAYERS"),
+        "compile_seconds": round(compile_s, 1),
+        "step_seconds": round(step_s, 4),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
