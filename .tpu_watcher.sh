@@ -1,9 +1,16 @@
 #!/bin/bash
-# TPU up-window watcher (round 5 re-arm). Probes the accelerator with a short
-# deadline; on the first healthy probe it runs the remaining capture queue
-# (GRPO bench, MFU sweep, bucketed decode, followup probes) one stage at a
-# time, artifacts into .tpu_results/. Each stage is skipped once its artifact
-# exists, so repeated up-windows resume where the last one died.
+# TPU up-window watcher (round 5, rev 3). Probes the accelerator with a short
+# deadline; on the first healthy probe it runs the remaining capture queue one
+# stage at a time, artifacts into .tpu_results/. Each stage is skipped once
+# its artifact exists, so repeated up-windows resume where the last one died.
+#
+# Queue ordering learned from live windows 1+2: anything that compiles a GRPO
+# learn-step program can wedge the tunnelled compile service for HOURS (the
+# same programs compile in <50s via local compile-only AOT). Cheap
+# kernel-/XLA-only probes therefore run FIRST; the GRPO-class stages run last,
+# behind a kill-switch bisection that identifies a compilable configuration.
+# A stage that fails twice is retired (-.failed/.failed2 markers) so a
+# poisonous stage cannot livelock the queue across windows.
 #
 # Launch: nohup bash .tpu_watcher.sh > .tpu_results/watcher.log 2>&1 &
 set -u
@@ -22,6 +29,7 @@ EOF
 stage() {  # stage <artifact> <timeout_s> <cmd...>
   local artifact="$1" tmo="$2"; shift 2
   if [ -s ".tpu_results/$artifact" ]; then return 0; fi
+  if [ -f ".tpu_results/$artifact.failed2" ]; then return 0; fi  # retired
   echo "[watcher $(date -u +%H:%M:%S)] stage $artifact: $*"
   timeout "$tmo" "$@" > ".tpu_results/.$artifact.tmp" 2>&1
   local rc=$?
@@ -29,6 +37,8 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
     # only a SUCCESSFUL run installs the artifact (a failure log would
     # satisfy the [-s] resume guard and block retries forever)
     mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact" 2>/dev/null
+  elif [ -f ".tpu_results/$artifact.failed" ]; then
+    mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact.failed2" 2>/dev/null
   else
     mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact.failed" 2>/dev/null
   fi
@@ -40,15 +50,23 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
 while true; do
   if probe; then
     echo "[watcher $(date -u +%H:%M:%S)] pool UP — running capture queue"
+    # -- cheap, proven-shape captures first ---------------------------------
+    stage followup_flash.log 1200 python benchmarking/tpu_followup.py flash && \
+    stage followup_fused_llama.log 1200 python benchmarking/tpu_followup.py fused_llama && \
+    stage followup_paged_kv.log 900 python benchmarking/tpu_followup.py paged_kv && \
+    stage bucketed_decode_l4.log 1500 env BENCH_DECODE_LAYERS=4 python benchmarking/bucketed_decode_bench.py && \
+    stage followup_evoppo_scale.log 3600 python benchmarking/tpu_followup.py evoppo_scale && \
+    # -- GRPO compile-poison bisection (small cells, fresh process each) ----
+    stage grpo_probe_noplas.log 600 env AGILERL_TPU_DISABLE_PALLAS=1 python benchmarking/grpo_compile_probe.py 2 && \
+    stage grpo_probe_noscan.log 600 env AGILERL_TPU_DISABLE_SCAN_LAYERS=1 python benchmarking/grpo_compile_probe.py 2 && \
+    stage grpo_probe_default.log 600 python benchmarking/grpo_compile_probe.py 2 && \
+    # -- full GRPO-class stages LAST (service-poison risk) ------------------
     stage bench_grpo_tpu2.log 2400 env BENCH_CHILD=1 BENCH_MODE=grpo python bench.py && \
     stage grpo_mfu_sweep.log2 3600 python benchmarking/grpo_mfu_sweep.py && \
-    stage bucketed_decode_tpu.log 1200 python benchmarking/bucketed_decode_bench.py && \
-    stage followup_paged_kv.log 900 python benchmarking/tpu_followup.py paged_kv && \
-    stage followup_fused_llama.log 1800 python benchmarking/tpu_followup.py fused_llama && \
-    stage followup_flash.log 1800 python benchmarking/tpu_followup.py flash && \
-    stage followup_evoppo_scale.log 3600 python benchmarking/tpu_followup.py evoppo_scale && \
-    { echo "[watcher $(date -u +%H:%M:%S)] queue COMPLETE"; exit 0; }
+    stage bucketed_decode_tpu.log 1500 python benchmarking/bucketed_decode_bench.py && \
+    { echo "[watcher $(date -u +%H:%M:%S)] queue COMPLETE"; python benchmarking/fold_tpu_captures.py; exit 0; }
     echo "[watcher $(date -u +%H:%M:%S)] queue interrupted (service wedged?)"
+    python benchmarking/fold_tpu_captures.py
   else
     echo "[watcher $(date -u +%H:%M:%S)] pool down/degraded"
   fi

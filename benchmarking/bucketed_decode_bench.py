@@ -100,6 +100,8 @@ def main():
         "value": round(dense_s / bucket_s, 2),
         "unit": "x",
         "backend": jax.default_backend(),
+        "n_layer": cfg.n_layer,  # depth is tunable (BENCH_DECODE_LAYERS) —
+        # a reduced-depth capture must be distinguishable from the headline
         "dense_seconds": round(dense_s, 2),
         "bucketed_seconds": round(bucket_s, 2),
         "dense_programs": len(dense_shapes),  # jit: one program per (B, P)

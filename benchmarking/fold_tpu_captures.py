@@ -73,7 +73,10 @@ def main():
         if meta.get(logname) is not None and mtime <= meta[logname]:
             continue  # this capture (or a newer one) was already folded
         existing = progress.get(key)
-        if isinstance(existing, dict):
+        if isinstance(existing, dict) and \
+                existing.get("backend") not in (None, "cpu"):
+            # an existing ACCELERATOR result may outrank this log; a cpu
+            # fallback never blocks folding a real TPU capture.
             # playbook-owned results carry no per-result stamp — they are
             # covered by the file-level ts
             existing_ts = existing.get("captured_at_ts") or (

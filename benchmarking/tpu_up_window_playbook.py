@@ -98,8 +98,29 @@ def main():
             capture_output=True, text=True, timeout=30).stdout.strip() or None
     except Exception:
         commit = None
-    captured = {"backend": backend, "ts": time.strftime("%Y%m%dT%H%M%S"),
-                "commit": commit}
+    # merge into the existing progress file: a previous window's (or the
+    # watcher fold's) captures survive unless this run replaces them with a
+    # real result — rebuilding from scratch used to wipe folded results
+    try:
+        with open(os.path.join(OUT, "playbook_progress.json")) as fh:
+            captured = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        captured = {}
+    # results inherited from the old file keep the OLD file-level provenance
+    # (per-result stamps), since the top-level ts/commit now describe THIS run
+    for key, val in captured.items():
+        if isinstance(val, dict) and "value" in val \
+                and "captured_at_commit" not in val:
+            val["captured_at_commit"] = captured.get("commit") or "unknown"
+            val["captured_at_ts"] = captured.get("ts", "unknown")
+    captured.update({"backend": backend,
+                     "ts": time.strftime("%Y%m%dT%H%M%S"),
+                     "commit": commit})
+
+    def record(key, value):
+        """Install a capture; never clobber an existing result with None."""
+        if value is not None or captured.get(key) is None:
+            captured[key] = value
 
     # 2. kernel validation (cheap, de-risks everything else)
     rc, out, dt = run_child(
@@ -119,31 +140,32 @@ def main():
     rc, out, dt = run_child(
         [sys.executable, os.path.join(REPO, "bench.py")], 900,
         env={"BENCH_CHILD": "1"}, name="bench_evoppo_tpu.log")
-    captured["evoppo"] = last_json(out)
+    record("evoppo", last_json(out))
     save("playbook_progress.json", captured)
 
-    # 4. GRPO tokens/sec + MFU
+    # 4. bucketed vs dense ragged decode (compile amortisation + early exit)
+    rc, out, dt = run_child(
+        [sys.executable, os.path.join(HERE, "bucketed_decode_bench.py")], 900,
+        name="bucketed_decode_tpu.log")
+    record("bucketed_decode", last_json(out))
+    save("playbook_progress.json", captured)
+
+    # 5+6 LAST — both compile GRPO learn-step programs, which are known to
+    # wedge the tunnelled compile service for hours (round-5 windows 1+2);
+    # everything above must already be on disk when that happens.
     rc, out, dt = run_child(
         [sys.executable, os.path.join(REPO, "bench.py")], 900,
         env={"BENCH_CHILD": "1", "BENCH_MODE": "grpo"},
         name="bench_grpo_tpu.log")
-    captured["grpo"] = last_json(out)
+    record("grpo", last_json(out))
     save("playbook_progress.json", captured)
 
-    # 5. MFU recipe sweep — bf16/remat/batch on the GRPO learn step
     rc, out, dt = run_child(
         [sys.executable, os.path.join(HERE, "grpo_mfu_sweep.py")], 1800,
         name="grpo_mfu_sweep.log")
-    captured["mfu_sweep"] = last_json(out)
-    if captured["mfu_sweep"] is not None:
+    record("mfu_sweep", last_json(out))
+    if captured.get("mfu_sweep") is not None:
         save("grpo_mfu_sweep.json", captured["mfu_sweep"])
-    save("playbook_progress.json", captured)
-
-    # 6. bucketed vs dense ragged decode (compile amortisation + early exit)
-    rc, out, dt = run_child(
-        [sys.executable, os.path.join(HERE, "bucketed_decode_bench.py")], 900,
-        name="bucketed_decode_tpu.log")
-    captured["bucketed_decode"] = last_json(out)
     save("playbook_progress.json", captured)
     log("playbook complete — commit .tpu_results/")
     return 0
