@@ -60,9 +60,10 @@ while true; do
     stage grpo_probe_noplas.log 600 env AGILERL_TPU_DISABLE_PALLAS=1 python benchmarking/grpo_compile_probe.py 2 && \
     stage grpo_probe_noscan.log 600 env AGILERL_TPU_DISABLE_SCAN_LAYERS=1 python benchmarking/grpo_compile_probe.py 2 && \
     stage grpo_probe_default.log 600 python benchmarking/grpo_compile_probe.py 2 && \
-    # -- full GRPO-class stages LAST (service-poison risk) ------------------
-    stage bench_grpo_tpu2.log 2400 env BENCH_CHILD=1 BENCH_MODE=grpo python bench.py && \
-    stage grpo_mfu_sweep.log2 3600 python benchmarking/grpo_mfu_sweep.py && \
+    # -- full GRPO-class stages LAST (service-poison risk), in the config the
+    # -- bisection proved the remote service can compile --------------------
+    stage bench_grpo_tpu2.log 2400 bash -c 'python benchmarking/grpo_safe_env.py && . .tpu_results/grpo_safe_env.sh && BENCH_CHILD=1 BENCH_MODE=grpo python bench.py' && \
+    stage grpo_mfu_sweep.log2 3600 bash -c '[ -f .tpu_results/grpo_safe_env.sh ] && . .tpu_results/grpo_safe_env.sh && python benchmarking/grpo_mfu_sweep.py' && \
     stage bucketed_decode_tpu.log 1500 python benchmarking/bucketed_decode_bench.py && \
     { echo "[watcher $(date -u +%H:%M:%S)] queue COMPLETE"; python benchmarking/fold_tpu_captures.py; exit 0; }
     echo "[watcher $(date -u +%H:%M:%S)] queue interrupted (service wedged?)"

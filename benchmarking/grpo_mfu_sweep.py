@@ -46,13 +46,22 @@ def main():
 
     ok = [c for c in cells if "mfu" in c]
     best = max(ok, key=lambda c: c["mfu"]) if ok else None
-    print(json.dumps({
+    out = {
         "metric": "GRPO learn-step MFU sweep",
         "backend": jax.default_backend(),
         "n_layer": n_layer,
         "best": best,
         "cells": cells,
-    }), flush=True)
+    }
+    # a sweep run under a compile-service kill switch must say so (the
+    # watcher sources .tpu_results/grpo_safe_env.sh when bisection required
+    # it — same invariant as bench.py's grpo mode)
+    disabled = [k for k in ("AGILERL_TPU_DISABLE_PALLAS",
+                            "AGILERL_TPU_DISABLE_SCAN_LAYERS")
+                if _os.environ.get(k)]
+    if disabled:
+        out["kill_switches"] = disabled
+    print(json.dumps(out), flush=True)
     return 0 if ok else 1
 
 
