@@ -37,6 +37,10 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
     # only a SUCCESSFUL run installs the artifact (a failure log would
     # satisfy the [-s] resume guard and block retries forever)
     mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact" 2>/dev/null
+  elif [ "$rc" -eq 75 ]; then
+    # EX_TEMPFAIL: a deliberate refusal (e.g. no safe GRPO config selected
+    # yet) — skip THIS window without consuming a retry
+    rm -f ".tpu_results/.$artifact.tmp"
   elif [ -f ".tpu_results/$artifact.failed" ]; then
     mv ".tpu_results/.$artifact.tmp" ".tpu_results/$artifact.failed2" 2>/dev/null
   else
@@ -62,8 +66,8 @@ while true; do
     stage grpo_probe_default.log 600 python benchmarking/grpo_compile_probe.py 2 && \
     # -- full GRPO-class stages LAST (service-poison risk), in the config the
     # -- bisection proved the remote service can compile --------------------
-    stage bench_grpo_tpu2.log 2400 bash -c 'python benchmarking/grpo_safe_env.py && . .tpu_results/grpo_safe_env.sh && BENCH_CHILD=1 BENCH_MODE=grpo python bench.py' && \
-    stage grpo_mfu_sweep.log2 3600 bash -c '[ -f .tpu_results/grpo_safe_env.sh ] && . .tpu_results/grpo_safe_env.sh && python benchmarking/grpo_mfu_sweep.py' && \
+    stage bench_grpo_tpu2.log 2400 bash -c 'python benchmarking/grpo_safe_env.py || exit 75; . .tpu_results/grpo_safe_env.sh; BENCH_CHILD=1 BENCH_MODE=grpo python bench.py' && \
+    stage grpo_mfu_sweep.log2 3600 bash -c '[ -f .tpu_results/grpo_safe_env.sh ] || exit 75; . .tpu_results/grpo_safe_env.sh; python benchmarking/grpo_mfu_sweep.py' && \
     stage bucketed_decode_tpu.log 1500 python benchmarking/bucketed_decode_bench.py && \
     { echo "[watcher $(date -u +%H:%M:%S)] queue COMPLETE"; python benchmarking/fold_tpu_captures.py; exit 0; }
     echo "[watcher $(date -u +%H:%M:%S)] queue interrupted (service wedged?)"

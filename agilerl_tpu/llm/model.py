@@ -52,14 +52,14 @@ class GPTConfig:
     rms_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM <-> FLOPs trade)
-    # Roll the layer stack into ONE lax.scan on the non-cached (training /
-    # logprob) paths: HLO size and XLA:TPU compile time become ~constant in
-    # n_layer instead of linear (the first live-chip window measured the
-    # unrolled 12-layer GRPO learn-step compile at >15 min against 35s for
-    # the rest of the program set). Layers must be structurally uniform —
-    # interleaved dense/MoE stacks (moe_every > 1) fall back to the
-    # unrolled loop automatically, as does the KV-cached decode path (its
-    # per-layer cache pytree is dict-keyed, and decode graphs are small).
+    # Roll the layer stack into ONE lax.scan on every path — training/logprob
+    # AND the KV-cached prefill/decode paths (the cache stacks all layers on
+    # a leading axis, so per-layer k/v ride as scan xs/ys): HLO size and
+    # XLA:TPU compile time become ~constant in n_layer instead of linear
+    # (the first live-chip window measured the unrolled 12-layer GRPO
+    # learn-step compile at >15 min against 35s for the rest of the program
+    # set). Layers must be structurally uniform — interleaved dense/MoE
+    # stacks (moe_every > 1) fall back to the unrolled loop automatically.
     # Kill switch: AGILERL_TPU_DISABLE_SCAN_LAYERS=1.
     scan_layers: bool = True
     use_flash_attention: bool = False  # Pallas kernel on the non-cached path
@@ -102,8 +102,17 @@ class GPTConfig:
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # [B, S, KV, hd]
-    v: jax.Array  # [B, S, KV, hd]
+    """All layers' KV cache, stacked on a leading layer axis.
+
+    ``length``/``mask`` are layer-invariant (every layer appends the same
+    tokens at the same slots), so they are stored ONCE — which is also what
+    lets the cached forward roll the layer stack into ``lax.scan`` with
+    (k[i], v[i]) as scan xs/ys: decode/prefill compile time is constant in
+    depth, like the non-cached paths (window-2 finding: the unrolled
+    12-layer cached prefill was the repo's last depth-linear program)."""
+
+    k: jax.Array  # [L, B, S, KV, hd]
+    v: jax.Array  # [L, B, S, KV, hd]
     length: jax.Array  # [] int32 — filled slots
     mask: jax.Array  # [B, S] int32 — 1 where the slot holds a REAL token
     # (left-padded prompts leave dead slots that must stay masked forever)
@@ -111,7 +120,7 @@ class KVCache(NamedTuple):
 
 def init_kv_cache(config: GPTConfig, batch: int, max_len: Optional[int] = None) -> KVCache:
     s = max_len or config.max_seq_len
-    shape = (batch, s, config.kv_heads, config.head_dim)
+    shape = (config.n_layer, batch, s, config.kv_heads, config.head_dim)
     return KVCache(
         k=jnp.zeros(shape, config.dtype),
         v=jnp.zeros(shape, config.dtype),
@@ -295,15 +304,15 @@ def forward(
     tokens: jax.Array,  # [B, T]
     attention_mask: Optional[jax.Array] = None,  # [B, T] 1=valid
     positions: Optional[jax.Array] = None,  # [B, T]
-    cache: Optional[KVCache] = None,  # per-layer caches stacked: dict of layer->KVCache
+    cache: Optional[KVCache] = None,  # stacked over layers (leading axis L)
     lora: Optional[Params] = None,
     lora_scale: float = 2.0,
     flash: Optional[bool] = None,  # override config.use_flash_attention
     # (the Pallas kernel is forward-only: keep flash OFF inside loss grads
     # until the custom-VJP lands; no-grad logprob/generate paths may enable it)
     return_aux: bool = False,  # also return the MoE router load-balance loss
-) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
-    """Returns (hidden [B, T, D] float32, new caches). With a cache, tokens are
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Returns (hidden [B, T, D] float32, new cache). With a cache, tokens are
     appended at cache.length (all rows share a length — use left-padding for
     ragged prompts so positions/masks do the aligning)."""
     B, T = tokens.shape
@@ -318,9 +327,17 @@ def forward(
     chunked_decode = use_chunked_decode()  # read once: trace-time constant
     h = jnp.take(params["tok_emb"], tokens, axis=0).astype(dtype)
 
-    new_caches: Optional[Dict[str, KVCache]] = {} if cache is not None else None
+    # length/mask are layer-invariant: computed ONCE for the whole stack
+    if cache is not None:
+        start = cache.length
+        cache_mask = jax.lax.dynamic_update_slice(
+            cache.mask, attention_mask.astype(jnp.int32), (0, start)
+        )
+    else:
+        start = cache_mask = None
 
-    def block_fn(h, blk, layer_cache, lora_layer):
+    def block_fn(h, blk, layer_kv, lora_layer):
+        """layer_kv: (k_cache [B,S,KV,hd], v_cache [B,S,KV,hd]) or None."""
         x = _rms(h, blk["ln1"], config.rms_eps)
         q = _maybe_lora(x, blk["wq"], lora_layer, "wq", lora_scale, dtype)
         k = _maybe_lora(x, blk["wk"], lora_layer, "wk", lora_scale, dtype)
@@ -335,14 +352,20 @@ def forward(
         q = _rope(q, positions, config.rope_theta)
         k = _rope(k, positions, config.rope_theta)
 
-        if layer_cache is not None:
-            start = layer_cache.length
-            ck = jax.lax.dynamic_update_slice(layer_cache.k, k, (0, start, 0, 0))
-            cv = jax.lax.dynamic_update_slice(layer_cache.v, v, (0, start, 0, 0))
-            cm = jax.lax.dynamic_update_slice(
-                layer_cache.mask, attention_mask.astype(jnp.int32), (0, start)
-            )
-            new_cache = KVCache(ck, cv, start + T, cm)
+        if layer_kv is not None:
+            # layer_kv = this layer's PRE-update (k_slab, v_slab). Attention
+            # sees the locally-updated slab; the function returns only the
+            # NEW tokens' post-rope projections — the caller bulk-writes
+            # them into the stacked cache ONCE after the layer loop/scan
+            # (returning full updated slabs as scan ys forced a cache-sized
+            # copy per step: +11 GiB temp at 7B decode-chunk dims, and a
+            # cache-as-carry variant made XLA double-buffer the carry).
+            ck = jax.lax.dynamic_update_slice(
+                layer_kv[0], k, (0, start, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                layer_kv[1], v, (0, start, 0, 0))
+            new_kv = (k, v)
+            cm = cache_mask
             if not chunked_decode:
                 k_all, v_all = ck, cv
                 S = ck.shape[1]
@@ -353,14 +376,14 @@ def forward(
                 )
                 mask = jnp.logical_and(causal, cm[:, None, :].astype(bool))
         else:
-            new_cache = None
+            new_kv = None
             k_all, v_all = k, v
             # causal within the block + padding mask
             t_ids = jnp.arange(T)
             mask = (t_ids[None, None, :] <= t_ids[None, :, None])  # [1, T, S=T]
             mask = jnp.logical_and(mask, attention_mask[:, None, :].astype(bool))
 
-        if layer_cache is not None and chunked_decode:
+        if layer_kv is not None and chunked_decode:
             # flash-decode: online-softmax over KV chunks bounded by the LIVE
             # cache length — never reads the dead cache tail, never
             # materializes GQA-repeated K/V (ops/decode_attention.py)
@@ -378,7 +401,7 @@ def forward(
             qh = jnp.moveaxis(q, 2, 1)  # [B, H, T, d]
             kh = jnp.moveaxis(k_all, 2, 1)
             vh = jnp.moveaxis(v_all, 2, 1)
-            if use_flash and layer_cache is None:
+            if use_flash and layer_kv is None:
                 # Pallas flash attention (causal + padding mask, custom VJP so
                 # it also serves training losses)
                 from agilerl_tpu.ops.flash_attention_vjp import (
@@ -427,13 +450,13 @@ def forward(
                 top_k=config.expert_top_k,
                 capacity_factor=config.capacity_factor,
             )
-            return h + out2d.reshape(B, T, config.d_model), new_cache, aux
+            return h + out2d.reshape(B, T, config.d_model), new_kv, aux
         gate = _maybe_lora(x, blk["w_gate"], lora_layer, "w_gate", lora_scale, dtype)
         up = _maybe_lora(x, blk["w_up"], lora_layer, "w_up", lora_scale, dtype)
         down = _maybe_lora(
             jax.nn.silu(gate) * up, blk["w_down"], lora_layer, "w_down", lora_scale, dtype
         )
-        return h + down, new_cache, jnp.zeros((), jnp.float32)
+        return h + down, new_kv, jnp.zeros((), jnp.float32)
 
     aux_total = jnp.zeros((), jnp.float32)
     fn = jax.checkpoint(block_fn, static_argnums=()) if config.remat else block_fn
@@ -442,33 +465,54 @@ def forward(
         lora["blocks"].get(str(i)) if lora is not None else None
         for i in range(config.n_layer)
     ]
-    if cache is None and _scannable(config, blocks, lora_layers):
+    new_caches: Optional[KVCache] = None
+    new_k = new_v = None  # [L, B, T, KV, hd] new-token projections
+    if _scannable(config, blocks, lora_layers):
+        # one scan over the stacked layer axis — cached (pre-update slabs
+        # ride as read-only xs, new tokens come back as small ys) and
+        # non-cached alike: compile time is constant in n_layer
         stack = lambda *xs: jnp.stack(xs)  # noqa: E731
         stacked_blk = jax.tree_util.tree_map(stack, *blocks)
-        if lora is not None:
-            xs = (stacked_blk, jax.tree_util.tree_map(stack, *lora_layers))
+        has_lora = lora is not None
+        has_cache = cache is not None
+        xs = [stacked_blk]
+        if has_cache:
+            xs.append((cache.k, cache.v))
+        if has_lora:
+            xs.append(jax.tree_util.tree_map(stack, *lora_layers))
 
-            def body(carry, x):
-                h, aux = carry
-                hn, _, aux_i = fn(h, x[0], None, x[1])
-                return (hn, aux + aux_i), None
+        def body(carry, x):
+            h, aux = carry
+            i = 1
+            layer_kv = x[i] if has_cache else None
+            i += has_cache
+            lora_i = x[i] if has_lora else None
+            hn, new_kv, aux_i = fn(h, x[0], layer_kv, lora_i)
+            return (hn, aux + aux_i), new_kv
 
-        else:
-            xs = stacked_blk
-
-            def body(carry, blk_i):
-                h, aux = carry
-                hn, _, aux_i = fn(h, blk_i, None, None)
-                return (hn, aux + aux_i), None
-
-        (h, aux_total), _ = jax.lax.scan(body, (h, aux_total), xs)
+        (h, aux_total), new_kvs = jax.lax.scan(
+            body, (h, aux_total), tuple(xs))
+        if has_cache:
+            new_k, new_v = new_kvs
     else:
+        nk_list, nv_list = [], []
         for i in range(config.n_layer):
-            layer_cache = cache[str(i)] if cache is not None else None
-            h, new_cache, aux = fn(h, blocks[i], layer_cache, lora_layers[i])
+            layer_kv = (cache.k[i], cache.v[i]) if cache is not None else None
+            h, new_kv, aux = fn(h, blocks[i], layer_kv, lora_layers[i])
             aux_total = aux_total + aux
-            if new_caches is not None:
-                new_caches[str(i)] = new_cache
+            if new_kv is not None:
+                nk_list.append(new_kv[0])
+                nv_list.append(new_kv[1])
+        if cache is not None:
+            new_k, new_v = jnp.stack(nk_list), jnp.stack(nv_list)
+
+    if cache is not None:
+        # ONE bulk write of the new tokens into the (aliasable) cache buffers
+        new_caches = KVCache(
+            jax.lax.dynamic_update_slice(cache.k, new_k, (0, 0, start, 0, 0)),
+            jax.lax.dynamic_update_slice(cache.v, new_v, (0, 0, start, 0, 0)),
+            start + T, cache_mask,
+        )
 
     h = _rms(h, params["ln_f"], config.rms_eps).astype(jnp.float32)
     if return_aux:
@@ -565,7 +609,7 @@ def apply(
     params: Params,
     tokens: jax.Array,
     **kw,
-) -> Tuple[jax.Array, Optional[Dict[str, KVCache]]]:
+) -> Tuple[jax.Array, Optional[KVCache]]:
     """Full forward to logits. With return_aux=True also returns the MoE
     router load-balance loss: (logits, caches, aux)."""
     if kw.get("return_aux"):
@@ -575,8 +619,9 @@ def apply(
     return logits_fn(config, params, hidden), caches
 
 
-def init_caches(config: GPTConfig, batch: int, max_len: Optional[int] = None) -> Dict[str, KVCache]:
-    return {str(i): init_kv_cache(config, batch, max_len) for i in range(config.n_layer)}
+def init_caches(config: GPTConfig, batch: int, max_len: Optional[int] = None) -> KVCache:
+    """One stacked cache for the whole layer stack (leading axis = layer)."""
+    return init_kv_cache(config, batch, max_len)
 
 
 # --------------------------------------------------------------------------- #

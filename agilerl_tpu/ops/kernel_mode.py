@@ -44,6 +44,24 @@ def resolve_interpret(explicit: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Compile-path kill switches honoured across the framework. ONE list so the
+# bisection probes (benchmarking/grpo_safe_env.py) and every capture labeler
+# (bench.py grpo mode, benchmarking/grpo_mfu_sweep.py) stay in lockstep — a
+# switch added here is automatically reported by all of them.
+KILL_SWITCH_ENV_VARS = (
+    "AGILERL_TPU_DISABLE_PALLAS",
+    "AGILERL_TPU_DISABLE_SCAN_LAYERS",
+    "AGILERL_TPU_DISABLE_CHUNKED_DECODE",
+)
+
+
+def active_kill_switches():
+    """Names of the compile-path kill switches set in this process."""
+    import os
+
+    return [k for k in KILL_SWITCH_ENV_VARS if os.environ.get(k)]
+
+
 @contextlib.contextmanager
 def native_kernels(enable: bool = True):
     """Force native (Mosaic) Pallas lowering while tracing/lowering inside

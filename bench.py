@@ -99,9 +99,9 @@ def bench_grpo():
     }
     # a capture under a compile-service kill switch must say so (the watcher
     # sources .tpu_results/grpo_safe_env.sh when the bisection required it)
-    disabled = [k for k in ("AGILERL_TPU_DISABLE_PALLAS",
-                            "AGILERL_TPU_DISABLE_SCAN_LAYERS")
-                if os.environ.get(k)]
+    from agilerl_tpu.ops.kernel_mode import active_kill_switches
+
+    disabled = active_kill_switches()
     if disabled:
         result["kill_switches"] = disabled
     print(json.dumps(result), flush=True)

@@ -40,10 +40,9 @@ def main():
     from agilerl_tpu.llm.serving import BucketedGenerator
 
     on_cpu = jax.default_backend() == "cpu"
-    # BENCH_DECODE_LAYERS: the cached decode path compiles UNROLLED (scan
-    # needs a uniform stacked pytree; the per-layer cache is dict-keyed), so
-    # depth directly scales remote-compile cost — tunable for compile-service
-    # constrained up-windows (round-5 live capture)
+    # BENCH_DECODE_LAYERS: depth knob for compile-service-constrained
+    # up-windows (with the stacked KV cache the decode path scans too, so
+    # compile cost is ~depth-independent; the knob stays for A/B evidence)
     cfg = M.GPTConfig(
         vocab_size=32_000,
         n_layer=int(os.environ.get("BENCH_DECODE_LAYERS",

@@ -56,9 +56,9 @@ def main():
     # a sweep run under a compile-service kill switch must say so (the
     # watcher sources .tpu_results/grpo_safe_env.sh when bisection required
     # it — same invariant as bench.py's grpo mode)
-    disabled = [k for k in ("AGILERL_TPU_DISABLE_PALLAS",
-                            "AGILERL_TPU_DISABLE_SCAN_LAYERS")
-                if _os.environ.get(k)]
+    from agilerl_tpu.ops.kernel_mode import active_kill_switches
+
+    disabled = active_kill_switches()
     if disabled:
         out["kill_switches"] = disabled
     print(json.dumps(out), flush=True)

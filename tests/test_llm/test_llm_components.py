@@ -137,14 +137,28 @@ class TestScanLayers:
         h3, _ = M.forward(icfg, ip, toks)  # and forward still works
         assert h3.shape == (1, 16, 64)
 
-    def test_cached_decode_path_unchanged(self):
-        # cache != None must keep the unrolled per-layer cache dict
+    def test_cached_path_scans_with_stacked_kv(self):
+        # the cache stacks all layers on a leading axis (length/mask stored
+        # once) so the cached forward scans too; scan and unrolled cached
+        # paths must agree exactly
         params = M.init_params(jax.random.PRNGKey(0), CFG)
         cache = M.init_caches(CFG, 1, 32)
         toks = jnp.arange(1, 9)[None]
         h, new_caches = M.forward(CFG, params, toks, cache=cache)
-        assert set(new_caches) == {"0", "1"}
-        assert int(new_caches["0"].length) == 8
+        assert new_caches.k.shape[0] == CFG.n_layer
+        assert int(new_caches.length) == 8
+        import os
+
+        os.environ["AGILERL_TPU_DISABLE_SCAN_LAYERS"] = "1"
+        try:
+            h2, nc2 = M.forward(CFG, params, toks, cache=cache)
+        finally:
+            del os.environ["AGILERL_TPU_DISABLE_SCAN_LAYERS"]
+        np.testing.assert_allclose(np.asarray(h), np.asarray(h2),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(new_caches.k),
+                                   np.asarray(nc2.k), rtol=1e-5, atol=1e-5)
+        assert int(nc2.length) == 8
 
 
 class TestTokenizerAndGym:
