@@ -381,6 +381,27 @@ def _tpu_aot_summary():
     return out
 
 
+def _grpo_safe_env():
+    """Env exports from the watcher's GRPO compile bisection
+    (.tpu_results/grpo_safe_env.sh, written by benchmarking/grpo_safe_env.py).
+    Returns None when NO verdict exists (file absent — the writer deletes it
+    when no probe compiled, and callers must then refuse to run GRPO-class
+    compiles at all); {} means the default config was proven safe."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".tpu_results", "grpo_safe_env.sh")
+    env = {}
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line.startswith("export ") and "=" in line:
+                    k, v = line[len("export "):].split("=", 1)
+                    env[k.strip()] = v.strip()
+    except OSError:
+        return None
+    return env
+
+
 def _attach_aot(result: dict) -> None:
     """Attach the committed compile-only TPU AOT summary: whatever the
     measurement's provenance (fresh CPU fallback or a re-emitted capture that
@@ -487,26 +508,40 @@ def parent_main():
                 f"launching workload (budget {budget:.0f}s)")
             result, err = _run_child({}, budget)
             if result is not None and result.get("backend") not in (None, "cpu"):
-                # headline landed on the accelerator — collect the secondary
-                # metric and on-chip kernel validation in the same up-window
+                # headline landed on the accelerator — collect on-chip kernel
+                # validation FIRST (cheap, proven to compile), then the
+                # secondary metric: a GRPO-class secondary can wedge the
+                # remote compile service for hours (NOTES_ROUND5 10b), so
+                # nothing of value may be scheduled after it
                 extras = []
+                kv_budget = deadline - time.monotonic()
+                if kv_budget > 120:
+                    log("bench parent: running kernel validation")
+                    kv = _run_kernel_validation(min(kv_budget, 900))
+                    if kv is not None:
+                        extras.append(kv)
                 sec_budget = deadline - time.monotonic()
                 sec_mode = "evoppo" if mode == "grpo" else "grpo"
-                if sec_budget > min_workload_budget:
+                safe_env = _grpo_safe_env() if sec_mode == "grpo" else {}
+                if sec_mode == "grpo" and safe_env is None:
+                    # no bisection verdict on disk: running the default GRPO
+                    # compile is known to wedge the remote compile service
+                    # for hours (NOTES_ROUND5 10b) — refuse, like the watcher
+                    extras.append({
+                        "metric": "secondary grpo",
+                        "skipped": "no grpo_safe_env.sh bisection verdict — "
+                                   "default compile is service-poison"})
+                elif sec_budget > min_workload_budget:
                     log(f"bench parent: running secondary ({sec_mode}) bench")
+                    sec_env = {"BENCH_MODE": sec_mode}
+                    sec_env.update(safe_env)
                     sec, sec_err = _run_child(
-                        {}, sec_budget, extra_env={"BENCH_MODE": sec_mode})
+                        {}, sec_budget, extra_env=sec_env)
                     if sec is not None:
                         extras.append(sec)
                     else:
                         extras.append({"metric": f"secondary {sec_mode}",
                                        "error": sec_err})
-                kv_budget = deadline - time.monotonic()
-                if kv_budget > 120:
-                    log("bench parent: running kernel validation")
-                    kv = _run_kernel_validation(kv_budget)
-                    if kv is not None:
-                        extras.append(kv)
                 if extras:
                     result["extra_metrics"] = extras
                 print(json.dumps(result), flush=True)
