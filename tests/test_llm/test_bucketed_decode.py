@@ -207,3 +207,25 @@ def test_grpo_row_overflow_falls_back_to_dense():
         {"input_ids": ids, "attention_mask": np.ones_like(ids)})
     assert comp.shape == (nb * 2, 8)
     assert agent.last_generation_info is None
+
+
+def test_greedy_parity_under_scan_kill_switch(monkeypatch):
+    """The unrolled layer loop over the STACKED cache (scan kill switch —
+    also the bisection's degraded serving config) must emit exactly the
+    same tokens as the scanned path."""
+    params = _params()
+    rng = np.random.default_rng(3)
+    seqs = _ragged(rng, 4, 4, 20)
+    gen = BucketedGenerator(CFG, max_new_tokens=12, pad_id=0, eos_id=None,
+                            prompt_buckets=(32,), row_buckets=(4,),
+                            decode_chunk=6)
+    comp, cmask, _ = gen.generate(seqs, jax.random.PRNGKey(2), params,
+                                  greedy=True)
+    monkeypatch.setenv("AGILERL_TPU_DISABLE_SCAN_LAYERS", "1")
+    gen2 = BucketedGenerator(CFG, max_new_tokens=12, pad_id=0, eos_id=None,
+                             prompt_buckets=(32,), row_buckets=(4,),
+                             decode_chunk=6)
+    comp2, cmask2, _ = gen2.generate(seqs, jax.random.PRNGKey(2), params,
+                                     greedy=True)
+    np.testing.assert_array_equal(comp, comp2)
+    np.testing.assert_array_equal(cmask, cmask2)
