@@ -25,6 +25,7 @@ from agilerl_tpu import (
     llm,
     modules,
     networks,
+    observability,
     ops,
     parallel,
     rollouts,
@@ -42,6 +43,7 @@ __all__ = [
     "llm",
     "modules",
     "networks",
+    "observability",
     "ops",
     "parallel",
     "rollouts",

@@ -1,0 +1,25 @@
+"""Version-portable jax API surface.
+
+The codebase targets the current jax idiom (top-level ``jax.shard_map`` with
+the ``check_vma`` kwarg); older installs (0.4.x) ship it as
+``jax.experimental.shard_map.shard_map`` with ``check_rep``. Import
+``shard_map`` from here so every call site works on both without scattering
+try/except blocks.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = None
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    if _CHECK_KW is not None and "check_vma" in kwargs:
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

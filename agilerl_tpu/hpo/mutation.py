@@ -35,6 +35,7 @@ class Mutations:
         activation_selection: Optional[List[str]] = None,
         mutate_elite: bool = True,
         rand_seed: Optional[int] = None,
+        lineage=None,
     ):
         self.no_mut = float(no_mutation)
         self.architecture_mut = float(architecture)
@@ -47,6 +48,9 @@ class Mutations:
         self.mutate_elite = bool(mutate_elite)
         self.rng = np.random.default_rng(rand_seed)
         self._key = jax.random.PRNGKey(rand_seed if rand_seed is not None else 0)
+        #: optional observability.LineageTracker — records which mutation
+        #: class landed on which child (genealogy fitness deltas)
+        self.lineage = lineage
 
     # ------------------------------------------------------------------ #
     def mutation(self, population: List, pre_training_mut: bool = False) -> List:
@@ -74,10 +78,12 @@ class Mutations:
         for i, agent in enumerate(population):
             if i == 0 and not self.mutate_elite and not pre_training_mut:
                 agent.mut = "None"
-                mutated.append(agent)
-                continue
-            fn = fns[int(self.rng.choice(len(fns), p=probs))]
-            mutated.append(fn(agent))
+            else:
+                fn = fns[int(self.rng.choice(len(fns), p=probs))]
+                agent = fn(agent)
+            if self.lineage is not None:
+                self.lineage.record_mutation(agent.index, agent.mut)
+            mutated.append(agent)
         return mutated
 
     # ------------------------------------------------------------------ #

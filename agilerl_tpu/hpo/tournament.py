@@ -24,12 +24,16 @@ class TournamentSelection:
         population_size: int = 6,
         eval_loop: int = 1,
         rng: Optional[np.random.Generator] = None,
+        lineage=None,
     ):
         self.tournament_size = int(tournament_size)
         self.elitism = bool(elitism)
         self.population_size = int(population_size)
         self.eval_loop = int(eval_loop)
         self.rng = rng or np.random.default_rng()
+        #: optional observability.LineageTracker — records the generation's
+        #: fitness distribution and every parent→child selection
+        self.lineage = lineage
 
     def _fitness(self, agent) -> float:
         window = agent.fitness[-self.eval_loop:]
@@ -49,13 +53,23 @@ class TournamentSelection:
         fitnesses = np.array([self._fitness(a) for a in population])
         elite_idx = int(np.argmax(fitnesses))
         elite = population[elite_idx]
+        if self.lineage is not None:
+            self.lineage.start_generation(
+                {a.index: f for a, f in zip(population, fitnesses)})
 
         max_id = max(a.index for a in population)
         new_population = []
         if self.elitism:
             new_population.append(elite.clone(index=elite.index))
+            if self.lineage is not None:
+                self.lineage.record_selection(
+                    elite.index, elite.index, fitnesses[elite_idx], elite=True)
         while len(new_population) < self.population_size:
-            winner = population[self._tournament(fitnesses)]
+            winner_idx = self._tournament(fitnesses)
+            winner = population[winner_idx]
             max_id += 1
             new_population.append(winner.clone(index=max_id))
+            if self.lineage is not None:
+                self.lineage.record_selection(
+                    winner.index, max_id, fitnesses[winner_idx])
         return elite, new_population

@@ -19,7 +19,9 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from agilerl_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from agilerl_tpu.llm.model import (

@@ -410,7 +410,7 @@ def forward(
 
                 smesh = _flash_mesh(config)
                 if smesh is not None:
-                    from jax import shard_map
+                    from agilerl_tpu.compat import shard_map
                     from jax.sharding import PartitionSpec as P
 
                     bax, hax = config.flash_shard_axes
@@ -671,7 +671,7 @@ def token_logprobs(
         if bspec is not None:
             # rows shard over the batch axes; the replicated head's dW
             # cotangent is psummed by shard_map's transpose rule
-            from jax import shard_map
+            from agilerl_tpu.compat import shard_map
             from jax.sharding import PartitionSpec as P
 
             lp = shard_map(

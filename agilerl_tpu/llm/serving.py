@@ -23,14 +23,27 @@ fold order across chunks.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from agilerl_tpu import observability
 from agilerl_tpu.llm import model as M
 from agilerl_tpu.llm.generate import decode_step, left_pad, prefill_head
+
+#: TTFT buckets (s): serving SLO granularity — sub-ms compile-cached prefill
+#: through multi-second cold compiles
+TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+#: per-token decode buckets (s): 10µs .. 1s
+DECODE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                  5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0)
+#: queue-depth buckets (rows in flight) — mirrors the row bucket grid
+QUEUE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def _round_up(n: int, buckets: Sequence[int]) -> int:
@@ -62,8 +75,14 @@ class BucketedGenerator:
         top_p: Optional[float] = None,
         min_new_tokens: Optional[int] = None,
         lora_scale: float = 2.0,
+        metrics=None,
     ):
         self.config = config
+        # latency telemetry: TTFT / per-token decode / queue depth land in
+        # this registry (process default unless a dedicated one is passed)
+        self.metrics = metrics if metrics is not None else observability.get_registry()
+        self._pending_rows = 0
+        self._pending_lock = threading.Lock()
         self.pad_id = int(pad_id)
         self.eos_id = eos_id
         self.prompt_buckets = tuple(sorted(prompt_buckets))
@@ -148,22 +167,54 @@ class BucketedGenerator:
             mask = np.concatenate([mask, np.zeros((Bb - B, Pb), np.int32)])
         row_valid = jnp.asarray(np.arange(Bb) < B)
 
-        carry, (tok0, emit0) = self._prefill(
-            params, lora, jnp.asarray(toks), jnp.asarray(mask), row_valid,
-            key, greedy=greedy,
-        )
-        out_toks, out_masks = [np.asarray(tok0)[:, None]], [np.asarray(emit0)[:, None]]
+        # queue depth = rows admitted and not yet fully decoded (covers
+        # callers generating from multiple threads over one generator)
+        with self._pending_lock:
+            self._pending_rows += B
+            pending = self._pending_rows
+            self.metrics.gauge("serving/queue_depth").set(pending)
+        self.metrics.histogram(
+            "serving/queue_depth_rows", buckets=QUEUE_BUCKETS,
+            help="rows in flight when a batch is admitted",
+        ).observe(pending)
+        t0 = time.perf_counter()
+
         steps = 1
-        for c in range(self.n_chunks):
-            if bool(np.asarray(carry[4]).all()):
-                break  # every live row hit EOS — skip the remaining chunks
-            if steps >= self.max_new_tokens:
-                break
-            carry, (toks_c, emits_c) = self._decode(
-                params, lora, carry, jnp.int32(steps), greedy=greedy)
-            out_toks.append(np.asarray(toks_c))
-            out_masks.append(np.asarray(emits_c))
-            steps += self.decode_chunk
+        decode_elapsed_s = 0.0
+        try:
+            carry, (tok0, emit0) = self._prefill(
+                params, lora, jnp.asarray(toks), jnp.asarray(mask), row_valid,
+                key, greedy=greedy,
+            )
+            out_toks = [np.asarray(tok0)[:, None]]
+            out_masks = [np.asarray(emit0)[:, None]]
+            # the np.asarray above synced the device: the batch's first token
+            # exists on the host — that is TTFT
+            ttft_s = time.perf_counter() - t0
+            self.metrics.histogram(
+                "serving/ttft_s", buckets=TTFT_BUCKETS,
+                help="prefill-to-first-token latency").observe(ttft_s)
+            for c in range(self.n_chunks):
+                if bool(np.asarray(carry[4]).all()):
+                    break  # every live row hit EOS — skip the remaining chunks
+                if steps >= self.max_new_tokens:
+                    break
+                t_chunk = time.perf_counter()
+                carry, (toks_c, emits_c) = self._decode(
+                    params, lora, carry, jnp.int32(steps), greedy=greedy)
+                out_toks.append(np.asarray(toks_c))
+                out_masks.append(np.asarray(emits_c))
+                dt_chunk = time.perf_counter() - t_chunk
+                decode_elapsed_s += dt_chunk
+                self.metrics.histogram(
+                    "serving/decode_time_per_token_s", buckets=DECODE_BUCKETS,
+                    help="decode-chunk wall time / chunk tokens",
+                ).observe(dt_chunk / self.decode_chunk)
+                steps += self.decode_chunk
+        finally:
+            with self._pending_lock:
+                self._pending_rows -= B
+                self.metrics.gauge("serving/queue_depth").set(self._pending_rows)
         comp = np.concatenate(out_toks, axis=1)
         cmask = np.concatenate(out_masks, axis=1).astype(np.int32)
         # trim: decode may stop early (short outputs) or overshoot the last
@@ -179,8 +230,34 @@ class BucketedGenerator:
             "decode_steps": steps,
             "max_new_tokens": N,
             "compiled_programs": self.compiled_programs,
+            "ttft_s": round(ttft_s, 6),
+            "decode_time_per_token_s": (
+                round(decode_elapsed_s / (steps - 1), 8) if steps > 1 else None
+            ),
         }
+        self.metrics.counter("serving/requests_total").inc()
+        self.metrics.counter("serving/rows_total").inc(B)
+        # the last chunk may overshoot the budget; delivered output is
+        # trimmed to N, so the throughput counter must be too
+        self.metrics.counter("serving/tokens_decoded_total").inc(B * min(steps, N))
+        self.metrics.emit("serving", rows=B, **info)
         return comp[:B, :N], cmask[:B, :N], info
+
+    def latency_summary(self) -> Dict[str, Any]:
+        """p50/p95/p99 for TTFT and per-token decode time plus request/row
+        counters — the serving SLO readout."""
+        reg = self.metrics
+        return {
+            "ttft_s": reg.histogram(
+                "serving/ttft_s", buckets=TTFT_BUCKETS).summary(),
+            "decode_time_per_token_s": reg.histogram(
+                "serving/decode_time_per_token_s",
+                buckets=DECODE_BUCKETS).summary(),
+            "queue_depth_rows": reg.histogram(
+                "serving/queue_depth_rows", buckets=QUEUE_BUCKETS).summary(),
+            "requests_total": reg.counter("serving/requests_total").value,
+            "rows_total": reg.counter("serving/rows_total").value,
+        }
 
     def fits(self, n_rows: int, longest_prompt: int) -> bool:
         """Whether a batch can be served inside the bucket grid (callers

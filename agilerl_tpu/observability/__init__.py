@@ -1,0 +1,35 @@
+"""Unified telemetry: metrics registry, JSONL events, step/MFU timelines,
+evolution lineage, serving latency histograms (see docs/observability.md)."""
+
+from agilerl_tpu.observability.events import (
+    JsonlSink,
+    MemorySink,
+    NullSink,
+    read_jsonl,
+)
+from agilerl_tpu.observability.facade import (
+    RunTelemetry,
+    get_registry,
+    init_run_telemetry,
+    warn_once,
+)
+from agilerl_tpu.observability.lineage import LineageTracker
+from agilerl_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from agilerl_tpu.observability.timeline import (
+    PhaseTimer,
+    StepTimeline,
+    device_memory_stats,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "JsonlSink", "MemorySink", "NullSink", "read_jsonl",
+    "StepTimeline", "PhaseTimer", "device_memory_stats",
+    "LineageTracker",
+    "RunTelemetry", "init_run_telemetry", "get_registry", "warn_once",
+]

@@ -1,0 +1,182 @@
+"""The one logging facade every training loop talks to.
+
+Replaces the seven hand-rolled ``init_wandb`` / ``wandb_run.log(...)`` blocks
+that were copy-pasted across ``training/train_*.py``: a loop builds ONE
+:class:`RunTelemetry` (or receives one via its ``telemetry=`` kwarg) and
+routes metrics through :meth:`RunTelemetry.log_step`. wandb remains optional
+exactly as before — when ``wb=True`` and wandb imports, metrics reach it with
+the SAME keys the loops always used; otherwise they only reach the registry
+and the JSONL sink.
+
+Module-level helpers (``get_registry`` / ``warn_once``) expose a process
+default registry for call sites with no run in scope (e.g.
+``utils/profiling.py``'s unknown-device-kind warning).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from agilerl_tpu.observability.events import JsonlSink, NullSink
+from agilerl_tpu.observability.lineage import LineageTracker
+from agilerl_tpu.observability.registry import MetricsRegistry
+from agilerl_tpu.observability.timeline import StepTimeline
+
+#: env var: write run telemetry JSONL here when no explicit path is given
+#: (a directory gets one file per run; a ``.jsonl`` path is used verbatim)
+TELEMETRY_ENV = "AGILERL_TPU_TELEMETRY"
+#: env var: emit a JSONL ``step`` event every N steps (default 1). Hot
+#: per-env-step loops with a JsonlSink should raise this — each step event
+#: is a flushed disk write. 0 disables step events; aggregates stay exact.
+STEP_EVERY_ENV = "AGILERL_TPU_TELEMETRY_STEP_EVERY"
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-default registry (used by call sites with no run in scope)."""
+    return _default_registry
+
+
+def warn_once(key: str, message: str, **fields: Any) -> bool:
+    return _default_registry.warn_once(key, message, **fields)
+
+
+def _resolve_jsonl_path(jsonl_path: Optional[str]) -> Optional[str]:
+    path = jsonl_path or os.environ.get(TELEMETRY_ENV)
+    if not path:
+        return None
+    if path.endswith(".jsonl"):
+        return path
+    os.makedirs(path, exist_ok=True)
+    import time
+
+    return os.path.join(path, f"run-{os.getpid()}-{int(time.time())}.jsonl")
+
+
+class RunTelemetry:
+    """Registry + sink + lineage + step timeline + optional wandb, for one
+    training run."""
+
+    def __init__(
+        self,
+        wb: bool = False,
+        config: Optional[Dict] = None,
+        jsonl_path: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        lineage: bool = True,
+        name: str = "train",
+        model_config=None,
+        step_event_every: Optional[int] = None,
+        project: str = "agilerl-tpu",
+    ):
+        if step_event_every is None:
+            step_event_every = int(os.environ.get(STEP_EVERY_ENV, "1") or 1)
+        self.registry = registry or MetricsRegistry()
+        self._closed = False
+        path = _resolve_jsonl_path(jsonl_path)
+        sink = self.registry.sink
+        # attach a live sink when: the registry has none, a previous run's
+        # sink was closed, or a JSONL path is requested but only a NullSink
+        # is attached (a live JsonlSink from the caller is respected)
+        if (sink is None or getattr(sink, "closed", False)
+                or (path and isinstance(sink, NullSink))):
+            self.registry.attach_sink(JsonlSink(path) if path else NullSink())
+            if path:
+                # a crashed/interrupted run still gets its lineage_summary at
+                # process exit; close() is idempotent so a normal close wins
+                import atexit
+                import weakref
+
+                ref = weakref.ref(self)
+                atexit.register(lambda: ref() and ref().close())
+        self.lineage = LineageTracker(self.registry) if lineage else None
+        if self.lineage is not None:
+            # marks the tracker as facade-owned: attach_evolution may replace
+            # it on HPO objects reused across runs (a user-wired tracker is
+            # never clobbered)
+            self.lineage._facade_owned = True
+        self.timeline = StepTimeline(
+            self.registry, name=name, model_config=model_config,
+            step_event_every=step_event_every)
+        self._wandb = None
+        if wb:
+            from agilerl_tpu.utils.utils import init_wandb
+
+            self._wandb = init_wandb(project=project, config=config)
+        if config:
+            self.registry.emit("run_config", config=config)
+
+    # -- the deduplicated per-loop logging surface -------------------------
+    def log_step(self, metrics: Dict[str, Any], kind: str = "metrics") -> None:
+        """Route one metrics dict to wandb (when enabled) + the event sink —
+        the single replacement for every ``if wandb_run is not None:
+        wandb_run.log({...})`` block."""
+        if self._wandb is not None:
+            self._wandb.log(metrics)
+        self.registry.emit(kind, **metrics)
+
+    def step(self, **kwargs) -> Optional[Dict[str, Any]]:
+        """Per-training-step timeline tick (see StepTimeline.step)."""
+        return self.timeline.step(**kwargs)
+
+    def record_eval(self, pop: List, fitnesses: List[float]) -> None:
+        """Feed an evaluation's fitnesses to the lineage tracker (closing out
+        the previous generation's parent→child records) and emit an ``eval``
+        event."""
+        if self.lineage is not None:
+            for agent, f in zip(pop, fitnesses):
+                self.lineage.record_fitness(agent.index, float(f))
+        if fitnesses:
+            mean = float(sum(float(f) for f in fitnesses) / len(fitnesses))
+            self.registry.gauge("eval/mean_fitness").set(mean)
+            self.registry.emit(
+                "eval",
+                mean_fitness=mean,
+                fitnesses=[float(f) for f in fitnesses],
+                agents=[int(a.index) for a in pop],
+            )
+
+    def attach_evolution(self, tournament, mutation) -> None:
+        """Point the HPO machinery's lineage hooks at this run's tracker."""
+        if self.lineage is None:
+            return
+
+        def _attachable(obj):
+            existing = getattr(obj, "lineage", None)
+            # replace nothing the caller wired in explicitly; a facade-owned
+            # tracker from a PREVIOUS run must be replaced or generation
+            # events would land in that run's closed sink
+            return existing is None or getattr(existing, "_facade_owned", False)
+
+        if tournament is not None and _attachable(tournament):
+            tournament.lineage = self.lineage
+        if mutation is not None and _attachable(mutation):
+            mutation.lineage = self.lineage
+
+    def close(self, lineage_path: Optional[str] = None) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.lineage is not None:
+            if lineage_path:
+                self.lineage.dump(lineage_path)
+            self.registry.emit("lineage_summary",
+                               mutation_effects=self.lineage.mutation_effects())
+        sink = self.registry.sink
+        if sink is not None:
+            sink.close()
+
+
+def init_run_telemetry(
+    wb: bool = False,
+    config: Optional[Dict] = None,
+    telemetry: Optional[RunTelemetry] = None,
+    **kwargs,
+) -> RunTelemetry:
+    """The loops' one-liner: reuse a caller-supplied RunTelemetry or build a
+    fresh one (wandb when ``wb``, JSONL when configured via arg/env)."""
+    if telemetry is not None:
+        return telemetry
+    return RunTelemetry(wb=wb, config=config, **kwargs)

@@ -15,7 +15,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from agilerl_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

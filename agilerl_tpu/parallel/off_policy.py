@@ -232,7 +232,7 @@ class EvoDQN:
         replicated-deterministically on every device (same key -> same
         tournament, no rank-0 broadcast; parity contrast: hpo/tournament.py:161
         broadcast_object_list)."""
-        from jax import shard_map
+        from agilerl_tpu.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         assert "pop" in mesh.axis_names

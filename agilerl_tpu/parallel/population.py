@@ -22,7 +22,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from agilerl_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from agilerl_tpu.envs.core import JaxEnv, VecState, make_autoreset_step

@@ -9,8 +9,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from agilerl_tpu.observability import init_run_telemetry
 from agilerl_tpu.utils.utils import (
-    init_wandb,
     print_hyperparams,
     resume_population_from_checkpoint,
     save_population_checkpoint,
@@ -45,10 +45,12 @@ def train_bandits(
     accelerator=None,
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
+    telemetry=None,
 ) -> Tuple[List, List[List[float]]]:
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
-    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
+    telem.attach_evolution(tournament, mutation)
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
@@ -72,6 +74,7 @@ def train_bandits(
                 context = next_context
                 total_steps += 1
                 agent.steps[-1] += 1
+                telem.step(env_steps=1, agent_index=agent.index)
                 if len(memory) >= agent.batch_size and step % max(agent.learn_step, 1) == 0:
                     agent.learn(memory.sample(agent.batch_size))
             agent.scores.append(regret_free / max(evo_steps, 1))
@@ -81,9 +84,9 @@ def train_bandits(
         ]
         for i, f in enumerate(fitnesses):
             pop_fitnesses[i].append(f)
-        if wandb_run is not None:
-            wandb_run.log({"global_step": total_steps,
-                           "eval/mean_fitness": float(np.mean(fitnesses))})
+        telem.record_eval(pop, fitnesses)
+        telem.log_step({"global_step": total_steps,
+                        "eval/mean_fitness": float(np.mean(fitnesses))})
         if verbose:
             print(f"--- steps {total_steps} fitness {[f'{f:.2f}' for f in fitnesses]}")
             print_hyperparams(pop)
@@ -102,4 +105,6 @@ def train_bandits(
         if target is not None and np.min(fitnesses) >= target:
             break
 
+    if telemetry is None:
+        telem.close()
     return pop, pop_fitnesses

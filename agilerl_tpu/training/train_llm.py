@@ -13,8 +13,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from agilerl_tpu.observability import init_run_telemetry
 from agilerl_tpu.utils.utils import (
-    init_wandb,
     print_hyperparams,
     resume_population_from_checkpoint,
     save_population_checkpoint,
@@ -51,12 +51,18 @@ def finetune_llm_reasoning(
     save_elite: bool = False,
     elite_path: Optional[str] = None,
     resume: bool = False,
+    telemetry=None,
 ) -> Tuple[List, List[List[float]]]:
     """GRPO reasoning finetune (parity: train_llm.py:25)."""
     _assert_llm_mutations(mutation)
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
-    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
+    telem.attach_evolution(tournament, mutation)
+    if telem.timeline.model_config is None:
+        # bind the population's transformer config so the timeline can emit
+        # MFU (tokens/step vs the chip's bf16 peak) alongside step_time_s
+        telem.timeline.set_model_config(getattr(pop[0], "model_config", None))
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     start = time.time()
 
@@ -74,11 +80,12 @@ def finetune_llm_reasoning(
                     f"[{step}] agent {agent.index} loss {loss:.4f} "
                     f"reward {np.mean(rewards):.3f}"
                 )
-            if wandb_run is not None:
-                wandb_run.log({
-                    "train/loss": loss, "train/mean_reward": float(np.mean(rewards)),
-                    "agent": agent.index,
-                })
+            telem.log_step({
+                "train/loss": loss, "train/mean_reward": float(np.mean(rewards)),
+                "agent": agent.index,
+            })
+            telem.step(tokens=int(np.asarray(ids).size), agent_index=agent.index,
+                       metrics={"loss": float(loss)})
             prompts = next_prompts
 
         if step % evaluation_interval == 0:
@@ -88,8 +95,8 @@ def finetune_llm_reasoning(
             if verbose:
                 print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
                 print_hyperparams(pop)
-            if wandb_run is not None:
-                wandb_run.log({"eval/mean_fitness": float(np.mean(fitnesses))})
+            telem.record_eval(pop, fitnesses)
+            telem.log_step({"eval/mean_fitness": float(np.mean(fitnesses))})
             if tournament is not None and mutation is not None:
                 pop = tournament_selection_and_mutation(
                     pop, tournament, mutation, language_model=True,
@@ -106,6 +113,8 @@ def finetune_llm_reasoning(
         if stop:
             break
 
+    if telemetry is None:
+        telem.close()
     return pop, pop_fitnesses
 
 
@@ -128,12 +137,16 @@ def finetune_llm_preference(
     save_elite: bool = False,
     elite_path: Optional[str] = None,
     resume: bool = False,
+    telemetry=None,
 ) -> Tuple[List, List[List[float]]]:
     """DPO preference finetune (parity: train_llm.py:417)."""
     _assert_llm_mutations(mutation)
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
-    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
+    telem.attach_evolution(tournament, mutation)
+    if telem.timeline.model_config is None:
+        telem.timeline.set_model_config(getattr(pop[0], "model_config", None))
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
 
     for step in range(1, max_steps + 1):
@@ -144,8 +157,9 @@ def finetune_llm_preference(
             agent.steps[-1] += len(batch["chosen_ids"])
             if verbose:
                 print(f"[{step}] agent {agent.index} dpo loss {loss:.4f} acc {acc:.3f}")
-            if wandb_run is not None:
-                wandb_run.log({"train/loss": loss, "train/acc": acc, "agent": agent.index})
+            telem.log_step({"train/loss": loss, "train/acc": acc, "agent": agent.index})
+            telem.step(tokens=int(np.asarray(batch["chosen_ids"]).size),
+                       agent_index=agent.index, metrics={"loss": float(loss)})
 
         if step % evaluation_interval == 0:
             fitnesses = [agent.test(env) for agent in pop]
@@ -153,8 +167,8 @@ def finetune_llm_preference(
                 pop_fitnesses[i].append(f)
             if verbose:
                 print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
-            if wandb_run is not None:
-                wandb_run.log({"eval/mean_fitness": float(np.mean(fitnesses))})
+            telem.record_eval(pop, fitnesses)
+            telem.log_step({"eval/mean_fitness": float(np.mean(fitnesses))})
             if tournament is not None and mutation is not None:
                 pop = tournament_selection_and_mutation(
                     pop, tournament, mutation, language_model=True,
@@ -169,4 +183,6 @@ def finetune_llm_preference(
         if stop:
             break
 
+    if telemetry is None:
+        telem.close()
     return pop, pop_fitnesses

@@ -10,8 +10,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from agilerl_tpu.observability import init_run_telemetry
 from agilerl_tpu.utils.utils import (
-    init_wandb,
     print_hyperparams,
     resume_population_from_checkpoint,
     save_population_checkpoint,
@@ -44,10 +44,12 @@ def train_multi_agent_on_policy(
     accelerator=None,
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
+    telemetry=None,
 ) -> Tuple[List, List[List[float]]]:
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
-    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
+    telem.attach_evolution(tournament, mutation)
     num_envs = getattr(env, "num_envs", 1)
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
@@ -63,6 +65,8 @@ def train_multi_agent_on_policy(
                 agent.learn()
                 steps += agent.learn_step * num_envs
                 total_steps += agent.learn_step * num_envs
+                telem.step(env_steps=agent.learn_step * num_envs,
+                           agent_index=agent.index)
             agent.steps[-1] += steps
 
         fitnesses = [
@@ -71,9 +75,9 @@ def train_multi_agent_on_policy(
         ]
         for i, f in enumerate(fitnesses):
             pop_fitnesses[i].append(f)
-        if wandb_run is not None:
-            wandb_run.log({"global_step": total_steps,
-                           "eval/mean_fitness": float(np.mean(fitnesses))})
+        telem.record_eval(pop, fitnesses)
+        telem.log_step({"global_step": total_steps,
+                        "eval/mean_fitness": float(np.mean(fitnesses))})
         if verbose:
             fps = total_steps / (time.time() - start)
             print(f"--- steps {total_steps} fps {fps:.0f} fitness {[f'{f:.1f}' for f in fitnesses]}")
@@ -93,4 +97,6 @@ def train_multi_agent_on_policy(
         if target is not None and np.min(fitnesses) >= target:
             break
 
+    if telemetry is None:
+        telem.close()
     return pop, pop_fitnesses

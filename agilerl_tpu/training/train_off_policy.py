@@ -13,8 +13,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from agilerl_tpu.components.sampler import Sampler
+from agilerl_tpu.observability import init_run_telemetry
 from agilerl_tpu.utils.utils import (
-    init_wandb,
     print_hyperparams,
     resume_population_from_checkpoint,
     save_population_checkpoint,
@@ -109,10 +109,12 @@ def train_off_policy(
     accelerator=None,
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
+    telemetry=None,
 ) -> Tuple[List, List[List[float]]]:
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
-    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
+    telem.attach_evolution(tournament, mutation)
     sampler = Sampler(
         memory=memory, per=per,
         n_step_memory=n_step_memory if n_step else None,
@@ -210,6 +212,7 @@ def train_off_policy(
                 steps += num_envs
                 total_steps += num_envs
                 epsilon = max(eps_end, epsilon * eps_decay)
+                telem.step(env_steps=num_envs, agent_index=agent.index)
 
                 if (
                     len(memory) >= agent.batch_size
@@ -239,11 +242,11 @@ def train_off_policy(
         ]
         for i, f in enumerate(fitnesses):
             pop_fitnesses[i].append(f)
-        if wandb_run is not None:
-            wandb_run.log(
-                {"global_step": total_steps, "fps": total_steps / (time.time() - start),
-                 "eval/mean_fitness": float(np.mean(fitnesses))}
-            )
+        telem.record_eval(pop, fitnesses)
+        telem.log_step(
+            {"global_step": total_steps, "fps": total_steps / (time.time() - start),
+             "eval/mean_fitness": float(np.mean(fitnesses))}
+        )
         if verbose:
             fps = total_steps / (time.time() - start)
             print(
@@ -269,4 +272,6 @@ def train_off_policy(
         if target is not None and np.min(fitnesses) >= target:
             break
 
+    if telemetry is None:
+        telem.close()
     return pop, pop_fitnesses

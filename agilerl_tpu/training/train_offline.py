@@ -9,8 +9,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from agilerl_tpu.observability import init_run_telemetry
 from agilerl_tpu.utils.utils import (
-    init_wandb,
     print_hyperparams,
     resume_population_from_checkpoint,
     save_population_checkpoint,
@@ -45,13 +45,15 @@ def train_offline(
     accelerator=None,
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
+    telemetry=None,
 ) -> Tuple[List, List[List[float]]]:
     """dataset: dict-like with observations/actions/rewards/next_observations/
     terminals arrays (h5py.File or numpy dict; parity with the reference's
     h5 format in data/cartpole)."""
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
-    wandb_run = init_wandb(config=INIT_HP) if wb else None
+    telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
+    telem.attach_evolution(tournament, mutation)
 
     if len(memory) == 0:
         obs = np.asarray(dataset["observations"])
@@ -75,6 +77,7 @@ def train_offline(
                 agent.learn(memory.sample(agent.batch_size))
                 agent.steps[-1] += agent.learn_step
                 total_steps += agent.learn_step
+                telem.step(env_steps=agent.learn_step, agent_index=agent.index)
 
         fitnesses = [
             agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
@@ -82,9 +85,9 @@ def train_offline(
         ]
         for i, f in enumerate(fitnesses):
             pop_fitnesses[i].append(f)
-        if wandb_run is not None:
-            wandb_run.log({"global_step": total_steps,
-                           "eval/mean_fitness": float(np.mean(fitnesses))})
+        telem.record_eval(pop, fitnesses)
+        telem.log_step({"global_step": total_steps,
+                        "eval/mean_fitness": float(np.mean(fitnesses))})
         if verbose:
             print(f"--- steps {total_steps} fitness {[f'{f:.1f}' for f in fitnesses]}")
             print_hyperparams(pop)
@@ -103,4 +106,6 @@ def train_offline(
         if target is not None and np.min(fitnesses) >= target:
             break
 
+    if telemetry is None:
+        telem.close()
     return pop, pop_fitnesses

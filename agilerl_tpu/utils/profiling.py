@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
 
@@ -49,13 +49,47 @@ PEAK_BF16_FLOPS = {
 }
 
 
+#: fallback peak (TPU v5 bf16) for TPU generations missing from the table
+_FALLBACK_TPU_PEAK = 197e12
+
+
+def peak_flops_info(device=None, registry=None) -> Tuple[Optional[float], bool]:
+    """``(peak_bf16_flops, estimated)`` for the device's chip generation.
+
+    ``peak`` is None when the backend has no well-defined peak (CPU/GPU) — no
+    fabricated MFU. A TPU generation missing from PEAK_BF16_FLOPS falls back
+    to the v5 peak with ``estimated=True`` and a one-time warning event
+    through ``registry`` (a run's own registry so the event reaches its JSONL
+    stream; the process-default registry otherwise), so the silent-default
+    failure mode (wrong-by-4x MFU on a future chip, nobody notices) cannot
+    recur.
+    """
+    device = device or jax.devices()[0]
+    if device.platform != "tpu":
+        return None, False
+    kind = device.device_kind.lower()
+    peak = PEAK_BF16_FLOPS.get(kind)
+    if peak is not None:
+        return peak, False
+    if registry is None:
+        from agilerl_tpu.observability import get_registry
+
+        registry = get_registry()
+    registry.warn_once(
+        f"peak_flops:{kind}",
+        f"unknown TPU device_kind {kind!r}: no entry in PEAK_BF16_FLOPS — "
+        f"falling back to {_FALLBACK_TPU_PEAK:.0f} FLOPs/s (TPU v5 bf16); "
+        "MFU readings will be tagged estimated=true",
+        device_kind=kind,
+        fallback_peak_flops=_FALLBACK_TPU_PEAK,
+    )
+    return _FALLBACK_TPU_PEAK, True
+
+
 def peak_flops_per_device(device=None) -> Optional[float]:
     """Peak bf16 FLOPs/s for the device's chip generation; None when the
     backend has no well-defined peak (CPU)."""
-    device = device or jax.devices()[0]
-    if device.platform != "tpu":
-        return None  # CPU/GPU/unknown: no peak table -> no fabricated MFU
-    return PEAK_BF16_FLOPS.get(device.device_kind.lower(), 197e12)
+    return peak_flops_info(device)[0]
 
 
 def estimate_mfu(
@@ -66,9 +100,22 @@ def estimate_mfu(
 ) -> float:
     """Model FLOPs utilisation (parity: modules/gpt.py:516, generalised).
 
-    peak_flops defaults per detected TPU generation (bf16)."""
+    peak_flops defaults per detected TPU generation (bf16). On a backend with
+    no defined peak (CPU/GPU) the historical v5 fallback is kept for
+    backward compatibility but announced via a one-time warning event — the
+    returned figure is an estimate, not a real MFU."""
     if peak_flops is None:
-        peak_flops = peak_flops_per_device() or 197e12
+        peak_flops, _ = peak_flops_info()
+        if peak_flops is None:
+            from agilerl_tpu.observability import warn_once
+
+            warn_once(
+                "estimate_mfu:no-peak",
+                "estimate_mfu called on a backend with no defined bf16 peak "
+                f"(CPU/GPU): using the TPU v5 fallback {_FALLBACK_TPU_PEAK:.0f} "
+                "FLOPs/s — treat the result as an estimate",
+            )
+            peak_flops = _FALLBACK_TPU_PEAK
     flops = transformer_flops_per_token(config) * tokens_per_step
     return flops / (step_time_s * peak_flops)
 
@@ -90,8 +137,10 @@ def achieved_flops_metrics(
         return {}
     achieved = flops * calls / elapsed_s
     out: Dict[str, Any] = {"achieved_tflops_per_sec": round(achieved / 1e12, 4)}
-    peak = peak_flops_per_device()
+    peak, estimated = peak_flops_info()
     out["mfu"] = round(achieved / peak, 4) if peak else None
+    if estimated:
+        out["estimated"] = True
     return out
 
 

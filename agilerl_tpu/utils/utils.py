@@ -485,8 +485,16 @@ def tournament_selection_and_mutation(
     save_elite: bool = False,
     accelerator=None,
     language_model: bool = False,
+    lineage=None,
 ) -> List:
-    """select -> mutate -> optionally save elite (parity: utils/utils.py:706)."""
+    """select -> mutate -> optionally save elite (parity: utils/utils.py:706).
+
+    ``lineage`` (an observability.LineageTracker) attaches to the tournament
+    and mutation engines for this call so genealogy is recorded without the
+    caller mutating HPO objects itself."""
+    if lineage is not None:
+        tournament.lineage = lineage
+        mutation.lineage = lineage
     elite, population = tournament.select(population)
     population = mutation.mutation(population)
     if save_elite and elite_path is not None:

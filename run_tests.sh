@@ -48,6 +48,7 @@ if [ ${#SHARDS[@]} -eq 0 ]; then
     tests/test_algorithms
     tests/test_hpo
     tests/test_llm
+    tests/test_observability
     tests/test_ops
     tests/test_parallel
     tests/test_train

@@ -1,0 +1,119 @@
+"""Lineage tracker genealogy (ISSUE 1 tentpole §3): two generations, one
+mutation each, fitness deltas recorded; plus the hpo hook wiring."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.observability import LineageTracker, MemorySink, MetricsRegistry
+
+
+def test_two_generation_genealogy_with_fitness_deltas():
+    sink = MemorySink()
+    tracker = LineageTracker(MetricsRegistry(sink=sink))
+
+    # generation 1: agents 0 (fit 1.0) and 1 (fit 3.0); 1 wins, child 2
+    # mutated with "param"
+    tracker.start_generation({0: 1.0, 1: 3.0})
+    tracker.record_selection(1, 1, 3.0, elite=True)
+    tracker.record_selection(1, 2, 3.0)
+    tracker.record_mutation(1, "None")
+    tracker.record_mutation(2, "param")
+    # next eval closes generation 1's children
+    tracker.record_fitness(1, 3.5)
+    tracker.record_fitness(2, 5.0)
+
+    # generation 2: child 2 is now fittest; child 3 mutated with "lr"
+    tracker.start_generation({1: 3.5, 2: 5.0})
+    tracker.record_selection(2, 2, 5.0, elite=True)
+    tracker.record_selection(2, 3, 5.0)
+    tracker.record_mutation(2, "None")
+    tracker.record_mutation(3, "lr")
+    tracker.record_fitness(2, 5.0)
+    tracker.record_fitness(3, 4.0)
+
+    doc = tracker.to_json()
+    assert len(doc["generations"]) == 2
+    g1, g2 = doc["generations"]
+    assert g1["generation"] == 1 and g2["generation"] == 2
+    assert g1["fitness"]["mean"] == pytest.approx(2.0)
+    assert g1["fitness"]["max"] == 3.0
+
+    by_child_g1 = {c["child"]: c for c in g1["children"]}
+    assert by_child_g1[1]["elite"] is True
+    assert by_child_g1[2]["parent"] == 1
+    assert by_child_g1[2]["mutation"] == "param"
+    assert by_child_g1[2]["fitness_delta"] == pytest.approx(5.0 - 3.0)
+
+    by_child_g2 = {c["child"]: c for c in g2["children"]}
+    assert by_child_g2[3]["mutation"] == "lr"
+    assert by_child_g2[3]["fitness_delta"] == pytest.approx(4.0 - 5.0)
+
+    # per-mutation-class delta rollup
+    effects = doc["mutation_effects"]
+    assert effects["param"]["mean"] == pytest.approx(2.0)
+    assert effects["lr"]["mean"] == pytest.approx(-1.0)
+
+    # events: one generation event per start_generation, one lineage event
+    # per closed child record
+    kinds = [e["kind"] for e in sink.events]
+    assert kinds.count("generation") == 2
+    assert kinds.count("lineage") == 4
+    lineage_events = [e for e in sink.events if e["kind"] == "lineage"]
+    assert all("fitness_delta" in e for e in lineage_events)
+
+
+def test_unknown_index_fitness_is_ignored():
+    tracker = LineageTracker()
+    tracker.record_fitness(99, 1.0)  # initial population, no open record
+    assert tracker.generations == []
+
+
+def test_dump_roundtrip(tmp_path):
+    import json
+
+    tracker = LineageTracker()
+    tracker.start_generation({0: 1.0})
+    tracker.record_selection(0, 1, 1.0)
+    tracker.record_mutation(1, "act")
+    tracker.record_fitness(1, 2.0)
+    path = tmp_path / "lineage.json"
+    tracker.dump(path)
+    doc = json.loads(path.read_text())
+    assert doc["generations"][0]["children"][0]["mutation"] == "act"
+
+
+def test_tournament_and_mutation_hooks_record_genealogy():
+    """The hpo machinery itself drives the tracker: TournamentSelection
+    records selections, Mutations records the applied class."""
+    from agilerl_tpu.hpo import Mutations, TournamentSelection
+
+    class FakeAgent:
+        def __init__(self, index, fitness):
+            self.index = index
+            self.fitness = [fitness]
+            self.mut = "None"
+
+        def clone(self, index):
+            c = FakeAgent(index, self.fitness[-1])
+            return c
+
+    tracker = LineageTracker()
+    tour = TournamentSelection(2, True, 3, eval_loop=1,
+                               rng=np.random.default_rng(0), lineage=tracker)
+    # rl-HP-only mutations on fakes: use pre_training_mut which only draws
+    # from {no_mutation, rl_hp}; zero rl_hp prob -> always no_mutation
+    mut = Mutations(no_mutation=1.0, architecture=0, parameters=0,
+                    activation=0, rl_hp=0, rand_seed=0, lineage=tracker)
+
+    pop = [FakeAgent(0, 1.0), FakeAgent(1, 2.0), FakeAgent(2, 3.0)]
+    elite, nxt = tour.select(pop)
+    assert elite.index == 2
+    nxt = mut.mutation(nxt, pre_training_mut=True)
+
+    gen = tracker.generations[0]
+    assert gen["fitness_by_index"] == {0: 1.0, 1: 2.0, 2: 3.0}
+    assert len(gen["children"]) == 3
+    assert gen["children"][0]["elite"] is True
+    assert all(c["mutation"] is not None for c in gen["children"])
+    # parents must come from the evaluated population
+    assert {c["parent"] for c in gen["children"]} <= {0, 1, 2}

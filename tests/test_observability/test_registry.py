@@ -1,0 +1,131 @@
+"""Metrics registry: histogram percentile interpolation, warn-once, JSONL
+sink, Prometheus exposition (ISSUE 1 tentpole §1)."""
+
+import json
+import math
+
+import pytest
+
+from agilerl_tpu.observability import (
+    JsonlSink,
+    MemorySink,
+    MetricsRegistry,
+    read_jsonl,
+)
+
+
+def test_counter_and_gauge():
+    reg = MetricsRegistry()
+    c = reg.counter("requests_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("queue_depth")
+    g.set(7)
+    assert g.value == 7.0
+    # get-or-create: same instrument back, type mismatch rejected
+    assert reg.counter("requests_total") is c
+    with pytest.raises(TypeError):
+        reg.gauge("requests_total")
+
+
+def test_histogram_percentile_bucket_boundary_interpolation():
+    """Percentiles interpolate linearly inside the containing bucket
+    (Prometheus histogram_quantile semantics)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=[1.0, 2.0, 4.0])
+    # empty histogram: NaN
+    assert math.isnan(h.percentile(50))
+    for v in [0.5, 1.5, 1.5, 3.0]:
+        h.observe(v)
+    # rank(p50) = 2 of 4 -> falls in bucket (1, 2] holding observations 2..3:
+    # lo + (hi-lo) * (rank - cum_prev)/bucket_count = 1 + 1 * (2-1)/2 = 1.5
+    assert h.percentile(50) == pytest.approx(1.5)
+    # rank(p25) = 1 -> first bucket (0, 1], interpolates from 0: 0 + 1*1/1
+    assert h.percentile(25) == pytest.approx(1.0)
+    # rank(p95) = 3.8 -> bucket (2, 4]: 2 + 2 * (3.8-3)/1 = 3.6
+    assert h.percentile(95) == pytest.approx(3.6)
+    assert h.percentile(100) == pytest.approx(4.0)
+    assert h.count == 4 and h.sum == pytest.approx(6.5)
+
+
+def test_histogram_overflow_bucket_reports_edge():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=[1.0, 2.0])
+    for v in [10.0, 20.0, 30.0]:
+        h.observe(v)
+    # every observation beyond the last bound: percentiles clamp to the edge
+    # (the histogram cannot see beyond its largest finite bucket)
+    assert h.percentile(50) == 2.0
+    assert h.percentile(99) == 2.0
+
+
+def test_warn_once_emits_single_event():
+    sink = MemorySink()
+    reg = MetricsRegistry(sink=sink)
+    with pytest.warns(RuntimeWarning):
+        assert reg.warn_once("k1", "first") is True
+    assert reg.warn_once("k1", "again") is False
+    with pytest.warns(RuntimeWarning):
+        assert reg.warn_once("k2", "other") is True
+    warnings_seen = [e for e in sink.events if e["kind"] == "warning"]
+    assert len(warnings_seen) == 2
+    assert reg.counter("warnings_total").value == 2
+
+
+def test_jsonl_sink_roundtrip_and_monotone_seq(tmp_path):
+    path = tmp_path / "events.jsonl"
+    sink = JsonlSink(path)
+    reg = MetricsRegistry(sink=sink)
+    for i in range(5):
+        reg.emit("step", step=i, value=float(i) / 2)
+    sink.close()
+    events = read_jsonl(path)
+    assert [e["seq"] for e in events] == list(range(5))
+    assert [e["step"] for e in events] == list(range(5))
+    assert all(e["kind"] == "step" for e in events)
+    # every line is standalone JSON (crash-safe flushing)
+    lines = path.read_text().strip().splitlines()
+    assert all(json.loads(l) for l in lines)
+
+
+def test_jsonl_sink_coerces_numpy_scalars(tmp_path):
+    import numpy as np
+
+    path = tmp_path / "events.jsonl"
+    sink = JsonlSink(path)
+    sink.emit("m", {"a": np.float32(1.5), "b": np.arange(3), "c": {"d": np.int64(2)}})
+    sink.close()
+    (e,) = read_jsonl(path)
+    assert e["a"] == 1.5 and e["b"] == [0, 1, 2] and e["c"]["d"] == 2
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.counter("reqs", help="requests").inc(3)
+    reg.gauge("depth").set(2)
+    h = reg.histogram("serving/ttft_s", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.prometheus_text()
+    assert "# TYPE reqs counter" in text
+    assert "reqs 3.0" in text
+    assert "depth 2.0" in text
+    # name sanitized, buckets cumulative, +Inf bucket == count
+    assert 'serving_ttft_s_bucket{le="0.1"} 1' in text
+    assert 'serving_ttft_s_bucket{le="1.0"} 2' in text
+    assert 'serving_ttft_s_bucket{le="+Inf"} 3' in text
+    assert "serving_ttft_s_count 3" in text
+
+
+def test_snapshot_mixes_instrument_kinds():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.gauge("g").set(1.0)
+    reg.histogram("h", buckets=[1.0]).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["c"] == 1.0 and snap["g"] == 1.0
+    assert snap["h"]["count"] == 1

@@ -1,0 +1,84 @@
+"""Tier-1 smoke (ISSUE 1 CI satellite): a 3-generation, pop=4 evo-PPO run on
+CPU must leave a JSONL timeline with step, generation, and lineage events,
+step indices monotone."""
+
+import json
+
+import numpy as np
+
+from agilerl_tpu.envs import CartPole, JaxVecEnv
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+from agilerl_tpu.observability import JsonlSink, MetricsRegistry, RunTelemetry
+from agilerl_tpu.training.train_on_policy import train_on_policy
+from agilerl_tpu.utils.utils import create_population
+
+
+def test_evo_ppo_smoke_emits_full_timeline(tmp_path):
+    env = JaxVecEnv(CartPole(), num_envs=4, seed=0)
+    pop = create_population(
+        "PPO", env.single_observation_space, env.single_action_space,
+        population_size=4, seed=0,
+        net_config={"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}},
+        num_envs=4, learn_step=16, batch_size=32, update_epochs=1,
+    )
+    tournament = TournamentSelection(2, True, 4, eval_loop=1,
+                                     rng=np.random.default_rng(0))
+    # parameter/no-op mutations only: learn_step stays fixed so the run is
+    # exactly 3 generations (128 steps each) within max_steps=384
+    mutation = Mutations(no_mutation=0.5, architecture=0.0, parameters=0.5,
+                         activation=0.0, rl_hp=0.0, rand_seed=0)
+    jsonl = tmp_path / "timeline.jsonl"
+    telem = RunTelemetry(
+        wb=False, registry=MetricsRegistry(sink=JsonlSink(jsonl)))
+
+    pop, fitnesses = train_on_policy(
+        env, "CartPole-v1", "PPO", pop,
+        max_steps=384, evo_steps=128, eval_steps=40, eval_loop=1,
+        tournament=tournament, mutation=mutation, verbose=False,
+        telemetry=telem,
+    )
+    telem.close()
+
+    events = [json.loads(l) for l in jsonl.read_text().splitlines() if l]
+    assert events, "telemetry JSONL is empty"
+    # sink sequence numbers are monotone
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["kind"], []).append(e)
+
+    # per-step timeline: monotone step indices, step_time_s + throughput on
+    # every record (mfu only on TPU — absent here)
+    steps = by_kind.get("step", [])
+    assert len(steps) >= 10
+    idx = [e["step"] for e in steps]
+    assert idx == sorted(idx) and len(set(idx)) == len(idx)
+    for e in steps:
+        assert e["step_time_s"] > 0
+        assert e["env_steps_per_sec"] > 0
+
+    # one generation event per tournament round (3 generations ran)
+    generations = by_kind.get("generation", [])
+    assert len(generations) == 3
+    assert [g["generation"] for g in generations] == [1, 2, 3]
+    for g in generations:
+        assert g["fitness"]["count"] == 4
+        assert {"mean", "std", "min", "max"} <= set(g["fitness"])
+
+    # parent→child lineage: generations 1 and 2's children were re-evaluated,
+    # so their records closed with mutation class + fitness delta
+    lineage = by_kind.get("lineage", [])
+    assert len(lineage) >= 4
+    for e in lineage:
+        assert "parent" in e and "child" in e
+        assert e["mutation"] is not None
+        assert e["fitness_delta"] is not None
+
+    # eval summaries ride along
+    assert len(by_kind.get("eval", [])) == 3
+    assert len(by_kind.get("metrics", [])) == 3
+    # the run itself still trains
+    assert len(pop) == 4
+    assert all(np.isfinite(f).all() for f in fitnesses)

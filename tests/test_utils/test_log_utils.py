@@ -1,0 +1,57 @@
+"""CombineLogs.reduce edge cases (ISSUE 1 satellite): all-zero weights,
+empty accumulator, single-host across_hosts=True."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.utils.log_utils import CombineLogs, DistributeCombineLogs
+
+
+def test_reduce_empty_accumulator():
+    logs = CombineLogs()
+    assert logs.reduce() == {}
+    assert logs.reduce(across_hosts=True) == {}
+
+
+def test_reduce_all_zero_weights_does_not_divide_by_zero():
+    logs = CombineLogs()
+    logs.accum({"loss": 2.0}, weight=0.0)
+    logs.accum({"loss": 4.0}, weight=0.0)
+    out = logs.reduce()
+    # num = 0, den clamps at 1e-12 -> finite 0.0, not NaN/inf
+    assert out["loss"] == 0.0
+    assert np.isfinite(out["loss"])
+
+
+def test_reduce_single_host_across_hosts_true():
+    """across_hosts=True on a single process must skip the allgather and
+    match the local weighted mean exactly."""
+    import jax
+
+    assert jax.process_count() == 1
+    logs = CombineLogs()
+    logs.accum({"loss": 1.0, "acc": 0.5}, weight=1.0)
+    logs.accum({"loss": 3.0, "acc": 1.0}, weight=3.0)
+    local = {"loss": 2.5, "acc": 0.875}
+    out = logs.reduce(across_hosts=True)
+    for k, v in local.items():
+        assert out[k] == pytest.approx(v)
+    # and equals the across_hosts=False path
+    assert out == pytest.approx(logs.reduce(across_hosts=False))
+
+
+def test_clear_resets_state():
+    logs = CombineLogs()
+    logs.accum({"x": 1.0})
+    logs.clear()
+    assert logs.reduce() == {}
+    # parity alias stays importable
+    assert DistributeCombineLogs is CombineLogs
+
+
+def test_mixed_weights_weighted_mean():
+    logs = CombineLogs()
+    logs.accum({"m": 10.0}, weight=1.0)
+    logs.accum({"m": 0.0}, weight=0.0)  # zero-weight sample must not count
+    logs.accum({"m": 20.0}, weight=3.0)
+    assert logs.reduce()["m"] == pytest.approx((10 + 60) / 4)
