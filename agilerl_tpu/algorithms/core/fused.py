@@ -1,0 +1,101 @@
+"""Fused sample+learn: the off-policy learn path as ONE device dispatch.
+
+The legacy interop learn path is a host-driven round-trip chain —
+``sample`` (dispatch) → host → ``learn`` (dispatch) → host →
+``update_priorities`` (dispatch) — 3+ dispatches per learn step, each with
+host↔device latency on the critical path. The fused path traces sampling
+(uniform or PER inverse-CDF), observation preprocessing, the algorithm's
+train core, and the PER priority write-back into a single jit, so one
+dispatch does it all and JAX's async dispatch can overlap the whole learn
+step with the next host ``env.step`` (docs/performance.md).
+
+Each off-policy algorithm exposes ``learn_from_buffer(memory, ...)`` built
+from these helpers plus its own un-jitted train core. The helpers reuse the
+buffer module's jitted ``_sample`` / ``_per_sample`` / ``_per_update``
+directly — called during tracing they inline into the outer jit, so the
+sampling math is the same code the standalone path runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.components.replay_buffer import (
+    BufferState,
+    PERState,
+    PrioritizedReplayBuffer,
+    _gather,
+    _per_sample,
+    _per_update,
+    _sample,
+    drain_staging,
+)
+from agilerl_tpu.utils.spaces import preprocess_observation
+
+PyTree = Any
+
+
+def preprocess_batch(batch: dict, obs_space) -> dict:
+    """obs/next_obs → network-ready arrays, traced inside the fused jit
+    (the legacy path does this on host between the sample and learn
+    dispatches)."""
+    batch = dict(batch)
+    batch["obs"] = preprocess_observation(obs_space, batch["obs"])
+    batch["next_obs"] = preprocess_observation(obs_space, batch["next_obs"])
+    return batch
+
+
+def uniform_sample(
+    state: BufferState, key: jax.Array, batch_size: int
+) -> Tuple[PyTree, jax.Array, jax.Array]:
+    """Uniform ``(batch, idx, weights)`` with explicit indices, so a paired
+    n-step batch can be gathered at the SAME ring positions (mirrors
+    Sampler's non-PER paired path)."""
+    idx = jax.random.randint(
+        key, (batch_size,), 0, jnp.maximum(state.size, 1)
+    )
+    return _gather(state, idx), idx, jnp.ones((batch_size,), jnp.float32)
+
+
+def per_sample(
+    state: PERState, key: jax.Array, batch_size: int, beta: jax.Array
+) -> Tuple[PyTree, jax.Array, jax.Array]:
+    """PER inverse-CDF sample traced inside the fused jit."""
+    return _per_sample(state, key, batch_size, beta)
+
+
+def per_write_back(
+    state: PERState, idx: jax.Array, priorities: jax.Array, alpha: jax.Array
+) -> PERState:
+    """Priority update traced inside the SAME dispatch as the learn step —
+    the third leg of the legacy round-trip chain, for free."""
+    return _per_update(state, idx, priorities, alpha)
+
+
+def gather_paired(state: BufferState, idx: jax.Array) -> PyTree:
+    """Index-aligned gather from the paired n-step ring (inside the jit)."""
+    return _gather(state, idx)
+
+
+def resolve_states(
+    memory, n_step_memory=None
+) -> Tuple[Any, Optional[BufferState], bool]:
+    """Host-side prologue for ``learn_from_buffer``: drain chunked-ingestion
+    staging (forwarding the n-step fold's displaced raw chunk to the main
+    buffer so the paired rings stay index-aligned) and hand back the device
+    states to sample from.
+
+    Returns ``(sample_state, n_step_buffer_state | None, per)`` where
+    ``sample_state`` is a :class:`PERState` when ``per`` else a
+    :class:`BufferState`.
+    """
+    drain_staging(memory, n_step_memory)
+    per = isinstance(memory, PrioritizedReplayBuffer)
+    state = memory.per_state if per else memory.state
+    nstate = None
+    if n_step_memory is not None:
+        nstate = getattr(n_step_memory, "state", None)
+    return state, nstate, per

@@ -38,6 +38,9 @@ def default_hp_config() -> HyperparameterConfig:
 
 class DDPG(RLAlgorithm):
     supports_activation_mutation = False
+    #: learn_from_buffer is uniform-replay only (learn has no priority
+    #: output) — the training loop falls back to the legacy path under PER
+    supports_fused_per = False
 
     def __init__(
         self,
@@ -172,13 +175,14 @@ class DDPG(RLAlgorithm):
         return action[0] if single else action
 
     # ------------------------------------------------------------------ #
-    def _critic_fn(self):
+    def _critic_core_fn(self):
+        """Un-jitted critic TD step — jitted standalone by ``_critic_fn``
+        and inlined into the fused sample+learn dispatch."""
         a_cfg = self.actor.config
         c_cfg = self.critic.config
         low, high = self.actor.action_low, self.actor.action_high
         tx = self.critic_optimizer.tx
 
-        @jax.jit
         def critic_step(cparams, ct_params, at_params, opt_state, batch, gamma, tau):
             obs = batch["obs"]
             action = batch["action"].astype(jnp.float32)
@@ -206,13 +210,15 @@ class DDPG(RLAlgorithm):
 
         return critic_step
 
-    def _actor_fn(self):
+    def _critic_fn(self):
+        return jax.jit(self._critic_core_fn())
+
+    def _actor_core_fn(self):
         a_cfg = self.actor.config
         c_cfg = self.critic.config
         low, high = self.actor.action_low, self.actor.action_high
         tx = self.actor_optimizer.tx
 
-        @jax.jit
         def actor_step(aparams, at_params, cparams, opt_state, batch, tau):
             obs = batch["obs"]
 
@@ -232,6 +238,100 @@ class DDPG(RLAlgorithm):
             return aparams, at_params, opt_state, loss
 
         return actor_step
+
+    def _actor_fn(self):
+        return jax.jit(self._actor_core_fn())
+
+    def _fused_learn_fn(self):
+        """Uniform sample + critic TD step + (policy_freq-gated) actor step
+        as ONE jit. The actor cadence rides a traced bool through
+        ``lax.cond`` so the cadence never recompiles
+        (docs/performance.md)."""
+        import functools
+
+        from agilerl_tpu.algorithms.core import fused as F
+        from agilerl_tpu.components.replay_buffer import _sample as _buffer_sample
+
+        critic_core = self._critic_core_fn()
+        actor_core = self._actor_core_fn()
+        obs_space = self.observation_space
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5),
+            static_argnames=("batch_size",),
+        )
+        def fused(aparams, at_params, cparams, ct_params, a_opt, c_opt,
+                  buf_state, key, gamma, tau, do_actor, batch_size):
+            batch = F.preprocess_batch(
+                dict(_buffer_sample(buf_state, key, batch_size)), obs_space
+            )
+            cparams, ct_params, c_opt, closs = critic_core(
+                cparams, ct_params, at_params, c_opt, batch, gamma, tau
+            )
+
+            def run_actor(ops):
+                ap, atp, ao = ops
+                ap, atp, ao, _ = actor_core(ap, atp, cparams, ao, batch, tau)
+                return ap, atp, ao
+
+            aparams, at_params, a_opt = jax.lax.cond(
+                do_actor, run_actor, lambda ops: ops,
+                (aparams, at_params, a_opt),
+            )
+            return aparams, at_params, cparams, ct_params, a_opt, c_opt, closs
+
+        return fused
+
+    def _fused_static_key(self) -> tuple:
+        """Everything the fused jit closes over, hashably — population
+        members with identical architectures/action bounds share ONE
+        compiled executable through the process-global jit cache."""
+        import numpy as np
+
+        return (
+            self.actor.config, self.critic.config,
+            str(self.observation_space),
+            tuple(np.asarray(self.actor.action_low).ravel().tolist()),
+            tuple(np.asarray(self.actor.action_high).ravel().tolist()),
+            self.actor_optimizer.optimizer_name,
+            self.actor_optimizer.max_grad_norm,
+            self.critic_optimizer.optimizer_name,
+            self.critic_optimizer.max_grad_norm,
+        )
+
+    def learn_from_buffer(self, memory, n_step_memory=None, key=None,
+                          beta=None):
+        """One fused sample+learn dispatch (uniform replay only — the
+        DDPG/TD3 learn contract has no priority output, exactly like the
+        legacy path). Returns the critic loss as a device array."""
+        from agilerl_tpu.algorithms.core import fused as F
+
+        state, _, per = F.resolve_states(memory, n_step_memory)
+        if per:
+            raise NotImplementedError(
+                f"{type(self).__name__}.learn_from_buffer supports uniform "
+                "replay only (no priority output to write back)"
+            )
+        if key is None:
+            key = self.next_key()
+        self._learn_counter += 1
+        do_actor = self._learn_counter % self.policy_freq == 0
+        fn = self.jit_fn("fused_learn", self._fused_learn_fn,
+                         static_key=self._fused_static_key())
+        aparams, at_params, cparams, ct_params, a_opt, c_opt, closs = fn(
+            self.actor.params, self.actor_target.params,
+            self.critic.params, self.critic_target.params,
+            self.actor_optimizer.opt_state, self.critic_optimizer.opt_state,
+            state, key, jnp.float32(self.gamma), jnp.float32(self.tau),
+            jnp.bool_(do_actor), batch_size=self.batch_size,
+        )
+        self.actor.params = aparams
+        self.actor_target.params = at_params
+        self.critic.params = cparams
+        self.critic_target.params = ct_params
+        self.actor_optimizer.opt_state = a_opt
+        self.critic_optimizer.opt_state = c_opt
+        return closs
 
     def learn(self, experiences: Dict[str, jax.Array]) -> float:
         batch = dict(experiences)

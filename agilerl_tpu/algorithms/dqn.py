@@ -25,6 +25,7 @@ from agilerl_tpu.algorithms.core.registry import (
     OptimizerConfig,
     RLParameter,
 )
+from agilerl_tpu.components.replay_buffer import _sample as _buffer_sample
 from agilerl_tpu.networks.q_networks import QNetwork
 
 
@@ -37,6 +38,10 @@ def default_hp_config() -> HyperparameterConfig:
 
 
 class DQN(RLAlgorithm):
+    #: learn_from_buffer supports PER sampling + in-dispatch priority
+    #: write-back (the training loop gates the fused path on this)
+    supports_fused_per = True
+
     def __init__(
         self,
         observation_space,
@@ -140,12 +145,13 @@ class DQN(RLAlgorithm):
         return actions[0] if single else actions
 
     # ------------------------------------------------------------------ #
-    def _train_fn(self):
+    def _train_core_fn(self):
+        """The un-jitted TD update — jitted standalone by ``_train_fn`` and
+        inlined into the fused sample+learn dispatch by ``_fused_learn_fn``."""
         config = self.actor.config
         tx = self.optimizer.tx
         double = self.double
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, target_params, opt_state, batch, weights, gamma, tau):
             obs, action = batch["obs"], batch["action"].astype(jnp.int32)
             reward = batch["reward"].astype(jnp.float32)
@@ -175,6 +181,91 @@ class DQN(RLAlgorithm):
             return params, target_params, opt_state, loss, td_abs
 
         return train_step
+
+    def _train_fn(self):
+        return functools.partial(jax.jit, donate_argnums=(0, 1, 2))(
+            self._train_core_fn()
+        )
+
+    def _fused_learn_fn(self, per: bool):
+        """sample (uniform / PER inverse-CDF) + preprocess + TD update
+        (+ PER priority write-back) as ONE jit (docs/performance.md)."""
+        from agilerl_tpu.algorithms.core import fused as F
+
+        core = self._train_core_fn()
+        obs_space = self.observation_space
+
+        if per:
+
+            @functools.partial(
+                jax.jit, donate_argnums=(0, 1, 2, 3), static_argnames=("batch_size",)
+            )
+            def fused_per(params, tparams, opt_state, per_state, key, gamma,
+                          tau, alpha, beta, batch_size):
+                batch, idx, weights = F.per_sample(per_state, key, batch_size, beta)
+                batch = F.preprocess_batch(batch, obs_space)
+                params, tparams, opt_state, loss, td_abs = core(
+                    params, tparams, opt_state, batch, weights, gamma, tau
+                )
+                per_state = F.per_write_back(per_state, idx, td_abs + 1e-6, alpha)
+                return params, tparams, opt_state, per_state, loss
+
+            return fused_per
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1, 2), static_argnames=("batch_size",)
+        )
+        def fused(params, tparams, opt_state, buf_state, key, gamma, tau,
+                  batch_size):
+            batch = F.preprocess_batch(
+                dict(_buffer_sample(buf_state, key, batch_size)), obs_space
+            )
+            weights = jnp.ones((batch_size,), jnp.float32)
+            params, tparams, opt_state, loss, _ = core(
+                params, tparams, opt_state, batch, weights, gamma, tau
+            )
+            return params, tparams, opt_state, loss
+
+        return fused
+
+    def learn_from_buffer(self, memory, n_step_memory=None, key=None,
+                          beta: float = 0.4):
+        """One fused sample+learn dispatch from the replay buffer; for PER
+        the priority write-back rides the same dispatch. Returns the loss as
+        a DEVICE array — the hot loop stays sync-free and converts it to a
+        float only at telemetry cadence."""
+        from agilerl_tpu.algorithms.core import fused as F
+
+        state, _, per = F.resolve_states(memory, n_step_memory)
+        if key is None:
+            key = self.next_key()
+        fn = self.jit_fn(
+            "fused_learn_per" if per else "fused_learn",
+            lambda: self._fused_learn_fn(per),
+            static_key=(self.actor.config, str(self.observation_space),
+                        self.double, per, self.optimizer.optimizer_name,
+                        self.optimizer.max_grad_norm),
+        )
+        if per:
+            params, tparams, opt_state, per_state, loss = fn(
+                self.actor.params, self.actor_target.params,
+                self.optimizer.opt_state, state, key,
+                jnp.float32(self.gamma), jnp.float32(self.tau),
+                jnp.float32(memory.alpha), jnp.float32(beta),
+                batch_size=self.batch_size,
+            )
+            memory.per_state = per_state
+        else:
+            params, tparams, opt_state, loss = fn(
+                self.actor.params, self.actor_target.params,
+                self.optimizer.opt_state, state, key,
+                jnp.float32(self.gamma), jnp.float32(self.tau),
+                batch_size=self.batch_size,
+            )
+        self.actor.params = params
+        self.actor_target.params = tparams
+        self.optimizer.opt_state = opt_state
+        return loss
 
     def learn(self, experiences) -> float:
         """One TD update from a sampled batch (parity: dqn.py learn/update).

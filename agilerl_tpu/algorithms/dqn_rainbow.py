@@ -67,6 +67,10 @@ def categorical_projection(
 
 
 class RainbowDQN(RLAlgorithm):
+    #: learn_from_buffer supports PER sampling + in-dispatch priority
+    #: write-back (the training loop gates the fused path on this)
+    supports_fused_per = True
+
     def __init__(
         self,
         observation_space,
@@ -199,13 +203,14 @@ class RainbowDQN(RLAlgorithm):
         logp_a = logp[jnp.arange(action.shape[0]), action]  # [B, atoms]
         return -jnp.sum(jax.lax.stop_gradient(proj) * logp_a, axis=-1)  # [B]
 
-    def _train_fn(self):
+    def _train_core_fn(self):
+        """Un-jitted C51 update — jitted standalone by ``_train_fn`` and
+        inlined into the fused sample+learn dispatch."""
         config = self.actor.config
         tx = self.optimizer.tx
         use_n_step = self.n_step > 1
         loss_terms = self._loss_terms
 
-        @jax.jit
         def train_step(params, tparams, opt_state, batch, weights, n_batch, gamma, tau, key):
             k1, k2 = jax.random.split(key)
 
@@ -229,6 +234,111 @@ class RainbowDQN(RLAlgorithm):
 
         config_n_step = self.n_step
         return train_step
+
+    def _train_fn(self):
+        return jax.jit(self._train_core_fn())
+
+    def _fused_learn_fn(self, per: bool, paired: bool):
+        """sample + paired n-step gather + preprocess + C51 update + PER
+        priority write-back, all in ONE jit (docs/performance.md)."""
+        import functools
+
+        from agilerl_tpu.algorithms.core import fused as F
+
+        core = self._train_core_fn()
+        obs_space = self.observation_space
+        prior_eps = self.prior_eps
+
+        if per:
+
+            @functools.partial(
+                jax.jit, donate_argnums=(0, 1, 2, 3), static_argnames=("batch_size",)
+            )
+            def fused_per(params, tparams, opt_state, per_state, nstep_buf,
+                          key, gamma, tau, alpha, beta, batch_size):
+                ks, kl = jax.random.split(key)
+                batch, idx, weights = F.per_sample(per_state, ks, batch_size, beta)
+                n_batch = None
+                if paired:
+                    n_batch = F.preprocess_batch(
+                        dict(F.gather_paired(nstep_buf, idx)), obs_space
+                    )
+                batch = F.preprocess_batch(dict(batch), obs_space)
+                params, tparams, opt_state, loss, elementwise = core(
+                    params, tparams, opt_state, batch, weights, n_batch,
+                    gamma, tau, kl,
+                )
+                per_state = F.per_write_back(
+                    per_state, idx, elementwise + prior_eps, alpha
+                )
+                return params, tparams, opt_state, per_state, loss
+
+            return fused_per
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1, 2), static_argnames=("batch_size",)
+        )
+        def fused(params, tparams, opt_state, buf_state, nstep_buf, key,
+                  gamma, tau, batch_size):
+            ks, kl = jax.random.split(key)
+            batch, idx, weights = F.uniform_sample(buf_state, ks, batch_size)
+            n_batch = None
+            if paired:
+                n_batch = F.preprocess_batch(
+                    dict(F.gather_paired(nstep_buf, idx)), obs_space
+                )
+            batch = F.preprocess_batch(dict(batch), obs_space)
+            params, tparams, opt_state, loss, _ = core(
+                params, tparams, opt_state, batch, weights, n_batch,
+                gamma, tau, kl,
+            )
+            return params, tparams, opt_state, loss
+
+        return fused
+
+    def learn_from_buffer(self, memory, n_step_memory=None, key=None,
+                          beta: Optional[float] = None):
+        """One fused sample+learn dispatch, with the paired n-step batch
+        gathered at the SAME ring indices inside the jit and PER priorities
+        written back in the same dispatch. Returns the loss as a device
+        array (sync-free hot path)."""
+        from agilerl_tpu.algorithms.core import fused as F
+
+        state, nstep_buf, per = F.resolve_states(memory, n_step_memory)
+        paired = nstep_buf is not None
+        if key is None:
+            key = self.next_key()
+        if beta is None:
+            beta = self.beta
+        name = f"fused_learn{'_per' if per else ''}{'_nstep' if paired else ''}"
+        fn = self.jit_fn(
+            name,
+            lambda: self._fused_learn_fn(per, paired),
+            static_key=(self.actor.config, str(self.observation_space),
+                        per, paired, self.n_step, self.prior_eps,
+                        self.optimizer.optimizer_name,
+                        self.optimizer.max_grad_norm),
+        )
+        if per:
+            params, tparams, opt_state, per_state, loss = fn(
+                self.actor.params, self.actor_target.params,
+                self.optimizer.opt_state, state, nstep_buf, key,
+                jnp.float32(self.gamma), jnp.float32(self.tau),
+                jnp.float32(memory.alpha), jnp.float32(beta),
+                batch_size=self.batch_size,
+            )
+            memory.per_state = per_state
+        else:
+            params, tparams, opt_state, loss = fn(
+                self.actor.params, self.actor_target.params,
+                self.optimizer.opt_state, state, nstep_buf, key,
+                jnp.float32(self.gamma), jnp.float32(self.tau),
+                batch_size=self.batch_size,
+            )
+        self.actor.params = params
+        self.actor_target.params = tparams
+        self.optimizer.opt_state = opt_state
+        return loss
 
     def learn(self, experiences) -> Tuple[float, Optional[np.ndarray]]:
         """experiences: batch dict (uniform), or (batch, idxs, weights) for PER,

@@ -59,7 +59,9 @@ class TD3(DDPG):
         return d
 
     # ------------------------------------------------------------------ #
-    def _twin_critic_fn(self):
+    def _twin_critic_core_fn(self):
+        """Un-jitted twin-critic step — jitted standalone by
+        ``_twin_critic_fn`` and inlined into the fused dispatch."""
         a_cfg = self.actor.config
         c1_cfg = self.critic.config
         c2_cfg = self.critic_2.config
@@ -68,7 +70,6 @@ class TD3(DDPG):
         tx2 = self.critic_2_optimizer.tx
         policy_noise, noise_clip = self.policy_noise, self.noise_clip
 
-        @jax.jit
         def critic_step(
             c1, c1t, c2, c2t, at_params, opt1, opt2, batch, gamma, tau, key,
             update_targets,
@@ -118,6 +119,96 @@ class TD3(DDPG):
             return c1, c1t, c2, c2t, opt1, opt2, l1 + l2
 
         return critic_step
+
+    def _twin_critic_fn(self):
+        return jax.jit(self._twin_critic_core_fn())
+
+    def _fused_learn_fn(self):
+        """Uniform sample + twin-critic step (target smoothing inside) +
+        delayed actor step as ONE jit; the policy cadence is a traced bool
+        (``update_targets`` gates both the target soft-updates and, via
+        ``lax.cond``, the actor step) so nothing recompiles per step."""
+        import functools
+
+        from agilerl_tpu.algorithms.core import fused as F
+        from agilerl_tpu.components.replay_buffer import _sample as _buffer_sample
+
+        critic_core = self._twin_critic_core_fn()
+        actor_core = self._actor_core_fn()
+        obs_space = self.observation_space
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+            static_argnames=("batch_size",),
+        )
+        def fused(aparams, at_params, c1, c1t, c2, c2t, a_opt, o1, o2,
+                  buf_state, key, gamma, tau, update_targets, batch_size):
+            ks, kn = jax.random.split(key)
+            batch = F.preprocess_batch(
+                dict(_buffer_sample(buf_state, ks, batch_size)), obs_space
+            )
+            c1, c1t, c2, c2t, o1, o2, closs = critic_core(
+                c1, c1t, c2, c2t, at_params, o1, o2, batch,
+                gamma, tau, kn, update_targets,
+            )
+
+            def run_actor(ops):
+                ap, atp, ao = ops
+                ap, atp, ao, _ = actor_core(ap, atp, c1, ao, batch, tau)
+                return ap, atp, ao
+
+            aparams, at_params, a_opt = jax.lax.cond(
+                update_targets, run_actor, lambda ops: ops,
+                (aparams, at_params, a_opt),
+            )
+            return aparams, at_params, c1, c1t, c2, c2t, a_opt, o1, o2, closs
+
+        return fused
+
+    def learn_from_buffer(self, memory, n_step_memory=None, key=None,
+                          beta=None):
+        """One fused sample+learn dispatch (uniform replay only, like
+        DDPG). Returns the summed twin-critic loss as a device array."""
+        from agilerl_tpu.algorithms.core import fused as F
+
+        state, _, per = F.resolve_states(memory, n_step_memory)
+        if per:
+            raise NotImplementedError(
+                "TD3.learn_from_buffer supports uniform replay only "
+                "(no priority output to write back)"
+            )
+        if key is None:
+            key = self.next_key()
+        self._learn_counter += 1
+        update_targets = self._learn_counter % self.policy_freq == 0
+        fn = self.jit_fn(
+            "fused_learn", self._fused_learn_fn,
+            static_key=self._fused_static_key() + (
+                self.critic_2.config, self.critic_2_optimizer.optimizer_name,
+                self.critic_2_optimizer.max_grad_norm,
+                self.policy_noise, self.noise_clip,
+            ),
+        )
+        (aparams, at_params, c1, c1t, c2, c2t, a_opt, o1, o2, closs) = fn(
+            self.actor.params, self.actor_target.params,
+            self.critic.params, self.critic_target.params,
+            self.critic_2.params, self.critic_2_target.params,
+            self.actor_optimizer.opt_state,
+            self.critic_optimizer.opt_state,
+            self.critic_2_optimizer.opt_state,
+            state, key, jnp.float32(self.gamma), jnp.float32(self.tau),
+            jnp.bool_(update_targets), batch_size=self.batch_size,
+        )
+        self.actor.params = aparams
+        self.actor_target.params = at_params
+        self.critic.params = c1
+        self.critic_target.params = c1t
+        self.critic_2.params = c2
+        self.critic_2_target.params = c2t
+        self.actor_optimizer.opt_state = a_opt
+        self.critic_optimizer.opt_state = o1
+        self.critic_2_optimizer.opt_state = o2
+        return closs
 
     def learn(self, experiences: Dict[str, jax.Array]) -> float:
         batch = dict(experiences)

@@ -17,9 +17,20 @@ from agilerl_tpu.components.replay_buffer import ReplayBuffer
 
 
 class MultiAgentReplayBuffer(ReplayBuffer):
-    def __init__(self, max_size: int, agent_ids: List[str], device=None):
-        super().__init__(max_size)
+    def __init__(self, max_size: int, agent_ids: List[str], device=None,
+                 seed: Optional[int] = None,
+                 flush_every: Optional[int] = None):
+        super().__init__(max_size, seed=seed, flush_every=flush_every)
         self.agent_ids = list(agent_ids)
+
+    def _transition(self, obs, action, reward, next_obs, done) -> Dict[str, Any]:
+        return {
+            "obs": {a: obs[a] for a in self.agent_ids},
+            "action": {a: action[a] for a in self.agent_ids},
+            "reward": {a: reward[a] for a in self.agent_ids},
+            "next_obs": {a: next_obs[a] for a in self.agent_ids},
+            "done": {a: done[a] for a in self.agent_ids},
+        }
 
     def save_to_memory(
         self,
@@ -31,11 +42,20 @@ class MultiAgentReplayBuffer(ReplayBuffer):
         is_vectorised: bool = False,
     ) -> None:
         """Parity: save_to_memory single-env :169 / vectorised :213."""
-        transition = {
-            "obs": {a: obs[a] for a in self.agent_ids},
-            "action": {a: action[a] for a in self.agent_ids},
-            "reward": {a: reward[a] for a in self.agent_ids},
-            "next_obs": {a: next_obs[a] for a in self.agent_ids},
-            "done": {a: done[a] for a in self.agent_ids},
-        }
-        self.add(transition, batched=is_vectorised)
+        self.add(self._transition(obs, action, reward, next_obs, done),
+                 batched=is_vectorised)
+
+    def stage_to_memory(
+        self,
+        obs: Dict[str, Any],
+        action: Dict[str, Any],
+        reward: Dict[str, Any],
+        next_obs: Dict[str, Any],
+        done: Dict[str, Any],
+        is_vectorised: bool = False,
+    ) -> None:
+        """Chunked-ingestion variant of ``save_to_memory``: queue on host,
+        coalesced into one device dispatch per ``flush_every`` steps (the
+        training loop flushes before every sample)."""
+        self.stage(self._transition(obs, action, reward, next_obs, done),
+                   batched=is_vectorised)

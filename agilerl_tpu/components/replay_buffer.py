@@ -12,6 +12,17 @@ host<->device churn); ``sample`` is a jitted gather. The PER "segment tree" of
 the reference becomes a dense priority array + cumulative-sum inverse-CDF
 sampling — O(N) cumsum on the VPU beats pointer-chasing trees on TPU and is
 fully vectorised.
+
+Host<->device pipelining (docs/performance.md): every buffer also exposes a
+host-side **staging ring** — ``stage()`` appends transitions to a host list
+and ``flush()`` coalesces them into ONE batched, donated ``_add`` dispatch,
+so the interop training loops pay one device round-trip per ``flush_every``
+env steps instead of one per step. ``len(buffer)`` / ``is_full`` read a
+host-mirrored size counter and never sync a device scalar, keeping warmup
+gates off the dispatch critical path. ``MultiStepReplayBuffer`` folds its
+n-step windows **vectorised over the whole staged chunk** at flush time
+(identical, op-for-op, to the per-step Python fold — see
+tests/test_components/test_chunked_ingestion.py).
 """
 
 from __future__ import annotations
@@ -75,20 +86,95 @@ def _gather(state: BufferState, idx: jax.Array) -> PyTree:
     return jax.tree_util.tree_map(lambda buf: buf[idx], state.storage)
 
 
+def _num_rows(transition: PyTree, batched: bool) -> int:
+    if not batched:
+        return 1
+    leaf = jax.tree_util.tree_leaves(transition)[0]
+    # read the leading dim WITHOUT materialising device arrays on host —
+    # a np.asarray here would reintroduce a per-add device sync
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        shape = np.asarray(leaf).shape
+    return int(shape[0])
+
+
+def _as_batched_host(transition: PyTree, batched: bool) -> PyTree:
+    """Host-side COPY of a transition, normalised to [N, ...] leaves.
+
+    The copy is load-bearing: staged rows outlive the env step that produced
+    them, and vector envs that reuse their observation buffers (gymnasium
+    ``copy=False``, envpool) would otherwise overwrite every staged view
+    before flush. The eager path never had the hazard — it materialises to
+    device inside ``_add`` immediately."""
+    if batched:
+        return jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True), transition
+        )
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True)[None], transition
+    )
+
+
+def _concat_chunks(chunks: list) -> PyTree:
+    if len(chunks) == 1:
+        return chunks[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *chunks
+    )
+
+
+def drain_staging(memory, n_step_memory=None) -> None:
+    """Drain chunked-ingestion staging before any sample: fold the n-step
+    buffer's staged steps and forward the displaced raw chunk to the MAIN
+    buffer (both rings receive the same rows in the same order — the
+    paired-index contract PER/n-step sampling relies on), then flush the
+    main buffer's own staging. The single owner of this invariant — both
+    ``Sampler.flush`` and the fused learn path call it."""
+    if n_step_memory is not None and hasattr(n_step_memory, "take_raw"):
+        raw = n_step_memory.take_raw()
+        if raw is not None and memory is not None:
+            memory.add(raw, batched=True)
+    if memory is not None and hasattr(memory, "flush"):
+        memory.flush()
+
+
 class ReplayBuffer:
     """Uniform experience replay in HBM (parity: replay_buffer.py:12).
 
     Lazy storage allocation happens on the first ``add`` (parity with the
     reference's lazy ``_init`` :60) so callers never declare obs specs.
+
+    ``seed=`` makes the sampling key deterministic; without it the key is
+    drawn from global numpy randomness (reproducible only under a global
+    ``np.random.seed``). ``stage()``/``flush()`` implement the chunked
+    ingestion path: staged transitions live on host until ``flush`` writes
+    them all in one device dispatch. ``len()`` counts FLUSHED rows only and
+    never syncs the device (host-mirrored counter).
     """
 
-    def __init__(self, max_size: int, device=None):
+    def __init__(self, max_size: int, device=None,
+                 seed: Optional[int] = None,
+                 flush_every: Optional[int] = None):
         self.max_size = int(max_size)
         self.state: Optional[BufferState] = None
-        self._key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        # an explicitly configured cadence is remembered so the training
+        # loops' pipelining default doesn't clobber it
+        self._flush_every_user_set = flush_every is not None
+        self.flush_every = max(int(flush_every), 1) if flush_every else 1
+        self._staged: list = []
+        self._staged_calls = 0
+        self._size_host = 0
+        self.seed(seed)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        """(Re)seed the sampling PRNG (threaded from the training loops'
+        ``seed=`` so runs are reproducible)."""
+        if seed is None:
+            seed = np.random.randint(0, 2**31 - 1)
+        self._key = jax.random.PRNGKey(int(seed))
 
     def __len__(self) -> int:
-        return 0 if self.state is None else int(self.state.size)
+        return self._size_host
 
     @property
     def is_full(self) -> bool:
@@ -106,22 +192,73 @@ class ReplayBuffer:
             size=jnp.zeros((), jnp.int32),
         )
 
-    def add(self, transition: PyTree, batched: bool = False) -> None:
-        """Append one transition (or a [N, ...] batch when batched=True)."""
+    # -- device write paths -------------------------------------------- #
+    def _device_add(self, transition: PyTree, batched: bool) -> None:
         self._ensure_init(transition, batched)
         self.state = _add(self.state, transition, batched=batched)
 
+    def add(self, transition: PyTree, batched: bool = False) -> None:
+        """Append one transition (or a [N, ...] batch when batched=True) —
+        eager: one device dispatch per call. Any staged rows flush first so
+        ring order matches call order."""
+        if self._staged:
+            ReplayBuffer.flush(self)
+        if batched and _num_rows(transition, batched) > self.max_size:
+            # oversized chunk (e.g. a long-deferred n-step raw chunk): route
+            # through the staging flush, which splits into capacity-sized
+            # dispatches with well-defined write order
+            ReplayBuffer.stage(self, transition, batched=True)
+            ReplayBuffer.flush(self)
+            return
+        self._device_add(transition, batched)
+        self._size_host = min(
+            self._size_host + _num_rows(transition, batched), self.max_size
+        )
+
+    def stage(self, transition: PyTree, batched: bool = False) -> None:
+        """Queue a transition on host; auto-flushes every ``flush_every``
+        calls. One ``flush`` = one device dispatch for the whole chunk."""
+        self._staged.append(_as_batched_host(transition, batched))
+        self._staged_calls += 1
+        if self._staged_calls >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write all staged rows in one batched, donated ``_add`` dispatch.
+
+        Chunks longer than the ring capacity are split so every dispatch
+        writes distinct slots (a single scatter with duplicate indices has
+        no defined write order — sequential sub-chunks keep the outcome
+        bit-identical to per-step adds)."""
+        if not self._staged:
+            return
+        chunk = _concat_chunks(self._staged)
+        self._staged = []
+        self._staged_calls = 0
+        rows = _num_rows(chunk, True)
+        for lo in range(0, rows, self.max_size):
+            piece = jax.tree_util.tree_map(
+                lambda x: x[lo:lo + self.max_size], chunk
+            )
+            self._device_add(piece, batched=True)
+        self._size_host = min(self._size_host + rows, self.max_size)
+
     def sample(self, batch_size: int, key: Optional[jax.Array] = None) -> PyTree:
+        self.flush()
         assert self.state is not None and len(self) > 0, "buffer is empty"
         if key is None:
             self._key, key = jax.random.split(self._key)
         return _sample(self.state, key, batch_size)
 
     def sample_from_indices(self, idx: np.ndarray) -> PyTree:
+        self.flush()
         return _gather(self.state, jnp.asarray(idx))
 
     def clear(self) -> None:
         self.state = None
+        self._staged = []
+        self._staged_calls = 0
+        self._size_host = 0
 
 
 # --------------------------------------------------------------------------- #
@@ -148,20 +285,31 @@ class MultiStepReplayBuffer(ReplayBuffer):
     changes — otherwise folds would span unrelated trajectories.
     """
 
-    def __init__(self, max_size: int, n_step: int = 3, gamma: float = 0.99, device=None):
-        super().__init__(max_size)
+    def __init__(self, max_size: int, n_step: int = 3, gamma: float = 0.99,
+                 device=None, seed: Optional[int] = None,
+                 flush_every: Optional[int] = None):
+        super().__init__(max_size, seed=seed, flush_every=flush_every)
         self.n_step = int(n_step)
         self.gamma = float(gamma)
         self._horizon: list = []
+        # chunked-ingestion state: raw per-step transitions staged since the
+        # last fold, plus folded-but-untaken raw chunks for the main buffer
+        self._staged_steps: list = []
+        self._pending_raw: list = []
 
     def reset_horizon(self) -> None:
+        """Folds must not span env resets / agent switches. Pending staged
+        steps are folded first (they happened before the reset)."""
+        self.flush()
         self._horizon = []
 
     def clear(self) -> None:
         # transitions added after clear() must not fold with stale pre-clear
         # steps (advisor finding)
+        self._staged_steps = []
+        self._pending_raw = []
         super().clear()
-        self.reset_horizon()
+        self._horizon = []
 
     def add(self, transition: Dict, batched: bool = False) -> Optional[Dict]:
         """transition keys: obs, action, reward, next_obs, done
@@ -214,6 +362,114 @@ class MultiStepReplayBuffer(ReplayBuffer):
         out = {**first, "reward": reward, "next_obs": next_obs, "done": done}
         out.pop("_boundary", None)
         return out
+
+    # -- chunked ingestion: vectorised fold over a staged chunk --------- #
+    def stage(self, transition: Dict, batched: bool = False) -> None:
+        """Queue one raw step on host (no device dispatch, no fold yet).
+        Auto-folds every ``flush_every`` steps. Do not mix with per-step
+        ``add`` on the same instance — the carried window is shared."""
+        self._staged_steps.append(_as_batched_host(transition, batched))
+        if len(self._staged_steps) >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """Fold every staged step VECTORISED (one pass over the chunk, all
+        window starts at once), push the fused chunk into this buffer's ring
+        in one dispatch, and stash the oldest-raw chunk for ``take_raw``.
+
+        The fold below runs the SAME numpy ops in the same order as the
+        per-step ``_fold`` — only vectorised over the M window starts — so
+        the resulting rows are bit-identical to per-step ingestion."""
+        if self._staged_steps:
+            steps, self._staged_steps = self._staged_steps, []
+            seq = self._horizon + steps
+            n = self.n_step
+            if len(seq) >= n:
+                fused, raw = self._fold_chunk(seq, len(self._horizon))
+                self._horizon = seq[-(n - 1):] if n > 1 else []
+                ReplayBuffer.stage(self, fused, batched=True)
+                self._pending_raw.append(raw)
+            else:
+                self._horizon = seq
+        ReplayBuffer.flush(self)
+
+    def take_raw(self) -> Optional[Dict]:
+        """The 1-step transitions displaced by folds since the last call, as
+        one batched chunk for the MAIN buffer (keeps the paired rings
+        index-aligned: both receive the same rows in the same order)."""
+        self.flush()
+        if not self._pending_raw:
+            return None
+        raw, self._pending_raw = _concat_chunks(self._pending_raw), []
+        return raw
+
+    def _fold_chunk(self, seq: list, n_prev: int) -> Tuple[Dict, Dict]:
+        """All n-step folds completed by this chunk, vectorised.
+
+        seq: the carried window + the staged steps, each a host transition
+        with [N, ...] leaves. n_prev: how many entries are carry — outputs
+        are produced for every window END landing in the new steps, i.e.
+        window starts s = max(0, n_prev - n + 1) .. len(seq) - n (the same
+        outputs the per-step path would have produced, in the same order).
+        Returns (fused_chunk, raw_chunk), both flattened to [M*N, ...]."""
+        n = self.n_step
+        first_start = max(0, n_prev - n + 1)
+        starts = np.arange(first_start, len(seq) - n + 1)
+
+        def at(j, key):
+            # [M, N, ...] gather of `key` across window position j
+            return np.stack([np.asarray(seq[s + j][key]) for s in starts])
+
+        def at_tree(j, key):
+            return jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs),
+                *[seq[s + j][key] for s in starts],
+            )
+
+        # gather the window-start rows ONCE — they are the raw chunk, the
+        # fused chunk's carried keys, and the loop's j=0 inputs all at once
+        keys = [k for k in seq[0] if k != "_boundary"]
+        first = {k: at_tree(0, k) for k in keys}
+
+        reward = np.zeros_like(np.asarray(first["reward"]).astype(np.float32))
+        done = None
+        next_obs = None
+        discount = 1.0
+        alive = np.ones_like(reward)
+        for j in range(n):
+            r = (np.asarray(first["reward"]) if j == 0
+                 else at(j, "reward")).astype(np.float32)
+            d = np.stack([
+                np.asarray(seq[s + j].get("_boundary", seq[s + j]["done"]))
+                for s in starts
+            ]).astype(np.float32)
+            reward = reward + discount * r * alive
+            if next_obs is None:
+                next_obs = first["next_obs"]
+                done = np.asarray(first["done"]).astype(np.float32).copy()
+            else:
+                step_next = at_tree(j, "next_obs")
+                upd = alive.astype(bool)
+                next_obs = jax.tree_util.tree_map(
+                    lambda cur, new: np.where(
+                        upd.reshape(upd.shape + (1,) * (new.ndim - upd.ndim)),
+                        new, cur,
+                    ),
+                    next_obs,
+                    step_next,
+                )
+                done = np.where(upd, at(j, "done").astype(np.float32), done)
+            alive = alive * (1.0 - d)
+            discount *= self.gamma
+
+        def flat(x):
+            # [M, N, ...] -> [M*N, ...] (step-major: per-step add order)
+            return np.reshape(x, (-1,) + x.shape[2:])
+
+        fused = {**first, "reward": reward, "next_obs": next_obs, "done": done}
+        fused = jax.tree_util.tree_map(flat, fused)
+        raw = jax.tree_util.tree_map(flat, first)
+        return fused, raw
 
 
 # --------------------------------------------------------------------------- #
@@ -285,36 +541,46 @@ def _per_update(state: PERState, idx: jax.Array, priorities: jax.Array, alpha: j
 
 
 class PrioritizedReplayBuffer(ReplayBuffer):
-    """Proportional PER (parity: replay_buffer.py:261)."""
+    """Proportional PER (parity: replay_buffer.py:261).
 
-    def __init__(self, max_size: int, alpha: float = 0.6, device=None):
-        super().__init__(max_size)
+    Chunked ingestion mirrors :class:`ReplayBuffer`: staged rows land in one
+    ``_per_add`` dispatch (every row gets the current max priority — exactly
+    what per-step adds would assign, since ``max_priority`` only moves in
+    ``update_priorities``)."""
+
+    def __init__(self, max_size: int, alpha: float = 0.6, device=None,
+                 seed: Optional[int] = None,
+                 flush_every: Optional[int] = None):
+        super().__init__(max_size, seed=seed, flush_every=flush_every)
         self.alpha = float(alpha)
         self.per_state: Optional[PERState] = None
 
-    def __len__(self) -> int:
-        return 0 if self.per_state is None else int(self.per_state.buffer.size)
+    def _ensure_per_init(self, transition: PyTree, batched: bool) -> None:
+        if self.per_state is not None:
+            return
+        example = transition
+        if batched:
+            example = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[0], transition)
+        buf = BufferState(
+            storage=_zeros_like_batch(example, self.max_size),
+            pos=jnp.zeros((), jnp.int32),
+            size=jnp.zeros((), jnp.int32),
+        )
+        self.per_state = PERState(
+            buffer=buf,
+            priorities=jnp.zeros((self.max_size,), jnp.float32),
+            max_priority=jnp.ones((), jnp.float32),
+        )
 
-    def add(self, transition: PyTree, batched: bool = False) -> None:
-        if self.per_state is None:
-            example = transition
-            if batched:
-                example = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[0], transition)
-            buf = BufferState(
-                storage=_zeros_like_batch(example, self.max_size),
-                pos=jnp.zeros((), jnp.int32),
-                size=jnp.zeros((), jnp.int32),
-            )
-            self.per_state = PERState(
-                buffer=buf,
-                priorities=jnp.zeros((self.max_size,), jnp.float32),
-                max_priority=jnp.ones((), jnp.float32),
-            )
+    def _device_add(self, transition: PyTree, batched: bool) -> None:
+        # the base add/stage/flush machinery routes every write through here
+        self._ensure_per_init(transition, batched)
         self.per_state = _per_add(self.per_state, transition, batched=batched)
 
     def sample(
         self, batch_size: int, beta: float = 0.4, key: Optional[jax.Array] = None
     ) -> Tuple[PyTree, jax.Array, jax.Array]:
+        self.flush()
         assert self.per_state is not None and len(self) > 0
         if key is None:
             self._key, key = jax.random.split(self._key)
@@ -326,7 +592,9 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         )
 
     def sample_from_indices(self, idx) -> PyTree:
+        self.flush()
         return _gather(self.per_state.buffer, jnp.asarray(idx))
 
     def clear(self) -> None:
+        super().clear()
         self.per_state = None

@@ -39,9 +39,18 @@ class Sampler:
         )
         self._iter = iter(dataset) if dataset is not None else None
 
+    def flush(self) -> None:
+        """Drain any staged (chunked-ingestion) rows into the device rings
+        before sampling — see ``replay_buffer.drain_staging`` for the
+        paired-ring alignment contract."""
+        from agilerl_tpu.components.replay_buffer import drain_staging
+
+        drain_staging(self.memory, self.n_step_memory)
+
     def sample(self, batch_size: int, beta: Optional[float] = None, idxs=None, **kw):
         if self._iter is not None:
             return next(self._iter)
+        self.flush()
         if self.per:
             batch, idx, weights = self.memory.sample(
                 batch_size, beta=beta if beta is not None else 0.4

@@ -101,12 +101,22 @@ class StepTimeline:
         tokens: int = 0,
         agent_index: Optional[int] = None,
         metrics: Optional[Dict[str, float]] = None,
+        host_time_s: Optional[float] = None,
+        device_time_s: Optional[float] = None,
     ) -> Optional[Dict[str, Any]]:
         """Record one step. The FIRST call only arms the timer (no interval
         exists yet) and returns None. Histograms/gauges/aggregates update on
         every call; the JSONL ``step`` event (and its payload build + memory
         probe) happens every ``step_event_every``-th step — the method
-        returns the payload when one was emitted, else None."""
+        returns the payload when one was emitted, else None.
+
+        ``host_time_s`` / ``device_time_s`` come from the pipelined interop
+        loops (docs/performance.md): host = time actively stepping the env /
+        staging on host; device = time the host spent BLOCKED on device
+        results (action syncs + explicit cadence syncs). The derived
+        ``overlap_fraction`` gauge is ``1 - device_time_s / step_time_s`` —
+        the fraction of the step during which device work ran hidden under
+        host work; it rises toward 1 as pipelining takes hold."""
         dt = self.timer.tick()
         if dt is None:
             return None
@@ -115,6 +125,9 @@ class StepTimeline:
         if tokens and self._flops_per_token is not None and self._peak_flops:
             mfu = round(
                 self._flops_per_token * tokens / (dt * self._peak_flops), 4)
+        overlap = None
+        if device_time_s is not None and dt > 0:
+            overlap = round(min(max(1.0 - device_time_s / dt, 0.0), 1.0), 4)
 
         self.registry.histogram(
             f"{self.name}/step_time_s",
@@ -123,9 +136,17 @@ class StepTimeline:
             self.registry.gauge(f"{self.name}/env_steps_per_sec").set(env_rate)
         if mfu is not None:
             self.registry.gauge(f"{self.name}/mfu").set(mfu)
+        if host_time_s is not None:
+            self.registry.gauge(f"{self.name}/host_time_s").set(host_time_s)
+        if device_time_s is not None:
+            self.registry.gauge(f"{self.name}/device_time_s").set(device_time_s)
+        if overlap is not None:
+            self.registry.gauge(f"{self.name}/overlap_fraction").set(overlap)
         self.registry.counter(f"{self.name}/steps_total").inc()
         for k, v in (("step_time_s", dt), ("env_steps_per_sec", env_rate),
-                     ("mfu", mfu)):
+                     ("mfu", mfu), ("host_time_s", host_time_s),
+                     ("device_time_s", device_time_s),
+                     ("overlap_fraction", overlap)):
             if v is not None:
                 total, n = self._acc.get(k, (0.0, 0))
                 self._acc[k] = (total + v, n + 1)
@@ -143,6 +164,12 @@ class StepTimeline:
                 event["agent"] = int(agent_index)
             if env_rate is not None:
                 event["env_steps_per_sec"] = env_rate
+            if host_time_s is not None:
+                event["host_time_s"] = round(host_time_s, 9)
+            if device_time_s is not None:
+                event["device_time_s"] = round(device_time_s, 9)
+            if overlap is not None:
+                event["overlap_fraction"] = overlap
             if tokens:
                 event["tokens_per_sec"] = round(tokens / dt, 2)
                 if mfu is not None:

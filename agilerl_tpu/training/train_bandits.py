@@ -1,5 +1,10 @@
 """Contextual-bandit training loop (parity: agilerl/training/train_bandits.py —
 BanditEnv loop with regret tracking, fitness eval, evolution).
+
+Pipelined like train_off_policy (docs/performance.md): per-arm transitions
+are staged on host and coalesced into one buffer dispatch per
+``flush_every`` pulls; warmup gates read the host-mirrored size counter,
+and the timeline carries host/device/overlap gauges.
 """
 
 from __future__ import annotations
@@ -46,11 +51,21 @@ def train_bandits(
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    seed: Optional[int] = None,
+    flush_every: Optional[int] = None,
 ) -> Tuple[List, List[List[float]]]:
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
+    if seed is not None and hasattr(memory, "seed"):
+        memory.seed(seed)
+    use_staging = hasattr(memory, "stage")
+    if hasattr(memory, "flush_every"):
+        if flush_every is not None:
+            memory.flush_every = max(int(flush_every), 1)
+        elif not getattr(memory, "_flush_every_user_set", False):
+            memory.flush_every = 8  # pipelining default for untouched buffers
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
@@ -60,23 +75,46 @@ def train_bandits(
         for agent in pop:
             context = env.reset()
             regret_free = 0.0
+            learn_every = max(agent.learn_step, 1)
             for step in range(max(evo_steps, 1)):
+                t_act = time.perf_counter()
                 arm = agent.get_action(context)
+                t_host = time.perf_counter()
                 next_context, reward = env.step(arm)
                 regret_free += float(np.asarray(reward).squeeze())
-                memory.add({
+                transition = {
                     "obs": np.asarray(context)[int(arm)],
                     "action": np.int32(arm),
                     "reward": np.float32(np.asarray(reward).squeeze()),
                     "next_obs": np.asarray(next_context)[int(arm)],
                     "done": np.float32(1.0),
-                })
+                }
+                if use_staging:
+                    # chunked ingestion: one coalesced buffer dispatch per
+                    # flush_every pulls (sampling flushes first)
+                    memory.stage(transition)
+                else:
+                    memory.add(transition)
                 context = next_context
                 total_steps += 1
                 agent.steps[-1] += 1
-                telem.step(env_steps=1, agent_index=agent.index)
-                if len(memory) >= agent.batch_size and step % max(agent.learn_step, 1) == 0:
-                    agent.learn(memory.sample(agent.batch_size))
+                learn_block_s = 0.0
+                if step % learn_every == 0:
+                    if use_staging:
+                        memory.flush()
+                    if len(memory) >= agent.batch_size:
+                        t_learn = time.perf_counter()
+                        agent.learn(memory.sample(agent.batch_size))
+                        learn_block_s = time.perf_counter() - t_learn
+                # the learn call blocks on the device — count it as device
+                # wait so overlap_fraction stays honest
+                telem.step(
+                    env_steps=1, agent_index=agent.index,
+                    host_time_s=time.perf_counter() - t_host - learn_block_s,
+                    device_time_s=t_host - t_act + learn_block_s,
+                )
+            if use_staging:
+                memory.flush()
             agent.scores.append(regret_free / max(evo_steps, 1))
 
         fitnesses = [

@@ -1,6 +1,11 @@
 """Multi-agent off-policy evolutionary training
 (parity: agilerl/training/train_multi_agent_off_policy.py — dict-keyed variant
 of train_off_policy over MultiAgentReplayBuffer).
+
+Pipelined like train_off_policy (docs/performance.md): transitions are
+staged on host and coalesced into one buffer dispatch per ``flush_every``
+steps, warmup gates read the host-mirrored size counter, and the timeline
+carries host/device/overlap gauges.
 """
 
 from __future__ import annotations
@@ -48,11 +53,21 @@ def train_multi_agent_off_policy(
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    seed: Optional[int] = None,
+    flush_every: Optional[int] = None,
 ) -> Tuple[List, List[List[float]]]:
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
+    if seed is not None and hasattr(memory, "seed"):
+        memory.seed(seed)
+    use_staging = hasattr(memory, "stage_to_memory")
+    if hasattr(memory, "flush_every"):
+        if flush_every is not None:
+            memory.flush_every = max(int(flush_every), 1)
+        elif not getattr(memory, "_flush_every_user_set", False):
+            memory.flush_every = 8  # pipelining default for untouched buffers
     num_envs = getattr(env, "num_envs", 1)
     agent_ids = pop[0].agent_ids
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
@@ -64,10 +79,13 @@ def train_multi_agent_off_policy(
         for agent in pop:
             obs, info = env.reset()
             steps = 0
+            learn_every = max(agent.learn_step, 1)
             for _ in range(max(evo_steps // num_envs, 1)):
                 # forward the env's info dict: action masks / env-defined
                 # actions ride it (parity: reference train_multi_agent.py)
+                t_act = time.perf_counter()
                 actions = agent.get_action(obs, infos=info)
+                t_host = time.perf_counter()
                 next_obs, reward, terminated, truncated, info = env.step(actions)
                 # dead/inactive agents arrive as NaN placeholders — zero them
                 # before they can reach the buffer (NaN Q-target poisoning)
@@ -82,19 +100,41 @@ def train_multi_agent_off_policy(
                     # final_obs is assembled from shared memory and can carry
                     # NaN placeholder rows too (review finding)
                     store_next, _ = sanitize_ma_transition(store_next, {})
-                memory.save_to_memory(
-                    obs, actions, reward, store_next, done, is_vectorised=num_envs > 1
-                )
+                if use_staging:
+                    # chunked ingestion: one coalesced buffer dispatch per
+                    # flush_every steps instead of one per step
+                    memory.stage_to_memory(
+                        obs, actions, reward, store_next, done,
+                        is_vectorised=num_envs > 1,
+                    )
+                else:
+                    memory.save_to_memory(
+                        obs, actions, reward, store_next, done,
+                        is_vectorised=num_envs > 1,
+                    )
                 obs = next_obs
                 steps += num_envs
                 total_steps += num_envs
-                telem.step(env_steps=num_envs, agent_index=agent.index)
-                if (
-                    len(memory) >= agent.batch_size
-                    and len(memory) >= learning_delay
-                    and steps % max(agent.learn_step, 1) < num_envs
-                ):
-                    agent.learn(memory.sample(agent.batch_size))
+                learn_block_s = 0.0
+                if steps % learn_every < num_envs:
+                    if use_staging:
+                        memory.flush()
+                    if (
+                        len(memory) >= agent.batch_size
+                        and len(memory) >= learning_delay
+                    ):
+                        t_learn = time.perf_counter()
+                        agent.learn(memory.sample(agent.batch_size))
+                        learn_block_s = time.perf_counter() - t_learn
+                # the learn call blocks on the device — count it as device
+                # wait so overlap_fraction stays honest
+                telem.step(
+                    env_steps=num_envs, agent_index=agent.index,
+                    host_time_s=(time.perf_counter() - t_host) - learn_block_s,
+                    device_time_s=(t_host - t_act) + learn_block_s,
+                )
+            if use_staging:
+                memory.flush()
             agent.steps[-1] += steps
 
         fitnesses = [

@@ -3,6 +3,16 @@
 :340-429, learn cadence, fitness eval, tournament+mutation, fps tracking :439,
 wandb + checkpointing; the Accelerate DataLoader path :213 is replaced by
 device-resident buffers).
+
+Host↔device pipelining (docs/performance.md): the hot loop stages
+transitions on host and coalesces them into one batched buffer dispatch per
+``flush_every`` steps; learning goes through each algorithm's fused
+``learn_from_buffer`` jit (sample + learn + PER priority write-back in ONE
+dispatch) whose loss stays a device array so JAX async dispatch overlaps it
+with the next host ``env.step``; warmup gates read the buffers'
+host-mirrored size counters. The loop syncs on the learn stream only at
+eval/telemetry cadence. Net effect: ≤2 device dispatches per env step
+(action + amortised flush/learn) instead of 3–5 blocking ones.
 """
 
 from __future__ import annotations
@@ -10,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
 import numpy as np
 
 from agilerl_tpu.components.sampler import Sampler
@@ -56,15 +67,12 @@ def merge_final_obs(next_obs, final_obs, done):
         d = done.reshape(done.shape + (1,) * max(n.ndim - done.ndim, 0))
         return np.where(d, f, n)
 
-    import jax
-
     return jax.tree_util.tree_map(merge, next_obs, final_obs)
 
 
 def _substitute_rows(transition, prev_transition, mask):
     """Replace rows of `transition` where `mask` is set with the corresponding
     rows of `prev_transition` (obs leaves may be pytrees)."""
-    import jax
 
     def sub(tv, pv):
         tv, pv = np.asarray(tv), np.asarray(pv)
@@ -110,11 +118,35 @@ def train_off_policy(
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    seed: Optional[int] = None,
+    flush_every: Optional[int] = None,
 ) -> Tuple[List, List[List[float]]]:
     if resume:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
+    # thread the run seed into the buffers' sampling PRNGs so runs are
+    # reproducible end to end (the buffers otherwise self-seed from global
+    # numpy randomness)
+    if seed is not None:
+        if hasattr(memory, "seed"):
+            memory.seed(seed)
+        if n_step_memory is not None and hasattr(n_step_memory, "seed"):
+            n_step_memory.seed(seed + 1)
+    # chunked ingestion: coalesce up to flush_every host steps into one
+    # buffer dispatch (sampling always flushes first, so cadence only
+    # bounds staleness, never correctness)
+    use_staging = hasattr(memory, "stage") and (
+        not (n_step and n_step_memory is not None)
+        or hasattr(n_step_memory, "stage")
+    )
+    for buf in (memory, n_step_memory):
+        if buf is None or not hasattr(buf, "flush_every"):
+            continue
+        if flush_every is not None:
+            buf.flush_every = max(int(flush_every), 1)
+        elif not getattr(buf, "_flush_every_user_set", False):
+            buf.flush_every = 8  # pipelining default for untouched buffers
     sampler = Sampler(
         memory=memory, per=per,
         n_step_memory=n_step_memory if n_step else None,
@@ -133,21 +165,37 @@ def train_off_policy(
     next_step_autoreset = "NEXT_STEP" in str(getattr(env, "autoreset_mode", ""))
 
     while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+        sync_wait_total = 0.0
         for agent in pop:
             obs, info = env.reset()
             prev_done = np.zeros(num_envs, dtype=bool)
             prev_transition = None
             if n_step and n_step_memory is not None:
                 # folds must not span the reset / the previous agent's steps
+                # (reset_horizon folds any staged pre-reset steps first)
                 n_step_memory.reset_horizon()
+            # fused sample+learn path: one jit dispatch per learn step, loss
+            # kept on device (sync-free). PER requires the algorithm to
+            # write priorities back in-dispatch.
+            use_fused = (
+                hasattr(agent, "learn_from_buffer")
+                and (not per or getattr(agent, "supports_fused_per", False))
+                # custom user memories without device ring state fall back
+                # to the legacy sample→learn path
+                and hasattr(memory, "per_state" if per else "state")
+            )
+            pending_loss = None
             scores = np.zeros(num_envs)
             completed_scores: List[float] = []
             steps = 0
+            learn_every = max(agent.learn_step, 1)
             for _ in range(max(evo_steps // num_envs, 1)):
                 # masked envs publish per-step action masks on the info dict
                 # (parity: train_off_policy.py:268)
                 action_mask = info.get("action_mask") if isinstance(info, dict) else None
+                t_act = time.perf_counter()
                 action = agent.get_action(obs, epsilon=epsilon, action_mask=action_mask)
+                t_host = time.perf_counter()
                 next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
                 done = np.logical_or(terminated, truncated)
                 # bootstrap target must see the TRUE successor state, not the
@@ -173,10 +221,11 @@ def train_off_policy(
                 }
                 if n_step and n_step_memory is not None:
                     # fused n-step goes into n_step_memory's own ring; the
-                    # returned OLDEST raw transition goes into the main buffer
-                    # so both rings stay index-aligned (parity: reference's
-                    # paired-buffer scheme, train_off_policy.py:340).
-                    # _boundary stops folds at truncations/autoresets.
+                    # OLDEST raw transitions displaced by the fold go into
+                    # the main buffer so both rings stay index-aligned
+                    # (parity: reference's paired-buffer scheme,
+                    # train_off_policy.py:340). _boundary stops folds at
+                    # truncations/autoresets.
                     transition["_boundary"] = np.asarray(done, np.float32)
                     if next_step_autoreset and prev_done.any() and prev_transition:
                         # gymnasium NEXT_STEP autoreset: this row is a bogus
@@ -190,20 +239,24 @@ def train_off_policy(
                             transition, prev_transition, prev_done
                         )
                     prev_transition = transition
-                    one_step = n_step_memory.add(transition, batched=num_envs > 1)
-                    if one_step is not None:
-                        memory.add(one_step, batched=num_envs > 1)
+                    if use_staging:
+                        n_step_memory.stage(transition, batched=num_envs > 1)
+                    else:
+                        one_step = n_step_memory.add(transition, batched=num_envs > 1)
+                        if one_step is not None:
+                            memory.add(one_step, batched=num_envs > 1)
                 elif next_step_autoreset and prev_done.any():
                     keep = np.where(~prev_done)[0]
                     if keep.size:
-                        import jax as _jax
-
-                        memory.add(
-                            _jax.tree_util.tree_map(
-                                lambda v: np.asarray(v)[keep], transition
-                            ),
-                            batched=True,
+                        kept = jax.tree_util.tree_map(
+                            lambda v: np.asarray(v)[keep], transition
                         )
+                        if use_staging:
+                            memory.stage(kept, batched=True)
+                        else:
+                            memory.add(kept, batched=True)
+                elif use_staging:
+                    memory.stage(transition, batched=num_envs > 1)
                 else:
                     memory.add(transition, batched=num_envs > 1)
                 prev_done = np.atleast_1d(done).astype(bool)
@@ -212,25 +265,61 @@ def train_off_policy(
                 steps += num_envs
                 total_steps += num_envs
                 epsilon = max(eps_end, epsilon * eps_decay)
-                telem.step(env_steps=num_envs, agent_index=agent.index)
 
-                if (
-                    len(memory) >= agent.batch_size
-                    and len(memory) >= learning_delay
-                    and steps % max(agent.learn_step, 1) < num_envs
-                ):
-                    if per:
-                        sampled = sampler.sample(agent.batch_size)
-                        idxs = sampled[1]
-                        result = agent.learn(sampled)
-                        new_priorities = (
-                            result[1] if isinstance(result, tuple) else None
-                        )
-                        if new_priorities is not None:
-                            memory.update_priorities(idxs, new_priorities)
-                    else:
-                        agent.learn(sampler.sample(agent.batch_size))
+                learn_block_s = 0.0
+                if steps % learn_every < num_envs:
+                    # drain staging so warmup gating sees every stored row
+                    # (host-mirrored counters — no device sync here)
+                    sampler.flush()
+                    if (
+                        len(memory) >= agent.batch_size
+                        and len(memory) >= learning_delay
+                    ):
+                        if use_fused:
+                            # ONE dispatch: sample + learn (+ PER priority
+                            # write-back), issued WITHOUT blocking — the
+                            # device chews on it while the host steps the env
+                            pending_loss = agent.learn_from_buffer(
+                                memory,
+                                n_step_memory if n_step else None,
+                            )
+                        elif per:
+                            t_learn = time.perf_counter()
+                            # same IS-weight beta as the fused path would
+                            # use (agent-defined, else the 0.4 default)
+                            sampled = sampler.sample(
+                                agent.batch_size,
+                                beta=getattr(agent, "beta", None),
+                            )
+                            idxs = sampled[1]
+                            result = agent.learn(sampled)
+                            new_priorities = (
+                                result[1] if isinstance(result, tuple) else None
+                            )
+                            if new_priorities is not None:
+                                memory.update_priorities(idxs, new_priorities)
+                            learn_block_s = time.perf_counter() - t_learn
+                        else:
+                            t_learn = time.perf_counter()
+                            agent.learn(sampler.sample(agent.batch_size))
+                            learn_block_s = time.perf_counter() - t_learn
+                # legacy learn blocks on the device (float(loss) etc.), so
+                # its time counts as device wait, not host work — otherwise
+                # an unpipelined run would report overlap near 1
+                telem.step(
+                    env_steps=num_envs, agent_index=agent.index,
+                    host_time_s=(time.perf_counter() - t_host) - learn_block_s,
+                    device_time_s=(t_host - t_act) + learn_block_s,
+                )
 
+            # segment sync point (eval/telemetry cadence): drain staging and
+            # wait for the learn stream — the ONLY place the hot path blocks
+            # on the device outside action selection
+            sampler.flush()
+            t_sync = time.perf_counter()
+            if pending_loss is not None:
+                jax.block_until_ready(pending_loss)
+            sync_wait_total += time.perf_counter() - t_sync
             agent.steps[-1] += steps
             mean_score = float(np.mean(completed_scores)) if completed_scores else float(np.mean(scores))
             agent.scores.append(mean_score)
@@ -245,7 +334,10 @@ def train_off_policy(
         telem.record_eval(pop, fitnesses)
         telem.log_step(
             {"global_step": total_steps, "fps": total_steps / (time.time() - start),
-             "eval/mean_fitness": float(np.mean(fitnesses))}
+             "eval/mean_fitness": float(np.mean(fitnesses)),
+             # how long the generation spent blocked waiting for the learn
+             # stream at its sync points — the pipelining win shrinks this
+             "pipeline/sync_wait_s": round(sync_wait_total, 6)}
         )
         if verbose:
             fps = total_steps / (time.time() - start)

@@ -195,6 +195,96 @@ def bench_evoppo():
     }), flush=True)
 
 
+def bench_pipeline():
+    """CPU-backend micro-bench for the host↔device pipelining layer
+    (docs/performance.md): the SAME DQN/CartPole interop hot loop run
+    per-step (eager buffer adds + host-driven sample→learn round-trips) vs
+    chunked+fused (staged ingestion + single-dispatch learn_from_buffer).
+    Run with BENCH_MODE=pipeline; knobs BENCH_PIPE_ENVS / BENCH_PIPE_STEPS."""
+    import jax
+    import numpy as np
+
+    from agilerl_tpu.components.replay_buffer import ReplayBuffer
+    from agilerl_tpu.components.sampler import Sampler
+    from agilerl_tpu.envs import CartPole, JaxVecEnv
+    from agilerl_tpu.utils.utils import create_population
+
+    backend = jax.default_backend()
+    num_envs = int(os.environ.get("BENCH_PIPE_ENVS", 8))
+    steps = int(os.environ.get("BENCH_PIPE_STEPS", 384))
+    learn_step = 4
+
+    def run(chunked: bool) -> float:
+        env = JaxVecEnv(CartPole(), num_envs=num_envs, seed=0)
+        agent = create_population(
+            "DQN", env.single_observation_space, env.single_action_space,
+            population_size=1, seed=0,
+            net_config={"latent_dim": 32,
+                        "encoder_config": {"hidden_size": (64,)}},
+            INIT_HP={"BATCH_SIZE": 64, "LR": 1e-3, "LEARN_STEP": learn_step},
+        )[0]
+        memory = ReplayBuffer(max_size=10_000, seed=0,
+                              flush_every=8 if chunked else 1)
+        sampler = Sampler(memory=memory)
+
+        def loop(n_steps):
+            # the pipelining layer targets HOST (gym-interop) envs, so the
+            # probe env's outputs are materialised to host numpy exactly as
+            # a gymnasium vector env would hand them over
+            obs, _ = env.reset()
+            obs = np.asarray(obs)
+            pending = None
+            for t in range(n_steps):
+                action = agent.get_action(obs, epsilon=0.1)
+                next_obs, reward, term, trunc, _ = env.step(np.asarray(action))
+                next_obs = np.asarray(next_obs)
+                tr = {"obs": obs, "action": np.asarray(action),
+                      "reward": np.asarray(reward, np.float32),
+                      "next_obs": next_obs,
+                      "done": np.asarray(term, np.float32)}
+                if chunked:
+                    memory.stage(tr, batched=True)
+                else:
+                    memory.add(tr, batched=True)
+                obs = next_obs
+                if t % learn_step == 0:
+                    if chunked:
+                        memory.flush()
+                    if len(memory) >= agent.batch_size:
+                        if chunked:
+                            pending = agent.learn_from_buffer(memory)
+                        else:
+                            agent.learn(sampler.sample(agent.batch_size))
+            if pending is not None:
+                jax.block_until_ready(pending)
+
+        loop(max(steps // 4, 2 * learn_step * 64 // num_envs))  # compile+warmup
+        t0 = time.perf_counter()
+        loop(steps)
+        return steps * num_envs / (time.perf_counter() - t0)
+
+    # alternate the two paths and keep each one's best run: single-shot A/B
+    # on a shared CPU host is dominated by scheduling noise
+    repeats = int(os.environ.get("BENCH_PIPE_REPEATS", 2))
+    per_step_sps = max(run(chunked=False) for _ in range(repeats))
+    fused_sps = max(run(chunked=True) for _ in range(repeats))
+    speedup = fused_sps / max(per_step_sps, 1e-9)
+    log(f"bench_pipeline: per-step {per_step_sps:.0f} vs chunked+fused "
+        f"{fused_sps:.0f} env-steps/s ({speedup:.2f}x)")
+    print(json.dumps({
+        "metric": ("off-policy interop hot loop chunked+fused env-steps/sec "
+                   f"(DQN CartPole, {num_envs} envs; vs_baseline = speedup "
+                   "over the per-step path)"),
+        "value": round(fused_sps),
+        "unit": "env-steps/sec",
+        "vs_baseline": round(speedup, 3),
+        "per_step_env_steps_per_sec": round(per_step_sps),
+        "chunked_fused_env_steps_per_sec": round(fused_sps),
+        "backend": backend,
+        "error": None,
+    }), flush=True)
+
+
 def _cpu_pinned() -> bool:
     """True iff JAX_PLATFORMS is an exact "cpu" pin. A fallback list like
     "axon,cpu" is NOT a pin — the accelerator should still be attempted."""
@@ -233,8 +323,11 @@ def probe_main():
 
 def child_main():
     _maybe_pin_cpu()
-    if os.environ.get("BENCH_MODE") == "grpo":
+    mode = os.environ.get("BENCH_MODE")
+    if mode == "grpo":
         bench_grpo()
+    elif mode == "pipeline":
+        bench_pipeline()
     else:
         bench_evoppo()
 
@@ -449,9 +542,26 @@ def parent_main():
     mode = os.environ.get("BENCH_MODE", "evoppo")
     metric = (
         "GRPO learn-step tokens/sec" if mode == "grpo"
+        else "pipelined off-policy hot-loop env-steps/sec" if mode == "pipeline"
         else "evo-PPO aggregate env-steps/sec"
     )
     errors = []
+
+    if mode == "pipeline":
+        # host↔device pipelining micro-bench: defined as a CPU-backend A/B
+        # (per-step vs chunked+fused on the same host loop) — no accelerator
+        # phase, no capture re-emission
+        cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 900))
+        result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
+        if result is not None:
+            print(json.dumps(result), flush=True)
+            return 0
+        print(json.dumps({
+            "metric": metric, "value": 0, "unit": "env-steps/sec",
+            "vs_baseline": 0.0, "backend": None,
+            "error": f"pipeline micro-bench: {err}",
+        }), flush=True)
+        return 0
 
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     user_forced_cpu = _cpu_pinned()
@@ -464,7 +574,19 @@ def parent_main():
     min_workload_budget = float(os.environ.get("BENCH_MIN_WORKLOAD_BUDGET", 240))
     min_workload_budget = min(min_workload_budget, max(30.0, tpu_timeout * 0.6))
 
-    if not (force_cpu or user_forced_cpu):
+    # a GRPO-class headline gets the SAME compile-bisection gating as the
+    # secondary bench: without a grpo_safe_env.sh verdict the default compile
+    # is known to wedge the remote compile service for hours (NOTES_ROUND5
+    # 10b) — a direct BENCH_MODE=grpo run must refuse, not gamble
+    headline_safe_env = _grpo_safe_env() if mode == "grpo" else {}
+    skip_accelerator = False
+    if mode == "grpo" and headline_safe_env is None:
+        errors.append(
+            "accelerator phase: no grpo_safe_env.sh bisection verdict — "
+            "default GRPO compile is service-poison; refusing headline")
+        skip_accelerator = True
+
+    if not (force_cpu or user_forced_cpu or skip_accelerator):
         deadline = time.monotonic() + tpu_timeout
         probes = 0
         pool_seen_up = False
@@ -506,7 +628,7 @@ def parent_main():
                 break
             log(f"bench parent: pool UP (backend={backend}, probe {probes}); "
                 f"launching workload (budget {budget:.0f}s)")
-            result, err = _run_child({}, budget)
+            result, err = _run_child({}, budget, extra_env=headline_safe_env)
             if result is not None and result.get("backend") not in (None, "cpu"):
                 # headline landed on the accelerator — collect on-chip kernel
                 # validation FIRST (cheap, proven to compile), then the

@@ -15,6 +15,7 @@ Run: python benchmarking/fold_tpu_captures.py
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -23,13 +24,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, ".tpu_results")
 PROGRESS = os.path.join(OUT, "playbook_progress.json")
 
-# stage log -> progress key (both the watcher's and capture2's names)
+# stage log -> progress key (both the watcher's and capture2's names).
+# The depth-4 decode capture (BENCH_DECODE_LAYERS=4) folds under its OWN key:
+# it must never masquerade as — or block — the full-depth headline (ADVICE.md)
 STAGES = {
     "bench_grpo_tpu2.log": "grpo",
     "grpo_mfu_sweep.log2": "mfu_sweep",
     "bucketed_decode_tpu.log": "bucketed_decode",
-    "bucketed_decode_l4.log": "bucketed_decode",
+    "bucketed_decode_l4.log": "bucketed_decode_l4",
 }
+
+
+def _ts_or_empty(stamp):
+    """A %Y%m%dT%H%M%S stamp, or '' for anything else. Comparisons are
+    lexicographic, so a non-timestamp stamp like 'unknown' would sort above
+    every real stamp and permanently block newer captures (ADVICE.md)."""
+    stamp = stamp or ""
+    return stamp if re.fullmatch(r"\d{8}T\d{6}", stamp) else ""
 
 
 def last_json_line(path):
@@ -79,8 +90,10 @@ def main():
             # fallback never blocks folding a real TPU capture.
             # playbook-owned results carry no per-result stamp — they are
             # covered by the file-level ts
-            existing_ts = existing.get("captured_at_ts") or (
-                progress.get("ts", "") if "captured_from" not in existing else "")
+            existing_ts = _ts_or_empty(
+                existing.get("captured_at_ts") or (
+                    progress.get("ts", "")
+                    if "captured_from" not in existing else ""))
             if existing_ts > time.strftime("%Y%m%dT%H%M%S",
                                            time.localtime(mtime)):
                 continue  # a newer capture (e.g. the playbook's own) wins

@@ -26,7 +26,7 @@ if __name__ == "__main__":
         "DQN", env.single_observation_space, env.single_action_space,
         net_config=NET_CONFIG, INIT_HP=INIT_HP, seed=42,
     )
-    memory = ReplayBuffer(max_size=20_000)
+    memory = ReplayBuffer(max_size=20_000, seed=42)
     tournament = TournamentSelection(tournament_size=2, elitism=True,
                                      population_size=4, eval_loop=1)
     mutations = Mutations(no_mutation=0.4, architecture=0.2, new_layer_prob=0.2,

@@ -1,0 +1,229 @@
+"""Chunked replay ingestion (ISSUE 2 tentpole §1): staged flush must be
+bit-identical to per-step adds — including n-step folds across flush
+boundaries and ring wraparound — and ``len(buffer)`` must never sync a
+device scalar (host-mirrored size counter)."""
+
+import jax
+import numpy as np
+import pytest
+
+from agilerl_tpu.components.multi_agent_replay_buffer import MultiAgentReplayBuffer
+from agilerl_tpu.components.replay_buffer import (
+    MultiStepReplayBuffer,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+
+
+def _transitions(n_steps, num_envs=3, obs_dim=4, seed=0, boundary=True):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_steps):
+        tr = {
+            "obs": rng.normal(size=(num_envs, obs_dim)).astype(np.float32),
+            "action": rng.integers(0, 2, size=(num_envs,)),
+            "reward": rng.normal(size=(num_envs,)).astype(np.float32),
+            "next_obs": rng.normal(size=(num_envs, obs_dim)).astype(np.float32),
+            "done": (rng.random(num_envs) < 0.2).astype(np.float32),
+        }
+        if boundary:
+            tr["_boundary"] = np.maximum(
+                tr["done"], (rng.random(num_envs) < 0.15).astype(np.float32)
+            )
+        out.append(tr)
+    return out
+
+
+def _assert_states_identical(a, b, context=""):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=context)
+
+
+def test_uniform_chunked_equivalence_with_wraparound():
+    """37 steps x 3 envs through a 16-slot ring: staged flush == per-step
+    add, bit for bit, across ring wraparound."""
+    steps = [{k: v for k, v in tr.items() if k != "_boundary"}
+             for tr in _transitions(37)]
+    eager = ReplayBuffer(max_size=16, seed=1)
+    staged = ReplayBuffer(max_size=16, seed=1, flush_every=5)
+    for tr in steps:
+        eager.add(tr, batched=True)
+    for i, tr in enumerate(steps):
+        staged.stage(tr, batched=True)
+        if i % 13 == 12:
+            staged.flush()
+    staged.flush()
+    assert len(eager) == len(staged) == 16
+    _assert_states_identical(eager.state, staged.state)
+
+
+def test_per_chunked_equivalence():
+    steps = [{k: v for k, v in tr.items() if k != "_boundary"}
+             for tr in _transitions(21)]
+    eager = PrioritizedReplayBuffer(max_size=32, seed=1)
+    staged = PrioritizedReplayBuffer(max_size=32, seed=1, flush_every=4)
+    for tr in steps:
+        eager.add(tr, batched=True)
+    for tr in steps:
+        staged.stage(tr, batched=True)
+    staged.flush()
+    assert len(eager) == len(staged)
+    _assert_states_identical(eager.per_state, staged.per_state)
+
+
+def test_nstep_chunked_equivalence_across_flush_boundaries():
+    """The vectorised fold must produce the SAME fused rows — and displace
+    the SAME raw rows to the main buffer — as the per-step Python fold,
+    with folds spanning flush boundaries and both rings wrapping."""
+    steps = _transitions(37)
+    eager_n = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.87, seed=1)
+    eager_m = ReplayBuffer(max_size=16, seed=1)
+    for tr in steps:
+        old = eager_n.add(dict(tr), batched=True)
+        if old is not None:
+            eager_m.add(old, batched=True)
+
+    staged_n = MultiStepReplayBuffer(max_size=16, n_step=3, gamma=0.87,
+                                     seed=1, flush_every=5)
+    staged_m = ReplayBuffer(max_size=16, seed=1)
+    for i, tr in enumerate(steps):
+        staged_n.stage(dict(tr), batched=True)
+        if i % 11 == 10:  # deliberately misaligned with flush_every
+            raw = staged_n.take_raw()
+            if raw is not None:
+                staged_m.add(raw, batched=True)
+    raw = staged_n.take_raw()
+    if raw is not None:
+        staged_m.add(raw, batched=True)
+
+    assert len(eager_n) == len(staged_n)
+    assert len(eager_m) == len(staged_m)
+    _assert_states_identical(eager_n.state, staged_n.state, "fused ring")
+    _assert_states_identical(eager_m.state, staged_m.state, "main ring")
+
+
+def test_nstep_reset_horizon_folds_staged_steps_first():
+    """reset_horizon() on a buffer with staged steps must fold them (they
+    happened before the reset) instead of dropping them."""
+    steps = _transitions(4, num_envs=2)
+    buf = MultiStepReplayBuffer(max_size=32, n_step=3, gamma=0.9, seed=0,
+                                flush_every=100)
+    for tr in steps:
+        buf.stage(dict(tr), batched=True)
+    buf.reset_horizon()
+    assert len(buf) == 2 * 2  # 4 steps -> 2 complete windows x 2 envs
+    assert buf.take_raw() is not None
+    # and the carried window is gone: the next 2 steps complete no window
+    for tr in _transitions(2, num_envs=2, seed=9):
+        buf.stage(dict(tr), batched=True)
+    buf.flush()
+    assert len(buf) == 4
+
+
+def test_len_never_syncs_device_scalar():
+    """Warmup gates call len(memory) every hot-loop step — it must read the
+    host mirror, never int(device_scalar)."""
+
+    class Boom:
+        def __int__(self):
+            raise AssertionError("len(memory) synced a device scalar")
+
+    buf = ReplayBuffer(max_size=8, seed=0)
+    for tr in _transitions(3, boundary=False):
+        buf.add(tr, batched=True)
+    buf.state = buf.state._replace(size=Boom())
+    assert len(buf) == 8  # 3 steps x 3 envs, capped at capacity
+    assert buf.is_full
+
+    per = PrioritizedReplayBuffer(max_size=64, seed=0)
+    per.add({k: v for k, v in _transitions(1, boundary=False)[0].items()},
+            batched=True)
+    per.per_state = per.per_state._replace(
+        buffer=per.per_state.buffer._replace(size=Boom()))
+    assert len(per) == 3
+    assert not per.is_full
+
+
+def test_host_mirror_tracks_device_size():
+    buf = ReplayBuffer(max_size=16, seed=0)
+    for i, tr in enumerate(_transitions(9, boundary=False)):
+        buf.stage(tr, batched=True)
+        if i % 2:
+            buf.flush()
+    buf.flush()
+    assert len(buf) == int(buf.state.size)
+
+
+def test_seed_threading_reproducible_sampling():
+    """Two identically seeded buffers with identical contents sample the
+    SAME batch (satellite: ReplayBuffer PRNG was unseedable)."""
+    steps = [{k: v for k, v in tr.items() if k != "_boundary"}
+             for tr in _transitions(10)]
+    a, b = ReplayBuffer(64, seed=7), ReplayBuffer(64, seed=7)
+    for tr in steps:
+        a.add(tr, batched=True)
+        b.add(tr, batched=True)
+    _assert_states_identical(a.sample(8), b.sample(8))
+    # reseeding mid-run realigns the streams
+    a.seed(3)
+    b.seed(3)
+    _assert_states_identical(a.sample(8), b.sample(8))
+
+
+def test_oversized_chunk_splits_into_capacity_dispatches():
+    """A chunk longer than the ring must land exactly like sequential adds
+    (split into capacity-sized dispatches, no duplicate-index scatter)."""
+    rng = np.random.default_rng(0)
+    rows = {"obs": rng.normal(size=(23, 2)).astype(np.float32),
+            "reward": np.arange(23, dtype=np.float32)}
+    eager = ReplayBuffer(max_size=8, seed=0)
+    for i in range(23):
+        eager.add({k: v[i] for k, v in rows.items()})
+    big = ReplayBuffer(max_size=8, seed=0)
+    big.add(rows, batched=True)
+    assert len(big) == 8
+    _assert_states_identical(eager.state, big.state)
+
+
+def test_stage_copies_reused_env_buffers():
+    """Vector envs with copy=False (or envpool) hand back the SAME ndarray
+    every step — staging must copy, or every staged row silently becomes
+    the last step's data by flush time."""
+    shared = np.zeros((2, 3), np.float32)
+    buf = ReplayBuffer(max_size=16, seed=0, flush_every=100)
+    for step in range(3):
+        shared[:] = step  # env overwrites its buffer in place
+        buf.stage({"obs": shared, "reward": np.full(2, step, np.float32)},
+                  batched=True)
+    buf.flush()
+    obs = np.asarray(buf.state.storage["obs"])[: len(buf)]
+    np.testing.assert_array_equal(obs[:, 0], [0.0, 0.0, 1.0, 1.0, 2.0, 2.0])
+
+
+def test_multi_agent_stage_to_memory_equivalence():
+    rng = np.random.default_rng(0)
+    ids = ["a_0", "a_1"]
+
+    def step():
+        return tuple(
+            {a: rng.normal(size=(2, 3)).astype(np.float32) for a in ids}
+            for _ in range(2)
+        ) + tuple(
+            {a: rng.normal(size=(2,)).astype(np.float32) for a in ids}
+            for _ in range(2)
+        )
+
+    eager = MultiAgentReplayBuffer(max_size=16, agent_ids=ids, seed=0)
+    staged = MultiAgentReplayBuffer(max_size=16, agent_ids=ids, seed=0,
+                                    flush_every=4)
+    for _ in range(9):
+        obs, nxt, rew, done = step()
+        act = {a: rng.integers(0, 2, size=(2,)) for a in ids}
+        eager.save_to_memory(obs, act, rew, nxt, done, is_vectorised=True)
+        staged.stage_to_memory(obs, act, rew, nxt, done, is_vectorised=True)
+    staged.flush()
+    assert len(eager) == len(staged)
+    _assert_states_identical(eager.state, staged.state)
