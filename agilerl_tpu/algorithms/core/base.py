@@ -66,6 +66,28 @@ class EvolvableAlgorithm:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def rng_state(self) -> Dict[str, Any]:
+        """Picklable capture of both PRNG streams (JAX key + numpy
+        Generator) — whole-run snapshots need these so a resumed agent draws
+        the exact action/exploration sequence the live run would have
+        (``checkpoint_dict`` deliberately excludes them: a plain weight
+        checkpoint restore should NOT replay an old RNG stream)."""
+        from agilerl_tpu.resilience.snapshot import key_to_host
+
+        return {
+            "jax_key": key_to_host(self._key),
+            "np_rng": self.rng.bit_generator.state,
+        }
+
+    def set_rng_state(self, state: Dict[str, Any]) -> None:
+        from agilerl_tpu.resilience.snapshot import (
+            key_from_host,
+            restore_np_generator,
+        )
+
+        self._key = key_from_host(state["jax_key"])
+        self.rng = restore_np_generator(state["np_rng"])
+
     # -- registry -------------------------------------------------------- #
     def register_network_group(self, group: NetworkGroup) -> None:
         self.registry.register_group(group)
@@ -214,10 +236,16 @@ class EvolvableAlgorithm:
         }
 
     def save_checkpoint(self, path: Union[str, Path]) -> None:
+        """Atomic save (tmp + fsync + ``os.replace``): a kill mid-save leaves
+        either the previous checkpoint or the new one, never a torn pickle."""
+        from agilerl_tpu.resilience.atomic import atomic_write_bytes
+
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        with open(path, "wb") as f:
-            pickle.dump(self.checkpoint_dict(), f)
+        atomic_write_bytes(
+            path,
+            pickle.dumps(self.checkpoint_dict(), protocol=pickle.HIGHEST_PROTOCOL),
+        )
 
     def load_checkpoint(self, path: Union[str, Path]) -> None:
         with open(path, "rb") as f:

@@ -260,6 +260,50 @@ class ReplayBuffer:
         self._staged_calls = 0
         self._size_host = 0
 
+    # -- whole-run snapshots (resilience subsystem) ---------------------- #
+    def state_dict(self) -> Dict[str, Any]:
+        """Host-picklable snapshot of the full ring: storage, cursors, the
+        sampling PRNG key and the host-mirrored size counter. The staging
+        ring is flushed first (reusing ``stage()``/``flush()``), so the
+        capture is exactly what per-step ingestion would have produced."""
+        self.flush()
+        sd: Dict[str, Any] = {
+            "kind": type(self).__name__,
+            "max_size": self.max_size,
+            "flush_every": self.flush_every,
+            "flush_every_user_set": self._flush_every_user_set,
+            "size_host": self._size_host,
+            "key": np.asarray(jax.device_get(self._key)),
+            "state": None,
+        }
+        if self.state is not None:
+            sd["state"] = {
+                "storage": jax.device_get(self.state.storage),
+                "pos": int(self.state.pos),
+                "size": int(self.state.size),
+            }
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        """Restore a :meth:`state_dict` capture in place (sampling continues
+        the exact PRNG stream the snapshotted run would have drawn)."""
+        self._staged = []
+        self._staged_calls = 0
+        self.max_size = int(sd["max_size"])
+        self.flush_every = max(int(sd["flush_every"]), 1)
+        self._flush_every_user_set = bool(sd.get("flush_every_user_set", False))
+        self._size_host = int(sd["size_host"])
+        self._key = jnp.asarray(sd["key"])
+        st = sd.get("state")
+        if st is None:
+            self.state = None
+        else:
+            self.state = BufferState(
+                storage=jax.tree_util.tree_map(jnp.asarray, st["storage"]),
+                pos=jnp.asarray(st["pos"], jnp.int32),
+                size=jnp.asarray(st["size"], jnp.int32),
+            )
+
 
 # --------------------------------------------------------------------------- #
 # N-step buffer
@@ -471,6 +515,32 @@ class MultiStepReplayBuffer(ReplayBuffer):
         raw = jax.tree_util.tree_map(flat, first)
         return fused, raw
 
+    # -- whole-run snapshots (resilience subsystem) ---------------------- #
+    def state_dict(self) -> Dict[str, Any]:
+        """Ring snapshot + the n-step carry: the fold window (``_horizon``)
+        and any folded-but-untaken raw chunks, so a resumed run folds the
+        exact same windows the uninterrupted run would have. ``flush()``
+        (called by the base capture) folds staged steps first."""
+        sd = super().state_dict()
+        sd["n_step"] = self.n_step
+        sd["gamma"] = self.gamma
+        sd["horizon"] = [
+            jax.tree_util.tree_map(np.asarray, tr) for tr in self._horizon
+        ]
+        sd["pending_raw"] = [
+            jax.tree_util.tree_map(np.asarray, chunk)
+            for chunk in self._pending_raw
+        ]
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        super().load_state_dict(sd)
+        self.n_step = int(sd["n_step"])
+        self.gamma = float(sd["gamma"])
+        self._horizon = list(sd.get("horizon", []))
+        self._pending_raw = list(sd.get("pending_raw", []))
+        self._staged_steps = []
+
 
 # --------------------------------------------------------------------------- #
 # Prioritized buffer — dense-array PER
@@ -598,3 +668,39 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     def clear(self) -> None:
         super().clear()
         self.per_state = None
+
+    # -- whole-run snapshots (resilience subsystem) ---------------------- #
+    def state_dict(self) -> Dict[str, Any]:
+        """Ring + priority array + running max priority (the base capture's
+        ``state`` stays None for PER — everything lives in ``per_state``)."""
+        sd = super().state_dict()
+        sd["alpha"] = self.alpha
+        if self.per_state is None:
+            sd["per_state"] = None
+        else:
+            buf = self.per_state.buffer
+            sd["per_state"] = {
+                "storage": jax.device_get(buf.storage),
+                "pos": int(buf.pos),
+                "size": int(buf.size),
+                "priorities": np.asarray(jax.device_get(self.per_state.priorities)),
+                "max_priority": float(self.per_state.max_priority),
+            }
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        super().load_state_dict(sd)
+        self.alpha = float(sd.get("alpha", self.alpha))
+        ps = sd.get("per_state")
+        if ps is None:
+            self.per_state = None
+            return
+        self.per_state = PERState(
+            buffer=BufferState(
+                storage=jax.tree_util.tree_map(jnp.asarray, ps["storage"]),
+                pos=jnp.asarray(ps["pos"], jnp.int32),
+                size=jnp.asarray(ps["size"], jnp.int32),
+            ),
+            priorities=jnp.asarray(ps["priorities"], jnp.float32),
+            max_priority=jnp.asarray(ps["max_priority"], jnp.float32),
+        )

@@ -9,6 +9,7 @@ which can repeat at millisecond granularity.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -78,6 +79,20 @@ class JsonlSink:
     def closed(self) -> bool:
         return self._fh.closed
 
+    def flush(self) -> None:
+        """Flush + best-effort fsync — the resilience PreemptionGuard calls
+        this (from the main thread, at the first step boundary after a
+        preemption signal) so the timeline is durable even when the grace
+        window expires before the final snapshot."""
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.flush()
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
+
     def close(self) -> None:
         with self._lock:
             if not self._fh.closed:
@@ -106,6 +121,9 @@ class MemorySink:
             self._seq += 1
             self.events.append(record)
 
+    def flush(self) -> None:
+        pass
+
     def close(self) -> None:
         pass
 
@@ -114,6 +132,9 @@ class NullSink:
     """Discard everything (the default when telemetry is not configured)."""
 
     def emit(self, kind: str, payload: Dict[str, Any]) -> None:
+        pass
+
+    def flush(self) -> None:
         pass
 
     def close(self) -> None:

@@ -1,0 +1,54 @@
+"""Resilience subsystem: crash-consistent run snapshots, preemption-aware
+checkpointing, retry policies for flaky host edges, and a deterministic
+fault-injection harness (see docs/resilience.md)."""
+
+from agilerl_tpu.resilience.atomic import (
+    CorruptSnapshotError,
+    atomic_pickle,
+    atomic_write_bytes,
+    commit_dir,
+    content_hash,
+    set_fault_hook,
+    staged_pickle,
+    staged_write_bytes,
+)
+from agilerl_tpu.resilience.facade import Resilience, max_fitness
+from agilerl_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedCrash,
+    ScheduledFailureEnv,
+)
+from agilerl_tpu.resilience.preemption import PreemptionGuard
+from agilerl_tpu.resilience.retry import (
+    DEFAULT_ENV_POLICY,
+    RetryingEnv,
+    RetryPolicy,
+    call_with_retries,
+    with_retries,
+)
+from agilerl_tpu.resilience.snapshot import (
+    AsyncPytree,
+    CheckpointManager,
+    SnapshotInfo,
+    capture_agent,
+    capture_env_rng,
+    capture_host_rng,
+    restore_agent,
+    restore_env_rng,
+    restore_host_rng,
+)
+
+__all__ = [
+    "Resilience", "max_fitness",
+    "AsyncPytree", "CheckpointManager", "SnapshotInfo",
+    "PreemptionGuard",
+    "RetryPolicy", "RetryingEnv", "call_with_retries", "with_retries",
+    "DEFAULT_ENV_POLICY",
+    "FaultInjector", "InjectedCrash", "ScheduledFailureEnv",
+    "CorruptSnapshotError", "set_fault_hook",
+    "atomic_write_bytes", "atomic_pickle", "commit_dir", "content_hash",
+    "staged_write_bytes", "staged_pickle",
+    "capture_agent", "restore_agent",
+    "capture_host_rng", "restore_host_rng",
+    "capture_env_rng", "restore_env_rng",
+]

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from agilerl_tpu.observability import init_run_telemetry
+from agilerl_tpu.resilience import max_fitness
 from agilerl_tpu.utils.utils import (
     print_hyperparams,
     resume_population_from_checkpoint,
@@ -53,8 +54,9 @@ def train_bandits(
     telemetry=None,
     seed: Optional[int] = None,
     flush_every: Optional[int] = None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
-    if resume:
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
@@ -69,80 +71,114 @@ def train_bandits(
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
-    start = time.time()
 
-    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
-        for agent in pop:
-            context = env.reset()
-            regret_free = 0.0
-            learn_every = max(agent.learn_step, 1)
-            for step in range(max(evo_steps, 1)):
-                t_act = time.perf_counter()
-                arm = agent.get_action(context)
-                t_host = time.perf_counter()
-                next_context, reward = env.step(arm)
-                regret_free += float(np.asarray(reward).squeeze())
-                transition = {
-                    "obs": np.asarray(context)[int(arm)],
-                    "action": np.int32(arm),
-                    "reward": np.float32(np.asarray(reward).squeeze()),
-                    "next_obs": np.asarray(next_context)[int(arm)],
-                    "done": np.float32(1.0),
-                }
-                if use_staging:
-                    # chunked ingestion: one coalesced buffer dispatch per
-                    # flush_every pulls (sampling flushes first)
-                    memory.stage(transition)
-                else:
-                    memory.add(transition)
-                context = next_context
-                total_steps += 1
-                agent.steps[-1] += 1
-                learn_block_s = 0.0
-                if step % learn_every == 0:
+    def _counters():
+        return {"total_steps": total_steps, "checkpoint_count": checkpoint_count,
+                "pop_fitnesses": pop_fitnesses}
+
+    try:
+        if resilience is not None:
+            resilience.attach(pop=pop, memory=memory, tournament=tournament,
+                              mutation=mutation, telemetry=telem, env=env)
+            if resume:
+                restored = resilience.resume(_counters())
+                total_steps = int(restored["total_steps"])
+                checkpoint_count = int(restored["checkpoint_count"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+        start = time.time()
+
+        while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+            for agent in pop:
+                if resilience is not None and resilience.abort_generation:
+                    break
+                context = env.reset()
+                regret_free = 0.0
+                learn_every = max(agent.learn_step, 1)
+                for step in range(max(evo_steps, 1)):
+                    t_act = time.perf_counter()
+                    arm = agent.get_action(context)
+                    t_host = time.perf_counter()
+                    next_context, reward = env.step(arm)
+                    regret_free += float(np.asarray(reward).squeeze())
+                    transition = {
+                        "obs": np.asarray(context)[int(arm)],
+                        "action": np.int32(arm),
+                        "reward": np.float32(np.asarray(reward).squeeze()),
+                        "next_obs": np.asarray(next_context)[int(arm)],
+                        "done": np.float32(1.0),
+                    }
                     if use_staging:
-                        memory.flush()
-                    if len(memory) >= agent.batch_size:
-                        t_learn = time.perf_counter()
-                        agent.learn(memory.sample(agent.batch_size))
-                        learn_block_s = time.perf_counter() - t_learn
-                # the learn call blocks on the device — count it as device
-                # wait so overlap_fraction stays honest
-                telem.step(
-                    env_steps=1, agent_index=agent.index,
-                    host_time_s=time.perf_counter() - t_host - learn_block_s,
-                    device_time_s=t_host - t_act + learn_block_s,
+                        # chunked ingestion: one coalesced buffer dispatch per
+                        # flush_every pulls (sampling flushes first)
+                        memory.stage(transition)
+                    else:
+                        memory.add(transition)
+                    context = next_context
+                    total_steps += 1
+                    agent.steps[-1] += 1
+                    learn_block_s = 0.0
+                    if step % learn_every == 0:
+                        if use_staging:
+                            memory.flush()
+                        if len(memory) >= agent.batch_size:
+                            t_learn = time.perf_counter()
+                            agent.learn(memory.sample(agent.batch_size))
+                            learn_block_s = time.perf_counter() - t_learn
+                    # the learn call blocks on the device — count it as device
+                    # wait so overlap_fraction stays honest
+                    telem.step(
+                        env_steps=1, agent_index=agent.index,
+                        host_time_s=time.perf_counter() - t_host - learn_block_s,
+                        device_time_s=t_host - t_act + learn_block_s,
+                    )
+                    if resilience is not None and resilience.abort_generation:
+                        break
+                if use_staging:
+                    memory.flush()
+                agent.scores.append(regret_free / max(evo_steps, 1))
+
+            if resilience is not None and resilience.abort_generation:
+                resilience.step_boundary(total_steps, _counters(), pop=pop)
+                break
+
+            fitnesses = [
+                agent.test(env, max_steps=eval_steps or 100, loop=eval_loop) for agent in pop
+            ]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            telem.record_eval(pop, fitnesses)
+            telem.log_step({"global_step": total_steps,
+                            "eval/mean_fitness": float(np.mean(fitnesses))})
+            if verbose:
+                print(f"--- steps {total_steps} fitness {[f'{f:.2f}' for f in fitnesses]}")
+                print_hyperparams(pop)
+
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, env_name=env_name, algo=algo,
+                    elite_path=elite_path, save_elite=save_elite,
                 )
-            if use_staging:
-                memory.flush()
-            agent.scores.append(regret_free / max(evo_steps, 1))
+            for agent in pop:
+                agent.steps.append(agent.steps[-1])
+            if resilience is not None:
+                if resilience.step_boundary(
+                    total_steps, _counters(), pop=pop,
+                    fitness=max_fitness(fitnesses),
+                ):
+                    break
+            elif checkpoint is not None and checkpoint_path is not None:
+                if total_steps // checkpoint > checkpoint_count:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                    checkpoint_count = total_steps // checkpoint
+            if target is not None and np.min(fitnesses) >= target:
+                break
 
-        fitnesses = [
-            agent.test(env, max_steps=eval_steps or 100, loop=eval_loop) for agent in pop
-        ]
-        for i, f in enumerate(fitnesses):
-            pop_fitnesses[i].append(f)
-        telem.record_eval(pop, fitnesses)
-        telem.log_step({"global_step": total_steps,
-                        "eval/mean_fitness": float(np.mean(fitnesses))})
-        if verbose:
-            print(f"--- steps {total_steps} fitness {[f'{f:.2f}' for f in fitnesses]}")
-            print_hyperparams(pop)
-
-        if tournament is not None and mutation is not None:
-            pop = tournament_selection_and_mutation(
-                pop, tournament, mutation, env_name=env_name, algo=algo,
-                elite_path=elite_path, save_elite=save_elite,
-            )
-        for agent in pop:
-            agent.steps.append(agent.steps[-1])
-        if checkpoint is not None and checkpoint_path is not None:
-            if total_steps // checkpoint > checkpoint_count:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-                checkpoint_count = total_steps // checkpoint
-        if target is not None and np.min(fitnesses) >= target:
-            break
-
-    if telemetry is None:
-        telem.close()
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses

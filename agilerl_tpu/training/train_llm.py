@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from agilerl_tpu.observability import init_run_telemetry
+from agilerl_tpu.resilience import max_fitness
 from agilerl_tpu.utils.utils import (
     print_hyperparams,
     resume_population_from_checkpoint,
@@ -52,10 +53,11 @@ def finetune_llm_reasoning(
     elite_path: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
     """GRPO reasoning finetune (parity: train_llm.py:25)."""
     _assert_llm_mutations(mutation)
-    if resume:
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
@@ -64,57 +66,97 @@ def finetune_llm_reasoning(
         # MFU (tokens/step vs the chip's bf16 peak) alongside step_time_s
         telem.timeline.set_model_config(getattr(pop[0], "model_config", None))
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
-    start = time.time()
+    done_steps = 0
+    # cross-step loop state: each env.step returns the NEXT batch, carried
+    # via `prompts = next_prompts` below — so it belongs to the snapshot
+    # (a resumed run that re-reset the env would draw a fresh batch and
+    # diverge from the uninterrupted stream)
+    prompts = None
 
-    prompts = env.reset()
-    for step in range(1, max_steps + 1):
-        for agent in pop:
-            agent.set_reference_policy(env.num_epochs)
-            completions, completion_mask = agent.get_action(prompts)
-            ids, action_masks = env.assemble_learn_batch(completions, completion_mask)
-            next_prompts, rewards = env.step(completions, completion_mask)
-            loss, kl = agent.learn((ids, action_masks, rewards))
-            agent.steps[-1] += int(np.asarray(rewards).size)
-            if verbose:
-                print(
-                    f"[{step}] agent {agent.index} loss {loss:.4f} "
-                    f"reward {np.mean(rewards):.3f}"
-                )
-            telem.log_step({
-                "train/loss": loss, "train/mean_reward": float(np.mean(rewards)),
-                "agent": agent.index,
-            })
-            telem.step(tokens=int(np.asarray(ids).size), agent_index=agent.index,
-                       metrics={"loss": float(loss)})
-            prompts = next_prompts
+    def _counters():
+        return {"done_steps": done_steps, "pop_fitnesses": pop_fitnesses,
+                "prompts": prompts}
 
-        if step % evaluation_interval == 0:
-            fitnesses = [agent.test(env) for agent in pop]
-            for i, f in enumerate(fitnesses):
-                pop_fitnesses[i].append(f)
-            if verbose:
-                print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
-                print_hyperparams(pop)
-            telem.record_eval(pop, fitnesses)
-            telem.log_step({"eval/mean_fitness": float(np.mean(fitnesses))})
-            if tournament is not None and mutation is not None:
-                pop = tournament_selection_and_mutation(
-                    pop, tournament, mutation, language_model=True,
-                    elite_path=elite_path, save_elite=save_elite,
-                )
-            # stop AFTER the checkpoint block below so the state that
-            # reached the target is the state on disk (review finding)
-            stop = max_reward is not None and np.max(fitnesses) >= max_reward
-        else:
-            stop = False
-        if checkpoint_interval is not None and checkpoint_path is not None:
-            if stop or step % checkpoint_interval == 0:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-        if stop:
-            break
+    try:
+        if resilience is not None:
+            resilience.attach(pop=pop, tournament=tournament, mutation=mutation,
+                              telemetry=telem, env=env)
+            if resume:
+                restored = resilience.resume(_counters())
+                done_steps = int(restored["done_steps"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+                prompts = restored.get("prompts")
+        start = time.time()
 
-    if telemetry is None:
-        telem.close()
+        if prompts is None:
+            prompts = env.reset()
+        for step in range(done_steps + 1, max_steps + 1):
+            for agent in pop:
+                agent.set_reference_policy(env.num_epochs)
+                completions, completion_mask = agent.get_action(prompts)
+                ids, action_masks = env.assemble_learn_batch(completions, completion_mask)
+                next_prompts, rewards = env.step(completions, completion_mask)
+                loss, kl = agent.learn((ids, action_masks, rewards))
+                agent.steps[-1] += int(np.asarray(rewards).size)
+                if verbose:
+                    print(
+                        f"[{step}] agent {agent.index} loss {loss:.4f} "
+                        f"reward {np.mean(rewards):.3f}"
+                    )
+                telem.log_step({
+                    "train/loss": loss, "train/mean_reward": float(np.mean(rewards)),
+                    "agent": agent.index,
+                })
+                telem.step(tokens=int(np.asarray(ids).size), agent_index=agent.index,
+                           metrics={"loss": float(loss)})
+                prompts = next_prompts
+
+            if step % evaluation_interval == 0:
+                fitnesses = [agent.test(env) for agent in pop]
+                for i, f in enumerate(fitnesses):
+                    pop_fitnesses[i].append(f)
+                if verbose:
+                    print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
+                    print_hyperparams(pop)
+                telem.record_eval(pop, fitnesses)
+                telem.log_step({"eval/mean_fitness": float(np.mean(fitnesses))})
+                if tournament is not None and mutation is not None:
+                    pop = tournament_selection_and_mutation(
+                        pop, tournament, mutation, language_model=True,
+                        elite_path=elite_path, save_elite=save_elite,
+                    )
+                # stop AFTER the checkpoint block below so the state that
+                # reached the target is the state on disk (review finding)
+                stop = max_reward is not None and np.max(fitnesses) >= max_reward
+                last_fitness = max_fitness(fitnesses)
+            else:
+                stop = False
+                last_fitness = None
+            done_steps = step
+            if resilience is not None:
+                if resilience.step_boundary(
+                    step, _counters(), pop=pop, fitness=last_fitness,
+                ):
+                    break
+                if stop:
+                    # the state that reached the target must be the state on
+                    # disk (same contract as the legacy stop-checkpoint below)
+                    resilience.snapshot(step, _counters(), kind="final",
+                                        fitness=last_fitness)
+            elif checkpoint_interval is not None and checkpoint_path is not None:
+                if stop or step % checkpoint_interval == 0:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+            if stop:
+                break
+
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses
 
 
@@ -138,51 +180,83 @@ def finetune_llm_preference(
     elite_path: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
     """DPO preference finetune (parity: train_llm.py:417)."""
     _assert_llm_mutations(mutation)
-    if resume:
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
     if telem.timeline.model_config is None:
         telem.timeline.set_model_config(getattr(pop[0], "model_config", None))
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
+    done_steps = 0
 
-    for step in range(1, max_steps + 1):
-        batch = env.reset()
-        for agent in pop:
-            agent.set_reference_policy(env.num_epochs)
-            loss, acc = agent.learn(batch)
-            agent.steps[-1] += len(batch["chosen_ids"])
-            if verbose:
-                print(f"[{step}] agent {agent.index} dpo loss {loss:.4f} acc {acc:.3f}")
-            telem.log_step({"train/loss": loss, "train/acc": acc, "agent": agent.index})
-            telem.step(tokens=int(np.asarray(batch["chosen_ids"]).size),
-                       agent_index=agent.index, metrics={"loss": float(loss)})
+    def _counters():
+        return {"done_steps": done_steps, "pop_fitnesses": pop_fitnesses}
 
-        if step % evaluation_interval == 0:
-            fitnesses = [agent.test(env) for agent in pop]
-            for i, f in enumerate(fitnesses):
-                pop_fitnesses[i].append(f)
-            if verbose:
-                print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
-            telem.record_eval(pop, fitnesses)
-            telem.log_step({"eval/mean_fitness": float(np.mean(fitnesses))})
-            if tournament is not None and mutation is not None:
-                pop = tournament_selection_and_mutation(
-                    pop, tournament, mutation, language_model=True,
-                    elite_path=elite_path, save_elite=save_elite,
-                )
-            stop = max_reward is not None and np.max(fitnesses) >= max_reward
-        else:
-            stop = False
-        if checkpoint_interval is not None and checkpoint_path is not None:
-            if stop or step % checkpoint_interval == 0:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-        if stop:
-            break
+    try:
+        if resilience is not None:
+            resilience.attach(pop=pop, tournament=tournament, mutation=mutation,
+                              telemetry=telem, env=env)
+            if resume:
+                restored = resilience.resume(_counters())
+                done_steps = int(restored["done_steps"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+        for step in range(done_steps + 1, max_steps + 1):
+            batch = env.reset()
+            for agent in pop:
+                agent.set_reference_policy(env.num_epochs)
+                loss, acc = agent.learn(batch)
+                agent.steps[-1] += len(batch["chosen_ids"])
+                if verbose:
+                    print(f"[{step}] agent {agent.index} dpo loss {loss:.4f} acc {acc:.3f}")
+                telem.log_step({"train/loss": loss, "train/acc": acc, "agent": agent.index})
+                telem.step(tokens=int(np.asarray(batch["chosen_ids"]).size),
+                           agent_index=agent.index, metrics={"loss": float(loss)})
 
-    if telemetry is None:
-        telem.close()
+            if step % evaluation_interval == 0:
+                fitnesses = [agent.test(env) for agent in pop]
+                for i, f in enumerate(fitnesses):
+                    pop_fitnesses[i].append(f)
+                if verbose:
+                    print(f"=== eval @ {step}: {[f'{f:.3f}' for f in fitnesses]}")
+                telem.record_eval(pop, fitnesses)
+                telem.log_step({"eval/mean_fitness": float(np.mean(fitnesses))})
+                if tournament is not None and mutation is not None:
+                    pop = tournament_selection_and_mutation(
+                        pop, tournament, mutation, language_model=True,
+                        elite_path=elite_path, save_elite=save_elite,
+                    )
+                stop = max_reward is not None and np.max(fitnesses) >= max_reward
+                last_fitness = max_fitness(fitnesses)
+            else:
+                stop = False
+                last_fitness = None
+            done_steps = step
+            if resilience is not None:
+                if resilience.step_boundary(
+                    step, _counters(), pop=pop, fitness=last_fitness,
+                ):
+                    break
+                if stop:
+                    # the state that reached the target must be the state on
+                    # disk (same contract as the legacy stop-checkpoint below)
+                    resilience.snapshot(step, _counters(), kind="final",
+                                        fitness=last_fitness)
+            elif checkpoint_interval is not None and checkpoint_path is not None:
+                if stop or step % checkpoint_interval == 0:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+            if stop:
+                break
+
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from agilerl_tpu.observability import init_run_telemetry
+from agilerl_tpu.resilience import max_fitness
 from agilerl_tpu.vector import sanitize_ma_transition
 from agilerl_tpu.utils.utils import (
     print_hyperparams,
@@ -55,8 +56,9 @@ def train_multi_agent_off_policy(
     telemetry=None,
     seed: Optional[int] = None,
     flush_every: Optional[int] = None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
-    if resume:
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
@@ -73,98 +75,132 @@ def train_multi_agent_off_policy(
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
-    start = time.time()
 
-    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
-        for agent in pop:
-            obs, info = env.reset()
-            steps = 0
-            learn_every = max(agent.learn_step, 1)
-            for _ in range(max(evo_steps // num_envs, 1)):
-                # forward the env's info dict: action masks / env-defined
-                # actions ride it (parity: reference train_multi_agent.py)
-                t_act = time.perf_counter()
-                actions = agent.get_action(obs, infos=info)
-                t_host = time.perf_counter()
-                next_obs, reward, terminated, truncated, info = env.step(actions)
-                # dead/inactive agents arrive as NaN placeholders — zero them
-                # before they can reach the buffer (NaN Q-target poisoning)
-                next_obs, reward = sanitize_ma_transition(next_obs, reward)
-                done = {
-                    a: np.asarray(terminated[a], np.float32) for a in agent_ids
-                }
-                store_next = (
-                    info.get("final_obs", next_obs) if isinstance(info, dict) else next_obs
-                )
-                if store_next is not next_obs:
-                    # final_obs is assembled from shared memory and can carry
-                    # NaN placeholder rows too (review finding)
-                    store_next, _ = sanitize_ma_transition(store_next, {})
-                if use_staging:
-                    # chunked ingestion: one coalesced buffer dispatch per
-                    # flush_every steps instead of one per step
-                    memory.stage_to_memory(
-                        obs, actions, reward, store_next, done,
-                        is_vectorised=num_envs > 1,
+    def _counters():
+        return {"total_steps": total_steps, "checkpoint_count": checkpoint_count,
+                "pop_fitnesses": pop_fitnesses}
+
+    try:
+        if resilience is not None:
+            resilience.attach(pop=pop, memory=memory, tournament=tournament,
+                              mutation=mutation, telemetry=telem, env=env)
+            if resume:
+                restored = resilience.resume(_counters())
+                total_steps = int(restored["total_steps"])
+                checkpoint_count = int(restored["checkpoint_count"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+        start = time.time()
+
+        while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+            for agent in pop:
+                if resilience is not None and resilience.abort_generation:
+                    break
+                obs, info = env.reset()
+                steps = 0
+                learn_every = max(agent.learn_step, 1)
+                for _ in range(max(evo_steps // num_envs, 1)):
+                    # forward the env's info dict: action masks / env-defined
+                    # actions ride it (parity: reference train_multi_agent.py)
+                    t_act = time.perf_counter()
+                    actions = agent.get_action(obs, infos=info)
+                    t_host = time.perf_counter()
+                    next_obs, reward, terminated, truncated, info = env.step(actions)
+                    # dead/inactive agents arrive as NaN placeholders — zero them
+                    # before they can reach the buffer (NaN Q-target poisoning)
+                    next_obs, reward = sanitize_ma_transition(next_obs, reward)
+                    done = {
+                        a: np.asarray(terminated[a], np.float32) for a in agent_ids
+                    }
+                    store_next = (
+                        info.get("final_obs", next_obs) if isinstance(info, dict) else next_obs
                     )
-                else:
-                    memory.save_to_memory(
-                        obs, actions, reward, store_next, done,
-                        is_vectorised=num_envs > 1,
-                    )
-                obs = next_obs
-                steps += num_envs
-                total_steps += num_envs
-                learn_block_s = 0.0
-                if steps % learn_every < num_envs:
+                    if store_next is not next_obs:
+                        # final_obs is assembled from shared memory and can carry
+                        # NaN placeholder rows too (review finding)
+                        store_next, _ = sanitize_ma_transition(store_next, {})
                     if use_staging:
-                        memory.flush()
-                    if (
-                        len(memory) >= agent.batch_size
-                        and len(memory) >= learning_delay
-                    ):
-                        t_learn = time.perf_counter()
-                        agent.learn(memory.sample(agent.batch_size))
-                        learn_block_s = time.perf_counter() - t_learn
-                # the learn call blocks on the device — count it as device
-                # wait so overlap_fraction stays honest
-                telem.step(
-                    env_steps=num_envs, agent_index=agent.index,
-                    host_time_s=(time.perf_counter() - t_host) - learn_block_s,
-                    device_time_s=(t_host - t_act) + learn_block_s,
+                        # chunked ingestion: one coalesced buffer dispatch per
+                        # flush_every steps instead of one per step
+                        memory.stage_to_memory(
+                            obs, actions, reward, store_next, done,
+                            is_vectorised=num_envs > 1,
+                        )
+                    else:
+                        memory.save_to_memory(
+                            obs, actions, reward, store_next, done,
+                            is_vectorised=num_envs > 1,
+                        )
+                    obs = next_obs
+                    steps += num_envs
+                    total_steps += num_envs
+                    learn_block_s = 0.0
+                    if steps % learn_every < num_envs:
+                        if use_staging:
+                            memory.flush()
+                        if (
+                            len(memory) >= agent.batch_size
+                            and len(memory) >= learning_delay
+                        ):
+                            t_learn = time.perf_counter()
+                            agent.learn(memory.sample(agent.batch_size))
+                            learn_block_s = time.perf_counter() - t_learn
+                    # the learn call blocks on the device — count it as device
+                    # wait so overlap_fraction stays honest
+                    telem.step(
+                        env_steps=num_envs, agent_index=agent.index,
+                        host_time_s=(time.perf_counter() - t_host) - learn_block_s,
+                        device_time_s=(t_host - t_act) + learn_block_s,
+                    )
+                    if resilience is not None and resilience.abort_generation:
+                        break
+                if use_staging:
+                    memory.flush()
+                agent.steps[-1] += steps
+
+            if resilience is not None and resilience.abort_generation:
+                resilience.step_boundary(total_steps, _counters(), pop=pop)
+                break
+
+            fitnesses = [
+                agent.test(env, max_steps=eval_steps, loop=eval_loop, sum_scores=sum_scores)
+                for agent in pop
+            ]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            telem.record_eval(pop, fitnesses)
+            telem.log_step({"global_step": total_steps,
+                            "eval/mean_fitness": float(np.mean(fitnesses))})
+            if verbose:
+                fps = total_steps / (time.time() - start)
+                print(f"--- steps {total_steps} fps {fps:.0f} fitness {[f'{f:.1f}' for f in fitnesses]}")
+                print_hyperparams(pop)
+
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, env_name=env_name, algo=algo,
+                    elite_path=elite_path, save_elite=save_elite,
                 )
-            if use_staging:
-                memory.flush()
-            agent.steps[-1] += steps
+            for agent in pop:
+                agent.steps.append(agent.steps[-1])
+            if resilience is not None:
+                if resilience.step_boundary(
+                    total_steps, _counters(), pop=pop,
+                    fitness=max_fitness(fitnesses),
+                ):
+                    break
+            elif checkpoint is not None and checkpoint_path is not None:
+                if total_steps // checkpoint > checkpoint_count:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                    checkpoint_count = total_steps // checkpoint
+            if target is not None and np.min(fitnesses) >= target:
+                break
 
-        fitnesses = [
-            agent.test(env, max_steps=eval_steps, loop=eval_loop, sum_scores=sum_scores)
-            for agent in pop
-        ]
-        for i, f in enumerate(fitnesses):
-            pop_fitnesses[i].append(f)
-        telem.record_eval(pop, fitnesses)
-        telem.log_step({"global_step": total_steps,
-                        "eval/mean_fitness": float(np.mean(fitnesses))})
-        if verbose:
-            fps = total_steps / (time.time() - start)
-            print(f"--- steps {total_steps} fps {fps:.0f} fitness {[f'{f:.1f}' for f in fitnesses]}")
-            print_hyperparams(pop)
-
-        if tournament is not None and mutation is not None:
-            pop = tournament_selection_and_mutation(
-                pop, tournament, mutation, env_name=env_name, algo=algo,
-                elite_path=elite_path, save_elite=save_elite,
-            )
-        for agent in pop:
-            agent.steps.append(agent.steps[-1])
-        if checkpoint is not None and checkpoint_path is not None:
-            if total_steps // checkpoint > checkpoint_count:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-                checkpoint_count = total_steps // checkpoint
-        if target is not None and np.min(fitnesses) >= target:
-            break
-
-    if telemetry is None:
-        telem.close()
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses

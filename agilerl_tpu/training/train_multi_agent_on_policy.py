@@ -11,6 +11,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from agilerl_tpu.observability import init_run_telemetry
+from agilerl_tpu.resilience import max_fitness
 from agilerl_tpu.utils.utils import (
     print_hyperparams,
     resume_population_from_checkpoint,
@@ -45,8 +46,9 @@ def train_multi_agent_on_policy(
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
-    if resume:
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
@@ -54,49 +56,83 @@ def train_multi_agent_on_policy(
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
-    start = time.time()
 
-    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
-        for agent in pop:
-            steps = 0
-            agent._last_obs = None
-            for _ in range(max(evo_steps // (agent.learn_step * num_envs), 1)):
-                agent.collect_rollouts(env, n_steps=agent.learn_step)
-                agent.learn()
-                steps += agent.learn_step * num_envs
-                total_steps += agent.learn_step * num_envs
-                telem.step(env_steps=agent.learn_step * num_envs,
-                           agent_index=agent.index)
-            agent.steps[-1] += steps
+    def _counters():
+        return {"total_steps": total_steps, "checkpoint_count": checkpoint_count,
+                "pop_fitnesses": pop_fitnesses}
 
-        fitnesses = [
-            agent.test(env, max_steps=eval_steps, loop=eval_loop, sum_scores=sum_scores)
-            for agent in pop
-        ]
-        for i, f in enumerate(fitnesses):
-            pop_fitnesses[i].append(f)
-        telem.record_eval(pop, fitnesses)
-        telem.log_step({"global_step": total_steps,
-                        "eval/mean_fitness": float(np.mean(fitnesses))})
-        if verbose:
-            fps = total_steps / (time.time() - start)
-            print(f"--- steps {total_steps} fps {fps:.0f} fitness {[f'{f:.1f}' for f in fitnesses]}")
-            print_hyperparams(pop)
+    try:
+        if resilience is not None:
+            resilience.attach(pop=pop, tournament=tournament, mutation=mutation,
+                              telemetry=telem, env=env)
+            if resume:
+                restored = resilience.resume(_counters())
+                total_steps = int(restored["total_steps"])
+                checkpoint_count = int(restored["checkpoint_count"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+        start = time.time()
 
-        if tournament is not None and mutation is not None:
-            pop = tournament_selection_and_mutation(
-                pop, tournament, mutation, env_name=env_name, algo=algo,
-                elite_path=elite_path, save_elite=save_elite,
-            )
-        for agent in pop:
-            agent.steps.append(agent.steps[-1])
-        if checkpoint is not None and checkpoint_path is not None:
-            if total_steps // checkpoint > checkpoint_count:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-                checkpoint_count = total_steps // checkpoint
-        if target is not None and np.min(fitnesses) >= target:
-            break
+        while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+            for agent in pop:
+                if resilience is not None and resilience.abort_generation:
+                    break
+                steps = 0
+                agent._last_obs = None
+                for _ in range(max(evo_steps // (agent.learn_step * num_envs), 1)):
+                    agent.collect_rollouts(env, n_steps=agent.learn_step)
+                    agent.learn()
+                    steps += agent.learn_step * num_envs
+                    total_steps += agent.learn_step * num_envs
+                    telem.step(env_steps=agent.learn_step * num_envs,
+                               agent_index=agent.index)
+                    if resilience is not None and resilience.abort_generation:
+                        break
+                agent.steps[-1] += steps
 
-    if telemetry is None:
-        telem.close()
+            if resilience is not None and resilience.abort_generation:
+                resilience.step_boundary(total_steps, _counters(), pop=pop)
+                break
+
+            fitnesses = [
+                agent.test(env, max_steps=eval_steps, loop=eval_loop, sum_scores=sum_scores)
+                for agent in pop
+            ]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            telem.record_eval(pop, fitnesses)
+            telem.log_step({"global_step": total_steps,
+                            "eval/mean_fitness": float(np.mean(fitnesses))})
+            if verbose:
+                fps = total_steps / (time.time() - start)
+                print(f"--- steps {total_steps} fps {fps:.0f} fitness {[f'{f:.1f}' for f in fitnesses]}")
+                print_hyperparams(pop)
+
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, env_name=env_name, algo=algo,
+                    elite_path=elite_path, save_elite=save_elite,
+                )
+            for agent in pop:
+                agent.steps.append(agent.steps[-1])
+            if resilience is not None:
+                if resilience.step_boundary(
+                    total_steps, _counters(), pop=pop,
+                    fitness=max_fitness(fitnesses),
+                ):
+                    break
+            elif checkpoint is not None and checkpoint_path is not None:
+                if total_steps // checkpoint > checkpoint_count:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                    checkpoint_count = total_steps // checkpoint
+            if target is not None and np.min(fitnesses) >= target:
+                break
+
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses

@@ -25,6 +25,7 @@ import numpy as np
 
 from agilerl_tpu.components.sampler import Sampler
 from agilerl_tpu.observability import init_run_telemetry
+from agilerl_tpu.resilience import max_fitness
 from agilerl_tpu.utils.utils import (
     print_hyperparams,
     resume_population_from_checkpoint,
@@ -120,8 +121,13 @@ def train_off_policy(
     telemetry=None,
     seed: Optional[int] = None,
     flush_every: Optional[int] = None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
-    if resume:
+    # resilience= supersedes the ad-hoc checkpoint/checkpoint_path plumbing:
+    # whole-run crash-consistent snapshots (population + buffers + RNG +
+    # counters + lineage) with preemption-aware final saves. The legacy path
+    # below is kept for plain weight checkpoints.
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
@@ -156,214 +162,259 @@ def train_off_policy(
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
-    start = time.time()
 
-    # gymnasium >=1.0 vector envs autoreset on the NEXT step: the post-done
-    # step ignores the action and returns (reset_obs, reward 0) — such rows
-    # must not enter the replay buffer. JaxVecEnv autoresets same-step, so
-    # every row is valid there.
-    next_step_autoreset = "NEXT_STEP" in str(getattr(env, "autoreset_mode", ""))
+    def _counters():
+        return {"total_steps": total_steps, "checkpoint_count": checkpoint_count,
+                "epsilon": epsilon, "pop_fitnesses": pop_fitnesses}
 
-    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
-        sync_wait_total = 0.0
-        for agent in pop:
-            obs, info = env.reset()
-            prev_done = np.zeros(num_envs, dtype=bool)
-            prev_transition = None
-            if n_step and n_step_memory is not None:
-                # folds must not span the reset / the previous agent's steps
-                # (reset_horizon folds any staged pre-reset steps first)
-                n_step_memory.reset_horizon()
-            # fused sample+learn path: one jit dispatch per learn step, loss
-            # kept on device (sync-free). PER requires the algorithm to
-            # write priorities back in-dispatch.
-            use_fused = (
-                hasattr(agent, "learn_from_buffer")
-                and (not per or getattr(agent, "supports_fused_per", False))
-                # custom user memories without device ring state fall back
-                # to the legacy sample→learn path
-                and hasattr(memory, "per_state" if per else "state")
+    try:
+        if resilience is not None:
+            resilience.attach(
+                pop=pop, memory=memory,
+                n_step_memory=n_step_memory if n_step else None,
+                tournament=tournament, mutation=mutation,
+                telemetry=telem, env=env,
             )
-            pending_loss = None
-            scores = np.zeros(num_envs)
-            completed_scores: List[float] = []
-            steps = 0
-            learn_every = max(agent.learn_step, 1)
-            for _ in range(max(evo_steps // num_envs, 1)):
-                # masked envs publish per-step action masks on the info dict
-                # (parity: train_off_policy.py:268)
-                action_mask = info.get("action_mask") if isinstance(info, dict) else None
-                t_act = time.perf_counter()
-                action = agent.get_action(obs, epsilon=epsilon, action_mask=action_mask)
-                t_host = time.perf_counter()
-                next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
-                done = np.logical_or(terminated, truncated)
-                # bootstrap target must see the TRUE successor state, not the
-                # autoreset obs (review finding; gymnasium final_observation);
-                # merged per-env — final_obs applies only where done
-                final = (
-                    info.get("final_obs", info.get("final_observation"))
-                    if isinstance(info, dict) else None
-                )
-                store_next = merge_final_obs(next_obs, final, done)
-                scores += np.asarray(reward)
-                for i, d in enumerate(np.atleast_1d(done)):
-                    if d:
-                        completed_scores.append(float(np.atleast_1d(scores)[i]))
-                        scores[i] = 0.0
+            if resume:
+                restored = resilience.resume(_counters())
+                total_steps = int(restored["total_steps"])
+                checkpoint_count = int(restored["checkpoint_count"])
+                epsilon = float(restored["epsilon"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+        start = time.time()
 
-                transition = {
-                    "obs": obs,
-                    "action": action,
-                    "reward": np.asarray(reward, np.float32),
-                    "next_obs": store_next,
-                    "done": np.asarray(terminated, np.float32),
-                }
+        # gymnasium >=1.0 vector envs autoreset on the NEXT step: the post-done
+        # step ignores the action and returns (reset_obs, reward 0) — such rows
+        # must not enter the replay buffer. JaxVecEnv autoresets same-step, so
+        # every row is valid there.
+        next_step_autoreset = "NEXT_STEP" in str(getattr(env, "autoreset_mode", ""))
+
+        while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+            sync_wait_total = 0.0
+            for agent in pop:
+                if resilience is not None and resilience.abort_generation:
+                    break
+                obs, info = env.reset()
+                prev_done = np.zeros(num_envs, dtype=bool)
+                prev_transition = None
                 if n_step and n_step_memory is not None:
-                    # fused n-step goes into n_step_memory's own ring; the
-                    # OLDEST raw transitions displaced by the fold go into
-                    # the main buffer so both rings stay index-aligned
-                    # (parity: reference's paired-buffer scheme,
-                    # train_off_policy.py:340). _boundary stops folds at
-                    # truncations/autoresets.
-                    transition["_boundary"] = np.asarray(done, np.float32)
-                    if next_step_autoreset and prev_done.any() and prev_transition:
-                        # gymnasium NEXT_STEP autoreset: this row is a bogus
-                        # filler (obs = old terminal obs, ignored action, done
-                        # False — training on it would bootstrap the old
-                        # terminal obs into the NEW episode). Substitute the
-                        # env's previous (real, episode-ending) row: a benign
-                        # duplicate whose _boundary=1 keeps folds frozen, and
-                        # paired-buffer indices stay aligned (advisor finding).
-                        transition = _substitute_rows(
-                            transition, prev_transition, prev_done
-                        )
-                    prev_transition = transition
-                    if use_staging:
-                        n_step_memory.stage(transition, batched=num_envs > 1)
-                    else:
-                        one_step = n_step_memory.add(transition, batched=num_envs > 1)
-                        if one_step is not None:
-                            memory.add(one_step, batched=num_envs > 1)
-                elif next_step_autoreset and prev_done.any():
-                    keep = np.where(~prev_done)[0]
-                    if keep.size:
-                        kept = jax.tree_util.tree_map(
-                            lambda v: np.asarray(v)[keep], transition
-                        )
+                    # folds must not span the reset / the previous agent's steps
+                    # (reset_horizon folds any staged pre-reset steps first)
+                    n_step_memory.reset_horizon()
+                # fused sample+learn path: one jit dispatch per learn step, loss
+                # kept on device (sync-free). PER requires the algorithm to
+                # write priorities back in-dispatch.
+                use_fused = (
+                    hasattr(agent, "learn_from_buffer")
+                    and (not per or getattr(agent, "supports_fused_per", False))
+                    # custom user memories without device ring state fall back
+                    # to the legacy sample→learn path
+                    and hasattr(memory, "per_state" if per else "state")
+                )
+                pending_loss = None
+                scores = np.zeros(num_envs)
+                completed_scores: List[float] = []
+                steps = 0
+                learn_every = max(agent.learn_step, 1)
+                for _ in range(max(evo_steps // num_envs, 1)):
+                    # masked envs publish per-step action masks on the info dict
+                    # (parity: train_off_policy.py:268)
+                    action_mask = info.get("action_mask") if isinstance(info, dict) else None
+                    t_act = time.perf_counter()
+                    action = agent.get_action(obs, epsilon=epsilon, action_mask=action_mask)
+                    t_host = time.perf_counter()
+                    next_obs, reward, terminated, truncated, info = env.step(np.asarray(action))
+                    done = np.logical_or(terminated, truncated)
+                    # bootstrap target must see the TRUE successor state, not the
+                    # autoreset obs (review finding; gymnasium final_observation);
+                    # merged per-env — final_obs applies only where done
+                    final = (
+                        info.get("final_obs", info.get("final_observation"))
+                        if isinstance(info, dict) else None
+                    )
+                    store_next = merge_final_obs(next_obs, final, done)
+                    scores += np.asarray(reward)
+                    for i, d in enumerate(np.atleast_1d(done)):
+                        if d:
+                            completed_scores.append(float(np.atleast_1d(scores)[i]))
+                            scores[i] = 0.0
+
+                    transition = {
+                        "obs": obs,
+                        "action": action,
+                        "reward": np.asarray(reward, np.float32),
+                        "next_obs": store_next,
+                        "done": np.asarray(terminated, np.float32),
+                    }
+                    if n_step and n_step_memory is not None:
+                        # fused n-step goes into n_step_memory's own ring; the
+                        # OLDEST raw transitions displaced by the fold go into
+                        # the main buffer so both rings stay index-aligned
+                        # (parity: reference's paired-buffer scheme,
+                        # train_off_policy.py:340). _boundary stops folds at
+                        # truncations/autoresets.
+                        transition["_boundary"] = np.asarray(done, np.float32)
+                        if next_step_autoreset and prev_done.any() and prev_transition:
+                            # gymnasium NEXT_STEP autoreset: this row is a bogus
+                            # filler (obs = old terminal obs, ignored action, done
+                            # False — training on it would bootstrap the old
+                            # terminal obs into the NEW episode). Substitute the
+                            # env's previous (real, episode-ending) row: a benign
+                            # duplicate whose _boundary=1 keeps folds frozen, and
+                            # paired-buffer indices stay aligned (advisor finding).
+                            transition = _substitute_rows(
+                                transition, prev_transition, prev_done
+                            )
+                        prev_transition = transition
                         if use_staging:
-                            memory.stage(kept, batched=True)
+                            n_step_memory.stage(transition, batched=num_envs > 1)
                         else:
-                            memory.add(kept, batched=True)
-                elif use_staging:
-                    memory.stage(transition, batched=num_envs > 1)
-                else:
-                    memory.add(transition, batched=num_envs > 1)
-                prev_done = np.atleast_1d(done).astype(bool)
+                            one_step = n_step_memory.add(transition, batched=num_envs > 1)
+                            if one_step is not None:
+                                memory.add(one_step, batched=num_envs > 1)
+                    elif next_step_autoreset and prev_done.any():
+                        keep = np.where(~prev_done)[0]
+                        if keep.size:
+                            kept = jax.tree_util.tree_map(
+                                lambda v: np.asarray(v)[keep], transition
+                            )
+                            if use_staging:
+                                memory.stage(kept, batched=True)
+                            else:
+                                memory.add(kept, batched=True)
+                    elif use_staging:
+                        memory.stage(transition, batched=num_envs > 1)
+                    else:
+                        memory.add(transition, batched=num_envs > 1)
+                    prev_done = np.atleast_1d(done).astype(bool)
 
-                obs = next_obs
-                steps += num_envs
-                total_steps += num_envs
-                epsilon = max(eps_end, epsilon * eps_decay)
+                    obs = next_obs
+                    steps += num_envs
+                    total_steps += num_envs
+                    epsilon = max(eps_end, epsilon * eps_decay)
 
-                learn_block_s = 0.0
-                if steps % learn_every < num_envs:
-                    # drain staging so warmup gating sees every stored row
-                    # (host-mirrored counters — no device sync here)
-                    sampler.flush()
-                    if (
-                        len(memory) >= agent.batch_size
-                        and len(memory) >= learning_delay
-                    ):
-                        if use_fused:
-                            # ONE dispatch: sample + learn (+ PER priority
-                            # write-back), issued WITHOUT blocking — the
-                            # device chews on it while the host steps the env
-                            pending_loss = agent.learn_from_buffer(
-                                memory,
-                                n_step_memory if n_step else None,
-                            )
-                        elif per:
-                            t_learn = time.perf_counter()
-                            # same IS-weight beta as the fused path would
-                            # use (agent-defined, else the 0.4 default)
-                            sampled = sampler.sample(
-                                agent.batch_size,
-                                beta=getattr(agent, "beta", None),
-                            )
-                            idxs = sampled[1]
-                            result = agent.learn(sampled)
-                            new_priorities = (
-                                result[1] if isinstance(result, tuple) else None
-                            )
-                            if new_priorities is not None:
-                                memory.update_priorities(idxs, new_priorities)
-                            learn_block_s = time.perf_counter() - t_learn
-                        else:
-                            t_learn = time.perf_counter()
-                            agent.learn(sampler.sample(agent.batch_size))
-                            learn_block_s = time.perf_counter() - t_learn
-                # legacy learn blocks on the device (float(loss) etc.), so
-                # its time counts as device wait, not host work — otherwise
-                # an unpipelined run would report overlap near 1
-                telem.step(
-                    env_steps=num_envs, agent_index=agent.index,
-                    host_time_s=(time.perf_counter() - t_host) - learn_block_s,
-                    device_time_s=(t_host - t_act) + learn_block_s,
+                    learn_block_s = 0.0
+                    if steps % learn_every < num_envs:
+                        # drain staging so warmup gating sees every stored row
+                        # (host-mirrored counters — no device sync here)
+                        sampler.flush()
+                        if (
+                            len(memory) >= agent.batch_size
+                            and len(memory) >= learning_delay
+                        ):
+                            if use_fused:
+                                # ONE dispatch: sample + learn (+ PER priority
+                                # write-back), issued WITHOUT blocking — the
+                                # device chews on it while the host steps the env
+                                pending_loss = agent.learn_from_buffer(
+                                    memory,
+                                    n_step_memory if n_step else None,
+                                )
+                            elif per:
+                                t_learn = time.perf_counter()
+                                # same IS-weight beta as the fused path would
+                                # use (agent-defined, else the 0.4 default)
+                                sampled = sampler.sample(
+                                    agent.batch_size,
+                                    beta=getattr(agent, "beta", None),
+                                )
+                                idxs = sampled[1]
+                                result = agent.learn(sampled)
+                                new_priorities = (
+                                    result[1] if isinstance(result, tuple) else None
+                                )
+                                if new_priorities is not None:
+                                    memory.update_priorities(idxs, new_priorities)
+                                learn_block_s = time.perf_counter() - t_learn
+                            else:
+                                t_learn = time.perf_counter()
+                                agent.learn(sampler.sample(agent.batch_size))
+                                learn_block_s = time.perf_counter() - t_learn
+                    # legacy learn blocks on the device (float(loss) etc.), so
+                    # its time counts as device wait, not host work — otherwise
+                    # an unpipelined run would report overlap near 1
+                    telem.step(
+                        env_steps=num_envs, agent_index=agent.index,
+                        host_time_s=(time.perf_counter() - t_host) - learn_block_s,
+                        device_time_s=(t_host - t_act) + learn_block_s,
+                    )
+                    if resilience is not None and resilience.abort_generation:
+                        break  # final snapshot happens at the boundary below
+
+                # segment sync point (eval/telemetry cadence): drain staging and
+                # wait for the learn stream — the ONLY place the hot path blocks
+                # on the device outside action selection
+                sampler.flush()
+                t_sync = time.perf_counter()
+                if pending_loss is not None:
+                    jax.block_until_ready(pending_loss)
+                sync_wait_total += time.perf_counter() - t_sync
+                agent.steps[-1] += steps
+                mean_score = float(np.mean(completed_scores)) if completed_scores else float(np.mean(scores))
+                agent.scores.append(mean_score)
+
+            if resilience is not None and resilience.abort_generation:
+                # on_preempt="now": final snapshot mid-generation, skip the
+                # (expensive) eval + evolution, exit cleanly. Under
+                # "finish_generation" this stays False and the boundary
+                # step_boundary below takes the final snapshot instead.
+                resilience.step_boundary(total_steps, _counters(), pop=pop)
+                break
+
+            # evaluation + evolution
+            fitnesses = [
+                agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
+                for agent in pop
+            ]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            telem.record_eval(pop, fitnesses)
+            telem.log_step(
+                {"global_step": total_steps, "fps": total_steps / (time.time() - start),
+                 "eval/mean_fitness": float(np.mean(fitnesses)),
+                 # how long the generation spent blocked waiting for the learn
+                 # stream at its sync points — the pipelining win shrinks this
+                 "pipeline/sync_wait_s": round(sync_wait_total, 6)}
+            )
+            if verbose:
+                fps = total_steps / (time.time() - start)
+                print(
+                    f"--- steps {total_steps} fps {fps:.0f} eps {epsilon:.3f} "
+                    f"fitness {[f'{f:.1f}' for f in fitnesses]}"
+                )
+                print_hyperparams(pop)
+
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, env_name=env_name, algo=algo,
+                    elite_path=elite_path, save_elite=save_elite,
                 )
 
-            # segment sync point (eval/telemetry cadence): drain staging and
-            # wait for the learn stream — the ONLY place the hot path blocks
-            # on the device outside action selection
-            sampler.flush()
-            t_sync = time.perf_counter()
-            if pending_loss is not None:
-                jax.block_until_ready(pending_loss)
-            sync_wait_total += time.perf_counter() - t_sync
-            agent.steps[-1] += steps
-            mean_score = float(np.mean(completed_scores)) if completed_scores else float(np.mean(scores))
-            agent.scores.append(mean_score)
+            for agent in pop:
+                agent.steps.append(agent.steps[-1])
 
-        # evaluation + evolution
-        fitnesses = [
-            agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
-            for agent in pop
-        ]
-        for i, f in enumerate(fitnesses):
-            pop_fitnesses[i].append(f)
-        telem.record_eval(pop, fitnesses)
-        telem.log_step(
-            {"global_step": total_steps, "fps": total_steps / (time.time() - start),
-             "eval/mean_fitness": float(np.mean(fitnesses)),
-             # how long the generation spent blocked waiting for the learn
-             # stream at its sync points — the pipelining win shrinks this
-             "pipeline/sync_wait_s": round(sync_wait_total, 6)}
-        )
-        if verbose:
-            fps = total_steps / (time.time() - start)
-            print(
-                f"--- steps {total_steps} fps {fps:.0f} eps {epsilon:.3f} "
-                f"fitness {[f'{f:.1f}' for f in fitnesses]}"
-            )
-            print_hyperparams(pop)
+            if resilience is not None:
+                # the crash-consistent step boundary: cadence snapshot when due,
+                # final snapshot + clean exit when a preemption was requested
+                if resilience.step_boundary(
+                    total_steps, _counters(), pop=pop,
+                    fitness=max_fitness(fitnesses),
+                ):
+                    break
+            elif checkpoint is not None and checkpoint_path is not None:
+                if total_steps // checkpoint > checkpoint_count:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                    checkpoint_count = total_steps // checkpoint
 
-        if tournament is not None and mutation is not None:
-            pop = tournament_selection_and_mutation(
-                pop, tournament, mutation, env_name=env_name, algo=algo,
-                elite_path=elite_path, save_elite=save_elite,
-            )
+            if target is not None and np.min(fitnesses) >= target:
+                break
 
-        for agent in pop:
-            agent.steps.append(agent.steps[-1])
-
-        if checkpoint is not None and checkpoint_path is not None:
-            if total_steps // checkpoint > checkpoint_count:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-                checkpoint_count = total_steps // checkpoint
-
-        if target is not None and np.min(fitnesses) >= target:
-            break
-
-    if telemetry is None:
-        telem.close()
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses

@@ -10,6 +10,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from agilerl_tpu.observability import init_run_telemetry
+from agilerl_tpu.resilience import max_fitness
 from agilerl_tpu.utils.utils import (
     print_hyperparams,
     resume_population_from_checkpoint,
@@ -46,66 +47,102 @@ def train_offline(
     wandb_api_key: Optional[str] = None,
     resume: bool = False,
     telemetry=None,
+    resilience=None,
 ) -> Tuple[List, List[List[float]]]:
     """dataset: dict-like with observations/actions/rewards/next_observations/
     terminals arrays (h5py.File or numpy dict; parity with the reference's
     h5 format in data/cartpole)."""
-    if resume:
+    if resume and resilience is None:
         resume_population_from_checkpoint(pop, checkpoint_path)
     telem = init_run_telemetry(wb=wb, config=INIT_HP, telemetry=telemetry)
     telem.attach_evolution(tournament, mutation)
 
-    if len(memory) == 0:
-        obs = np.asarray(dataset["observations"])
-        transition = {
-            "obs": obs,
-            "action": np.asarray(dataset["actions"]).squeeze(),
-            "reward": np.asarray(dataset["rewards"], np.float32).squeeze(),
-            "next_obs": np.asarray(dataset["next_observations"]),
-            "done": np.asarray(dataset["terminals"], np.float32).squeeze(),
-        }
-        memory.add(transition, batched=True)
-
     pop_fitnesses: List[List[float]] = [[] for _ in pop]
     total_steps = 0
     checkpoint_count = 0
-    start = time.time()
 
-    while np.min([agent.steps[-1] for agent in pop]) < max_steps:
-        for agent in pop:
-            for _ in range(max(evo_steps // max(agent.learn_step, 1), 1)):
-                agent.learn(memory.sample(agent.batch_size))
-                agent.steps[-1] += agent.learn_step
-                total_steps += agent.learn_step
-                telem.step(env_steps=agent.learn_step, agent_index=agent.index)
+    def _counters():
+        return {"total_steps": total_steps, "checkpoint_count": checkpoint_count,
+                "pop_fitnesses": pop_fitnesses}
 
-        fitnesses = [
-            agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
-            for agent in pop
-        ]
-        for i, f in enumerate(fitnesses):
-            pop_fitnesses[i].append(f)
-        telem.record_eval(pop, fitnesses)
-        telem.log_step({"global_step": total_steps,
-                        "eval/mean_fitness": float(np.mean(fitnesses))})
-        if verbose:
-            print(f"--- steps {total_steps} fitness {[f'{f:.1f}' for f in fitnesses]}")
-            print_hyperparams(pop)
+    try:
+        if resilience is not None:
+            resilience.attach(pop=pop, memory=memory, tournament=tournament,
+                              mutation=mutation, telemetry=telem, env=env)
+            if resume:
+                # a restored buffer skips the dataset re-ingest below
+                restored = resilience.resume(_counters())
+                total_steps = int(restored["total_steps"])
+                checkpoint_count = int(restored["checkpoint_count"])
+                pop_fitnesses = [list(f) for f in restored["pop_fitnesses"]]
+        if len(memory) == 0:
+            obs = np.asarray(dataset["observations"])
+            transition = {
+                "obs": obs,
+                "action": np.asarray(dataset["actions"]).squeeze(),
+                "reward": np.asarray(dataset["rewards"], np.float32).squeeze(),
+                "next_obs": np.asarray(dataset["next_observations"]),
+                "done": np.asarray(dataset["terminals"], np.float32).squeeze(),
+            }
+            memory.add(transition, batched=True)
 
-        if tournament is not None and mutation is not None:
-            pop = tournament_selection_and_mutation(
-                pop, tournament, mutation, env_name=env_name, algo=algo,
-                elite_path=elite_path, save_elite=save_elite,
-            )
-        for agent in pop:
-            agent.steps.append(agent.steps[-1])
-        if checkpoint is not None and checkpoint_path is not None:
-            if total_steps // checkpoint > checkpoint_count:
-                save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
-                checkpoint_count = total_steps // checkpoint
-        if target is not None and np.min(fitnesses) >= target:
-            break
+        start = time.time()
 
-    if telemetry is None:
-        telem.close()
+        while np.min([agent.steps[-1] for agent in pop]) < max_steps:
+            for agent in pop:
+                if resilience is not None and resilience.abort_generation:
+                    break
+                for _ in range(max(evo_steps // max(agent.learn_step, 1), 1)):
+                    agent.learn(memory.sample(agent.batch_size))
+                    agent.steps[-1] += agent.learn_step
+                    total_steps += agent.learn_step
+                    telem.step(env_steps=agent.learn_step, agent_index=agent.index)
+                    if resilience is not None and resilience.abort_generation:
+                        break
+
+            if resilience is not None and resilience.abort_generation:
+                resilience.step_boundary(total_steps, _counters(), pop=pop)
+                break
+
+            fitnesses = [
+                agent.test(env, swap_channels=swap_channels, max_steps=eval_steps, loop=eval_loop)
+                for agent in pop
+            ]
+            for i, f in enumerate(fitnesses):
+                pop_fitnesses[i].append(f)
+            telem.record_eval(pop, fitnesses)
+            telem.log_step({"global_step": total_steps,
+                            "eval/mean_fitness": float(np.mean(fitnesses))})
+            if verbose:
+                print(f"--- steps {total_steps} fitness {[f'{f:.1f}' for f in fitnesses]}")
+                print_hyperparams(pop)
+
+            if tournament is not None and mutation is not None:
+                pop = tournament_selection_and_mutation(
+                    pop, tournament, mutation, env_name=env_name, algo=algo,
+                    elite_path=elite_path, save_elite=save_elite,
+                )
+            for agent in pop:
+                agent.steps.append(agent.steps[-1])
+            if resilience is not None:
+                if resilience.step_boundary(
+                    total_steps, _counters(), pop=pop,
+                    fitness=max_fitness(fitnesses),
+                ):
+                    break
+            elif checkpoint is not None and checkpoint_path is not None:
+                if total_steps // checkpoint > checkpoint_count:
+                    save_population_checkpoint(pop, checkpoint_path, overwrite_checkpoints)
+                    checkpoint_count = total_steps // checkpoint
+            if target is not None and np.min(fitnesses) >= target:
+                break
+
+    finally:
+        # a crash escaping the loop must not leak the guard's process-wide
+        # SIGTERM/SIGINT handlers (or an unflushed telemetry sink) into a
+        # driver that catches the exception and keeps running
+        if resilience is not None:
+            resilience.close()
+        if telemetry is None:
+            telem.close()
     return pop, pop_fitnesses

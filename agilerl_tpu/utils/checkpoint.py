@@ -7,33 +7,107 @@ Pickle checkpoints (EvolvableAlgorithm.save_checkpoint) remain the lightweight
 per-agent path; these orbax helpers add:
 - sharded, async-capable saves of arbitrarily large pytrees (LLM tier) where
   every host writes only its param shards (multi-host safe);
-- atomic versioned step directories with retention.
+- atomic versioned step directories (staged under ``step_N.tmp`` and
+  published with the resilience subsystem's fsync + ``os.replace`` commit,
+  so a kill mid-save never leaves a half-written step dir) with optional
+  retention (``keep_last=K`` prunes older step dirs after each save).
+
+orbax-checkpoint is an optional dependency: ``pip install
+agilerl-tpu[checkpoint]``.
 """
 
 from __future__ import annotations
 
+import shutil
 from pathlib import Path
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 import jax
 
+_STEP_PREFIX = "step_"
 
-def save_pytree(path: Union[str, Path], tree: Any, step: Optional[int] = None) -> None:
-    """Save a (possibly sharded) pytree with orbax."""
-    import orbax.checkpoint as ocp
+
+def _require_orbax():
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        raise ImportError(
+            "orbax-checkpoint is required for sharded pytree checkpoints "
+            "(save_pytree/load_pytree) but is not installed. Install it with "
+            "`pip install orbax-checkpoint` or `pip install "
+            "'agilerl-tpu[checkpoint]'`. For CPU-scale whole-run snapshots "
+            "no orbax is needed — use agilerl_tpu.resilience.Resilience, "
+            "which pickles through the same atomic-commit protocol."
+        ) from e
+    return ocp
+
+
+def step_dirs(path: Union[str, Path]) -> List[Path]:
+    """Committed ``step_N`` directories under ``path``, ascending by step
+    (uncommitted ``*.tmp`` staging dirs are invisible)."""
+    path = Path(path)
+    if not path.is_dir():
+        return []
+    out = []
+    for d in path.iterdir():
+        if not d.is_dir() or d.name.endswith(".tmp"):
+            continue
+        if d.name.startswith(_STEP_PREFIX):
+            try:
+                out.append((int(d.name[len(_STEP_PREFIX):]), d))
+            except ValueError:
+                continue
+    return [d for _, d in sorted(out)]
+
+
+def retain_step_dirs(path: Union[str, Path], keep_last: int) -> int:
+    """Prune all but the newest ``keep_last`` committed step dirs. Returns
+    how many were removed."""
+    dirs = step_dirs(path)
+    removed = 0
+    for d in dirs[: -max(int(keep_last), 1)]:
+        shutil.rmtree(d, ignore_errors=True)
+        removed += 1
+    return removed
+
+
+def save_pytree(
+    path: Union[str, Path],
+    tree: Any,
+    step: Optional[int] = None,
+    keep_last: Optional[int] = None,
+) -> None:
+    """Save a (possibly sharded) pytree with orbax.
+
+    With ``step``, the checkpoint is staged under ``step_N.tmp`` and
+    atomically published as ``step_N`` (resilience commit protocol), then
+    older step dirs beyond ``keep_last`` are pruned."""
+    ocp = _require_orbax()
 
     path = Path(path).absolute()
     ckptr = ocp.StandardCheckpointer()
-    target = path if step is None else path / f"step_{step}"
-    ckptr.save(target, tree, force=True)
+    if step is None:
+        ckptr.save(path, tree, force=True)
+        ckptr.wait_until_finished()
+        return
+    from agilerl_tpu.resilience.atomic import commit_dir
+
+    final = path / f"{_STEP_PREFIX}{step}"
+    tmp = path / (final.name + ".tmp")
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    ckptr.save(tmp, tree, force=True)
     ckptr.wait_until_finished()
+    commit_dir(tmp, final)
+    if keep_last is not None:
+        retain_step_dirs(path, keep_last)
 
 
 def load_pytree(path: Union[str, Path], like: Any = None, step: Optional[int] = None) -> Any:
-    import orbax.checkpoint as ocp
+    ocp = _require_orbax()
 
     path = Path(path).absolute()
-    target = path if step is None else path / f"step_{step}"
+    target = path if step is None else path / f"{_STEP_PREFIX}{step}"
     ckptr = ocp.StandardCheckpointer()
     if like is not None:
         return ckptr.restore(target, like)

@@ -115,6 +115,31 @@ class HuggingFaceGym:
     def __len__(self):
         return len(self.train_rows)
 
+    # -- resumable data-stream state --------------------------------------- #
+    # the resilience snapshot's env entry: capture_env_rng prefers an env's
+    # own state_dict over raw PRNG attributes, so a resumed run continues
+    # the exact prompt stream instead of restarting the data epoch
+    def state_dict(self) -> Dict:
+        """Epoch/cursor counters, the epoch-shuffle RNG, and the current
+        shuffled row order it produced (a fresh env would otherwise replay
+        epoch 0's order and diverge from the uninterrupted run)."""
+        return {
+            "rng": self._rng.bit_generator.state,
+            "epoch": self._epoch,
+            "cursor": self._cursor,
+            "num_epochs": self.num_epochs,
+            "train_rows": list(self.train_rows),
+        }
+
+    def load_state_dict(self, state: Dict) -> None:
+        from agilerl_tpu.resilience.snapshot import restore_np_generator
+
+        self._rng = restore_np_generator(state["rng"])
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        self.num_epochs = int(state["num_epochs"])
+        self.train_rows = list(state["train_rows"])
+
 
 class ReasoningGym(HuggingFaceGym):
     """reset() -> tokenized prompt batch; step(completions) -> rewards
@@ -158,6 +183,20 @@ class ReasoningGym(HuggingFaceGym):
     def step_eval(self, completion_ids, completion_mask):
         rewards = self._rewards(completion_ids, completion_mask, 1)
         return None, rewards.reshape(-1)
+
+    def state_dict(self) -> Dict:
+        state = super().state_dict()
+        # the rows step() will score the in-flight completions against
+        state["current_rows"] = self._current
+        return state
+
+    def load_state_dict(self, state: Dict) -> None:
+        super().load_state_dict(state)
+        rows = state.get("current_rows")
+        self._current = rows
+        self._current_prompts = (
+            None if rows is None else self._tokenize_prompts(rows)
+        )
 
     def eval_batches(self):
         """Iterate tokenized prompt batches over the whole test split; each

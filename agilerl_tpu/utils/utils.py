@@ -441,7 +441,12 @@ def forced_action_arrays(
 def gather_across_hosts(value) -> np.ndarray:
     """All-gather a host-local scalar/array across processes, stacked on a
     leading process axis (parity: utils/utils.py:985 gather_tensor — the
-    accelerate gather becomes a process_allgather)."""
+    accelerate gather becomes a process_allgather).
+
+    Deliberately NOT retried: a per-host retry of a collective desyncs the
+    pod (the retrying host re-issues an op its peers already completed and
+    pairs with the wrong collective). Collectives fail fast; the resilience
+    subsystem's snapshot-resume is the recovery path (docs/resilience.md)."""
     arr = np.asarray(value)
     if jax.process_count() == 1:
         return arr[None]
@@ -524,14 +529,45 @@ def resume_population_from_checkpoint(pop: List, checkpoint_path: Optional[str])
     """Restore each member in place from its `{stem}_{index}` checkpoint file
     if one exists (parity: the reference trainers' wandb-resume restore path,
     agilerl/training/train_off_policy.py resume branch). Members without a file
-    (e.g. population grew) keep their fresh initialisation."""
+    (e.g. population grew) keep their fresh initialisation.
+
+    Corrupt/torn files (a kill mid-save predating the atomic
+    ``save_checkpoint``, disk trouble) are skipped with a warn-once instead of
+    crashing mid-restore — that member simply keeps its fresh weights. For
+    crash-consistent whole-run restore use the resilience subsystem
+    (``agilerl_tpu.resilience.Resilience``) instead."""
     if checkpoint_path is None:
         return pop
+    import pickle
+
     for agent in pop:
         p = Path(checkpoint_path)
         f = p.parent / f"{p.stem}_{agent.index}{p.suffix or '.ckpt'}"
-        if f.exists():
+        if not f.exists():
+            continue
+        # torn pickles fail before touching the agent, but an incompatible
+        # checkpoint (another code version) can raise from INSIDE _restore,
+        # which mutates networks, then optimizers, then attrs in sequence —
+        # capture the pre-restore state so a mid-sequence failure rolls
+        # back instead of leaving a silently inconsistent agent
+        before = agent.checkpoint_dict()
+        try:
             agent.load_checkpoint(f)
+        except (pickle.UnpicklingError, EOFError, OSError, AttributeError,
+                KeyError, IndexError, ValueError, ImportError) as e:
+            from agilerl_tpu.observability import warn_once
+
+            try:
+                agent._restore(before)
+                detail = f"agent {agent.index} keeps its current weights"
+            except Exception:
+                detail = (f"agent {agent.index} could not be rolled back "
+                          "and may be inconsistent")
+            warn_once(
+                f"resume:corrupt_checkpoint:{f.name}",
+                f"skipping corrupt/torn checkpoint {f} "
+                f"({type(e).__name__}: {e}) — {detail}",
+            )
     return pop
 
 
@@ -585,6 +621,8 @@ def aggregate_metrics_across_hosts(value: float) -> float:
         return float(value)
     from jax.experimental import multihost_utils
 
+    # not retried — see gather_across_hosts: per-host collective retry
+    # desyncs the pod; snapshot-resume is the recovery path
     arr = multihost_utils.process_allgather(np.asarray([value]))
     return float(np.mean(arr))
 

@@ -14,6 +14,8 @@
 # Usage:
 #   bash run_tests.sh            # full suite, sharded (exit 0 == all green)
 #   bash run_tests.sh fast       # fast tier only: -m "not slow", sharded
+#   bash run_tests.sh faults     # fault-injection suite only (crash
+#                                # consistency, torn writes, kill+resume)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -33,6 +35,12 @@ SHARDS=()
 for arg in "$@"; do
   case "$arg" in
     fast) MARKER=(-m "not slow") ;;
+    faults)
+      # fast path: only the fault-injection suite (resilience crash
+      # consistency + the checkpoint round-trips it protects)
+      MARKER=(-m "fault_injection")
+      SHARDS+=("tests/test_resilience tests/test_utils/test_checkpoint_roundtrip.py")
+      ;;
     *) SHARDS+=("$arg") ;;
   esac
 done
@@ -51,6 +59,7 @@ if [ ${#SHARDS[@]} -eq 0 ]; then
     tests/test_observability
     tests/test_ops
     tests/test_parallel
+    tests/test_resilience
     tests/test_train
     tests/test_utils
     tests/test_vector

@@ -1,0 +1,182 @@
+"""Resilience facade: attach/snapshot/resume round-trip without a training
+loop, cadence accounting, env wrapping, and the fitness helper."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.components import ReplayBuffer
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+from agilerl_tpu.resilience import Resilience, RetryingEnv, RetryPolicy, max_fitness
+
+
+class TinyAgent:
+    """Duck-typed stand-in: checkpoint_dict/_restore is the whole contract."""
+
+    def __init__(self, index, w=0.0):
+        self.index = index
+        self.w = w
+
+    def checkpoint_dict(self):
+        return {"agilerl_tpu_class": "TinyAgent",
+                "state": {"index": self.index, "w": self.w}}
+
+    def _restore(self, ckpt):
+        self.index = ckpt["state"]["index"]
+        self.w = ckpt["state"]["w"]
+
+
+def transition(i):
+    return {"obs": np.full((4,), float(i), np.float32), "action": np.int32(0),
+            "reward": np.float32(i), "next_obs": np.zeros((4,), np.float32),
+            "done": np.float32(0)}
+
+
+def test_snapshot_resume_roundtrip(tmp_path):
+    pop = [TinyAgent(0, w=1.0), TinyAgent(1, w=2.0)]
+    memory = ReplayBuffer(max_size=32, seed=0)
+    for i in range(6):
+        memory.add(transition(i))
+    tournament = TournamentSelection(2, True, 2, eval_loop=1,
+                                     rng=np.random.default_rng(5))
+    mutation = Mutations(no_mutation=1.0, architecture=0, parameters=0,
+                         activation=0, rl_hp=0, rand_seed=5)
+    np.random.seed(99)
+
+    res = Resilience(tmp_path, save_every=None, handle_signals=False)
+    res.attach(pop=pop, memory=memory, tournament=tournament, mutation=mutation)
+    res.snapshot(step=50, counters={"total_steps": 50, "epsilon": 0.7})
+    marker = np.random.random()  # advances the captured global stream
+
+    # clobber everything
+    pop2 = [TinyAgent(0), TinyAgent(1)]
+    memory2 = ReplayBuffer(max_size=32, seed=123)
+    tournament2 = TournamentSelection(2, True, 2, eval_loop=1,
+                                      rng=np.random.default_rng(777))
+    mutation2 = Mutations(no_mutation=1.0, architecture=0, parameters=0,
+                          activation=0, rl_hp=0, rand_seed=777)
+    np.random.seed(31337)
+
+    res2 = Resilience(tmp_path, save_every=None, handle_signals=False)
+    res2.attach(pop=pop2, memory=memory2, tournament=tournament2,
+                mutation=mutation2)
+    counters = res2.resume({"total_steps": 0, "epsilon": 1.0, "extra": "kept"})
+
+    assert counters["total_steps"] == 50
+    assert counters["epsilon"] == 0.7
+    assert counters["extra"] == "kept"  # caller defaults merge under saved
+    assert pop2[0].w == 1.0 and pop2[1].w == 2.0
+    assert len(memory2) == 6
+    # host global RNG stream continues from the snapshot point
+    assert np.random.random() == marker
+    # tournament rng stream restored
+    r_orig = np.random.default_rng(5)
+    assert tournament2.rng.integers(0, 10**9) == r_orig.integers(0, 10**9)
+
+
+def test_reattach_resets_cadence_counter(tmp_path):
+    """A reused Resilience object attached to a fresh run must snapshot at
+    the fresh run's cadence — not stay silent until it passes the previous
+    run's last save step."""
+    res = Resilience(tmp_path / "a", save_every=100, handle_signals=False)
+    res.attach(pop=[TinyAgent(0)])
+    assert res.step_boundary(1000, {}) is False  # save_count -> 10
+    res.close()
+    res.manager = type(res.manager)(tmp_path / "b",
+                                    registry=res.manager._registry)
+    res.attach(pop=[TinyAgent(0)])  # fresh run from step 0
+    assert res.step_boundary(100, {}) is False
+    assert len(res.manager.snapshots()) == 1  # cadence fired at step 100
+
+
+def test_step_boundary_cadence(tmp_path):
+    res = Resilience(tmp_path, save_every=100, handle_signals=False)
+    res.attach(pop=[TinyAgent(0)])
+    assert res.step_boundary(50, {"total_steps": 50}) is False
+    assert len(res.manager.snapshots()) == 0
+    assert res.step_boundary(100, {"total_steps": 100}) is False  # due: saves
+    assert res.step_boundary(150, {"total_steps": 150}) is False  # not due
+    assert res.step_boundary(250, {"total_steps": 250}) is False  # due again
+    assert [s.step for s in res.manager.snapshots()] == [100, 250]
+
+
+def test_step_boundary_preemption_returns_true(tmp_path):
+    res = Resilience(tmp_path, save_every=None, handle_signals=False)
+    res.attach(pop=[TinyAgent(0)])
+    res.guard.request()
+    assert res.step_boundary(70, {"total_steps": 70}) is True
+    snaps = res.manager.snapshots()
+    assert len(snaps) == 1 and snaps[0].kind == "preempt"
+
+
+def test_reused_resilience_object_does_not_replay_preemption(tmp_path):
+    """attach() clears a latched request: ^C a run, then resume with the
+    SAME Resilience object — the fresh run must not exit before step one."""
+    res = Resilience(tmp_path, save_every=None, handle_signals=False)
+    res.attach(pop=[TinyAgent(0)])
+    res.guard.request()
+    assert res.step_boundary(10, {}) is True  # preempt snapshot + exit
+    res.close()
+    res.attach(pop=[TinyAgent(0)])            # same object, next run
+    assert res.preempted is False
+    assert res.step_boundary(20, {}) is False
+
+
+def test_nan_fitness_does_not_poison_best(tmp_path):
+    res = Resilience(tmp_path, save_every=1, handle_signals=False)
+    res.attach(pop=[TinyAgent(0)])
+    res.step_boundary(1, {}, fitness=float("nan"))
+    res.step_boundary(2, {}, fitness=3.0)
+    assert res.manager.best().step == 2
+
+
+def test_wrap_env(tmp_path):
+    class E:
+        pass
+
+    env = E()
+    res = Resilience(tmp_path, handle_signals=False)
+    assert res.wrap_env(env) is env  # no policy -> identity
+    res2 = Resilience(tmp_path, handle_signals=False,
+                      retry=RetryPolicy(max_attempts=2))
+    wrapped = res2.wrap_env(env)
+    assert isinstance(wrapped, RetryingEnv)
+    assert wrapped.env is env
+
+
+def test_close_drops_run_references(tmp_path):
+    """A Resilience object kept around between sequential runs must not pin
+    the previous run's buffers/population after close()."""
+    res = Resilience(tmp_path, handle_signals=False)
+    memory = ReplayBuffer(max_size=8, seed=0)
+    res.attach(pop=[TinyAgent(0)], memory=memory)
+    res.close()
+    assert res._pop is None and res._memory is None and res._env is None
+
+
+def test_max_fitness():
+    assert max_fitness([1.0, 3.0, 2.0]) == 3.0
+    assert max_fitness([float("nan"), 2.0]) == 2.0
+    assert max_fitness([float("nan")]) is None
+    assert max_fitness([]) is None
+    # numpy arrays have ambiguous truth value — must not be truth-tested
+    assert max_fitness(np.asarray([1.0, 2.0])) == 2.0
+    assert max_fitness(np.asarray([])) is None
+
+
+def test_resume_population_size_mismatch_restores_prefix(tmp_path):
+    res = Resilience(tmp_path, handle_signals=False)
+    res.attach(pop=[TinyAgent(0, w=5.0), TinyAgent(1, w=6.0)])
+    res.snapshot(step=1, counters={"total_steps": 9,
+                                   "pop_fitnesses": [[1.0], [2.0]]})
+    bigger = [TinyAgent(0), TinyAgent(1), TinyAgent(2, w=-1.0)]
+    res2 = Resilience(tmp_path, handle_signals=False)
+    res2.attach(pop=bigger)
+    counters = res2.resume({"total_steps": 0,
+                            "pop_fitnesses": [[], [], []]})
+    assert bigger[0].w == 5.0 and bigger[1].w == 6.0
+    assert bigger[2].w == -1.0  # grew member keeps fresh init
+    # per-agent counters follow the same prefix contract: a wholesale
+    # replace would hand the loop a 2-long pop_fitnesses for 3 agents and
+    # crash its first eval round
+    assert counters["total_steps"] == 9
+    assert counters["pop_fitnesses"] == [[1.0], [2.0], []]

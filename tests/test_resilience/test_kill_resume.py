@@ -1,0 +1,236 @@
+"""Kill-and-resume determinism (the PR's acceptance criterion): a seeded
+pop=2 DQN CPU run snapshotted mid-run, killed via the FaultInjector, and
+resumed produces a fitness stream identical to the uninterrupted run —
+replay buffer, RNG streams, counters, evolution RNG and lineage all
+restored."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.components import ReplayBuffer
+from agilerl_tpu.envs import CartPole, JaxVecEnv
+from agilerl_tpu.hpo import Mutations, TournamentSelection
+from agilerl_tpu.resilience import FaultInjector, InjectedCrash, Resilience
+from agilerl_tpu.training.train_off_policy import train_off_policy
+from agilerl_tpu.utils.utils import create_population
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+MAX_STEPS = 400
+EVO_STEPS = 100
+SAVE_EVERY = 200  # total_steps grows 200/generation (pop=2) -> snapshot every gen
+
+
+def make_run():
+    """A fully seeded run: same call -> same env, population, buffer, HPO.
+
+    The host GLOBAL RNGs are seeded too: tournament cloning rebuilds
+    networks whose init draws np.random when no key is given, so two runs
+    only match if they start from the same global stream (mid-run the
+    resilience snapshot captures and restores exactly that stream)."""
+    import random
+
+    np.random.seed(1234)
+    random.seed(1234)
+    env = JaxVecEnv(CartPole(), num_envs=4, seed=0)
+    pop = create_population(
+        "DQN", env.single_observation_space, env.single_action_space,
+        population_size=2, seed=0, net_config=NET,
+        INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3, "LEARN_STEP": 8},
+    )
+    memory = ReplayBuffer(max_size=1024, seed=0)
+    tournament = TournamentSelection(2, True, 2, eval_loop=1,
+                                     rng=np.random.default_rng(0))
+    # architecture/parameter mutations off: whole-run restore loads params
+    # into same-shaped nets; RL-HP mutations exercise the evolution RNG
+    mutation = Mutations(no_mutation=0.5, architecture=0.0, parameters=0.0,
+                         activation=0.0, rl_hp=0.5, rand_seed=0)
+    return env, pop, memory, tournament, mutation
+
+
+def run(resilience, resume=False):
+    env, pop, memory, tournament, mutation = make_run()
+    return train_off_policy(
+        env, "CartPole-v1", "DQN", pop, memory,
+        max_steps=MAX_STEPS, evo_steps=EVO_STEPS, eval_steps=20, eval_loop=1,
+        tournament=tournament, mutation=mutation, verbose=False,
+        resilience=resilience, resume=resume,
+    )
+
+
+@pytest.mark.fault_injection
+def test_kill_and_resume_is_the_same_run(tmp_path):
+    # --- reference: uninterrupted run (snapshotting at the same cadence) ---
+    res_a = Resilience(tmp_path / "a", save_every=SAVE_EVERY,
+                       handle_signals=False)
+    _, fit_a = run(res_a)
+    assert all(len(f) >= 2 for f in fit_a)
+
+    # --- victim: killed mid-commit of the SECOND snapshot ------------------
+    res_b = Resilience(tmp_path / "b", save_every=SAVE_EVERY,
+                       handle_signals=False)
+    with FaultInjector(kill_at_op=1, match=("commit",)):
+        with pytest.raises(InjectedCrash):
+            run(res_b)
+    # the torn snapshot is invisible; only the first commit survives
+    mgr_b = Resilience(tmp_path / "b", save_every=SAVE_EVERY,
+                       handle_signals=False).manager
+    assert len(mgr_b.snapshots()) == 1
+
+    # --- resume: fresh process state, restore, run to completion -----------
+    res_b2 = Resilience(tmp_path / "b", save_every=SAVE_EVERY,
+                        handle_signals=False)
+    _, fit_b = run(res_b2, resume=True)
+
+    # the resumed run's metrics/fitness stream is IDENTICAL to the
+    # uninterrupted run's — buffer, RNG, counters and lineage all restored
+    assert len(fit_a) == len(fit_b)
+    for fa, fb in zip(fit_a, fit_b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+@pytest.mark.fault_injection
+def test_resume_with_no_snapshot_starts_fresh(tmp_path):
+    """resume=True against an empty snapshot dir is a clean cold start, and
+    matches a plain run bit-for-bit (the counters merge is a no-op)."""
+    res_plain = Resilience(tmp_path / "p", save_every=SAVE_EVERY,
+                           handle_signals=False)
+    _, fit_plain = run(res_plain)
+    res_fresh = Resilience(tmp_path / "f", save_every=SAVE_EVERY,
+                           handle_signals=False)
+    _, fit_fresh = run(res_fresh, resume=True)
+    for fa, fb in zip(fit_plain, fit_fresh):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+class _PreemptAfter:
+    """Env proxy that flips the guard after N steps — a deterministic
+    SIGTERM stand-in."""
+
+    def __init__(self, env, guard, after_steps):
+        self.env = env
+        self._guard = guard
+        self._after = after_steps
+        self._n = 0
+
+    def step(self, *a, **kw):
+        self._n += 1
+        if self._n == self._after:
+            self._guard.request()
+        return self.env.step(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+
+def test_preempt_finish_generation_resumes_identically(tmp_path):
+    """on_preempt="finish_generation": the SIGTERM stand-in lands
+    mid-generation, but the final snapshot is deferred to the generation
+    boundary — so the resumed run continues the EXACT fitness stream the
+    uninterrupted reference produces."""
+    res_ref = Resilience(tmp_path / "ref", save_every=None,
+                         handle_signals=False)
+    _, fit_ref = run(res_ref)
+
+    res = Resilience(tmp_path / "v", save_every=None, handle_signals=False,
+                     on_preempt="finish_generation")
+    env, pop, memory, tournament, mutation = make_run()
+    wrapped = _PreemptAfter(env, res.guard, after_steps=30)
+    train_off_policy(
+        wrapped, "CartPole-v1", "DQN", pop, memory,
+        max_steps=MAX_STEPS, evo_steps=EVO_STEPS, eval_steps=20, eval_loop=1,
+        tournament=tournament, mutation=mutation, verbose=False,
+        resilience=res,
+    )
+    snaps = res.manager.snapshots()
+    assert len(snaps) == 1 and snaps[-1].kind == "preempt"
+
+    res2 = Resilience(tmp_path / "v", save_every=None, handle_signals=False)
+    _, fit2 = run(res2, resume=True)
+    assert len(fit_ref) == len(fit2)
+    for fa, fb in zip(fit_ref, fit2):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+def test_llm_reasoning_preempt_resume_identical(tmp_path):
+    """The LLM reasoning loop carries hidden cross-step state the other
+    loops don't: the prompt batch (``prompts = next_prompts``) and the gym's
+    data stream (cursor/epoch/shuffle RNG/current rows). The snapshot must
+    carry both, or a resumed run re-resets the env, draws a fresh batch,
+    and diverges from the uninterrupted stream."""
+    import jax.numpy as jnp
+
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.training.train_llm import finetune_llm_reasoning
+    from agilerl_tpu.utils.llm_utils import CharTokenizer, ReasoningGym
+
+    tok = CharTokenizer()
+    cfg = M.GPTConfig(vocab_size=tok.vocab_size, n_layer=1, n_head=2,
+                      d_model=32, max_seq_len=48, dtype=jnp.float32)
+    rows = [{"question": f"{a}+1=", "answer": str(a + 1)} for a in range(8)]
+
+    def make():
+        import random
+
+        np.random.seed(7)
+        random.seed(7)
+        env = ReasoningGym(
+            rows[:6], rows[6:], tok,
+            reward_fn=lambda c, a, p: float(c.startswith(str(a))),
+            data_batch_size=2, seed=11,
+        )
+        pop = [GRPO(config=cfg, pad_token_id=tok.pad_token_id,
+                    eos_token_id=tok.eos_token_id, group_size=2, batch_size=4,
+                    max_output_tokens=2, index=0, seed=0)]
+        return env, pop
+
+    def go(env, pop, res, resume=False):
+        return finetune_llm_reasoning(
+            pop, env, max_steps=4, evaluation_interval=1, verbose=False,
+            resilience=res, resume=resume,
+        )
+
+    env, pop = make()
+    res_ref = Resilience(tmp_path / "ref", save_every=None,
+                         handle_signals=False)
+    _, fit_ref = go(env, pop, res_ref)
+    assert len(fit_ref[0]) == 4
+
+    env, pop = make()
+    res_v = Resilience(tmp_path / "v", save_every=None, handle_signals=False)
+    wrapped = _PreemptAfter(env, res_v.guard, after_steps=2)
+    go(wrapped, pop, res_v)
+    snaps = res_v.manager.snapshots()
+    assert len(snaps) == 1 and snaps[-1].kind == "preempt"
+
+    env, pop = make()
+    res_v2 = Resilience(tmp_path / "v", save_every=None, handle_signals=False)
+    _, fit2 = go(env, pop, res_v2, resume=True)
+    np.testing.assert_array_equal(np.asarray(fit_ref[0]), np.asarray(fit2[0]))
+
+
+def test_on_preempt_validates():
+    with pytest.raises(ValueError):
+        Resilience("unused", on_preempt="later")
+
+
+def test_preemption_takes_final_snapshot_and_resumes(tmp_path):
+    res = Resilience(tmp_path, save_every=None, handle_signals=False)
+    env, pop, memory, tournament, mutation = make_run()
+    wrapped = _PreemptAfter(env, res.guard, after_steps=30)
+    _, fit = train_off_policy(
+        wrapped, "CartPole-v1", "DQN", pop, memory,
+        max_steps=MAX_STEPS, evo_steps=EVO_STEPS, eval_steps=20, eval_loop=1,
+        tournament=tournament, mutation=mutation, verbose=False,
+        resilience=res,
+    )
+    snaps = res.manager.snapshots()
+    assert len(snaps) == 1
+    assert snaps[-1].kind == "preempt"
+    assert res.registry.counter("resilience/preemptions_total").value == 1
+
+    # resumed run picks the counters back up and completes
+    res2 = Resilience(tmp_path, save_every=None, handle_signals=False)
+    pop2, fit2 = run(res2, resume=True)
+    assert len(pop2) == 2
+    assert all(len(f) >= 2 for f in fit2)

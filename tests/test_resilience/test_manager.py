@@ -1,0 +1,176 @@
+"""CheckpointManager: atomic versioned snapshots, retention, and the
+torn-write acceptance criterion — FaultInjector kill/truncation schedules
+never leave the manifest pointing at an unreadable snapshot; restore always
+falls back to the latest complete one."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.observability import MetricsRegistry
+from agilerl_tpu.resilience import (
+    CheckpointManager,
+    FaultInjector,
+    InjectedCrash,
+)
+
+
+def entries(i):
+    return {
+        "population": [{"w": np.full((4, 4), float(i))}],
+        "counters": {"total_steps": i * 100},
+    }
+
+
+@pytest.fixture
+def registry():
+    return MetricsRegistry()
+
+
+def test_save_load_roundtrip(tmp_path, registry):
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    mgr.save(entries(1), step=100, fitness=1.0)
+    mgr.save(entries(2), step=200, fitness=2.0)
+    info, loaded = mgr.load()
+    assert info.step == 200
+    assert loaded["counters"]["total_steps"] == 200
+    np.testing.assert_array_equal(loaded["population"][0]["w"], np.full((4, 4), 2.0))
+    assert registry.counter("resilience/snapshots_total").value == 2
+
+
+def test_retention_keeps_last_k_plus_best(tmp_path, registry):
+    mgr = CheckpointManager(tmp_path, keep_last=2, keep_best=True, registry=registry)
+    mgr.save(entries(1), step=100, fitness=9.0)  # the best
+    for i in range(2, 6):
+        mgr.save(entries(i), step=i * 100, fitness=float(i))
+    steps = [s.step for s in mgr.snapshots()]
+    # last two (400, 500) plus the best-fitness snapshot (100)
+    assert steps == [100, 400, 500]
+    assert mgr.best().step == 100
+
+
+def test_same_step_resaves_order_numerically(tmp_path, registry):
+    """>=11 snapshots at one step: restore and retention must order the
+    ``step_N_<seq>`` suffixes numerically — a lexicographic name sort ranks
+    ``_9`` above ``_10``, resumes from a stale snapshot, and retains the
+    wrong survivors."""
+    mgr = CheckpointManager(tmp_path, keep_last=3, keep_best=False,
+                            registry=registry)
+    for i in range(12):
+        mgr.save(entries(i), step=100)
+    _, loaded = mgr.load()
+    assert loaded["counters"]["total_steps"] == 1100  # the 12th save
+    # retention kept the three NEWEST resaves, newest last
+    kept = [mgr.load(s)[1]["counters"]["total_steps"] for s in mgr.snapshots()]
+    assert kept == [900, 1000, 1100]
+
+
+def test_retention_without_best(tmp_path, registry):
+    mgr = CheckpointManager(tmp_path, keep_last=1, keep_best=False, registry=registry)
+    for i in range(1, 4):
+        mgr.save(entries(i), step=i * 100, fitness=float(10 - i))
+    assert [s.step for s in mgr.snapshots()] == [300]
+
+
+@pytest.mark.fault_injection
+def test_kill_between_entry_writes_falls_back(tmp_path, registry):
+    """Kill after some entries landed but before the manifest: the torn
+    snapshot is invisible (tmp dir, no manifest) and restore lands on the
+    previous complete snapshot."""
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    mgr.save(entries(1), step=100)
+    # entries(2) writes population, counters, then the manifest (3 "wrote"
+    # ops); kill at op 1: one entry landed, the manifest never did
+    with FaultInjector(kill_at_op=1, match=("wrote",)):
+        with pytest.raises(InjectedCrash):
+            mgr.save(entries(2), step=200)
+    # a fresh manager (new process after the kill) sweeps the staging dir
+    mgr2 = CheckpointManager(tmp_path, registry=registry)
+    assert [s.step for s in mgr2.snapshots()] == [100]
+    info, loaded = mgr2.load()
+    assert info.step == 100
+    assert loaded["counters"]["total_steps"] == 100
+
+
+@pytest.mark.fault_injection
+def test_kill_before_commit_falls_back(tmp_path, registry):
+    """Every file (manifest included) written, killed right before the
+    directory publish — the canonical torn-snapshot point."""
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    mgr.save(entries(1), step=100)
+    with FaultInjector(kill_at_op=0, match=("commit",)):
+        with pytest.raises(InjectedCrash):
+            mgr.save(entries(2), step=200)
+    mgr2 = CheckpointManager(tmp_path, registry=registry)
+    info, _ = mgr2.load()
+    assert info.step == 100
+
+
+@pytest.mark.fault_injection
+def test_truncated_entry_detected_and_skipped(tmp_path, registry):
+    """A snapshot whose entry bytes rot AFTER a successful commit still
+    validates against the manifest hashes; restore skips it with a warn-once
+    and falls back."""
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    mgr.save(entries(1), step=100)
+    mgr.save(entries(2), step=200)
+    newest = mgr.snapshots()[-1]
+    victim = newest.path / "population.pkl"
+    victim.write_bytes(victim.read_bytes()[:10])
+    assert not mgr.validate(newest)
+    info, loaded = mgr.load()
+    assert info.step == 100
+    assert loaded["counters"]["total_steps"] == 100
+    assert registry.counter("resilience/restore_fallbacks_total").value >= 1
+
+
+@pytest.mark.fault_injection
+def test_truncation_during_save_detected(tmp_path, registry):
+    """FaultInjector truncates an entry mid-save (silent disk corruption):
+    the commit 'succeeds' but validation fails and restore falls back."""
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    mgr.save(entries(1), step=100)
+    with FaultInjector(truncate_at_ops=[0], match=("wrote",)):
+        mgr.save(entries(2), step=200)
+    info, _ = mgr.load()
+    assert info.step == 100
+
+
+def test_no_snapshot_returns_none(tmp_path, registry):
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    assert mgr.load() is None
+    assert mgr.latest() is None
+    assert mgr.best() is None
+
+
+def test_async_pytree_entry_rides_the_commit(tmp_path, registry):
+    """AsyncPytree entries go through the orbax helpers (sharded LLM-tier
+    path) inside the same atomic snapshot commit."""
+    pytest.importorskip("orbax.checkpoint")
+    from agilerl_tpu.resilience import AsyncPytree
+
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    tree = {"w": np.arange(16.0, dtype=np.float32).reshape(4, 4)}
+    mgr.save({"params": AsyncPytree(tree), "counters": {"total_steps": 5}},
+             step=100)
+    info, loaded = mgr.load()
+    assert mgr.validate(info)
+    assert loaded["counters"]["total_steps"] == 5
+    np.testing.assert_array_equal(np.asarray(loaded["params"]["w"]), tree["w"])
+
+
+def test_resave_same_step_never_clobbers(tmp_path, registry):
+    """A same-step resave commits under a suffixed sibling name — the old
+    committed snapshot is never deleted mid-publish; restore prefers the
+    newer one."""
+    mgr = CheckpointManager(tmp_path, registry=registry)
+    mgr.save(entries(1), step=100)
+    mgr.save(entries(7), step=100)
+    snaps = mgr.snapshots()
+    assert [s.step for s in snaps] == [100, 100]
+    _, loaded = mgr.load()
+    assert loaded["counters"]["total_steps"] == 700
+    # tear the newer one: restore falls back to the ORIGINAL same-step save
+    victim = snaps[-1].path / "counters.pkl"
+    victim.write_bytes(victim.read_bytes()[:4])
+    _, loaded = mgr.load()
+    assert loaded["counters"]["total_steps"] == 100

@@ -1,0 +1,182 @@
+"""Save→load round-trips across the algorithm classes: params, HP config,
+``steps`` and ``fitness`` all survive, for single agents and whole
+populations — plus the utils/checkpoint step-dir retention helpers."""
+
+import jax
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+from agilerl_tpu.algorithms import CQN, DDPG, DQN, PPO, TD3, RainbowDQN
+from agilerl_tpu.utils.utils import (
+    create_population,
+    load_population_checkpoint,
+    resume_population_from_checkpoint,
+    save_population_checkpoint,
+)
+
+# the whole module rides the fault-injection tier (`run_tests.sh faults`):
+# these round-trips are the surface the crash-consistency machinery protects
+pytestmark = pytest.mark.fault_injection
+
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+OBS = spaces.Box(-1, 1, (6,), np.float32)
+DISC = spaces.Discrete(3)
+BOX = spaces.Box(-1.0, 1.0, (2,), np.float32)
+
+ALGOS = {
+    "DQN": lambda: DQN(OBS, DISC, net_config=NET, seed=0),
+    "RainbowDQN": lambda: RainbowDQN(OBS, DISC, net_config=NET, v_min=-2,
+                                     v_max=2, num_atoms=13, seed=0),
+    "CQN": lambda: CQN(OBS, DISC, net_config=NET, seed=0),
+    "DDPG": lambda: DDPG(OBS, BOX, net_config=NET, seed=0),
+    "TD3": lambda: TD3(OBS, BOX, net_config=NET, seed=0),
+    "PPO": lambda: PPO(OBS, DISC, net_config=NET, seed=0, num_envs=2,
+                       learn_step=8, batch_size=16),
+}
+
+
+def assert_params_equal(a, b):
+    for name, net in a.evolvable_attributes().items():
+        other = getattr(b, name)
+        if isinstance(net, dict):
+            items = [(net[k], other[k]) for k in net]
+        else:
+            items = [(net, other)]
+        for na, nb in items:
+            la = jax.tree_util.tree_leaves(na.params)
+            lb = jax.tree_util.tree_leaves(nb.params)
+            assert len(la) == len(lb)
+            for x, y in zip(la, lb):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("algo", list(ALGOS))
+def test_save_load_roundtrip(algo, tmp_path):
+    agent = ALGOS[algo]()
+    # distinctive training state that must survive the round-trip
+    agent.steps = [0, 137]
+    agent.fitness = [1.5, 2.5]
+    agent.scores = [3.0]
+
+    path = tmp_path / f"{algo}.ckpt"
+    agent.save_checkpoint(path)
+    loaded = type(agent).load(path)
+
+    assert_params_equal(agent, loaded)
+    assert loaded.steps == [0, 137]
+    assert loaded.fitness == [1.5, 2.5]
+    assert loaded.scores == [3.0]
+    # every registered hyperparameter survives
+    for hp in agent.hp_config.names():
+        assert getattr(loaded, hp) == getattr(agent, hp), hp
+    # in-place restore into a fresh agent matches too
+    fresh = ALGOS[algo]()
+    fresh.load_checkpoint(path)
+    assert_params_equal(agent, fresh)
+    assert fresh.steps == [0, 137]
+
+
+def test_population_checkpoint_roundtrip(tmp_path):
+    pop = create_population(
+        "DQN", OBS, DISC, population_size=3, seed=0, net_config=NET,
+        INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3},
+    )
+    for i, agent in enumerate(pop):
+        agent.steps = [0, 100 + i]
+        agent.fitness = [float(i)]
+    ckpt = tmp_path / "pop.ckpt"
+    save_population_checkpoint(pop, str(ckpt), overwrite_checkpoints=True)
+
+    loaded = load_population_checkpoint("DQN", str(ckpt), [0, 1, 2])
+    assert len(loaded) == 3
+    for i, (orig, back) in enumerate(zip(pop, loaded)):
+        assert_params_equal(orig, back)
+        assert back.steps == [0, 100 + i]
+        assert back.fitness == [float(i)]
+
+
+def test_resume_skips_corrupt_member(tmp_path):
+    """A torn per-agent checkpoint (pre-atomic save, disk trouble) is
+    skipped with a warn-once — the member keeps its weights, the rest of the
+    population restores."""
+    pop = create_population(
+        "DQN", OBS, DISC, population_size=2, seed=0, net_config=NET,
+        INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3},
+    )
+    pop[0].steps = [0, 42]
+    pop[1].steps = [0, 43]
+    ckpt = tmp_path / "pop.ckpt"
+    save_population_checkpoint(pop, str(ckpt), overwrite_checkpoints=True)
+    # tear agent 1's file mid-pickle
+    victim = tmp_path / "pop_1.ckpt"
+    victim.write_bytes(victim.read_bytes()[: victim.stat().st_size // 2])
+
+    fresh = create_population(
+        "DQN", OBS, DISC, population_size=2, seed=7, net_config=NET,
+        INIT_HP={"BATCH_SIZE": 16, "LR": 1e-3},
+    )
+    out = resume_population_from_checkpoint(fresh, str(ckpt))
+    assert out[0].steps == [0, 42]       # restored
+    assert out[1].steps != [0, 43]       # kept its fresh init, no crash
+
+
+def test_atomic_save_overwrites_cleanly(tmp_path):
+    agent = ALGOS["DQN"]()
+    path = tmp_path / "a.ckpt"
+    agent.save_checkpoint(path)
+    first = path.read_bytes()
+    agent.steps = [0, 999]
+    agent.save_checkpoint(path)
+    assert path.read_bytes() != first
+    # no staging residue next to the checkpoint
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["a.ckpt"]
+
+
+# --------------------------------------------------------------------------- #
+# utils/checkpoint.py step-dir retention (orbax-independent helpers)
+# --------------------------------------------------------------------------- #
+
+
+def test_step_dir_retention(tmp_path):
+    from agilerl_tpu.utils.checkpoint import retain_step_dirs, step_dirs
+
+    for s in (100, 200, 300, 400):
+        (tmp_path / f"step_{s}").mkdir()
+    (tmp_path / "step_500.tmp").mkdir()       # crashed save: invisible
+    (tmp_path / "unrelated").mkdir()
+    assert [d.name for d in step_dirs(tmp_path)] == [
+        "step_100", "step_200", "step_300", "step_400"
+    ]
+    assert retain_step_dirs(tmp_path, keep_last=2) == 2
+    assert [d.name for d in step_dirs(tmp_path)] == ["step_300", "step_400"]
+    assert (tmp_path / "unrelated").exists()
+
+
+def test_save_pytree_versioned_atomic_with_retention(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")  # noqa: F841
+    from agilerl_tpu.utils.checkpoint import load_pytree, save_pytree, step_dirs
+
+    tree = {"w": np.arange(8.0, dtype=np.float32)}
+    for s in (1, 2, 3):
+        save_pytree(tmp_path, {"w": tree["w"] * s}, step=s, keep_last=2)
+    assert [d.name for d in step_dirs(tmp_path)] == ["step_2", "step_3"]
+    back = load_pytree(tmp_path, like=tree, step=3)
+    np.testing.assert_array_equal(np.asarray(back["w"]), tree["w"] * 3)
+
+
+def test_orbax_import_error_is_actionable(monkeypatch):
+    import builtins
+
+    from agilerl_tpu.utils import checkpoint as ckpt_mod
+
+    real_import = builtins.__import__
+
+    def no_orbax(name, *args, **kwargs):
+        if name.startswith("orbax"):
+            raise ImportError("No module named 'orbax'")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", no_orbax)
+    with pytest.raises(ImportError, match="agilerl-tpu\\[checkpoint\\]"):
+        ckpt_mod.save_pytree("/tmp/nope", {"w": np.zeros(2)})
