@@ -143,6 +143,7 @@ class GRPO(EvolvableAlgorithm):
         lora_scale: float = 2.0,
         sequence_parallel_axis: Optional[str] = None,
         bucketed_decode: bool = True,
+        continuous_decode: bool = False,
         **kwargs,
     ):
         super().__init__(index=index, hp_config=hp_config or default_hp_config(), **kwargs)
@@ -171,11 +172,31 @@ class GRPO(EvolvableAlgorithm):
         # ragged generation with a bounded compile set (llm/serving.py — the
         # vLLM continuous-batching role); kill switch for exact-RNG parity
         # with the dense path
-        self.bucketed_decode = bool(bucketed_decode) and os.environ.get(
+        # AGILERL_TPU_DISABLE_BUCKETED_DECODE is the serving-tier kill
+        # switch (exact-RNG parity with the dense path): it disables BOTH
+        # serving routes. The two flags are otherwise independent —
+        # bucketed_decode=False with continuous_decode=True is a valid
+        # continuous-only configuration.
+        serving_killed = os.environ.get(
             "AGILERL_TPU_DISABLE_BUCKETED_DECODE", ""
-        ).strip().lower() not in ("1", "true", "yes")
+        ).strip().lower() in ("1", "true", "yes")
+        self.bucketed_decode = bool(bucketed_decode) and not serving_killed
+        # OPT-IN: rollouts through the continuous/paged serving tier
+        # (llm/serving.ContinuousGenerator). Wins when prompts within a
+        # learn batch are ragged in OUTPUT length (slots recycle per chunk
+        # instead of the whole batch draining together) and group_size
+        # repeats hit the prefix cache (one prefill per unique prompt).
+        # Env opt-in AGILERL_TPU_CONTINUOUS_DECODE=1 mirrors the kill-switch
+        # convention in the other direction.
+        self.continuous_decode = (
+            bool(continuous_decode) or os.environ.get(
+                "AGILERL_TPU_CONTINUOUS_DECODE", ""
+            ).strip().lower() in ("1", "true", "yes")
+        ) and not serving_killed
         self._bucketed_gen = None
         self._bucketed_gen_knobs = None
+        self._continuous_gen = None
+        self._continuous_gen_knobs = None
         self.last_generation_info = None
 
         if base_params is None:
@@ -226,6 +247,7 @@ class GRPO(EvolvableAlgorithm):
             "lora_scale": self.lora_scale,
             "sequence_parallel_axis": self.sequence_parallel_axis,
             "bucketed_decode": self.bucketed_decode,
+            "continuous_decode": self.continuous_decode,
         }
 
     def _on_clone(self, parent) -> None:
@@ -241,25 +263,41 @@ class GRPO(EvolvableAlgorithm):
             self._reference_epoch = epoch
 
     # ------------------------------------------------------------------ #
+    def _serving_knobs(self):
+        """The ONE sampling-recipe tuple both serving generators are built
+        from — a knob added here reaches the bucketed and continuous paths
+        together (they take identical constructor kwargs)."""
+        return dict(
+            max_new_tokens=self.max_output_tokens,
+            pad_id=self.pad_token_id, eos_id=self.eos_token_id,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p, min_new_tokens=self.min_output_tokens,
+            lora_scale=self.lora_scale,
+        )
+
     def _get_bucketed_generator(self):
         """Lazily build (and rebuild on knob change) the bounded-compile
         ragged generator (llm/serving.py)."""
         from agilerl_tpu.llm.serving import BucketedGenerator
 
-        knobs = (self.max_output_tokens, self.temperature, self.top_k,
-                 self.top_p, self.min_output_tokens, self.eos_token_id,
-                 self.pad_token_id, self.lora_scale)
+        knobs = self._serving_knobs()
         if self._bucketed_gen is None or self._bucketed_gen_knobs != knobs:
-            self._bucketed_gen = BucketedGenerator(
-                self.model_config,
-                max_new_tokens=self.max_output_tokens,
-                pad_id=self.pad_token_id, eos_id=self.eos_token_id,
-                temperature=self.temperature, top_k=self.top_k,
-                top_p=self.top_p, min_new_tokens=self.min_output_tokens,
-                lora_scale=self.lora_scale,
-            )
+            self._bucketed_gen = BucketedGenerator(self.model_config, **knobs)
             self._bucketed_gen_knobs = knobs
         return self._bucketed_gen
+
+    def _get_continuous_generator(self):
+        """Lazily build (and rebuild on knob change) the continuous/paged
+        serving-tier generator (llm/serving.ContinuousGenerator). GRPO
+        rollouts are the no-shed path: every row must come back."""
+        from agilerl_tpu.llm.serving import ContinuousGenerator
+
+        knobs = self._serving_knobs()
+        if self._continuous_gen is None or self._continuous_gen_knobs != knobs:
+            self._continuous_gen = ContinuousGenerator(
+                self.model_config, **knobs)
+            self._continuous_gen_knobs = knobs
+        return self._continuous_gen
 
     def get_action(self, prompts: Dict[str, np.ndarray], training: bool = True):
         """Generate group_size completions per prompt
@@ -271,7 +309,12 @@ class GRPO(EvolvableAlgorithm):
         through llm/serving.BucketedGenerator: compile count is bounded by
         the bucket grid instead of one program per (B, P), and decode stops
         within one chunk of every row hitting EOS (the vLLM continuous-
-        batching role). Telemetry lands in ``last_generation_info``."""
+        batching role). With ``continuous_decode`` (opt-in, or env
+        AGILERL_TPU_CONTINUOUS_DECODE=1), rollouts route through the paged
+        continuous scheduler instead: short completions free their slot for
+        queued rows per chunk, and group_size repeats of a prompt prefill
+        once via the prefix cache (docs/serving.md). Telemetry lands in
+        ``last_generation_info``."""
         ids_np = np.asarray(prompts["input_ids"])
         mask_np = np.asarray(prompts["attention_mask"])
         g = self.group_size if training else 1
@@ -281,7 +324,22 @@ class GRPO(EvolvableAlgorithm):
             N = self.max_output_tokens
             self.last_generation_info = None
             return np.zeros((0, N), np.int32), np.zeros((0, N), np.int32)
-        if self.bucketed_decode:
+        if self.continuous_decode:
+            gen = self._get_continuous_generator()
+            row_lens = mask_np.sum(axis=1)
+            longest = int(row_lens.max()) if mask_np.size else 0
+            # an all-pad row has no prompt to admit — dense path handles it
+            if int(row_lens.min() if mask_np.size else 0) > 0 and \
+                    gen.fits(ids_np.shape[0], longest):
+                seqs = [row[m.astype(bool)]
+                        for row, m in zip(ids_np, mask_np)]
+                comp, cmask, self.last_generation_info = gen.generate(
+                    seqs, self.next_key(), self.base_params,
+                    lora=self.actor.params, greedy=not training,
+                )
+                return comp, cmask
+            # prompt too long for the bucket grid: dense path below
+        elif self.bucketed_decode:
             gen = self._get_bucketed_generator()
             longest = int(mask_np.sum(axis=1).max()) if mask_np.size else 0
             if gen.fits(ids_np.shape[0], longest):

@@ -35,10 +35,10 @@ def left_pad(
     return toks, mask
 
 
-def _sample_token(logits, key, temperature, top_k, top_p=None):
-    if temperature == 0.0:
-        # greedy: filters can't change the argmax
-        return jnp.argmax(logits, axis=-1)
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Temperature + top-k + nucleus filtering — ONE home shared by the
+    batch-key sampler below and the per-row-key sampler the continuous
+    decode path uses, so the two recipes cannot drift."""
     # temperature applies BEFORE the nucleus filter (reference order,
     # sampling_utils.py:107 process_logits): top_p is order-sensitive —
     # a hotter distribution admits more tokens into the nucleus
@@ -60,17 +60,38 @@ def _sample_token(logits, key, temperature, top_k, top_p=None):
             jnp.arange(logits.shape[0])[:, None], sort_idx
         ].set(drop_sorted)
         logits = jnp.where(drop, -1e9, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
+
+
+def _sample_token(logits, key, temperature, top_k, top_p=None):
+    if temperature == 0.0:
+        # greedy: filters can't change the argmax
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        key, _filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def _sample_token_per_row(logits, keys, temperature, top_k, top_p=None):
+    """Per-row-key sampling for continuous batching: every slot carries its
+    own RNG stream, so the admission order and slot placement of OTHER
+    requests cannot change a request's samples."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    filtered = _filter_logits(logits, temperature, top_k, top_p)
+    return jax.vmap(lambda k, l: jax.random.categorical(k, l))(keys, filtered)
 
 
 def _suppress_eos(logits, step, eos_id, min_new_tokens):
     """EOS logit floor for the first min_new_tokens sampled tokens
-    (parity: vllm/HF min_output_tokens)."""
+    (parity: vllm/HF min_output_tokens). step: [] (batch-aligned decode)
+    or [B] (per-slot step indices in the continuous path)."""
     if eos_id is None or not min_new_tokens:
         return logits
+    lt = jnp.asarray(step) < min_new_tokens
+    if lt.ndim:
+        lt = lt[:, None]
     return jnp.where(
-        (step < min_new_tokens)
-        & (jnp.arange(logits.shape[-1]) == eos_id)[None, :],
+        lt & (jnp.arange(logits.shape[-1]) == eos_id)[None, :],
         -1e9, logits,
     )
 
@@ -184,3 +205,69 @@ def generate(
     tokens = jnp.concatenate([tok0[None], tokens], axis=0)
     masks = jnp.concatenate([mask0[None], masks], axis=0)
     return tokens.T, masks.T.astype(jnp.int32)  # [B, N]
+
+
+# --------------------------------------------------------------------------- #
+# Continuous (in-flight) batching decode step over a paged slot pool — the
+# iteration-level-scheduling role of Orca (Yu et al., OSDI 2022) under XLA's
+# compile-once model. The host scheduler (llm/serving.ContinuousGenerator)
+# admits/releases slots BETWEEN decode chunks; this step is the per-token
+# body, the paged twin of decode_step above: same sampling order, same
+# done/emit discipline, but per-slot cache depths, RoPE positions, step
+# indices, and RNG streams.
+# --------------------------------------------------------------------------- #
+
+
+def paged_decode_step(config, params, carry, *, lora, lora_scale, temperature,
+                      top_k, top_p, eos_id, pad_id, min_new_tokens):
+    """One decode step for every slot in the pool.
+
+    carry:
+      cache        PagedKVCache — the shared physical block pool
+      block_tables [slots, max_blocks] int32 (free slots: all-zero -> writes
+                   land in the reserved garbage block 0)
+      slot_mask    [slots, S] int32 logical-slot validity
+      lengths      [slots] int32 cache fill (incl. left-pad; the write slot)
+      prev_tok     [slots] previous sampled token (enters the cache now)
+      prev_ok      [slots] bool — prev_tok is a real emission (mirrors the
+                   dense decode_step's prev_valid/emit)
+      pos          [slots] int32 RoPE position (count of real tokens)
+      step_idx     [slots] int32 absolute sampled-token index (min_new_tokens)
+      done         [slots] bool (free slots are parked done=True)
+      keys         [slots, 2] per-slot PRNG keys
+
+    Returns (carry', (tok, emit)). Greedy outputs are bit-identical to
+    decode_step for a slot whose slab content matches the dense cache (the
+    serving equivalence tests pin this)."""
+    (cache, block_tables, slot_mask, lengths, prev_tok, prev_ok, pos,
+     step_idx, done, keys) = carry
+    n_slots = prev_tok.shape[0]
+    S = slot_mask.shape[1]
+    # the previous token's slot becomes visible exactly as in the dense path
+    # (forward writes attention_mask=prev_valid at cache.length); released
+    # slots' lengths may run past S — clamp, their mask rows are all-zero
+    # and prev_ok is 0 so the write is a masked no-op
+    slot_mask = slot_mask.at[
+        jnp.arange(n_slots), jnp.minimum(lengths, S - 1)
+    ].set(prev_ok.astype(slot_mask.dtype))
+    hidden, (new_k, new_v) = M.forward_paged(
+        config, params, prev_tok[:, None], pos, lengths, cache, block_tables,
+        slot_mask, lora=lora, lora_scale=lora_scale,
+    )
+    cache = M.paged_scatter_tokens(cache, block_tables, lengths, new_k, new_v)
+    logits = M.logits_fn(config, params, hidden)[:, 0, :]
+    pos = pos + prev_ok.astype(pos.dtype)
+    split = jax.vmap(jax.random.split)(keys)  # [slots, 2, 2]
+    keys, k_s = split[:, 0], split[:, 1]
+    tok = _sample_token_per_row(
+        _suppress_eos(logits, step_idx, eos_id, min_new_tokens), k_s,
+        temperature, top_k, top_p,
+    )
+    tok = jnp.where(done, pad_id, tok)
+    emit = jnp.logical_not(done)
+    if eos_id is not None:
+        done = jnp.logical_or(done, tok == eos_id)
+    lengths = lengths + 1
+    step_idx = step_idx + 1
+    return (cache, block_tables, slot_mask, lengths, tok, emit, pos,
+            step_idx, done, keys), (tok, emit)

@@ -270,6 +270,53 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.reshape(x.shape)
 
 
+def _qkv_rope(config: GPTConfig, blk, x, positions, lora_layer, lora_scale):
+    """Shared q/k/v projection + bias + head split + RoPE. ONE home for the
+    projection maths so the dense cached path (forward) and the paged decode
+    path (forward_paged) cannot drift — the paged serving tier's greedy
+    bit-parity guarantee rests on both paths running these exact ops."""
+    B, T = x.shape[:2]
+    dtype = x.dtype
+    q = _maybe_lora(x, blk["wq"], lora_layer, "wq", lora_scale, dtype)
+    k = _maybe_lora(x, blk["wk"], lora_layer, "wk", lora_scale, dtype)
+    v = _maybe_lora(x, blk["wv"], lora_layer, "wv", lora_scale, dtype)
+    if config.qkv_bias:
+        q = q + blk["bq"].astype(dtype)
+        k = k + blk["bk"].astype(dtype)
+        v = v + blk["bv"].astype(dtype)
+    q = q.reshape(B, T, config.n_head, config.head_dim)
+    k = k.reshape(B, T, config.kv_heads, config.head_dim)
+    v = v.reshape(B, T, config.kv_heads, config.head_dim)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    return q, k, v
+
+
+def _block_ffn(config: GPTConfig, blk, h, lora_layer, lora_scale):
+    """Post-attention half of a block: RMSNorm + (MoE | SwiGLU) FFN with the
+    residual add. Returns (h_out, aux). Shared between forward's block_fn
+    and forward_paged (same no-drift contract as _qkv_rope)."""
+    B, T = h.shape[:2]
+    dtype = h.dtype
+    x = _rms(h, blk["ln2"], config.rms_eps)
+    if "router" in blk:
+        from agilerl_tpu.llm.moe import moe_ffn
+
+        out2d, aux = moe_ffn(
+            x.reshape(B * T, config.d_model),
+            blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
+            top_k=config.expert_top_k,
+            capacity_factor=config.capacity_factor,
+        )
+        return h + out2d.reshape(B, T, config.d_model), aux
+    gate = _maybe_lora(x, blk["w_gate"], lora_layer, "w_gate", lora_scale, dtype)
+    up = _maybe_lora(x, blk["w_up"], lora_layer, "w_up", lora_scale, dtype)
+    down = _maybe_lora(
+        jax.nn.silu(gate) * up, blk["w_down"], lora_layer, "w_down", lora_scale, dtype
+    )
+    return h + down, jnp.zeros((), jnp.float32)
+
+
 def _scannable(config: GPTConfig, blocks, lora_layers) -> bool:
     """True when the layer stack can roll into one lax.scan: scan_layers
     enabled, >1 layer, and every block (and LoRA layer, if any) structurally
@@ -339,18 +386,7 @@ def forward(
     def block_fn(h, blk, layer_kv, lora_layer):
         """layer_kv: (k_cache [B,S,KV,hd], v_cache [B,S,KV,hd]) or None."""
         x = _rms(h, blk["ln1"], config.rms_eps)
-        q = _maybe_lora(x, blk["wq"], lora_layer, "wq", lora_scale, dtype)
-        k = _maybe_lora(x, blk["wk"], lora_layer, "wk", lora_scale, dtype)
-        v = _maybe_lora(x, blk["wv"], lora_layer, "wv", lora_scale, dtype)
-        if config.qkv_bias:
-            q = q + blk["bq"].astype(dtype)
-            k = k + blk["bk"].astype(dtype)
-            v = v + blk["bv"].astype(dtype)
-        q = q.reshape(B, T, config.n_head, config.head_dim)
-        k = k.reshape(B, T, config.kv_heads, config.head_dim)
-        v = v.reshape(B, T, config.kv_heads, config.head_dim)
-        q = _rope(q, positions, config.rope_theta)
-        k = _rope(k, positions, config.rope_theta)
+        q, k, v = _qkv_rope(config, blk, x, positions, lora_layer, lora_scale)
 
         if layer_kv is not None:
             # layer_kv = this layer's PRE-update (k_slab, v_slab). Attention
@@ -439,24 +475,8 @@ def forward(
             )
         attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
         h = h + attn
-
-        x = _rms(h, blk["ln2"], config.rms_eps)
-        if "router" in blk:
-            from agilerl_tpu.llm.moe import moe_ffn
-
-            out2d, aux = moe_ffn(
-                x.reshape(B * T, config.d_model),
-                blk["router"], blk["w_gate"], blk["w_up"], blk["w_down"],
-                top_k=config.expert_top_k,
-                capacity_factor=config.capacity_factor,
-            )
-            return h + out2d.reshape(B, T, config.d_model), new_kv, aux
-        gate = _maybe_lora(x, blk["w_gate"], lora_layer, "w_gate", lora_scale, dtype)
-        up = _maybe_lora(x, blk["w_up"], lora_layer, "w_up", lora_scale, dtype)
-        down = _maybe_lora(
-            jax.nn.silu(gate) * up, blk["w_down"], lora_layer, "w_down", lora_scale, dtype
-        )
-        return h + down, new_kv, jnp.zeros((), jnp.float32)
+        h, aux = _block_ffn(config, blk, h, lora_layer, lora_scale)
+        return h, new_kv, aux
 
     aux_total = jnp.zeros((), jnp.float32)
     fn = jax.checkpoint(block_fn, static_argnums=()) if config.remat else block_fn
@@ -622,6 +642,210 @@ def apply(
 def init_caches(config: GPTConfig, batch: int, max_len: Optional[int] = None) -> KVCache:
     """One stacked cache for the whole layer stack (leading axis = layer)."""
     return init_kv_cache(config, batch, max_len)
+
+
+# --------------------------------------------------------------------------- #
+# Paged KV cache (vLLM PagedAttention role, Kwon et al. SOSP 2023, redesigned
+# for XLA's compile-once model): ONE physical block pool shared by every
+# in-flight sequence + per-slot int32 block tables. A finished sequence's
+# blocks return to the host free list immediately; heterogeneous lengths
+# never strand HBM on a dense [B, P_max + N] allocation. The serving tier
+# (llm/serving.ContinuousGenerator) owns the tables/free-list on the host;
+# the device only ever sees gathers/scatters through them.
+# --------------------------------------------------------------------------- #
+
+
+class PagedKVCache(NamedTuple):
+    """Physical KV block pool, stacked over layers.
+
+    Block 0 is reserved as a garbage sink: free slots in the decode program
+    point their whole block table at it, so masked writes always have a
+    legal destination and no compiled program ever branches on occupancy."""
+
+    k: jax.Array  # [L, n_blocks, block_size, KV, hd]
+    v: jax.Array  # [L, n_blocks, block_size, KV, hd]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_paged_cache(config: GPTConfig, n_blocks: int, block_size: int) -> PagedKVCache:
+    shape = (config.n_layer, n_blocks, block_size, config.kv_heads,
+             config.head_dim)
+    return PagedKVCache(k=jnp.zeros(shape, config.dtype),
+                        v=jnp.zeros(shape, config.dtype))
+
+
+def paged_gather(pool_k: jax.Array, pool_v: jax.Array, block_tables: jax.Array):
+    """Materialise per-slot contiguous KV slabs from the pool.
+
+    pool_*: [nb, bs, KV, hd] (ONE layer — called inside the layer scan so the
+    temp is per-layer, not [L, ...]); block_tables: [B, max_blocks] ->
+    ([B, S, KV, hd], ...) with S = max_blocks * bs. This is the gather the
+    on-chip profile target from NOTES_ROUND4/5 meters: a [B, S] temp per
+    layer per step, while the RESIDENT allocation stays the shared pool."""
+    bs = pool_k.shape[1]
+    B, mb = block_tables.shape
+
+    def slab(pool):
+        g = jnp.take(pool, block_tables.reshape(-1), axis=0)
+        return g.reshape(B, mb * bs, *pool.shape[2:])
+
+    return slab(pool_k), slab(pool_v)
+
+
+def paged_write_index(block_tables: jax.Array, write_pos: jax.Array,
+                      block_size: int) -> jax.Array:
+    """Flat pool index [B] for each slot's write position. Positions past the
+    table (possible only for released slots whose lengths keep advancing)
+    clamp into the last table entry — released slots' tables are all-zero,
+    so the write lands in the reserved garbage block."""
+    mb = block_tables.shape[1]
+    bidx = jnp.minimum(write_pos // block_size, mb - 1)
+    phys = jnp.take_along_axis(block_tables, bidx[:, None], axis=1)[:, 0]
+    return phys * block_size + write_pos % block_size
+
+
+def paged_scatter_tokens(cache: PagedKVCache, block_tables: jax.Array,
+                         write_pos: jax.Array, new_k: jax.Array,
+                         new_v: jax.Array) -> PagedKVCache:
+    """ONE bulk write of the step's new tokens into the pool across all
+    layers (mirrors forward's single dynamic_update_slice after the layer
+    scan). new_k/new_v: [L, B, KV, hd]; write_pos: [B] logical slot index."""
+    L, nb, bs, KV, hd = cache.k.shape
+    idx = paged_write_index(block_tables, write_pos, bs)
+    flat_k = cache.k.reshape(L, nb * bs, KV, hd).at[:, idx].set(new_k)
+    flat_v = cache.v.reshape(L, nb * bs, KV, hd).at[:, idx].set(new_v)
+    return PagedKVCache(k=flat_k.reshape(L, nb, bs, KV, hd),
+                        v=flat_v.reshape(L, nb, bs, KV, hd))
+
+
+def paged_scatter_prompt(cache: PagedKVCache, block_ids: jax.Array,
+                         k_prompt: jax.Array, v_prompt: jax.Array) -> PagedKVCache:
+    """Write one request's prefilled prompt KV ([L, Pb, KV, hd], Pb a whole
+    number of blocks) into its assigned physical blocks ([Pb // bs])."""
+    L, _, bs, KV, hd = cache.k.shape
+    nb_p = k_prompt.shape[1] // bs
+    return PagedKVCache(
+        k=cache.k.at[:, block_ids].set(k_prompt.reshape(L, nb_p, bs, KV, hd)),
+        v=cache.v.at[:, block_ids].set(v_prompt.reshape(L, nb_p, bs, KV, hd)),
+    )
+
+
+def paged_copy_block(cache: PagedKVCache, src, dst) -> PagedKVCache:
+    """Copy one physical block (prefix-cache hit: the last prompt block is
+    duplicated into a private block so the first decode write cannot touch
+    the shared original)."""
+    return PagedKVCache(k=cache.k.at[:, dst].set(cache.k[:, src]),
+                        v=cache.v.at[:, dst].set(cache.v[:, src]))
+
+
+def forward_paged(
+    config: GPTConfig,
+    params: Params,
+    tokens: jax.Array,       # [B, 1] the current token per slot
+    positions: jax.Array,    # [B] RoPE position (count of real prior tokens)
+    write_pos: jax.Array,    # [B] logical cache slot for this token's K/V
+    cache: PagedKVCache,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    slot_mask: jax.Array,    # [B, S] 1 where the LOGICAL slot holds a real
+    # token — including the current token at write_pos (caller pre-sets it)
+    lora: Optional[Params] = None,
+    lora_scale: float = 2.0,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """One decode forward over the slot pool: returns (hidden [B, 1, D]
+    float32, (new_k, new_v) [L, B, KV, hd]) — the caller scatters the new
+    KV into the pool (paged_scatter_tokens) exactly once.
+
+    Per-slot `write_pos` is what distinguishes this from forward-with-cache:
+    continuous batching admits slots at different times, so there is no
+    shared scalar cache length. Attention sees the locally-updated slab
+    (gather + in-slab insert), the same pre-update discipline as forward's
+    block_fn; greedy outputs are bit-identical to the dense path because the
+    projection/FFN maths is the SAME code (_qkv_rope/_block_ffn) and masked
+    slab positions contribute exact zeros to the softmax."""
+    B, T = tokens.shape
+    dtype = config.dtype
+    chunked_decode = use_chunked_decode()
+    h = jnp.take(params["tok_emb"], tokens, axis=0).astype(dtype)
+    pos2d = positions[:, None]
+    arange_b = jnp.arange(B)
+
+    def block_fn(h, blk, layer_kv, lora_layer):
+        x = _rms(h, blk["ln1"], config.rms_eps)
+        q, k, v = _qkv_rope(config, blk, x, pos2d, lora_layer, lora_scale)
+        k_slab, v_slab = paged_gather(layer_kv[0], layer_kv[1], block_tables)
+        k_slab = k_slab.at[arange_b, write_pos].set(k[:, 0])
+        v_slab = v_slab.at[arange_b, write_pos].set(v[:, 0])
+        if chunked_decode:
+            from agilerl_tpu.ops.decode_attention import (
+                chunked_cached_attention,
+            )
+
+            attn = chunked_cached_attention(q, k_slab, v_slab, slot_mask,
+                                            write_pos)
+        else:
+            # dense fallback — same repeat-heads formulation as forward's
+            # kill-switch branch so the two kill-switch paths match exactly
+            S = k_slab.shape[1]
+            rep = config.n_head // config.kv_heads
+            if rep > 1:
+                k_slab = jnp.repeat(k_slab, rep, axis=2)
+                v_slab = jnp.repeat(v_slab, rep, axis=2)
+            qh = jnp.moveaxis(q, 2, 1)
+            kh = jnp.moveaxis(k_slab, 2, 1)
+            vh = jnp.moveaxis(v_slab, 2, 1)
+            kv_slot = jnp.arange(S)
+            causal = (kv_slot[None, None, :]
+                      <= (write_pos[:, None] + jnp.arange(T)[None, :])[:, :, None])
+            mask = jnp.logical_and(causal, slot_mask[:, None, :].astype(bool))
+            scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
+            scores = scores / math.sqrt(config.head_dim)
+            scores = jnp.where(mask[:, None, :, :], scores, -1e9)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            attn = jnp.einsum("bhts,bhsd->bhtd", probs, vh)
+            attn = jnp.moveaxis(attn, 1, 2)
+        attn = attn.reshape(B, T, config.n_head * config.head_dim)
+        attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
+        h = h + attn
+        h, _ = _block_ffn(config, blk, h, lora_layer, lora_scale)
+        return h, (k[:, 0], v[:, 0])
+
+    blocks = [params["blocks"][str(i)] for i in range(config.n_layer)]
+    lora_layers = [
+        lora["blocks"].get(str(i)) if lora is not None else None
+        for i in range(config.n_layer)
+    ]
+    if _scannable(config, blocks, lora_layers):
+        stack = lambda *xs: jnp.stack(xs)  # noqa: E731
+        stacked_blk = jax.tree_util.tree_map(stack, *blocks)
+        has_lora = lora is not None
+        xs = [stacked_blk, (cache.k, cache.v)]
+        if has_lora:
+            xs.append(jax.tree_util.tree_map(stack, *lora_layers))
+
+        def body(h, x):
+            lora_i = x[2] if has_lora else None
+            hn, new_kv = block_fn(h, x[0], x[1], lora_i)
+            return hn, new_kv
+
+        h, (new_k, new_v) = jax.lax.scan(body, h, tuple(xs))
+    else:
+        nk_list, nv_list = [], []
+        for i in range(config.n_layer):
+            h, (nk, nv) = block_fn(h, blocks[i], (cache.k[i], cache.v[i]),
+                                   lora_layers[i])
+            nk_list.append(nk)
+            nv_list.append(nv)
+        new_k, new_v = jnp.stack(nk_list), jnp.stack(nv_list)
+
+    h = _rms(h, params["ln_f"], config.rms_eps).astype(jnp.float32)
+    return h, (new_k, new_v)
 
 
 # --------------------------------------------------------------------------- #

@@ -23,6 +23,9 @@ fold order across chunks.
 
 from __future__ import annotations
 
+import collections
+import dataclasses
+import hashlib
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -33,7 +36,12 @@ import numpy as np
 
 from agilerl_tpu import observability
 from agilerl_tpu.llm import model as M
-from agilerl_tpu.llm.generate import decode_step, left_pad, prefill_head
+from agilerl_tpu.llm.generate import (
+    decode_step,
+    left_pad,
+    paged_decode_step,
+    prefill_head,
+)
 
 #: TTFT buckets (s): serving SLO granularity — sub-ms compile-cached prefill
 #: through multi-second cold compiles
@@ -51,6 +59,36 @@ def _round_up(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return b
     raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
+
+
+def _sampling_knobs(gen, greedy: bool, lora) -> Dict[str, Any]:
+    """The per-call knob dict both serving generators hand to the shared
+    prefill/decode building blocks — ONE home so the two tiers cannot
+    sample differently (same no-drift contract as generate._filter_logits)."""
+    return dict(
+        lora=lora, lora_scale=gen.lora_scale,
+        temperature=0.0 if greedy else gen.temperature,
+        top_k=gen.top_k, top_p=gen.top_p, eos_id=gen.eos_id,
+        pad_id=gen.pad_id, min_new_tokens=gen.min_new_tokens,
+    )
+
+
+def measured_cache_size(*jitted) -> int:
+    """Total LIVE compiled-program count across jitted callables, read from
+    the jit caches themselves (VERDICT r4 #4: a self-inserted signature set
+    asserts a proxy; the measured cache size cannot lie). ``_cache_size`` is
+    private jax API — VERIFIED present and correct on this image's jax
+    0.4.37 (the old comment pinned 0.9.0; compat.py documents the installed
+    version) and on current jax; the getattr guard degrades a future rename
+    into the -1 sentinel instead of crashing generate() (the missing-API
+    path is pinned in tests/test_llm/test_continuous_batching.py).
+    Notes: ``jax.clear_caches()`` restarts the count, a change of input
+    sharding/dtype is honestly a new program, and an early-exit batch that
+    never reached decode counts only its prefill."""
+    sizes = [getattr(fn, "_cache_size", None) for fn in jitted]
+    if None in sizes:
+        return -1
+    return sum(s() for s in sizes)
 
 
 class BucketedGenerator:
@@ -106,12 +144,7 @@ class BucketedGenerator:
     # -- compiled pieces (the SHARED generate.py prefill/decode maths — the
     # two paths cannot drift, review finding) -----------------------------
     def _knobs(self, greedy: bool, lora) -> Dict[str, Any]:
-        return dict(
-            lora=lora, lora_scale=self.lora_scale,
-            temperature=0.0 if greedy else self.temperature,
-            top_k=self.top_k, top_p=self.top_p, eos_id=self.eos_id,
-            pad_id=self.pad_id, min_new_tokens=self.min_new_tokens,
-        )
+        return _sampling_knobs(self, greedy, lora)
 
     def _prefill_impl(self, params, lora, prompt, prompt_mask, row_valid,
                       key, greedy=False):
@@ -206,10 +239,16 @@ class BucketedGenerator:
                 out_masks.append(np.asarray(emits_c))
                 dt_chunk = time.perf_counter() - t_chunk
                 decode_elapsed_s += dt_chunk
+                # the final chunk may overshoot max_new_tokens; metering by
+                # decode_chunk would overstate delivered-token throughput on
+                # that chunk — divide by DELIVERED tokens (the same trim the
+                # tokens_decoded_total counter applies below)
+                delivered_chunk = (
+                    min(steps + self.decode_chunk, self.max_new_tokens) - steps)
                 self.metrics.histogram(
                     "serving/decode_time_per_token_s", buckets=DECODE_BUCKETS,
-                    help="decode-chunk wall time / chunk tokens",
-                ).observe(dt_chunk / self.decode_chunk)
+                    help="decode-chunk wall time / delivered chunk tokens",
+                ).observe(dt_chunk / max(delivered_chunk, 1))
                 steps += self.decode_chunk
         finally:
             with self._pending_lock:
@@ -231,8 +270,11 @@ class BucketedGenerator:
             "max_new_tokens": N,
             "compiled_programs": self.compiled_programs,
             "ttft_s": round(ttft_s, 6),
+            # delivered decode tokens beyond tok0 = min(steps, N) - 1: the
+            # overshooting final chunk must not inflate per-token throughput
             "decode_time_per_token_s": (
-                round(decode_elapsed_s / (steps - 1), 8) if steps > 1 else None
+                round(decode_elapsed_s / (min(steps, N) - 1), 8)
+                if min(steps, N) > 1 else None
             ),
         }
         self.metrics.counter("serving/requests_total").inc()
@@ -268,18 +310,760 @@ class BucketedGenerator:
     @property
     def compiled_programs(self) -> int:
         """Total compiled (prefill + decode) program count — the bounded
-        compile set the bucketing exists to guarantee. Read from the jit
-        caches themselves (VERDICT r4 #4: the previous self-inserted shape
-        signatures asserted a proxy — a regression that retraced per call,
-        e.g. an accidentally-traced knob, would have passed unnoticed; the
-        measured cache size cannot lie). Notes: the count reflects LIVE
-        programs (``jax.clear_caches()`` restarts it), a change of input
-        sharding/dtype is honestly a new program, and an early-exit batch
-        that never reached decode counts only its prefill. ``_cache_size``
-        is private jax API (pinned 0.9.0); the getattr guard turns a future
-        rename into a sentinel instead of crashing generate()."""
-        sizes = [getattr(fn, "_cache_size", None)
-                 for fn in (self._prefill, self._decode)]
-        if None in sizes:  # pragma: no cover - future-jax fallback
-            return -1
-        return sum(s() for s in sizes)
+        compile set the bucketing exists to guarantee (measured from the jit
+        caches; see measured_cache_size for the accounting contract)."""
+        return measured_cache_size(self._prefill, self._decode)
+
+
+# --------------------------------------------------------------------------- #
+# Continuous (in-flight) batching on a paged KV pool — the Orca
+# iteration-level-scheduling + vLLM PagedAttention pair (Yu et al. OSDI 2022;
+# Kwon et al. SOSP 2023), redesigned for XLA: ONE compiled decode program
+# over a fixed [slots, ...] width is reused forever, and the host scheduler
+# admits queued requests into freed slots BETWEEN decode chunks instead of
+# waiting for a whole batch to drain.
+# --------------------------------------------------------------------------- #
+
+#: queue-wait buckets (s): sub-ms same-iteration admission through
+#: multi-second backlog under load shedding
+QUEUE_WAIT_BUCKETS = (0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                      1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class BlockAllocator:
+    """Host-side physical-block free list with a refcounted prefix cache.
+
+    Block 0 is reserved as the garbage sink the decode program points free
+    slots at, so it is never handed out. Prompt blocks registered in the
+    prefix cache survive their request: at refcount 0 they become EVICTABLE
+    (still hit-able) and are reclaimed LRU-first when the free list runs
+    dry — the vLLM cached-block lifecycle."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.n_blocks = n_blocks
+        self._free = list(range(n_blocks - 1, 0, -1))  # LIFO: low ids first
+        self._ref: Dict[int, int] = {}        # cached block -> refcount
+        self._by_hash: Dict[bytes, int] = {}  # chain hash -> block id
+        self._hash_of: Dict[int, bytes] = {}
+        # refcount-0 cached blocks in eviction order (oldest first)
+        self._lru: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def evictable_blocks(self) -> int:
+        return len(self._lru)
+
+    def available(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n private blocks, evicting cold cached blocks if needed; None
+        (and no state change) when even eviction cannot cover the request."""
+        if self.available() < n:
+            return None
+        out = []
+        for _ in range(n):
+            if self._free:
+                out.append(self._free.pop())
+            else:
+                bid, _ = self._lru.popitem(last=False)
+                del self._by_hash[self._hash_of.pop(bid)]
+                del self._ref[bid]
+                out.append(bid)
+        return out
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Return PRIVATE (decode / copy) blocks to the free list."""
+        self._free.extend(ids)
+
+    def register(self, chain_hash: bytes, bid: int) -> bool:
+        """Enter a freshly prefilled prompt block into the prefix cache with
+        one reference (its owning slot). First writer wins: if another block
+        already serves this hash (e.g. the identical all-pad leading block
+        of two different prompts that both MISSED on later blocks), the new
+        block is refused and the caller keeps it private — a silent
+        overwrite would orphan the old block's reverse mapping."""
+        if chain_hash in self._by_hash:
+            return False
+        self._by_hash[chain_hash] = bid
+        self._hash_of[bid] = chain_hash
+        self._ref[bid] = self._ref.get(bid, 0) + 1
+        self._lru.pop(bid, None)
+        return True
+
+    def lookup_chain(self, hashes: Sequence[bytes]) -> Optional[List[int]]:
+        """All-or-nothing hit on a full block-hash chain; a hit takes one
+        reference on every block."""
+        ids = []
+        for h in hashes:
+            bid = self._by_hash.get(h)
+            if bid is None:
+                return None
+            ids.append(bid)
+        for bid in ids:
+            self._ref[bid] += 1
+            self._lru.pop(bid, None)
+        return ids
+
+    def release_shared(self, ids: Sequence[int]) -> None:
+        """Drop one reference per block; refcount-0 blocks stay CACHED but
+        become evictable (future identical prompts still hit them). Blocks
+        whose hash was forgotten by invalidate_cache() go straight back to
+        the free list instead."""
+        for bid in ids:
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                if bid in self._hash_of:
+                    self._lru[bid] = None
+                else:  # weight-epoch flush forgot the hash: plain free
+                    del self._ref[bid]
+                    self._free.append(bid)
+
+    def invalidate_cache(self) -> None:
+        """Flush the prefix cache (weight update: every cached KV block is
+        stale). Evictable blocks return to the free list now; blocks still
+        referenced by in-flight slots merely forget their hashes, so no
+        future admission can hit them and release_shared frees them."""
+        for bid in list(self._lru):
+            del self._by_hash[self._hash_of.pop(bid)]
+            del self._ref[bid]
+            self._free.append(bid)
+        self._lru.clear()
+        for bid, h in list(self._hash_of.items()):
+            del self._by_hash[h]
+            del self._hash_of[bid]
+
+
+@dataclasses.dataclass
+class _Request:
+    ticket: int
+    tokens: np.ndarray          # [plen] int32
+    key: np.ndarray             # [2] uint32 per-request PRNG key
+    max_new: int
+    arrival_s: float
+    admitted_s: Optional[float] = None
+    ttft_observed: bool = False
+    prefix_hit: bool = False
+    toks: List[np.ndarray] = dataclasses.field(default_factory=list)
+    emits: List[np.ndarray] = dataclasses.field(default_factory=list)
+    n_emitted: int = 0
+    hashes: Optional[List[bytes]] = None  # chain hashes, computed once
+
+
+class ContinuousGenerator:
+    """Compile-bounded continuous-batching serving over one
+    (config, sampling-recipe): the millions-of-users path of ROADMAP item 3.
+
+    Architecture (all host state numpy; device sees only the block pool plus
+    small per-slot arrays):
+
+    - **Slot pool** — ``slots`` decode lanes; ONE jitted chunk program over
+      ``[slots, ...]`` (plus a greedy variant) regardless of request count,
+      arrival order, or lengths. Free slots are parked ``done=True`` with an
+      all-zero block table (writes land in the reserved garbage block 0).
+    - **Paged KV** — llm/model.PagedKVCache: requests own whole
+      ``block_size``-token physical blocks via per-slot block tables; a
+      finished request's blocks return to the free list at the chunk
+      boundary it finishes in, not when its batch drains.
+    - **Prefix cache** — prompt blocks are keyed by a hash chain over the
+      left-padded block contents; a FULL-chain hit skips prefill entirely
+      (one private copy of the last prompt block so decode writes cannot
+      touch shared state). Covers identical prompts — GRPO group_size
+      repeats, best-of-N, retries. Partial-prefix resume is future work
+      (docs/serving.md sketches the design).
+    - **Admission control** — a bounded queue with load shedding on queue
+      overflow, on p95 TTFT exceeding ``ttft_slo_s``, and on the free-block
+      watermark; ``submit(..., no_shed=True)`` bypasses shedding for
+      training rollouts.
+
+    Greedy decode is token-for-token identical to ``llm/generate.generate``
+    at the same prompt bucket: prefill is the SAME prefill_head at the same
+    cache extent, and the paged decode runs the same projection/FFN code
+    with masked slab positions contributing exact zeros."""
+
+    def __init__(
+        self,
+        config: M.GPTConfig,
+        max_new_tokens: int = 64,
+        pad_id: int = 0,
+        eos_id: Optional[int] = None,
+        prompt_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
+        slots: int = 8,
+        block_size: int = 32,
+        n_blocks: Optional[int] = None,
+        decode_chunk: int = 32,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        min_new_tokens: Optional[int] = None,
+        lora_scale: float = 2.0,
+        metrics=None,
+        max_queue: int = 256,
+        ttft_slo_s: Optional[float] = None,
+        min_slo_samples: int = 20,
+        free_block_watermark: float = 0.0,
+        prefix_cache: bool = True,
+    ):
+        self.config = config
+        self.metrics = metrics if metrics is not None else observability.get_registry()
+        self.pad_id = int(pad_id)
+        self.eos_id = eos_id
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.block_size = int(block_size)
+        for b in self.prompt_buckets:
+            if b % self.block_size:
+                raise ValueError(
+                    f"block_size {self.block_size} must divide every prompt "
+                    f"bucket (got {b}): prompt KV is written whole blocks at "
+                    "a time and prefix hashes chain at block granularity")
+        self.decode_chunk = min(int(decode_chunk), int(max_new_tokens))
+        self.n_chunks = -(-int(max_new_tokens) // self.decode_chunk)
+        self.max_new_tokens = int(max_new_tokens)
+        self.slots = int(slots)
+        # per-slot logical extent mirrors the bucketed/dense cache sizing
+        # (bucket + whole chunks) — the greedy-parity contract
+        self._decode_extent = self.n_chunks * self.decode_chunk
+        self.max_blocks = -(-(self.prompt_buckets[-1] + self._decode_extent)
+                            // self.block_size)
+        if n_blocks is None:
+            # full provisioning: every slot can hold a worst-case request
+            # (+1 for the reserved garbage block). Smaller pools exploit
+            # paging harder and lean on admission control instead.
+            n_blocks = 1 + self.slots * self.max_blocks
+        self.n_blocks = int(n_blocks)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.min_new_tokens = min_new_tokens
+        self.lora_scale = lora_scale
+        self.max_queue = int(max_queue)
+        self.ttft_slo_s = ttft_slo_s
+        self.min_slo_samples = int(min_slo_samples)
+        self.free_block_watermark = float(free_block_watermark)
+        self.prefix_cache = bool(prefix_cache)
+
+        self._prefill = jax.jit(self._prefill_admit_impl,
+                                static_argnames=("greedy",),
+                                donate_argnums=(5,))
+        self._decode = jax.jit(self._decode_chunk_impl,
+                               static_argnames=("greedy",),
+                               donate_argnums=(2,))
+        self._copy_block = jax.jit(M.paged_copy_block, donate_argnums=(0,))
+
+        # -- host scheduler state --
+        # Threading contract: submit()/result() may be called from request
+        # threads (deque append/pop are atomic; the ticket counter takes
+        # this lock), but step()/run_until_drained()/generate() must be
+        # driven by ONE scheduler thread — slot state is not locked.
+        self._submit_lock = threading.Lock()
+        self.allocator = BlockAllocator(self.n_blocks)
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        # shed decisions use a ROLLING window of recent TTFTs, not the
+        # lifetime histogram — a cold-compile outlier in a cumulative p95
+        # would keep shedding healthy traffic long after latency recovered
+        self._recent_ttft: "collections.deque[float]" = collections.deque(
+            maxlen=max(self.min_slo_samples, 64))
+        self._next_ticket = 0
+        self._results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._pool: Optional[M.PagedKVCache] = None
+        S = self.max_blocks * self.block_size
+        self._tables = np.zeros((self.slots, self.max_blocks), np.int32)
+        self._mask = np.zeros((self.slots, S), np.int32)
+        self._lengths = np.zeros(self.slots, np.int32)
+        self._prev_tok = np.zeros(self.slots, np.int32)
+        self._prev_ok = np.zeros(self.slots, bool)
+        self._pos = np.zeros(self.slots, np.int32)
+        self._step_idx = np.zeros(self.slots, np.int32)
+        self._done = np.ones(self.slots, bool)
+        self._keys = np.zeros((self.slots, 2), np.uint32)
+        self._slot_req: List[Optional[_Request]] = [None] * self.slots
+        self._slot_shared: List[List[int]] = [[] for _ in range(self.slots)]
+        self._slot_private: List[List[int]] = [[] for _ in range(self.slots)]
+        # strong refs to the last-served weight trees: cached prompt KV is
+        # only valid for the weights that prefilled it
+        self._weights: Optional[Tuple[Any, Any]] = None
+
+    # -- compiled pieces ---------------------------------------------------
+    def _knobs(self, greedy: bool, lora) -> Dict[str, Any]:
+        return _sampling_knobs(self, greedy, lora)
+
+    def _prefill_admit_impl(self, params, lora, prompt, prompt_mask, key,
+                            cache, block_ids, greedy=False):
+        """Prefill ONE request at its prompt bucket (the SHARED prefill_head
+        — dense-parity maths) and scatter its prompt KV into the assigned
+        physical blocks. Compiles once per (prompt bucket, greedy)."""
+        Pb = prompt.shape[1]
+        # dense-parity extent: the same Pb + chunks*chunk the bucketed/dense
+        # paths allocate, so chunked-attention chunking is identical
+        dense = M.init_caches(self.config, 1, Pb + self._decode_extent)
+        carry, (tok0, _emit0) = prefill_head(
+            self.config, params, prompt, prompt_mask, dense, key,
+            **self._knobs(greedy, lora),
+        )
+        filled, _tok0, _rv, pos, done0, key_next = carry
+        cache = M.paged_scatter_prompt(
+            cache, block_ids, filled.k[:, 0, :Pb], filled.v[:, 0, :Pb])
+        return cache, tok0[0], pos[0], done0[0], key_next
+
+    def _decode_chunk_impl(self, params, lora, cache, tables, slot_mask,
+                           lengths, prev_tok, prev_ok, pos, step_idx, done,
+                           keys, greedy=False):
+        """One fixed-size decode chunk over the WHOLE slot pool — the single
+        compiled program the scheduler reuses forever."""
+        knobs = self._knobs(greedy, lora)
+
+        def step(carry, _):
+            return paged_decode_step(self.config, params, carry, **knobs)
+
+        carry = (cache, tables, slot_mask, lengths, prev_tok, prev_ok, pos,
+                 step_idx, done, keys)
+        carry, (toks, emits) = jax.lax.scan(
+            step, carry, None, length=self.decode_chunk)
+        return carry, (toks.T, emits.T)  # [slots, chunk]
+
+    # -- host API ----------------------------------------------------------
+    def fits(self, n_rows: int, longest_prompt: int) -> bool:
+        """Row count is unbounded (the queue absorbs it); only the prompt
+        must fit the bucket grid."""
+        return n_rows > 0 and 0 < longest_prompt <= self.prompt_buckets[-1]
+
+    def submit(self, tokens, *, max_new: Optional[int] = None, key=None,
+               no_shed: bool = False) -> Optional[int]:
+        """Enqueue one request; returns a ticket, or None when admission
+        control sheds it (queue overflow / TTFT SLO breach / free-block
+        watermark). ``no_shed`` bypasses shedding — the training-rollout
+        mode, where dropping a rollout would corrupt the learn batch."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0 or tokens.size > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt of {tokens.size} tokens outside the bucket grid "
+                f"(1..{self.prompt_buckets[-1]}); check fits() and fall "
+                "back to the dense generate path")
+        if not no_shed:
+            reason = self._shed_reason()
+            if reason is not None:
+                self.metrics.counter(
+                    "serving/shed_requests_total",
+                    help="requests dropped by admission control").inc()
+                self.metrics.emit("serving_shed", reason=reason,
+                                  queue_len=len(self._queue))
+                return None
+        if max_new is None:
+            budget = self.max_new_tokens
+        else:
+            budget = min(int(max_new), self.max_new_tokens)
+            if budget <= 0:
+                # a falsy-zero fallback here would silently burn a slot on
+                # a full-budget generation the caller asked NOT to run
+                raise ValueError(f"max_new must be positive, got {max_new}")
+        with self._submit_lock:
+            ticket = self._next_ticket
+            self._next_ticket += 1
+        if key is None:
+            key = jax.random.PRNGKey(ticket)
+        self._queue.append(_Request(
+            ticket=ticket, tokens=tokens, key=np.asarray(key, np.uint32),
+            max_new=budget, arrival_s=time.perf_counter()))
+        self.metrics.histogram(
+            "serving/queue_depth_rows", buckets=QUEUE_BUCKETS,
+            help="rows in flight when a batch is admitted",
+        ).observe(len(self._queue) + self._occupancy())
+        return ticket
+
+    def _shed_reason(self) -> Optional[str]:
+        if len(self._queue) >= self.max_queue:
+            return "queue_full"
+        if self.free_block_watermark > 0:
+            watermark = int(self.free_block_watermark * self.n_blocks)
+            if self.allocator.available() < watermark:
+                return "free_block_watermark"
+        if self.ttft_slo_s is not None:
+            with self._submit_lock:  # scheduler thread appends concurrently
+                recent = list(self._recent_ttft)
+            if (len(recent) >= self.min_slo_samples
+                    and float(np.percentile(np.asarray(recent), 95))
+                    > self.ttft_slo_s):
+                return "ttft_slo"
+        return None
+
+    def _observe_ttft(self, ttft_s: float) -> None:
+        with self._submit_lock:
+            self._recent_ttft.append(ttft_s)
+        self.metrics.histogram(
+            "serving/ttft_s", buckets=TTFT_BUCKETS,
+            help="submit-to-first-token latency").observe(ttft_s)
+
+    def _occupancy(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    def _ensure_pool(self) -> None:
+        if self._pool is None:
+            self._pool = M.init_paged_cache(
+                self.config, self.n_blocks, self.block_size)
+
+    def _chain_hashes(self, toks_row: np.ndarray,
+                      mask_row: np.ndarray) -> List[bytes]:
+        """Block-hash chain over the LEFT-PADDED prompt layout. The chain
+        covers content AND pad pattern, so a hit guarantees every real
+        position's KV is identical (causal attention: a block's KV depends
+        only on content at <= positions, i.e. on the chain prefix). Pad
+        positions' stored KV never matters — masked slots contribute exact
+        zeros to every later softmax."""
+        hashes, h = [], b""
+        bs = self.block_size
+        for i in range(toks_row.size // bs):
+            m = hashlib.sha1()
+            m.update(h)
+            m.update(toks_row[i * bs:(i + 1) * bs].tobytes())
+            m.update(mask_row[i * bs:(i + 1) * bs].tobytes())
+            h = m.digest()
+            hashes.append(h)
+        return hashes
+
+    def _admit(self, params, lora, greedy: bool) -> List[int]:
+        """Fill free slots from the queue head; returns tickets completed AT
+        admission (immediate-EOS / budget-1 requests never enter a chunk).
+
+        Prefill dispatches are NOT synced inside the loop — each miss's
+        (tok0, done0, key) device handles are collected and converted once
+        after every admission has been dispatched, so host-side hashing /
+        allocation / left_pad for request i+1 overlaps request i's prefill
+        on the device."""
+        finished: List[int] = []
+        pending: List[Tuple[int, _Request, Any, Any, Any]] = []
+        while self._queue:
+            try:
+                slot = self._slot_req.index(None)
+            except ValueError:
+                break  # no free slot: decode must free one first
+            req = self._queue[0]
+            Pb = _round_up(req.tokens.size, self.prompt_buckets)
+            nb_p = Pb // self.block_size
+            req_chunks = -(-req.max_new // self.decode_chunk)
+            n_dec = -(-(req_chunks * self.decode_chunk) // self.block_size)
+            toks_row, mask_row = left_pad([req.tokens], self.pad_id, Pb)
+            toks_row, mask_row = toks_row[0], mask_row[0]
+            if self.prefix_cache and req.hashes is None:
+                req.hashes = self._chain_hashes(toks_row, mask_row)
+            shared = (self.allocator.lookup_chain(req.hashes)
+                      if self.prefix_cache else None)
+            if shared is not None:
+                private = self.allocator.alloc(1 + n_dec)
+                if private is None:
+                    # hit unaffordable: fall back to a MISS — releasing the
+                    # shared refs makes those cold blocks evictable, so the
+                    # larger miss allocation may still fit (a pool that
+                    # served this prompt once must keep serving it)
+                    self.allocator.release_shared(shared)
+                    shared = None
+            if shared is None:
+                private = self.allocator.alloc(nb_p + n_dec)
+                if private is None:
+                    break
+            self._queue.popleft()
+            now = time.perf_counter()
+            req.admitted_s = now
+            self.metrics.histogram(
+                "serving/queue_wait_s", buckets=QUEUE_WAIT_BUCKETS,
+                help="submit-to-admission wait").observe(now - req.arrival_s)
+            self._ensure_pool()
+            plen = int(mask_row.sum())
+            table = np.zeros(self.max_blocks, np.int32)
+            if shared is not None:
+                # full prefix hit: reuse every prompt block; the LAST one is
+                # copied into a private block because the first decode write
+                # (the re-entering last prompt token) lands inside it
+                req.prefix_hit = True
+                self.metrics.counter("serving/prefix_cache_hits_total").inc()
+                copy_dst = private[0]
+                self._pool = self._copy_block(
+                    self._pool, jnp.int32(shared[-1]), jnp.int32(copy_dst))
+                table[:nb_p - 1] = shared[:-1]
+                table[nb_p - 1] = copy_dst
+                table[nb_p:nb_p + n_dec] = private[1:]
+                self._slot_shared[slot] = list(shared)
+                self._slot_private[slot] = list(private)
+                # resume state: the last prompt token re-enters the cache on
+                # the first decode step; seeding the slot key with the RAW
+                # request key continues the same split stream prefill_head
+                # would have used (split -> (carry, sample))
+                self._lengths[slot] = Pb - 1
+                self._prev_tok[slot] = toks_row[-1]
+                self._pos[slot] = plen - 1
+                self._step_idx[slot] = 0
+                self._done[slot] = False
+                self._keys[slot] = req.key
+                self._mask[slot] = 0
+                self._mask[slot, :Pb] = mask_row
+                self._mask[slot, Pb - 1] = 0  # set by the first decode step
+            else:
+                self.metrics.counter("serving/prefix_cache_misses_total").inc()
+                prompt_blocks, dec_blocks = private[:nb_p], private[nb_p:]
+                self._pool, tok0, _pos0, done0, key_next = self._prefill(
+                    params, lora, jnp.asarray(toks_row[None]),
+                    jnp.asarray(mask_row[None]), jnp.asarray(req.key),
+                    self._pool, jnp.asarray(np.asarray(prompt_blocks,
+                                                       np.int32)),
+                    greedy=greedy,
+                )
+                pending.append((slot, req, tok0, done0, key_next))
+                shared_blocks, dup_private = [], []
+                if self.prefix_cache:
+                    for h, bid in zip(req.hashes[:nb_p], prompt_blocks):
+                        (shared_blocks if self.allocator.register(h, bid)
+                         else dup_private).append(bid)
+                else:  # no cache: prompt blocks are plain private blocks
+                    dup_private = list(prompt_blocks)
+                table[:nb_p] = prompt_blocks
+                table[nb_p:nb_p + n_dec] = dec_blocks
+                self._slot_shared[slot] = shared_blocks
+                self._slot_private[slot] = list(dec_blocks) + dup_private
+                req.emits.append(np.asarray([1], np.int32))
+                req.n_emitted = 1
+                self._lengths[slot] = Pb
+                self._pos[slot] = plen
+                self._step_idx[slot] = 1
+                self._mask[slot] = 0
+                self._mask[slot, :Pb] = mask_row
+            self._tables[slot] = table
+            self._prev_ok[slot] = True
+            self._slot_req[slot] = req
+            self.metrics.counter("serving/requests_total").inc()
+            self.metrics.counter("serving/rows_total").inc()
+        # ONE sync pass over every prefill dispatched above
+        for slot, req, tok0, done0, key_next in pending:
+            tok0 = int(np.asarray(tok0))
+            # TTFT from ARRIVAL (includes queue wait — the SLO the
+            # admission controller sheds on), matching the hit path
+            req.ttft_observed = True
+            self._observe_ttft(time.perf_counter() - req.arrival_s)
+            req.toks.append(np.asarray([tok0], np.int32))
+            self._prev_tok[slot] = tok0
+            self._done[slot] = bool(np.asarray(done0))
+            self._keys[slot] = np.asarray(key_next, np.uint32)
+        for slot in list(range(self.slots)):
+            req = self._slot_req[slot]
+            if req is not None and (self._done[slot]
+                                    or req.n_emitted >= req.max_new):
+                finished.append(self._finish_slot(slot))
+        self.metrics.gauge("serving/slot_occupancy").set(self._occupancy())
+        self.metrics.gauge("serving/free_blocks").set(
+            self.allocator.available())
+        return finished
+
+    def _finish_slot(self, slot: int) -> int:
+        """Assemble the result, release the slot's blocks to the free
+        list / prefix cache, and park the slot."""
+        req = self._slot_req[slot]
+        toks = np.concatenate(req.toks) if req.toks else np.zeros(0, np.int32)
+        emits = (np.concatenate(req.emits) if req.emits
+                 else np.zeros(0, np.int32))
+        N = req.max_new
+        toks, emits = toks[:N], emits[:N].astype(np.int32)
+        if toks.size < N:  # immediate-EOS rows may undershoot the budget
+            toks = np.pad(toks, (0, N - toks.size),
+                          constant_values=self.pad_id)
+            emits = np.pad(emits, (0, N - emits.size))
+        # masked positions are pad (the dense path's post-EOS convention)
+        toks = np.where(emits.astype(bool), toks, self.pad_id).astype(np.int32)
+        self._results[req.ticket] = (toks, emits)
+        self.metrics.counter("serving/tokens_decoded_total").inc(
+            int(emits.sum()))
+        self.allocator.release_shared(self._slot_shared[slot])
+        self.allocator.free(self._slot_private[slot])
+        self._slot_shared[slot] = []
+        self._slot_private[slot] = []
+        self._slot_req[slot] = None
+        self._tables[slot] = 0
+        self._mask[slot] = 0
+        self._lengths[slot] = 0
+        self._prev_tok[slot] = self.pad_id
+        self._prev_ok[slot] = False
+        self._pos[slot] = 0
+        self._step_idx[slot] = 0
+        self._done[slot] = True
+        return req.ticket
+
+    def _check_weight_epoch(self, params, lora) -> None:
+        """Cached prompt KV is a pure function of (weights, chain prefix):
+        a NEW params/lora tree (GRPO swaps the actor adapter every learn
+        step; a server hot-swapping weights) invalidates every cached
+        block. Identity comparison is the contract — callers that mutate a
+        tree in place must call allocator.invalidate_cache() themselves."""
+        if self._weights is not None and (self._weights[0] is params
+                                          and self._weights[1] is lora):
+            return
+        if self._weights is not None and self.prefix_cache:
+            self.allocator.invalidate_cache()
+            self.metrics.counter(
+                "serving/prefix_cache_invalidations_total",
+                help="prefix-cache flushes on weight updates").inc()
+        self._weights = (params, lora)
+
+    def step(self, params, lora=None, greedy: bool = False) -> List[int]:
+        """ONE scheduler iteration: admit into free slots, then run one
+        decode chunk over the pool. Returns tickets finished this step
+        (fetch results with ``result()``)."""
+        self._check_weight_epoch(params, lora)
+        finished = self._admit(params, lora, greedy)
+        if self._occupancy() == 0:
+            if self._queue and not finished:
+                raise RuntimeError(
+                    f"scheduler wedged: {len(self._queue)} queued requests "
+                    f"but none admittable (pool of {self.n_blocks} blocks "
+                    "too small for a single request?)")
+            return finished
+        t0 = time.perf_counter()
+        carry, (toks, emits) = self._decode(
+            params, lora, self._pool, jnp.asarray(self._tables),
+            jnp.asarray(self._mask), jnp.asarray(self._lengths),
+            jnp.asarray(self._prev_tok), jnp.asarray(self._prev_ok),
+            jnp.asarray(self._pos), jnp.asarray(self._step_idx),
+            jnp.asarray(self._done), jnp.asarray(self._keys),
+            greedy=greedy,
+        )
+        (self._pool, _tables, slot_mask, lengths, prev_tok, prev_ok, pos,
+         step_idx, done, keys) = carry
+        toks = np.asarray(toks)
+        emits = np.asarray(emits)
+        dt_chunk = time.perf_counter() - t0
+        # host mirrors for the next chunk — np.array COPIES (np.asarray of a
+        # device array is a read-only view; admissions mutate these in place)
+        self._mask = np.array(slot_mask)
+        self._lengths = np.array(lengths)
+        self._prev_tok = np.array(prev_tok)
+        self._prev_ok = np.array(prev_ok)
+        self._pos = np.array(pos)
+        self._step_idx = np.array(step_idx)
+        self._done = np.array(done)
+        self._keys = np.array(keys)
+        delivered = 0
+        now = time.perf_counter()
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            req.toks.append(toks[slot])
+            req.emits.append(emits[slot])
+            chunk_emitted = int(emits[slot].sum())
+            delivered += min(chunk_emitted, req.max_new - req.n_emitted)
+            req.n_emitted += chunk_emitted
+            if not req.ttft_observed and chunk_emitted:
+                # prefix-hit requests produce their first token here
+                req.ttft_observed = True
+                self._observe_ttft(now - req.arrival_s)
+        if delivered:
+            self.metrics.histogram(
+                "serving/decode_time_per_token_s", buckets=DECODE_BUCKETS,
+                help="decode-chunk wall time / delivered chunk tokens",
+            ).observe(dt_chunk / delivered)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if self._done[slot] or req.n_emitted >= req.max_new:
+                finished.append(self._finish_slot(slot))
+        self.metrics.gauge("serving/slot_occupancy").set(self._occupancy())
+        self.metrics.gauge("serving/free_blocks").set(
+            self.allocator.available())
+        return finished
+
+    def result(self, ticket: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(tokens [max_new], emit mask [max_new]) for a finished ticket
+        (pops it)."""
+        return self._results.pop(ticket)
+
+    def run_until_drained(self, params, lora=None,
+                          greedy: bool = False) -> List[int]:
+        finished: List[int] = []
+        while self._queue or self._occupancy():
+            finished.extend(self.step(params, lora=lora, greedy=greedy))
+        return finished
+
+    def generate(
+        self,
+        sequences: List[Any],
+        key: jax.Array,
+        params,
+        lora=None,
+        greedy: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+        """Batch convenience with the BucketedGenerator.generate contract:
+        (completions [B, max_new_tokens], mask, info). Internally each row
+        is an independent request — rows are admitted/finished per chunk, so
+        a short row's slot is re-used while long rows still decode."""
+        B = len(sequences)
+        if B == 0:
+            raise ValueError(
+                "ContinuousGenerator.generate got an empty sequence list; "
+                "callers should gate batches with fits(n_rows, longest)")
+        # validate EVERY row before enqueueing ANY: a mid-batch submit()
+        # failure would orphan the earlier rows in the queue (served and
+        # leaked by the next caller)
+        lengths = [len(s) for s in sequences]
+        if not self.fits(B, max(lengths)) or min(lengths) == 0:
+            raise ValueError(
+                f"prompt lengths {min(lengths)}..{max(lengths)} outside the "
+                f"bucket grid (1..{self.prompt_buckets[-1]}); check fits() "
+                "and fall back to the dense generate path")
+        hits0 = self.metrics.counter("serving/prefix_cache_hits_total").value
+        tickets = [
+            self.submit(s, key=jax.random.fold_in(key, i), no_shed=True)
+            for i, s in enumerate(sequences)
+        ]
+        self.run_until_drained(params, lora=lora, greedy=greedy)
+        N = self.max_new_tokens
+        comp = np.full((B, N), self.pad_id, np.int32)
+        cmask = np.zeros((B, N), np.int32)
+        for i, t in enumerate(tickets):
+            toks, emits = self.result(t)
+            comp[i, :toks.size] = toks
+            cmask[i, :emits.size] = emits
+        info = {
+            "slots": self.slots,
+            "block_size": self.block_size,
+            "compiled_programs": self.compiled_programs,
+            "prefix_cache_hits": int(self.metrics.counter(
+                "serving/prefix_cache_hits_total").value - hits0),
+            "free_blocks": self.allocator.available(),
+            "max_new_tokens": N,
+        }
+        self.metrics.emit("serving", rows=B, **info)
+        return comp, cmask, info
+
+    def latency_summary(self) -> Dict[str, Any]:
+        """The serving SLO readout: BucketedGenerator's percentiles PLUS the
+        continuous-tier occupancy / shed / queue-wait telemetry."""
+        reg = self.metrics
+        return {
+            "ttft_s": reg.histogram(
+                "serving/ttft_s", buckets=TTFT_BUCKETS).summary(),
+            "decode_time_per_token_s": reg.histogram(
+                "serving/decode_time_per_token_s",
+                buckets=DECODE_BUCKETS).summary(),
+            "queue_wait_s": reg.histogram(
+                "serving/queue_wait_s", buckets=QUEUE_WAIT_BUCKETS).summary(),
+            "queue_depth_rows": reg.histogram(
+                "serving/queue_depth_rows", buckets=QUEUE_BUCKETS).summary(),
+            "requests_total": reg.counter("serving/requests_total").value,
+            "rows_total": reg.counter("serving/rows_total").value,
+            "tokens_decoded_total": reg.counter(
+                "serving/tokens_decoded_total").value,
+            "shed_requests_total": reg.counter(
+                "serving/shed_requests_total").value,
+            "prefix_cache_hits_total": reg.counter(
+                "serving/prefix_cache_hits_total").value,
+            "slot_occupancy": reg.gauge("serving/slot_occupancy").value,
+            "free_blocks": reg.gauge("serving/free_blocks").value,
+        }
+
+    @property
+    def compiled_programs(self) -> int:
+        """Prefill (per prompt bucket) + decode chunk (ONE program) + block
+        copy — bounded by the grid, constant in request count/order (the
+        tier-1 regression test pins this; see measured_cache_size)."""
+        return measured_cache_size(self._prefill, self._decode,
+                                   self._copy_block)

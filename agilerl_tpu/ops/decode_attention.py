@@ -46,9 +46,13 @@ def _dense_reference(q, k_cache, v_cache, valid, start):
         "bthrd,bshd->bhrts", qr, k_cache, preferred_element_type=jnp.float32
     ) / math.sqrt(d)
     slot = jnp.arange(S)
-    causal = slot[None, :] <= (start + jnp.arange(T))[:, None]          # [T, S]
+    # start may be [] (all rows aligned) or [B] (paged slots at
+    # heterogeneous depths) — broadcast to per-row either way
+    start_b = jnp.broadcast_to(jnp.asarray(start), (B,))
+    causal = (slot[None, None, :]
+              <= (start_b[:, None] + jnp.arange(T)[None, :])[:, :, None])  # [B, T, S]
     mask = jnp.logical_and(
-        causal[None, None, None], valid.astype(bool)[:, None, None, None, :]
+        causal[:, None, None], valid.astype(bool)[:, None, None, None, :]
     )
     probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
     out = jnp.einsum(
@@ -90,12 +94,14 @@ def chunked_cached_attention(
     k_cache: jax.Array,  # [B, S, Hkv, d] cache AFTER inserting this step's K
     v_cache: jax.Array,  # [B, S, Hkv, d]
     valid: jax.Array,    # [B, S] 1 = slot holds a real token
-    start,               # scalar: cache length before this step
+    start,               # [] or [B]: cache length before this step — per-row
+    #                      for the paged/continuous decode path, whose slots
+    #                      sit at heterogeneous depths
     *,
     block: int = 512,
 ) -> jax.Array:
     """Returns attention output [B, T, Hq, d] (same visibility rule as the
-    dense path: slot j visible to query t iff j <= start + t and valid[j]).
+    dense path: slot j visible to query t iff j <= start[b] + t and valid[j]).
     Reverse-differentiable: grads route through a dense backward (custom
     VJP) since the dynamic-bound forward loop cannot be transposed."""
     return _make_chunked(min(block, k_cache.shape[1]))(
@@ -112,7 +118,11 @@ def _chunked_impl(q, k_cache, v_cache, valid, start, block):
     qr = q.reshape(B, T, Hkv, rep, d)
     t_ids = jnp.arange(T)
 
-    live = start + T  # number of potentially-visible slots
+    # start: [] or [B] (paged decode slots sit at heterogeneous depths);
+    # the loop bound must cover the DEEPEST row — shallower rows' extra
+    # chunks are fully masked and contribute exact zeros
+    start_b = jnp.broadcast_to(jnp.asarray(start), (B,))
+    live = jnp.max(start_b) + T  # number of potentially-visible slots
     n_chunks = jnp.minimum(
         (live + block - 1) // block, -(-S // block)
     ).astype(jnp.int32)
@@ -137,10 +147,11 @@ def _chunked_impl(q, k_cache, v_cache, valid, start, block):
         ) * scale  # [B, Hkv, rep, T, BK]
 
         slot = off_c + jnp.arange(block)
-        causal = slot[None, :] <= (start + t_ids)[:, None]          # [T, BK]
-        fresh = slot >= off                                          # [BK]
+        causal = (slot[None, None, :]
+                  <= (start_b[:, None] + t_ids[None, :])[:, :, None])  # [B, T, BK]
+        fresh = slot >= off                                            # [BK]
         mask = jnp.logical_and(
-            jnp.logical_and(causal, fresh[None, :])[None, None, None],
+            jnp.logical_and(causal, fresh[None, None, :])[:, None, None],
             vm.astype(bool)[:, None, None, None, :],
         )
         scores = jnp.where(mask, scores, -1e30)

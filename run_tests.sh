@@ -16,6 +16,8 @@
 #   bash run_tests.sh fast       # fast tier only: -m "not slow", sharded
 #   bash run_tests.sh faults     # fault-injection suite only (crash
 #                                # consistency, torn writes, kill+resume)
+#   bash run_tests.sh serving    # serving tier only (bucketed + continuous
+#                                # paged generation, latency telemetry)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -40,6 +42,12 @@ for arg in "$@"; do
       # consistency + the checkpoint round-trips it protects)
       MARKER=(-m "fault_injection")
       SHARDS+=("tests/test_resilience tests/test_utils/test_checkpoint_roundtrip.py")
+      ;;
+    serving)
+      # fast path: the serving tier (greedy paged/dense equivalence,
+      # compile-count regression, admission control, latency telemetry)
+      MARKER=(-m "serving")
+      SHARDS+=("tests/test_llm tests/test_observability/test_serving_latency.py")
       ;;
     *) SHARDS+=("$arg") ;;
   esac

@@ -12,6 +12,8 @@ from agilerl_tpu.llm import model as M
 from agilerl_tpu.llm.generate import generate, left_pad
 from agilerl_tpu.llm.serving import BucketedGenerator
 
+pytestmark = pytest.mark.serving
+
 CFG = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
                   d_model=32, max_seq_len=256, dtype=jnp.float32)
 

@@ -15,6 +15,8 @@ from agilerl_tpu.llm.serving import (
 )
 from agilerl_tpu.observability import MemorySink, MetricsRegistry
 
+pytestmark = pytest.mark.serving
+
 CFG = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
                   d_model=32, max_seq_len=256, dtype=jnp.float32)
 
@@ -44,6 +46,47 @@ def test_generate_emits_latency_histograms_and_event():
     (ev,) = [e for e in reg.sink.events if e["kind"] == "serving"]
     assert ev["rows"] == 3 and ev["prompt_bucket"] == 32
     assert ev["ttft_s"] == info["ttft_s"]
+
+
+def test_final_chunk_decode_telemetry_meters_delivered_tokens(monkeypatch):
+    """ISSUE 7 satellite: the last decode chunk can overshoot
+    max_new_tokens; both serving/decode_time_per_token_s and
+    info["decode_time_per_token_s"] must divide by DELIVERED tokens
+    (min(steps, N) accounting, matching the tokens_decoded_total trim) —
+    the old decode_chunk/steps-1 denominators overstated throughput.
+    Deterministic via a fake perf_counter (+1.0 per call)."""
+    from agilerl_tpu.llm import serving as S
+
+    ticks = {"t": 0.0}
+
+    def fake_perf_counter():
+        ticks["t"] += 1.0
+        return ticks["t"]
+
+    monkeypatch.setattr(S.time, "perf_counter", fake_perf_counter)
+    reg = MetricsRegistry()
+    # max_new=6, chunk=4: chunk 1 delivers 4 tokens, chunk 2 runs 4 steps
+    # but delivers only 1 (steps 5 -> 9, trimmed at 6)
+    gen = BucketedGenerator(CFG, max_new_tokens=6, pad_id=0, eos_id=None,
+                            prompt_buckets=(32,), row_buckets=(8,),
+                            decode_chunk=4, metrics=reg)
+    params = M.init_params(jax.random.PRNGKey(0), CFG)
+    rng = np.random.default_rng(1)
+    seqs = [rng.integers(3, 95, size=10).astype(np.int32) for _ in range(2)]
+    _, _, info = gen.generate(seqs, jax.random.PRNGKey(1), params,
+                              greedy=True)
+    assert info["decode_steps"] == 9  # the overshoot happened
+    h = reg.histogram("serving/decode_time_per_token_s",
+                      buckets=DECODE_BUCKETS)
+    # fake clock: each chunk takes 1.0s -> observations 1/4 and 1/1
+    # (the old accounting observed 1/4 twice)
+    assert h.count == 2
+    assert h.sum == pytest.approx(0.25 + 1.0)
+    # info: 2.0s of decode over min(9, 6) - 1 = 5 delivered decode tokens
+    # (the old accounting divided by steps-1 = 8)
+    assert info["decode_time_per_token_s"] == pytest.approx(2.0 / 5)
+    # delivered-token counter agrees (existing trim, unchanged)
+    assert reg.counter("serving/tokens_decoded_total").value == 2 * 6
 
 
 def test_serving_percentiles_correct_on_deterministic_data():
