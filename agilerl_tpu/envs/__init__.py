@@ -1,8 +1,19 @@
-from agilerl_tpu.envs.classic import CartPole, MountainCar, Pendulum, make
+from agilerl_tpu.envs.classic import (
+    CartPole,
+    MountainCar,
+    MountainCarContinuous,
+    Pendulum,
+    make,
+)
 from agilerl_tpu.envs.core import JaxEnv, JaxVecEnv, rollout_scan
-from agilerl_tpu.envs.multi_agent import MultiAgentJaxVecEnv, SimpleSpreadJax
+from agilerl_tpu.envs.multi_agent import (
+    MultiAgentJaxVecEnv,
+    SimpleSpreadJax,
+    make_ma_autoreset_step,
+)
 
 __all__ = [
     "JaxEnv", "JaxVecEnv", "rollout_scan", "CartPole", "Pendulum", "MountainCar",
-    "make", "SimpleSpreadJax", "MultiAgentJaxVecEnv",
+    "MountainCarContinuous", "make", "SimpleSpreadJax", "MultiAgentJaxVecEnv",
+    "make_ma_autoreset_step",
 ]

@@ -137,6 +137,39 @@ class MountainCar(JaxEnv):
         return new, jnp.stack([position, velocity]), jnp.float32(-1.0), terminated, jnp.bool_(False)
 
 
+class MountainCarContinuous(JaxEnv):
+    """MountainCarContinuous-v0 dynamics (power-scaled Box(1) action, +100
+    goal bonus minus action cost) — gives the scan-resident continuous-control
+    programs (EvoDDPG/EvoTD3) a second JAX-native env next to Pendulum."""
+
+    max_episode_steps = 999
+
+    def __init__(self):
+        self.observation_space = spaces.Box(
+            np.array([-1.2, -0.07], np.float32), np.array([0.6, 0.07], np.float32)
+        )
+        self.action_space = spaces.Box(-1.0, 1.0, (1,), dtype=np.float32)
+
+    def reset_fn(self, key):
+        pos = jax.random.uniform(key, minval=-0.6, maxval=-0.4)
+        state = MountainCarState(pos, jnp.float32(0.0))
+        return state, jnp.stack([pos, jnp.float32(0.0)])
+
+    def step_fn(self, state, action, key):
+        force = jnp.clip(action[0] if action.ndim > 0 else action, -1.0, 1.0)
+        velocity = state.velocity + force * 0.0015 + jnp.cos(3 * state.position) * (
+            -0.0025
+        )
+        velocity = jnp.clip(velocity, -0.07, 0.07)
+        position = jnp.clip(state.position + velocity, -1.2, 0.6)
+        velocity = jnp.where((position <= -1.2) & (velocity < 0), 0.0, velocity)
+        terminated = (position >= 0.45) & (velocity >= 0)
+        reward = jnp.where(terminated, 100.0, 0.0) - 0.1 * force**2
+        new = MountainCarState(position, velocity)
+        return (new, jnp.stack([position, velocity]), reward, terminated,
+                jnp.bool_(False))
+
+
 class VisualCartPole(CartPole):
     """CartPole with an on-device rendered image observation [H, W, 1] —
     exercises the CNN encoder path end-to-end without an Atari dependency
@@ -176,6 +209,7 @@ REGISTRY = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "MountainCar-v0": MountainCar,
+    "MountainCarContinuous-v0": MountainCarContinuous,
     "VisualCartPole-v0": VisualCartPole,
 }
 

@@ -9,12 +9,14 @@ SimpleSpreadJax: N agents on a 2D plane must cover N landmarks; shared reward
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from gymnasium import spaces
+
+from agilerl_tpu.envs.core import VecState
 
 
 class MAState(NamedTuple):
@@ -79,6 +81,63 @@ class SimpleSpreadJax:
         terms = {a: jnp.bool_(False) for a in self.agent_ids}
         truncs = {a: truncated for a in self.agent_ids}
         return new, obs, rewards, terms, truncs
+
+
+def make_ma_autoreset_step(env: "SimpleSpreadJax") -> Callable:
+    """Stacked-array functional step for the scan-resident multi-agent tier.
+
+    Unlike :class:`MultiAgentJaxVecEnv` (the host dict-API wrapper), this
+    returns a pure jitted ``vec_step(vstate, actions) -> (vstate, obs,
+    reward, terminated, truncated, final_obs)`` where actions/observations
+    are **agent-major stacked arrays** ``[A, N, ...]`` (homogeneous agents)
+    and ``reward`` is the shared scalar per env ``[N]`` — the layout
+    ``EvoIPPO`` vmaps its per-agent networks over. Autoreset follows
+    gymnasium semantics (``final_obs`` is the pre-reset true successor)."""
+    ids = env.agent_ids
+    max_steps = env.max_episode_steps or 10**9
+
+    def single_step(state, step_count, actions, key):
+        # actions [A, ...] for one env
+        k_step, k_reset = jax.random.split(key)
+        act_dict = {aid: actions[i] for i, aid in enumerate(ids)}
+        new_state, obs, rew, term, trunc = env.step_fn(state, act_dict, k_step)
+        step_count = step_count + 1
+        terminated = jnp.any(jnp.stack([term[a] for a in ids]))
+        truncated = jnp.logical_or(
+            jnp.any(jnp.stack([trunc[a] for a in ids])),
+            step_count >= max_steps,
+        )
+        done = jnp.logical_or(terminated, truncated)
+        reset_state, reset_obs = env.reset_fn(k_reset)
+        out_state = jax.tree_util.tree_map(
+            lambda r, n: jnp.where(done, r, n), reset_state, new_state
+        )
+        obs_stacked = jnp.stack([obs[a] for a in ids])
+        reset_stacked = jnp.stack([reset_obs[a] for a in ids])
+        out_obs = jnp.where(done, reset_stacked, obs_stacked)
+        out_count = jnp.where(done, 0, step_count)
+        # shared-reward envs: every agent sees the same scalar
+        reward = rew[ids[0]]
+        return (out_state, out_obs, reward, terminated, truncated, out_count,
+                obs_stacked)
+
+    @jax.jit
+    def vec_step(vstate: VecState, actions: jax.Array):
+        key, sub = jax.random.split(vstate.key)
+        n = vstate.step_count.shape[0]
+        keys = jax.random.split(sub, n)
+        acts = jnp.moveaxis(actions, 0, 1)  # [A, N, ...] -> [N, A, ...]
+        new_state, obs, reward, term, trunc, counts, final_obs = jax.vmap(
+            single_step
+        )(vstate.env_state, vstate.step_count, acts, keys)
+        return (
+            VecState(new_state, counts, key),
+            jnp.moveaxis(obs, 0, 1),  # back to [A, N, ...]
+            reward, term, trunc,
+            jnp.moveaxis(final_obs, 0, 1),
+        )
+
+    return vec_step
 
 
 class MultiAgentJaxVecEnv:

@@ -1,3 +1,13 @@
+from agilerl_tpu.parallel.generation import (
+    DeviceReplayRing,
+    ScanMemberState,
+    ScanOffPolicy,
+    ScanRun,
+    gaussian_mutate,
+    make_pod_generation,
+    make_vmap_generation,
+    tournament_select,
+)
 from agilerl_tpu.parallel.mesh import (
     auto_mesh,
     batch_sharding,
@@ -6,11 +16,17 @@ from agilerl_tpu.parallel.mesh import (
     make_mesh,
     shard_params,
 )
+from agilerl_tpu.parallel.multi_agent import EvoIPPO, IPPOMemberState
 from agilerl_tpu.parallel.multihost import barrier, broadcast_seed, init_multihost
+from agilerl_tpu.parallel.off_policy import EvoDDPG, EvoDQN, EvoRainbow, EvoTD3
 from agilerl_tpu.parallel.population import EvoPPO, MemberState
 
 __all__ = [
     "make_mesh", "auto_mesh", "gpt_param_specs", "lora_specs", "shard_params",
     "batch_sharding", "EvoPPO", "MemberState",
+    "EvoDQN", "EvoRainbow", "EvoDDPG", "EvoTD3", "EvoIPPO", "IPPOMemberState",
+    "DeviceReplayRing", "ScanMemberState", "ScanOffPolicy", "ScanRun",
+    "tournament_select", "gaussian_mutate",
+    "make_vmap_generation", "make_pod_generation",
     "init_multihost", "broadcast_seed", "barrier",
 ]

@@ -1,267 +1,435 @@
-"""Fully-on-device evolutionary DQN: env stepping, replay, TD learning, and
-evolution in ONE jitted SPMD program (the off-policy sibling of
-population.EvoPPO; SURVEY.md §7 step 4's 'both hot loops collapse into one
-jitted scan' taken to the population level).
+"""Scan-resident off-policy algorithm cores on the generation engine.
 
-Per member: a device-resident ring replay buffer; each scan tick = one
-vectorised env step + one TD update on a uniformly sampled batch (gated until
-the buffer has warmup data). vmap over members on one chip; shard_map one
-member per device on a pod.
+Every class here is a :class:`~agilerl_tpu.parallel.generation.ScanOffPolicy`
+program: env stepping, the replay ring, TD learning, target updates and
+evolution all inside ONE jitted SPMD program (vmapped on a chip,
+shard_mapped one-member-per-device on a pod — the `make_pod_generation`
+contract). The TD/critic math mirrors the interop tier's train cores
+(``algorithms/dqn.py`` / ``dqn_rainbow.py`` / ``ddpg.py`` / ``td3.py``)
+op-for-op — the cross-tier loss-equivalence gate in
+``tests/test_parallel/test_cross_tier.py`` holds DQN and DDPG to it.
+
+- :class:`EvoDQN` — upgraded: optional double-DQN, PER, sample-time n-step
+  fold, and either polyak (``tau``) or hard (``target_every`` learns) target
+  cadence.
+- :class:`EvoRainbow` — C51 distributional + double selection + noisy-net
+  exploration + PER + n-step (reuses ``categorical_projection``).
+- :class:`EvoDDPG` — continuous control (Pendulum / MountainCarContinuous):
+  deterministic tanh actor, Q(s,a) critic, ``policy_freq``-delayed actor.
+- :class:`EvoTD3` — twin critics, target-policy smoothing, delayed actor +
+  delayed target updates.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
-
-import functools
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
-from agilerl_tpu.envs.core import JaxEnv, VecState, make_autoreset_step
+from agilerl_tpu.algorithms.dqn_rainbow import categorical_projection
+from agilerl_tpu.envs.core import JaxEnv
+from agilerl_tpu.networks.actors import DeterministicActor
 from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.networks.q_networks import ContinuousQNetwork, RainbowQNetwork
+from agilerl_tpu.parallel.generation import ScanOffPolicy
+from agilerl_tpu.utils.spaces import preprocess_observation
 
 
-class DQNMemberState(NamedTuple):
+def _polyak(target, params, tau):
+    return jax.tree_util.tree_map(
+        lambda t, p: (1.0 - tau) * t + tau * p, target, params
+    )
+
+
+# --------------------------------------------------------------------------- #
+# DQN
+# --------------------------------------------------------------------------- #
+
+
+class DQNLearner(NamedTuple):
     params: Any
     target: Any
     opt_state: Any
-    buf_obs: jax.Array  # [C, obs_dim]
-    buf_action: jax.Array  # [C]
-    buf_reward: jax.Array
-    buf_next_obs: jax.Array
-    buf_done: jax.Array
-    buf_pos: jax.Array  # [] int32
-    buf_size: jax.Array
-    env_state: Any
-    obs: jax.Array
-    ep_ret: jax.Array  # [num_envs] running episode return (spans iterations)
-    epsilon: jax.Array
-    key: jax.Array
 
 
-class EvoDQN:
-    def __init__(
-        self,
-        env: JaxEnv,
-        net_config,
-        tx=None,
-        num_envs: int = 64,
-        steps_per_iter: int = 128,
-        buffer_size: int = 10_000,
-        batch_size: int = 64,
-        gamma: float = 0.99,
-        tau: float = 0.01,
-        learn_every: int = 1,
-        eps_decay: float = 0.999,
-        eps_end: float = 0.05,
-        elitism: bool = True,
-        tournament_size: int = 2,
-        mutation_sd: float = 0.02,
-        mutation_prob: float = 0.5,
-    ):
-        self.env = env
+class EvoDQN(ScanOffPolicy):
+    """Fully-on-device evolutionary DQN (the upgraded off-policy flagship):
+    eps-greedy acting, ring replay (uniform or PER), 1-step or n-step TD,
+    polyak or hard target cadence."""
+
+    _mutate_fields = ("params",)
+
+    def __init__(self, env: JaxEnv, net_config, tx=None, *, double: bool = False,
+                 **kwargs):
         self.net_config = net_config
-        self.tx = tx or optax.adam(1e-3)
-        self.num_envs = num_envs
-        self.steps_per_iter = steps_per_iter
-        self.buffer_size = buffer_size
-        self.batch_size = batch_size
-        self.gamma = gamma
-        self.tau = tau
-        self.learn_every = learn_every
-        self.eps_decay = eps_decay
-        self.eps_end = eps_end
-        self.elitism = elitism
-        self.tournament_size = tournament_size
-        self.mutation_sd = mutation_sd
-        self.mutation_prob = mutation_prob
-        self._vec_step = make_autoreset_step(env)
-        self._reset = jax.vmap(env.reset_fn)
-        self.obs_dim = int(np.prod(env.observation_space.shape))
+        self.double = bool(double)
+        super().__init__(env, tx or optax.adam(1e-3), **kwargs)
         self.num_actions = int(env.action_space.n)
 
-    # ------------------------------------------------------------------ #
-    def init_member(self, key: jax.Array) -> DQNMemberState:
-        k1, k2, k3 = jax.random.split(key, 3)
-        params = EvolvableNetwork.init_params(k1, self.net_config)
+    def _action_example(self) -> jax.Array:
+        return jnp.zeros((), jnp.int32)
+
+    def _init_learner(self, key: jax.Array) -> DQNLearner:
+        params = EvolvableNetwork.init_params(key, self.net_config)
         target = jax.tree_util.tree_map(jnp.copy, params)
-        opt_state = self.tx.init(params)
-        env_state, obs = self._reset(jax.random.split(k2, self.num_envs))
-        C = self.buffer_size
-        return DQNMemberState(
-            params=params, target=target, opt_state=opt_state,
-            buf_obs=jnp.zeros((C, self.obs_dim)),
-            buf_action=jnp.zeros((C,), jnp.int32),
-            buf_reward=jnp.zeros((C,)),
-            buf_next_obs=jnp.zeros((C, self.obs_dim)),
-            buf_done=jnp.zeros((C,)),
-            buf_pos=jnp.zeros((), jnp.int32),
-            buf_size=jnp.zeros((), jnp.int32),
-            env_state=VecState(env_state, jnp.zeros(self.num_envs, jnp.int32), k3),
-            obs=obs, ep_ret=jnp.zeros(self.num_envs), epsilon=jnp.float32(1.0),
-            key=key,
-        )
+        return DQNLearner(params, target, self.tx.init(params))
 
-    def init_population(self, key: jax.Array, pop_size: int) -> DQNMemberState:
-        return jax.vmap(self.init_member)(jax.random.split(key, pop_size))
+    def _act(self, learner: DQNLearner, obs, epsilon, key):
+        q = EvolvableNetwork.apply(self.net_config, learner.params, obs)
+        greedy = jnp.argmax(q, axis=-1)
+        kx, ku = jax.random.split(key)
+        rand = jax.random.randint(ku, greedy.shape, 0, self.num_actions)
+        explore = jax.random.uniform(kx, greedy.shape) < epsilon
+        return jnp.where(explore, rand, greedy).astype(jnp.int32)
 
-    # ------------------------------------------------------------------ #
-    def member_iteration(self, s: DQNMemberState) -> Tuple[DQNMemberState, jax.Array]:
+    def _learn(self, learner: DQNLearner, batch, n_batch, weights, key, learn_count):
         cfg = self.net_config
-        C, N = self.buffer_size, self.num_envs
+        # n-step: folded reward + bootstrap gamma**steps at the last alive
+        # row (obs/action stay the window-start rows)
+        obs, reward, done, next_obs, gamma_n = self._td_fields(batch, n_batch)
+        action = batch["action"].astype(jnp.int32)
 
-        def tick(carry, _):
-            s, ep_ret, fsum, fn = carry
-            key, k_act, k_samp = jax.random.split(s.key, 3)
-            # eps-greedy act
-            q = EvolvableNetwork.apply(cfg, s.params, s.obs)
-            greedy = jnp.argmax(q, axis=-1)
-            rand = jax.random.randint(k_act, greedy.shape, 0, self.num_actions)
-            explore = jax.random.uniform(jax.random.fold_in(k_act, 1), greedy.shape)
-            action = jnp.where(explore < s.epsilon, rand, greedy)
-            vstate, next_obs, reward, term, trunc, final_obs = self._vec_step(s.env_state, action)
-            done = jnp.logical_or(term, trunc).astype(jnp.float32)
-
-            # ring-buffer write (N rows per tick)
-            idx = (s.buf_pos + jnp.arange(N)) % C
-            buf_obs = s.buf_obs.at[idx].set(s.obs)
-            buf_action = s.buf_action.at[idx].set(action.astype(jnp.int32))
-            buf_reward = s.buf_reward.at[idx].set(reward)
-            buf_next = s.buf_next_obs.at[idx].set(final_obs)  # true successor, pre-autoreset
-            buf_done = s.buf_done.at[idx].set(term.astype(jnp.float32))
-            pos = (s.buf_pos + N) % C
-            size = jnp.minimum(s.buf_size + N, C)
-
-            # TD update on a uniform batch (identity update until warm)
-            bidx = jax.random.randint(k_samp, (self.batch_size,), 0,
-                                      jnp.maximum(size, 1))
-            b_obs, b_act = buf_obs[bidx], buf_action[bidx]
-            b_rew, b_next, b_done = buf_reward[bidx], buf_next[bidx], buf_done[bidx]
-            q_next = EvolvableNetwork.apply(cfg, s.target, b_next)
-            tgt = b_rew + self.gamma * (1 - b_done) * jnp.max(q_next, axis=-1)
-
-            def loss_fn(p):
-                qv = EvolvableNetwork.apply(cfg, p, b_obs)
-                qa = jnp.take_along_axis(qv, b_act[:, None], axis=-1)[:, 0]
-                return jnp.mean(jnp.square(qa - tgt))
-
-            warm = size >= self.batch_size
-            loss, grads = jax.value_and_grad(loss_fn)(s.params)
-            grads = jax.tree_util.tree_map(
-                lambda g: jnp.where(warm, g, jnp.zeros_like(g)), grads
+        q_next_t = EvolvableNetwork.apply(cfg, learner.target, next_obs)
+        if self.double:
+            next_a = jnp.argmax(
+                EvolvableNetwork.apply(cfg, learner.params, next_obs), axis=-1
             )
-            updates, opt_state = self.tx.update(grads, s.opt_state, s.params)
-            params = optax.apply_updates(s.params, updates)
-            target = jax.tree_util.tree_map(
-                lambda t, p: (1 - self.tau) * t + self.tau * p, s.target, params
-            )
+            q_next = jnp.take_along_axis(q_next_t, next_a[..., None], axis=-1)[..., 0]
+        else:
+            q_next = jnp.max(q_next_t, axis=-1)
+        target = reward + gamma_n * (1.0 - done) * q_next
 
-            ep_ret = ep_ret + reward
-            fsum = fsum + jnp.sum(ep_ret * done)
-            fn = fn + jnp.sum(done)
-            ep_ret = ep_ret * (1 - done)
-            s = s._replace(
-                params=params, target=target, opt_state=opt_state,
-                buf_obs=buf_obs, buf_action=buf_action, buf_reward=buf_reward,
-                buf_next_obs=buf_next, buf_done=buf_done, buf_pos=pos,
-                buf_size=size, env_state=vstate, obs=next_obs,
-                epsilon=jnp.maximum(s.epsilon * self.eps_decay, self.eps_end),
-                key=key,
-            )
-            return (s, ep_ret, fsum, fn), None
+        def loss_fn(p):
+            q = EvolvableNetwork.apply(cfg, p, obs)
+            q_sel = jnp.take_along_axis(q, action[..., None], axis=-1)[..., 0]
+            td = q_sel - jax.lax.stop_gradient(target)
+            return jnp.mean(weights * jnp.square(td)), jnp.abs(td)
 
-        zero = 0.0 * jnp.sum(s.obs.astype(jnp.float32))
-        # carry the running episode return across iterations (review finding)
-        (s, ep_ret, fsum, fn), _ = jax.lax.scan(
-            tick, (s, s.ep_ret + zero, zero, zero), None,
-            length=self.steps_per_iter,
+        (loss, td_abs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            learner.params
         )
-        s = s._replace(ep_ret=ep_ret)
-        fitness = jnp.where(fn > 0, fsum / jnp.maximum(fn, 1.0), zero)
-        return s, fitness
+        updates, opt_state = self.tx.update(grads, learner.opt_state, learner.params)
+        params = optax.apply_updates(learner.params, updates)
+        tparams = self._update_target(learner.target, params, learn_count)
+        return DQNLearner(params, tparams, opt_state), loss, td_abs
 
-    # ------------------------------------------------------------------ #
-    def evolve(self, pop: DQNMemberState, fitness: jax.Array, key: jax.Array):
-        P = fitness.shape[0]
-        k_t, k_m, k_sel = jax.random.split(key, 3)
-        entrants = jax.random.randint(k_t, (P, self.tournament_size), 0, P)
-        winners = entrants[jnp.arange(P), jnp.argmax(fitness[entrants], axis=1)]
-        if self.elitism:
-            winners = winners.at[0].set(jnp.argmax(fitness))
 
-        def gather(x):
-            return x[winners]
+# --------------------------------------------------------------------------- #
+# Rainbow (C51 + double + noisy + PER + n-step)
+# --------------------------------------------------------------------------- #
 
-        new_params = jax.tree_util.tree_map(gather, pop.params)
-        new_target = jax.tree_util.tree_map(gather, pop.target)
-        new_opt = jax.tree_util.tree_map(gather, pop.opt_state)
-        # param mutation on non-elite members
-        do_mut = (jax.random.uniform(k_sel, (P,)) < self.mutation_prob).astype(jnp.float32)
-        if self.elitism:
-            do_mut = do_mut.at[0].set(0.0)
-        keys = jax.random.split(k_m, P)
 
-        def mutate(params, k, do):
-            leaves, treedef = jax.tree_util.tree_flatten(params)
-            ks = jax.random.split(k, len(leaves))
-            return jax.tree_util.tree_unflatten(
-                treedef,
-                [l + do * self.mutation_sd * jax.random.normal(kk, l.shape)
-                 for l, kk in zip(leaves, ks)],
+class EvoRainbow(ScanOffPolicy):
+    """Scan-resident Rainbow: noisy-net exploration (fresh noise per act and
+    per loss pass), double-selected C51 projection, combined 1-step + n-step
+    elementwise loss, PER priorities = elementwise loss (the interop
+    RainbowDQN recipe, inside one scan tick)."""
+
+    _mutate_fields = ("params",)
+
+    def __init__(self, env: JaxEnv, net_config, tx=None, **kwargs):
+        self.net_config = net_config  # a RainbowConfig
+        kwargs.setdefault("per", True)
+        kwargs.setdefault("n_step", 3)
+        super().__init__(env, tx or optax.adam(1e-4), **kwargs)
+        self.num_actions = int(env.action_space.n)
+
+    def _action_example(self) -> jax.Array:
+        return jnp.zeros((), jnp.int32)
+
+    def _init_learner(self, key: jax.Array) -> DQNLearner:
+        params = RainbowQNetwork.init_params(key, self.net_config)
+        target = jax.tree_util.tree_map(jnp.copy, params)
+        return DQNLearner(params, target, self.tx.init(params))
+
+    def _act(self, learner: DQNLearner, obs, epsilon, key):
+        q = RainbowQNetwork.apply(self.net_config, learner.params, obs, key=key)
+        return jnp.argmax(q, axis=-1).astype(jnp.int32)
+
+    def _elementwise(self, params, tparams, obs, action, reward, done, next_obs,
+                     gamma, key):
+        cfg = self.net_config
+        support = jnp.linspace(cfg.v_min, cfg.v_max, cfg.num_atoms)
+        k1, k2, k3 = jax.random.split(key, 3)
+        q_online_next = RainbowQNetwork.apply(cfg, params, next_obs, key=k1)
+        next_action = jnp.argmax(q_online_next, axis=-1)
+        logp_target = RainbowQNetwork.apply_dist(cfg, tparams, next_obs, key=k2)
+        next_dist = jnp.exp(logp_target)[
+            jnp.arange(next_action.shape[0]), next_action
+        ]
+        proj = categorical_projection(
+            next_dist, reward, done, gamma, support, cfg.v_min, cfg.v_max
+        )
+        logp = RainbowQNetwork.apply_dist(cfg, params, obs, key=k3)
+        logp_a = logp[jnp.arange(action.shape[0]), action]
+        return -jnp.sum(jax.lax.stop_gradient(proj) * logp_a, axis=-1)
+
+    def _learn(self, learner: DQNLearner, batch, n_batch, weights, key, learn_count):
+        obs = preprocess_observation(self.obs_space, batch["obs"])
+        action = batch["action"].astype(jnp.int32)
+        reward = batch["reward"].astype(jnp.float32)
+        done = batch["done"].astype(jnp.float32)
+        next_obs = preprocess_observation(self.obs_space, batch["next_obs"])
+        k1, k2 = jax.random.split(key)
+
+        def loss_fn(p):
+            elementwise = self._elementwise(
+                p, learner.target, obs, action, reward, done, next_obs,
+                jnp.float32(self.gamma), k1,
+            )
+            if n_batch is not None:
+                n_next = preprocess_observation(self.obs_space, n_batch["next_obs"])
+                # per-sample effective discount: clipped windows bootstrap
+                # with gamma**steps_actually_folded
+                gamma_n = (jnp.float32(self.gamma) ** n_batch["steps"])[:, None]
+                elementwise = elementwise + self._elementwise(
+                    p, learner.target, obs, action, n_batch["reward"],
+                    n_batch["done"], n_next, gamma_n, k2,
+                )
+            return jnp.mean(elementwise * weights), elementwise
+
+        (loss, elementwise), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            learner.params
+        )
+        updates, opt_state = self.tx.update(grads, learner.opt_state, learner.params)
+        params = optax.apply_updates(learner.params, updates)
+        tparams = self._update_target(learner.target, params, learn_count)
+        return (
+            DQNLearner(params, tparams, opt_state),
+            loss,
+            jax.lax.stop_gradient(elementwise),
+        )
+
+
+# --------------------------------------------------------------------------- #
+# DDPG / TD3 (continuous control)
+# --------------------------------------------------------------------------- #
+
+
+class DDPGLearner(NamedTuple):
+    actor: Any
+    actor_target: Any
+    critic: Any
+    critic_target: Any
+    actor_opt: Any
+    critic_opt: Any
+
+
+class EvoDDPG(ScanOffPolicy):
+    """Scan-resident DDPG over the JAX-native continuous envs (Pendulum,
+    MountainCarContinuous): deterministic tanh actor + Q(s,a) critic,
+    Gaussian exploration noise, ``policy_freq``-delayed actor updates —
+    the same critic/actor cores as ``algorithms/ddpg.py``."""
+
+    _mutate_fields = ("actor",)
+
+    def __init__(self, env: JaxEnv, actor_config, critic_config,
+                 tx_actor=None, tx_critic=None, *,
+                 expl_noise: float = 0.1, policy_freq: int = 2, **kwargs):
+        self.actor_config = actor_config
+        self.critic_config = critic_config
+        self.tx_actor = tx_actor or optax.adam(1e-4)
+        self.tx_critic = tx_critic or optax.adam(1e-3)
+        self.expl_noise = float(expl_noise)
+        self.policy_freq = int(policy_freq)
+        kwargs.setdefault("per", False)
+        assert not kwargs["per"], (
+            "EvoDDPG/EvoTD3 are uniform-replay only (no priority output), "
+            "matching the interop learn contract"
+        )
+        super().__init__(env, None, **kwargs)
+        self.action_low = jnp.asarray(env.action_space.low, jnp.float32)
+        self.action_high = jnp.asarray(env.action_space.high, jnp.float32)
+        self.action_dim = int(np.prod(env.action_space.shape))
+
+    def _action_example(self) -> jax.Array:
+        return jnp.zeros((self.action_dim,), jnp.float32)
+
+    def _init_learner(self, key: jax.Array) -> DDPGLearner:
+        k1, k2 = jax.random.split(key)
+        actor = EvolvableNetwork.init_params(k1, self.actor_config)
+        critic = EvolvableNetwork.init_params(k2, self.critic_config)
+        return DDPGLearner(
+            actor=actor,
+            actor_target=jax.tree_util.tree_map(jnp.copy, actor),
+            critic=critic,
+            critic_target=jax.tree_util.tree_map(jnp.copy, critic),
+            actor_opt=self.tx_actor.init(actor),
+            critic_opt=self.tx_critic.init(critic),
+        )
+
+    def _policy(self, params, obs):
+        raw = EvolvableNetwork.apply(self.actor_config, params, obs)
+        return DeterministicActor.rescale(raw, self.action_low, self.action_high)
+
+    def _act(self, learner: DDPGLearner, obs, epsilon, key):
+        action = self._policy(learner.actor, obs)
+        noise = self.expl_noise * jax.random.normal(key, action.shape)
+        return jnp.clip(action + noise, self.action_low, self.action_high)
+
+    def _critic_step(self, learner: DDPGLearner, obs, action, reward, done,
+                     next_obs, gamma_n, key):
+        c_cfg = self.critic_config
+        next_action = self._policy(learner.actor_target, next_obs)
+        q_next = ContinuousQNetwork.apply(
+            c_cfg, learner.critic_target, next_obs, action=next_action
+        )
+        target = reward + gamma_n * (1.0 - done) * q_next
+
+        def loss_fn(p):
+            q = ContinuousQNetwork.apply(c_cfg, p, obs, action=action)
+            return jnp.mean(jnp.square(q - jax.lax.stop_gradient(target)))
+
+        loss, grads = jax.value_and_grad(loss_fn)(learner.critic)
+        updates, c_opt = self.tx_critic.update(
+            grads, learner.critic_opt, learner.critic
+        )
+        critic = optax.apply_updates(learner.critic, updates)
+        c_target = _polyak(learner.critic_target, critic, self.tau)
+        return learner._replace(
+            critic=critic, critic_target=c_target, critic_opt=c_opt
+        ), loss
+
+    def _actor_step(self, learner: DDPGLearner, obs):
+        c_cfg = self.critic_config
+
+        def loss_fn(p):
+            action = self._policy(p, obs)
+            q = ContinuousQNetwork.apply(c_cfg, learner.critic, obs, action=action)
+            return -jnp.mean(q)
+
+        _, grads = jax.value_and_grad(loss_fn)(learner.actor)
+        updates, a_opt = self.tx_actor.update(grads, learner.actor_opt, learner.actor)
+        actor = optax.apply_updates(learner.actor, updates)
+        a_target = _polyak(learner.actor_target, actor, self.tau)
+        return learner._replace(
+            actor=actor, actor_target=a_target, actor_opt=a_opt
+        )
+
+    def _batch_fields(self, batch, n_batch):
+        obs, reward, done, next_obs, gamma_n = self._td_fields(batch, n_batch)
+        action = batch["action"].astype(jnp.float32)
+        return obs, action, reward, done, next_obs, gamma_n
+
+    def _learn(self, learner: DDPGLearner, batch, n_batch, weights, key, learn_count):
+        obs, action, reward, done, next_obs, gamma_n = self._batch_fields(
+            batch, n_batch
+        )
+        learner, closs = self._critic_step(
+            learner, obs, action, reward, done, next_obs, gamma_n, key
+        )
+        do_actor = (learn_count % self.policy_freq) == 0
+        learner = jax.lax.cond(
+            do_actor, lambda l: self._actor_step(l, obs), lambda l: l, learner
+        )
+        return learner, closs, jnp.abs(closs) * jnp.ones_like(reward)
+
+
+class TD3Learner(NamedTuple):
+    actor: Any
+    actor_target: Any
+    critic_1: Any
+    critic_1_target: Any
+    critic_2: Any
+    critic_2_target: Any
+    actor_opt: Any
+    critic_1_opt: Any
+    critic_2_opt: Any
+
+
+class EvoTD3(EvoDDPG):
+    """Scan-resident TD3: twin critics + target-policy smoothing + delayed
+    actor AND delayed target updates (all targets move only on the policy
+    cadence — the ``algorithms/td3.py`` core inside the scan tick)."""
+
+    _mutate_fields = ("actor",)
+
+    def __init__(self, env: JaxEnv, actor_config, critic_config, *args,
+                 policy_noise: float = 0.2, noise_clip: float = 0.5, **kwargs):
+        self.policy_noise = float(policy_noise)
+        self.noise_clip = float(noise_clip)
+        super().__init__(env, actor_config, critic_config, *args, **kwargs)
+
+    def _init_learner(self, key: jax.Array) -> TD3Learner:
+        k1, k2, k3 = jax.random.split(key, 3)
+        actor = EvolvableNetwork.init_params(k1, self.actor_config)
+        c1 = EvolvableNetwork.init_params(k2, self.critic_config)
+        c2 = EvolvableNetwork.init_params(k3, self.critic_config)
+        return TD3Learner(
+            actor=actor,
+            actor_target=jax.tree_util.tree_map(jnp.copy, actor),
+            critic_1=c1,
+            critic_1_target=jax.tree_util.tree_map(jnp.copy, c1),
+            critic_2=c2,
+            critic_2_target=jax.tree_util.tree_map(jnp.copy, c2),
+            actor_opt=self.tx_actor.init(actor),
+            critic_1_opt=self.tx_critic.init(c1),
+            critic_2_opt=self.tx_critic.init(c2),
+        )
+
+    def _learn(self, learner: TD3Learner, batch, n_batch, weights, key, learn_count):
+        c_cfg = self.critic_config
+        obs, action, reward, done, next_obs, gamma_n = self._batch_fields(
+            batch, n_batch
+        )
+        do_actor = (learn_count % self.policy_freq) == 0
+
+        next_action = self._policy(learner.actor_target, next_obs)
+        noise = jnp.clip(
+            self.policy_noise * jax.random.normal(key, next_action.shape),
+            -self.noise_clip, self.noise_clip,
+        )
+        next_action = jnp.clip(
+            next_action + noise, self.action_low, self.action_high
+        )
+        q1n = ContinuousQNetwork.apply(
+            c_cfg, learner.critic_1_target, next_obs, action=next_action
+        )
+        q2n = ContinuousQNetwork.apply(
+            c_cfg, learner.critic_2_target, next_obs, action=next_action
+        )
+        target = jax.lax.stop_gradient(
+            reward + gamma_n * (1.0 - done) * jnp.minimum(q1n, q2n)
+        )
+
+        def critic_loss(p):
+            return jnp.mean(jnp.square(
+                ContinuousQNetwork.apply(c_cfg, p, obs, action=action) - target
+            ))
+
+        l1, g1 = jax.value_and_grad(critic_loss)(learner.critic_1)
+        l2, g2 = jax.value_and_grad(critic_loss)(learner.critic_2)
+        u1, o1 = self.tx_critic.update(g1, learner.critic_1_opt, learner.critic_1)
+        c1 = optax.apply_updates(learner.critic_1, u1)
+        u2, o2 = self.tx_critic.update(g2, learner.critic_2_opt, learner.critic_2)
+        c2 = optax.apply_updates(learner.critic_2, u2)
+        # TD3 delays ALL target updates to the policy cadence
+        eff_tau = jnp.where(do_actor, jnp.float32(self.tau), 0.0)
+        c1t = _polyak(learner.critic_1_target, c1, eff_tau)
+        c2t = _polyak(learner.critic_2_target, c2, eff_tau)
+        learner = learner._replace(
+            critic_1=c1, critic_1_target=c1t, critic_1_opt=o1,
+            critic_2=c2, critic_2_target=c2t, critic_2_opt=o2,
+        )
+
+        def run_actor(l):
+            def loss_fn(p):
+                a = self._policy(p, obs)
+                q = ContinuousQNetwork.apply(c_cfg, l.critic_1, obs, action=a)
+                return -jnp.mean(q)
+
+            _, grads = jax.value_and_grad(loss_fn)(l.actor)
+            updates, a_opt = self.tx_actor.update(grads, l.actor_opt, l.actor)
+            actor = optax.apply_updates(l.actor, updates)
+            return l._replace(
+                actor=actor,
+                actor_target=_polyak(l.actor_target, actor, self.tau),
+                actor_opt=a_opt,
             )
 
-        new_params = jax.vmap(mutate)(new_params, keys, do_mut)
-        return pop._replace(params=new_params, target=new_target, opt_state=new_opt)
-
-    def make_vmap_generation(self) -> Callable:
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def generation(pop: DQNMemberState, key: jax.Array):
-            pop, fitness = jax.vmap(self.member_iteration)(pop)
-            pop = self.evolve(pop, fitness, key)
-            return pop, fitness
-
-        return generation
-
-    def make_pod_generation(self, mesh) -> Callable:
-        """Pod-sharded generation: the population shards over the 'pop' mesh
-        axis (any number of members per device); training runs locally, then
-        fitness + member params all-gather over ICI and evolution runs
-        replicated-deterministically on every device (same key -> same
-        tournament, no rank-0 broadcast; parity contrast: hpo/tournament.py:161
-        broadcast_object_list)."""
-        from agilerl_tpu.compat import shard_map
-        from jax.sharding import PartitionSpec as P
-
-        assert "pop" in mesh.axis_names
-
-        def gen(pop: DQNMemberState, key: jax.Array):
-            def per_device(pop_local, key):
-                pop_local, fit_local = jax.vmap(self.member_iteration)(pop_local)
-                fit_all = jax.lax.all_gather(fit_local, "pop", tiled=True)
-                gathered = jax.tree_util.tree_map(
-                    lambda x: jax.lax.all_gather(x, "pop", tiled=True), pop_local
-                )
-                new_pop = self.evolve(gathered, fit_all, key)
-                n_local = jax.tree_util.tree_leaves(pop_local)[0].shape[0]
-                my = jax.lax.axis_index("pop")
-                mine = jax.tree_util.tree_map(
-                    lambda x: jax.lax.dynamic_slice_in_dim(
-                        x, my * n_local, n_local
-                    ),
-                    new_pop,
-                )
-                return mine, fit_all
-
-            specs = P("pop")
-            return shard_map(
-                per_device,
-                mesh=mesh,
-                in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-                out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-                check_vma=False,
-            )(pop, key)
-
-        return jax.jit(gen, donate_argnums=(0,))
+        learner = jax.lax.cond(do_actor, run_actor, lambda l: l, learner)
+        closs = l1 + l2
+        return learner, closs, jnp.abs(closs) * jnp.ones_like(reward)

@@ -16,18 +16,21 @@ pod — same member_iteration function.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from agilerl_tpu.compat import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from agilerl_tpu.envs.core import JaxEnv, VecState, make_autoreset_step
 from agilerl_tpu.networks import distributions as D
 from agilerl_tpu.networks.base import EvolvableNetwork
+from agilerl_tpu.parallel.generation import (
+    evolve_actor_critic,
+    make_pod_generation,
+    make_vmap_generation,
+)
 
 
 class MemberState(NamedTuple):
@@ -228,46 +231,31 @@ class EvoPPO:
         return MemberState(actor, critic, opt_state, vstate, obs, ep_ret, key), fitness
 
     # ------------------------------------------------------------------ #
+    def _evolve_extracted(self, extracted, fitness: jax.Array, key: jax.Array):
+        """Tournament + mutation over exactly the subtrees evolution needs
+        (actor, critic, optimizer state) — the shared generation-engine
+        step, same key-split order as before the refactor."""
+        return evolve_actor_critic(
+            extracted, fitness, key,
+            tournament_size=self.tournament_size, elitism=self.elitism,
+            mutation_prob=self.mutation_prob, mutation_sd=self.mutation_sd,
+        )
+
     def evolve(self, pop: MemberState, fitness: jax.Array, key: jax.Array) -> MemberState:
         """Deterministic tournament + parameter mutation as pure array ops.
         pop leaves have leading pop axis; fitness [P]. Same key on every host
-        => same winners everywhere (replaces rank-0 + broadcast)."""
-        P_ = fitness.shape[0]
-        k_t, k_m, k_sel = jax.random.split(key, 3)
-        entrants = jax.random.randint(
-            k_t, (P_, self.tournament_size), 0, P_
-        )  # [P, k]
-        winners = entrants[jnp.arange(P_), jnp.argmax(fitness[entrants], axis=1)]
-        if self.elitism:
-            winners = winners.at[0].set(jnp.argmax(fitness))
+        => same winners everywhere (replaces rank-0 + broadcast).
 
-        def gather(x):
-            return x[winners]
-
-        new_actor = jax.tree_util.tree_map(gather, pop.actor)
-        new_critic = jax.tree_util.tree_map(gather, pop.critic)
-        new_opt = jax.tree_util.tree_map(gather, pop.opt_state)
-
-        # parameter mutation on a random subset of members (never the elite)
-        mutate_keys = jax.random.split(k_m, P_)
-
-        def mutate_member(params, k, do):
-            leaves, treedef = jax.tree_util.tree_flatten(params)
-            ks = jax.random.split(k, len(leaves))
-            out = [
-                l + do * self.mutation_sd * jax.random.normal(kk, l.shape)
-                for l, kk in zip(leaves, ks)
-            ]
-            return jax.tree_util.tree_unflatten(treedef, out)
-
-        do_mut = (
-            jax.random.uniform(k_sel, (P_,)) < self.mutation_prob
-        ).astype(jnp.float32)
-        if self.elitism:
-            do_mut = do_mut.at[0].set(0.0)
-        new_actor = jax.vmap(mutate_member)(new_actor, mutate_keys, do_mut)
+        NOTE: unlike the off-policy scan tier, EvoPPO carries ``ep_ret``
+        across the boundary — its fitness window (one rollout) is far
+        shorter than an episode, so segmenting would cap measurable returns
+        at ``rollout_len``. The scan-resident off-policy/multi-agent
+        programs segment instead (see generation.ScanOffPolicy.evolve)."""
+        actor, critic, opt_state = self._evolve_extracted(
+            (pop.actor, pop.critic, pop.opt_state), fitness, key
+        )
         return MemberState(
-            new_actor, new_critic, new_opt, pop.env_state, pop.obs,
+            actor, critic, opt_state, pop.env_state, pop.obs,
             pop.ep_ret, pop.key
         )
 
@@ -278,45 +266,19 @@ class EvoPPO:
         ``pop, fitness = gen(pop, key)`` pattern, and the dead input copy
         would otherwise cost a full parameter+optimizer+buffer memcpy per
         generation (measurable on the HBM/memory-bound hot loop)."""
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def generation(pop: MemberState, key: jax.Array):
-            pop, fitness = jax.vmap(self.member_iteration)(pop)
-            pop = self.evolve(pop, fitness, key)
-            return pop, fitness
-
-        return generation
+        return make_vmap_generation(self.member_iteration, self.evolve)
 
     def make_pod_generation(self, mesh: Mesh) -> Callable:
-        """Pod-sharded: one member per device over the 'pop' axis; fitness and
-        winner-params all-gather over ICI inside shard_map."""
-        assert "pop" in mesh.axis_names
-
-        def gen(pop: MemberState, key: jax.Array):
-            # pop leaves sharded [P, ...] over "pop"
-            def per_device(pop_local, key):
-                state = jax.tree_util.tree_map(lambda x: x[0], pop_local)
-                state, fitness = self.member_iteration(state)
-                pop_local = jax.tree_util.tree_map(
-                    lambda x: x[None], state
-                )
-                fit_all = jax.lax.all_gather(fitness, "pop")  # [P]
-                # all-gather member params over ICI, evolve deterministically
-                gathered = jax.tree_util.tree_map(
-                    lambda x: jax.lax.all_gather(x[0], "pop"), pop_local
-                )
-                new_pop = self.evolve(gathered, fit_all, key)
-                my = jax.lax.axis_index("pop")
-                mine = jax.tree_util.tree_map(lambda x: x[my][None], new_pop)
-                return mine, fit_all
-
-            specs = P("pop")
-            return shard_map(
-                per_device,
-                mesh=mesh,
-                in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-                out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-                check_vma=False,
-            )(pop, key)
-
-        return jax.jit(gen, donate_argnums=(0,))
+        """Pod-sharded: members shard over the 'pop' axis (any number per
+        device); fitness and ONLY the evolution subtrees (actor, critic,
+        optimizer) all-gather over ICI inside shard_map — env states stay
+        device-local (the pre-refactor path gathered the whole member)."""
+        return make_pod_generation(
+            mesh,
+            self.member_iteration,
+            extract=lambda pop: (pop.actor, pop.critic, pop.opt_state),
+            evolve_extracted=self._evolve_extracted,
+            insert=lambda pop, mine: pop._replace(
+                actor=mine[0], critic=mine[1], opt_state=mine[2]
+            ),
+        )

@@ -15,9 +15,11 @@ down-probe instead of the whole 1500s; the full workload launches only inside
 an up-window. On a successful accelerator run the headline JSON line also
 carries the secondary metric + on-chip kernel validation in "extra_metrics".
 
-Env knobs: BENCH_MODE=grpo for the LLM metric; BENCH_POP/ENVS/ROLLOUT/GENS and
-BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU attempt;
-BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
+Env knobs: BENCH_MODE=grpo for the LLM metric; BENCH_MODE=pipeline / serving /
+anakin for the CPU A/B micro-benches (anakin: scan-resident generation engine
+vs the interop off-policy hot loop, per algorithm); BENCH_POP/ENVS/ROLLOUT/
+GENS and BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU
+attempt; BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
 """
 
 import json
@@ -403,6 +405,185 @@ def bench_serving():
     }), flush=True)
 
 
+def bench_anakin():
+    """CPU-backend A/B for the scan-native generation engine
+    (docs/performance.md): per-algorithm env-steps/sec of the SCAN-RESIDENT
+    program (env step + ring write + fused sample/learn inside one
+    lax.scan, ~0 dispatches/env-step) vs the best INTEROP off-policy hot
+    loop (PR-2 chunked staging + fused learn_from_buffer, ≤2
+    dispatches/env-step) on the same env / net / batch / learn cadence.
+    Run with BENCH_MODE=anakin; knobs BENCH_ANAKIN_ENVS / _STEPS / _REPEATS
+    / _ALGOS (comma list from {dqn, ddpg})."""
+    import jax
+    import numpy as np
+    import optax
+
+    from agilerl_tpu.envs import CartPole, JaxVecEnv, Pendulum
+    from agilerl_tpu.modules.mlp import MLPConfig
+    from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+
+    backend = jax.default_backend()
+    num_envs = int(os.environ.get("BENCH_ANAKIN_ENVS", 8))
+    steps = int(os.environ.get("BENCH_ANAKIN_STEPS", 256))
+    repeats = int(os.environ.get("BENCH_ANAKIN_REPEATS", 2))
+    algos = [a.strip() for a in
+             os.environ.get("BENCH_ANAKIN_ALGOS", "dqn,ddpg").split(",") if a]
+    learn_every = 4
+    batch_size = 64
+    latent, hidden = 32, 64
+
+    def net_cfg(env, outputs, **head_kw):
+        kind, enc = default_encoder_config(
+            env.observation_space, latent_dim=latent,
+            encoder_config={"hidden_size": (hidden,)})
+        return NetworkConfig(
+            encoder_kind=kind, encoder=enc,
+            head=MLPConfig(num_inputs=head_kw.pop("num_inputs", latent),
+                           num_outputs=outputs, hidden_size=(hidden,),
+                           **head_kw),
+            latent_dim=latent)
+
+    # ---- interop loops (the PR-2 best path: staging + fused learn) -------
+    def _interop_sps(make_env_agent, act, action_dtype=None) -> float:
+        """One benchmark protocol for every interop algorithm (warmup
+        formula, flush cadence and learn gating included) so the
+        per-algorithm A/B numbers stay comparable."""
+        from agilerl_tpu.components.replay_buffer import ReplayBuffer
+
+        env, agent = make_env_agent()
+        memory = ReplayBuffer(max_size=10_000, seed=0, flush_every=8)
+
+        def loop(n_steps):
+            obs, _ = env.reset()
+            obs = np.asarray(obs)
+            pending = None
+            for t in range(n_steps):
+                action = act(agent, obs)
+                next_obs, reward, term, trunc, _ = env.step(np.asarray(action))
+                next_obs = np.asarray(next_obs)
+                memory.stage({"obs": obs,
+                              "action": np.asarray(action, action_dtype),
+                              "reward": np.asarray(reward, np.float32),
+                              "next_obs": next_obs,
+                              "done": np.asarray(term, np.float32)},
+                             batched=True)
+                obs = next_obs
+                if t % learn_every == 0:
+                    memory.flush()
+                    if len(memory) >= batch_size:
+                        pending = agent.learn_from_buffer(memory)
+            if pending is not None:
+                jax.block_until_ready(pending)
+
+        loop(max(steps // 4, 2 * learn_every * batch_size // num_envs))
+        t0 = time.perf_counter()
+        loop(steps)
+        return steps * num_envs / (time.perf_counter() - t0)
+
+    def interop_dqn_sps() -> float:
+        from agilerl_tpu.algorithms.dqn import DQN
+
+        def make():
+            env = JaxVecEnv(CartPole(), num_envs=num_envs, seed=0)
+            agent = DQN(env.single_observation_space, env.single_action_space,
+                        batch_size=batch_size, lr=1e-3,
+                        net_config={"latent_dim": latent,
+                                    "encoder_config": {"hidden_size": (hidden,)}})
+            return env, agent
+
+        return _interop_sps(make, lambda a, obs: a.get_action(obs, epsilon=0.1))
+
+    def interop_ddpg_sps() -> float:
+        from agilerl_tpu.algorithms.ddpg import DDPG
+
+        def make():
+            env = JaxVecEnv(Pendulum(), num_envs=num_envs, seed=0)
+            agent = DDPG(env.single_observation_space, env.single_action_space,
+                         batch_size=batch_size, O_U_noise=False,
+                         net_config={"latent_dim": latent,
+                                     "encoder_config": {"hidden_size": (hidden,)}})
+            return env, agent
+
+        return _interop_sps(make, lambda a, obs: a.get_action(obs),
+                            action_dtype=np.float32)
+
+    # ---- scan-resident programs (pop=1 vmap: same workload, ~0 dispatches)
+    def scan_dqn_sps() -> float:
+        from agilerl_tpu.parallel.off_policy import EvoDQN
+
+        env = CartPole()
+        evo = EvoDQN(env, net_cfg(env, 2), optax.adam(1e-3),
+                     num_envs=num_envs, steps_per_iter=steps,
+                     buffer_size=10_000, batch_size=batch_size,
+                     learn_every=learn_every)
+        pop = evo.init_population(jax.random.PRNGKey(0), 1)
+        gen = evo.make_vmap_generation()
+        pop, f = gen(pop, jax.random.PRNGKey(1))  # compile+warm
+        jax.block_until_ready(f)
+        gens = 4
+        t0 = time.perf_counter()
+        for i in range(gens):
+            pop, f = gen(pop, jax.random.PRNGKey(2 + i))
+        jax.block_until_ready(f)
+        return gens * steps * num_envs / (time.perf_counter() - t0)
+
+    def scan_ddpg_sps() -> float:
+        from agilerl_tpu.parallel.off_policy import EvoDDPG
+
+        env = Pendulum()
+        actor = net_cfg(env, 1, output_activation="Tanh")
+        critic = net_cfg(env, 1, num_inputs=latent + 1)
+        evo = EvoDDPG(env, actor, critic,
+                      num_envs=num_envs, steps_per_iter=steps,
+                      buffer_size=10_000, batch_size=batch_size,
+                      learn_every=learn_every)
+        pop = evo.init_population(jax.random.PRNGKey(0), 1)
+        gen = evo.make_vmap_generation()
+        pop, f = gen(pop, jax.random.PRNGKey(1))
+        jax.block_until_ready(f)
+        gens = 4
+        t0 = time.perf_counter()
+        for i in range(gens):
+            pop, f = gen(pop, jax.random.PRNGKey(2 + i))
+        jax.block_until_ready(f)
+        return gens * steps * num_envs / (time.perf_counter() - t0)
+
+    runners = {
+        "dqn": (interop_dqn_sps, scan_dqn_sps),
+        "ddpg": (interop_ddpg_sps, scan_ddpg_sps),
+    }
+    per_algo = {}
+    for algo in algos:
+        interop_fn, scan_fn = runners[algo]
+        # best-of-N per path: single-shot A/Bs on a shared host are noise
+        interop = max(interop_fn() for _ in range(repeats))
+        scan = max(scan_fn() for _ in range(repeats))
+        per_algo[algo] = {
+            "interop_env_steps_per_sec": round(interop),
+            "scan_env_steps_per_sec": round(scan),
+            "speedup": round(scan / max(interop, 1e-9), 2),
+        }
+        log(f"bench_anakin: {algo} interop {interop:.0f} vs scan {scan:.0f} "
+            f"env-steps/s ({per_algo[algo]['speedup']}x)")
+
+    head = per_algo.get("dqn") or per_algo[algos[0]]
+    print(json.dumps({
+        "metric": ("scan-resident generation engine env-steps/sec "
+                   f"(DQN CartPole, {num_envs} envs, learn_every="
+                   f"{learn_every}; vs_baseline = speedup over the interop "
+                   "off-policy hot loop, same env/net/batch/cadence)"),
+        "value": head["scan_env_steps_per_sec"],
+        "unit": "env-steps/sec",
+        "vs_baseline": head["speedup"],
+        "per_algorithm": per_algo,
+        "provenance": ("fresh CPU A/B at HEAD; the scan tier's TPU headline "
+                       "(evo-PPO pop=64 on v5e) re-emits separately via the "
+                       "default BENCH_MODE with its own capture provenance"),
+        "backend": backend,
+        "error": None,
+    }), flush=True)
+
+
 def _cpu_pinned() -> bool:
     """True iff JAX_PLATFORMS is an exact "cpu" pin. A fallback list like
     "axon,cpu" is NOT a pin — the accelerator should still be attempted."""
@@ -448,6 +629,8 @@ def child_main():
         bench_pipeline()
     elif mode == "serving":
         bench_serving()
+    elif mode == "anakin":
+        bench_anakin()
     else:
         bench_evoppo()
 
@@ -664,14 +847,16 @@ def parent_main():
         "GRPO learn-step tokens/sec" if mode == "grpo"
         else "pipelined off-policy hot-loop env-steps/sec" if mode == "pipeline"
         else "serving-tier continuous vs batch-sync tokens/sec" if mode == "serving"
+        else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
         else "evo-PPO aggregate env-steps/sec"
     )
     errors = []
 
-    if mode in ("pipeline", "serving"):
+    if mode in ("pipeline", "serving", "anakin"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
-        # continuous serving): defined as CPU-backend comparisons on the
-        # same host — no accelerator phase, no capture re-emission
+        # continuous serving; interop vs scan-resident): defined as
+        # CPU-backend comparisons on the same host — no accelerator phase,
+        # no capture re-emission
         cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 900))
         result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
         if result is not None:
@@ -679,7 +864,7 @@ def parent_main():
             return 0
         print(json.dumps({
             "metric": metric, "value": 0,
-            "unit": "env-steps/sec" if mode == "pipeline" else "tokens/sec",
+            "unit": "tokens/sec" if mode == "serving" else "env-steps/sec",
             "vs_baseline": 0.0, "backend": None,
             "error": f"{mode} micro-bench: {err}",
         }), flush=True)

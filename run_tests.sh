@@ -18,6 +18,9 @@
 #                                # consistency, torn writes, kill+resume)
 #   bash run_tests.sh serving    # serving tier only (bucketed + continuous
 #                                # paged generation, latency telemetry)
+#   bash run_tests.sh anakin     # scan-native generation engine only (ring
+#                                # math, scan algos, pod≡vmap, cross-tier
+#                                # loss gates, scan snapshot/restore)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -48,6 +51,13 @@ for arg in "$@"; do
       # compile-count regression, admission control, latency telemetry)
       MARKER=(-m "serving")
       SHARDS+=("tests/test_llm tests/test_observability/test_serving_latency.py")
+      ;;
+    anakin)
+      # fast path: the scan-native generation engine (ring-vs-buffer math,
+      # per-algorithm scan programs, pod≡vmap equivalence, cross-tier loss
+      # gates, autoreset edge cases, scan snapshot determinism)
+      MARKER=(-m "anakin")
+      SHARDS+=("tests/test_parallel tests/test_envs/test_jax_envs.py tests/test_resilience/test_scan_snapshot.py")
       ;;
     *) SHARDS+=("$arg") ;;
   esac

@@ -56,3 +56,148 @@ def test_rollout_scan_shapes():
     assert traj["reward"].shape == (32, 8)
     assert traj["done"].shape == (32, 8)
     assert last_obs.shape == (8, 4)
+
+
+# --------------------------------------------------------------------------- #
+# make_autoreset_step edge cases (ISSUE 8 satellite) + the stacked MA step
+# --------------------------------------------------------------------------- #
+
+from typing import NamedTuple  # noqa: E402
+
+import pytest  # noqa: E402
+
+from agilerl_tpu.envs import (  # noqa: E402
+    MountainCarContinuous,
+    SimpleSpreadJax,
+    make_ma_autoreset_step,
+)
+from agilerl_tpu.envs.core import JaxEnv, VecState, make_autoreset_step  # noqa: E402
+
+
+class _CounterState(NamedTuple):
+    t: jax.Array
+
+
+class _TerminateAfter(JaxEnv):
+    """obs = steps-into-episode; terminates after `horizon` steps (horizon=1
+    => terminal on the very FIRST step of every episode)."""
+
+    max_episode_steps = 50
+
+    def __init__(self, horizon: int = 1):
+        from gymnasium import spaces
+
+        self.horizon = horizon
+        self.observation_space = spaces.Box(-np.inf, np.inf, (1,), np.float32)
+        self.action_space = spaces.Discrete(2)
+
+    def reset_fn(self, key):
+        state = _CounterState(jnp.int32(0))
+        return state, jnp.zeros((1,))
+
+    def step_fn(self, state, action, key):
+        t = state.t + 1
+        terminated = t >= self.horizon
+        return (_CounterState(t), t.astype(jnp.float32)[None],
+                jnp.float32(1.0), terminated, jnp.bool_(False))
+
+
+@pytest.mark.anakin
+def test_autoreset_terminal_on_first_step():
+    """An env that terminates on its first step must autoreset EVERY tick:
+    returned obs is the next episode's initial obs, final_obs is the true
+    terminal successor, and step counts restart from zero."""
+    env = _TerminateAfter(horizon=1)
+    step = make_autoreset_step(env)
+    reset = jax.vmap(env.reset_fn)
+    env_state, obs = reset(jax.random.split(jax.random.PRNGKey(0), 3))
+    vstate = VecState(env_state, jnp.zeros(3, jnp.int32), jax.random.PRNGKey(1))
+    for _ in range(4):
+        vstate, obs, reward, term, trunc, final_obs = step(
+            vstate, jnp.zeros(3, jnp.int32)
+        )
+        assert np.asarray(term).all()
+        # autoreset obs = fresh episode start (0), final_obs = terminal (1)
+        np.testing.assert_array_equal(np.asarray(obs), 0.0)
+        np.testing.assert_array_equal(np.asarray(final_obs), 1.0)
+        np.testing.assert_array_equal(np.asarray(vstate.step_count), 0)
+
+
+@pytest.mark.anakin
+def test_autoreset_simultaneous_done_across_batch():
+    """All envs hitting done on the same tick (deterministic horizon) must
+    all reset together — and envs stepped past the time limit truncate in
+    lockstep too."""
+    env = _TerminateAfter(horizon=3)
+    step = make_autoreset_step(env)
+    reset = jax.vmap(env.reset_fn)
+    env_state, obs = reset(jax.random.split(jax.random.PRNGKey(0), 4))
+    vstate = VecState(env_state, jnp.zeros(4, jnp.int32), jax.random.PRNGKey(1))
+    dones = []
+    for _ in range(7):
+        vstate, obs, reward, term, trunc, final_obs = step(
+            vstate, jnp.zeros(4, jnp.int32)
+        )
+        dones.append(np.asarray(term))
+    dones = np.stack(dones)
+    # every 3rd tick all four envs terminate simultaneously; none in between
+    np.testing.assert_array_equal(dones[2], True)
+    np.testing.assert_array_equal(dones[5], True)
+    assert not dones[[0, 1, 3, 4, 6]].any()
+
+
+@pytest.mark.anakin
+def test_autoreset_truncation_at_time_limit():
+    """An env that never terminates truncates exactly at max_episode_steps,
+    with final_obs carrying the pre-reset successor."""
+    env = _TerminateAfter(horizon=10**9)
+    env.max_episode_steps = 5
+    step = make_autoreset_step(env)
+    reset = jax.vmap(env.reset_fn)
+    env_state, obs = reset(jax.random.split(jax.random.PRNGKey(0), 2))
+    vstate = VecState(env_state, jnp.zeros(2, jnp.int32), jax.random.PRNGKey(1))
+    for i in range(5):
+        vstate, obs, reward, term, trunc, final_obs = step(
+            vstate, jnp.zeros(2, jnp.int32)
+        )
+    assert np.asarray(trunc).all() and not np.asarray(term).any()
+    np.testing.assert_array_equal(np.asarray(final_obs), 5.0)
+    np.testing.assert_array_equal(np.asarray(obs), 0.0)
+
+
+@pytest.mark.anakin
+def test_mountaincar_continuous_dynamics():
+    env = MountainCarContinuous()
+    state, obs = env.reset_fn(jax.random.PRNGKey(0))
+    assert obs.shape == (2,)
+    # full throttle right from the valley: position must move
+    for _ in range(10):
+        state, obs, reward, term, trunc = env.step_fn(
+            state, jnp.ones((1,)), jax.random.PRNGKey(1)
+        )
+    assert float(reward) <= 0.0  # action cost while not at the goal
+    # reaching the goal pays the +100 bonus
+    from agilerl_tpu.envs.classic import MountainCarState
+
+    near_goal = MountainCarState(jnp.float32(0.449), jnp.float32(0.07))
+    _, _, reward, term, _ = env.step_fn(near_goal, jnp.ones((1,)),
+                                        jax.random.PRNGKey(2))
+    assert bool(term) and float(reward) > 90.0
+
+
+@pytest.mark.anakin
+def test_ma_autoreset_step_stacked_layout():
+    env = SimpleSpreadJax(n_agents=2, max_steps=5)
+    step = make_ma_autoreset_step(env)
+    reset = jax.vmap(env.reset_fn)
+    N = 3
+    env_state, obs_dict = reset(jax.random.split(jax.random.PRNGKey(0), N))
+    vstate = VecState(env_state, jnp.zeros(N, jnp.int32), jax.random.PRNGKey(1))
+    actions = jnp.zeros((2, N), jnp.int32)  # [A, N] stay-put
+    for i in range(5):
+        vstate, obs, reward, term, trunc, final_obs = step(vstate, actions)
+        assert obs.shape == (2, N, 2 + 2 * 2)
+        assert reward.shape == (N,)
+    # the shared 5-step horizon truncates every env simultaneously
+    assert np.asarray(trunc).all()
+    np.testing.assert_array_equal(np.asarray(vstate.step_count), 0)

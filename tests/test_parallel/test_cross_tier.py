@@ -1,0 +1,109 @@
+"""Cross-tier loss-equivalence gate (ISSUE 8 satellite): N ticks of the
+scan-resident program vs. the interop loop's fused ``learn_from_buffer`` on
+the SAME transition stream and sampling keys must produce matching losses —
+the regression net that catches silent drift between the two tiers.
+
+The scan member runs in debug mode (recording every transition it wrote,
+every sampling key it drew and every loss); the interop side replays the
+identical stream through a real :class:`ReplayBuffer` + the algorithm's
+fused learn path, starting from the identical params/targets/optimizer
+state and sharing the optax transform object."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.algorithms.ddpg import DDPG
+from agilerl_tpu.algorithms.dqn import DQN
+from agilerl_tpu.components.replay_buffer import ReplayBuffer
+from agilerl_tpu.envs import CartPole, Pendulum
+from agilerl_tpu.parallel import EvoDDPG, EvoDQN
+
+pytestmark = pytest.mark.anakin
+
+TICKS = 30
+NET = {"latent_dim": 16, "encoder_config": {"hidden_size": (32,)}}
+
+_copy = lambda t: jax.tree_util.tree_map(jnp.array, t)  # noqa: E731
+
+
+def _replay_through_interop(agent, aux, ticks, buffer_size):
+    """Feed the scan tier's recorded stream through the interop fused path;
+    returns the (tick, scan_loss, interop_loss) triples where learning
+    happened."""
+    memory = ReplayBuffer(max_size=buffer_size, seed=0)
+    compared = []
+    for t in range(ticks):
+        tr = {
+            k: np.asarray(aux["transition"][k][t])
+            for k in ("obs", "action", "reward", "next_obs", "done")
+        }
+        memory.add(tr, batched=True)
+        if bool(aux["do_learn"][t]):
+            loss = agent.learn_from_buffer(
+                memory, key=jnp.asarray(aux["sample_key"][t])
+            )
+            compared.append((t, float(aux["loss"][t]), float(loss)))
+    return compared
+
+
+def test_scan_dqn_losses_match_interop_fused():
+    env = CartPole()
+    agent = DQN(env.observation_space, env.action_space, batch_size=16,
+                lr=1e-3, gamma=0.99, tau=0.01, net_config=NET)
+    evo = EvoDQN(env, agent.actor.config, agent.optimizer.tx, num_envs=4,
+                 steps_per_iter=TICKS, buffer_size=128, batch_size=16,
+                 gamma=0.99, tau=0.01)
+    s = evo.init_member(jax.random.PRNGKey(0))
+    agent.actor.params = _copy(s.learner.params)
+    agent.actor_target.params = _copy(s.learner.target)
+    agent.optimizer.opt_state = _copy(s.learner.opt_state)
+
+    s2, _fitness, aux = jax.jit(evo.member_iteration_debug)(s)
+    aux = jax.device_get(aux)
+    compared = _replay_through_interop(agent, aux, TICKS, 128)
+    assert len(compared) >= TICKS // 2, "warmup never cleared — gate is vacuous"
+    for t, l_scan, l_interop in compared:
+        assert np.isclose(l_scan, l_interop, rtol=1e-4, atol=1e-6), (
+            f"tick {t}: scan loss {l_scan} != interop loss {l_interop}"
+        )
+    # end-state params agree too (optimizer trajectories stayed in lockstep)
+    for a, b in zip(jax.tree_util.tree_leaves(agent.actor.params),
+                    jax.tree_util.tree_leaves(s2.learner.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_scan_ddpg_losses_match_interop_fused():
+    env = Pendulum()
+    agent = DDPG(env.observation_space, env.action_space, batch_size=16,
+                 lr_actor=1e-4, lr_critic=1e-3, gamma=0.99, tau=0.01,
+                 policy_freq=2, O_U_noise=False, net_config=NET)
+    evo = EvoDDPG(env, agent.actor.config, agent.critic.config,
+                  tx_actor=agent.actor_optimizer.tx,
+                  tx_critic=agent.critic_optimizer.tx,
+                  num_envs=4, steps_per_iter=TICKS, buffer_size=128,
+                  batch_size=16, gamma=0.99, tau=0.01, policy_freq=2)
+    s = evo.init_member(jax.random.PRNGKey(1))
+    agent.actor.params = _copy(s.learner.actor)
+    agent.actor_target.params = _copy(s.learner.actor_target)
+    agent.critic.params = _copy(s.learner.critic)
+    agent.critic_target.params = _copy(s.learner.critic_target)
+    agent.actor_optimizer.opt_state = _copy(s.learner.actor_opt)
+    agent.critic_optimizer.opt_state = _copy(s.learner.critic_opt)
+    agent._learn_counter = 0  # the scan member's learn_count starts at 0 too
+
+    s2, _fitness, aux = jax.jit(evo.member_iteration_debug)(s)
+    aux = jax.device_get(aux)
+    compared = _replay_through_interop(agent, aux, TICKS, 128)
+    assert len(compared) >= TICKS // 2
+    for t, l_scan, l_interop in compared:
+        assert np.isclose(l_scan, l_interop, rtol=1e-4, atol=1e-6), (
+            f"tick {t}: scan critic loss {l_scan} != interop {l_interop}"
+        )
+    # the delayed-actor cadence stayed aligned: actor params match at the end
+    for a, b in zip(jax.tree_util.tree_leaves(agent.actor.params),
+                    jax.tree_util.tree_leaves(s2.learner.actor)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

@@ -1,0 +1,269 @@
+"""Generation-engine unit tests: ring math vs the interop buffer module,
+the n-step fold, fitness segmentation at evolution boundaries, and the
+ScanRun telemetry/snapshot surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from agilerl_tpu.components.replay_buffer import (
+    BufferState,
+    PERState,
+    _add,
+    _per_add,
+    _per_sample,
+    _per_update,
+    _sample,
+)
+from agilerl_tpu.envs import CartPole
+from agilerl_tpu.modules.mlp import MLPConfig
+from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+from agilerl_tpu.parallel.generation import (
+    ScanRun,
+    population_load_state_dict,
+    population_state_dict,
+    ring_init,
+    ring_nstep_gather,
+    ring_sample_per,
+    ring_sample_uniform,
+    ring_update_priorities,
+    ring_write,
+)
+from agilerl_tpu.parallel.off_policy import EvoDQN
+
+pytestmark = pytest.mark.anakin
+
+
+def _transitions(rng, n):
+    return {
+        "obs": rng.normal(size=(n, 3)).astype(np.float32),
+        "action": rng.integers(0, 2, size=(n,)).astype(np.int32),
+        "reward": rng.normal(size=(n,)).astype(np.float32),
+        "next_obs": rng.normal(size=(n, 3)).astype(np.float32),
+        "done": (rng.random(n) < 0.2).astype(np.float32),
+        "boundary": (rng.random(n) < 0.3).astype(np.float32),
+    }
+
+
+def _filled_ring(rng, capacity=32, chunks=3, chunk=8):
+    example = jax.tree_util.tree_map(lambda x: jnp.asarray(x[0]),
+                                     _transitions(rng, 1))
+    ring = ring_init(example, capacity)
+    batches = []
+    for _ in range(chunks):
+        b = _transitions(rng, chunk)
+        ring = ring_write(ring, jax.tree_util.tree_map(jnp.asarray, b))
+        batches.append(b)
+    return ring, batches, example
+
+
+def test_ring_uniform_sampling_matches_buffer_module():
+    """Same storage + same key => the exact indices/rows the interop
+    ``_sample`` would return (the invariant the cross-tier gate rides)."""
+    rng = np.random.default_rng(0)
+    ring, batches, example = _filled_ring(rng)
+    buf = BufferState(
+        storage=jax.tree_util.tree_map(
+            lambda x: jnp.zeros((32,) + x.shape, x.dtype), example
+        ),
+        pos=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+    for b in batches:
+        buf = _add(buf, jax.tree_util.tree_map(jnp.asarray, b), batched=True)
+    for leaf_r, leaf_b in zip(jax.tree_util.tree_leaves(ring.storage),
+                              jax.tree_util.tree_leaves(buf.storage)):
+        np.testing.assert_array_equal(np.asarray(leaf_r), np.asarray(leaf_b))
+    key = jax.random.PRNGKey(7)
+    batch_r, idx, w = ring_sample_uniform(ring, key, 16)
+    batch_b = _sample(buf, key, 16)
+    for a, b in zip(jax.tree_util.tree_leaves(batch_r),
+                    jax.tree_util.tree_leaves(dict(batch_b))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.all(np.asarray(w) == 1.0)
+
+
+def test_ring_per_sampling_and_writeback_match_buffer_module():
+    rng = np.random.default_rng(1)
+    ring, batches, example = _filled_ring(rng)
+    per = PERState(
+        buffer=BufferState(
+            storage=jax.tree_util.tree_map(
+                lambda x: jnp.zeros((32,) + x.shape, x.dtype), example
+            ),
+            pos=jnp.zeros((), jnp.int32),
+            size=jnp.zeros((), jnp.int32),
+        ),
+        priorities=jnp.zeros((32,), jnp.float32),
+        max_priority=jnp.ones((), jnp.float32),
+    )
+    for b in batches:
+        per = _per_add(per, jax.tree_util.tree_map(jnp.asarray, b), batched=True)
+    np.testing.assert_array_equal(np.asarray(ring.priorities),
+                                  np.asarray(per.priorities))
+    key = jax.random.PRNGKey(9)
+    beta = jnp.float32(0.4)
+    batch_r, idx_r, w_r = ring_sample_per(ring, key, 16, beta)
+    batch_p, idx_p, w_p = _per_sample(per, key, 16, beta)
+    np.testing.assert_array_equal(np.asarray(idx_r), np.asarray(idx_p))
+    np.testing.assert_allclose(np.asarray(w_r), np.asarray(w_p), rtol=1e-6)
+    # priority write-back: same floor/power/max math
+    new_pri = jnp.abs(jax.random.normal(jax.random.PRNGKey(3), (16,)))
+    alpha = jnp.float32(0.6)
+    ring2 = ring_update_priorities(ring, idx_r, new_pri, alpha)
+    per2 = _per_update(per, idx_p, new_pri, alpha)
+    np.testing.assert_allclose(np.asarray(ring2.priorities),
+                               np.asarray(per2.priorities), rtol=1e-6)
+    np.testing.assert_allclose(float(ring2.max_priority),
+                               float(per2.max_priority), rtol=1e-6)
+
+
+def test_ring_nstep_fold_freezes_at_boundary_and_reports_steps():
+    example = {
+        "obs": jnp.zeros((1,)), "action": jnp.int32(0),
+        "reward": jnp.float32(0.0), "next_obs": jnp.zeros((1,)),
+        "done": jnp.float32(0.0), "boundary": jnp.float32(0.0),
+    }
+    ring = ring_init(example, 16)
+    # rows 0..5: rewards 1..6, boundary at row 2 (e.g. a truncation)
+    batch = {
+        "obs": jnp.arange(6, dtype=jnp.float32)[:, None],
+        "action": jnp.zeros(6, jnp.int32),
+        "reward": jnp.arange(1.0, 7.0),
+        "next_obs": 10.0 + jnp.arange(6, dtype=jnp.float32)[:, None],
+        "done": jnp.zeros(6).at[2].set(1.0),
+        "boundary": jnp.zeros(6).at[2].set(1.0),
+    }
+    ring = ring_write(ring, batch)
+    gamma = 0.9
+    out = ring_nstep_gather(ring, jnp.array([0, 1, 3]), 3, gamma)
+    # start 0: full 3-step fold 1 + .9*2 + .81*3
+    np.testing.assert_allclose(float(out["reward"][0]), 1 + 0.9 * 2 + 0.81 * 3,
+                               rtol=1e-6)
+    assert float(out["steps"][0]) == 3.0
+    np.testing.assert_allclose(np.asarray(out["next_obs"][0]), [12.0])
+    # start 1: boundary at row 2 freezes the fold after 2 rows
+    np.testing.assert_allclose(float(out["reward"][1]), 2 + 0.9 * 3, rtol=1e-6)
+    assert float(out["steps"][1]) == 2.0
+    assert float(out["done"][1]) == 1.0
+    # start 3: window would run past the write head -> clipped fold
+    np.testing.assert_allclose(float(out["reward"][2]), 4 + 0.9 * 5 + 0.81 * 6,
+                               rtol=1e-6)
+    assert float(out["steps"][2]) == 3.0
+
+
+def test_ring_nstep_fold_strides_over_interleaved_env_streams():
+    """Regression (review finding): the engine writes [num_envs] rows per
+    tick, so one env's next transition lives num_envs rows ahead — a
+    stride-1 fold would sum rewards across UNRELATED env streams."""
+    example = {
+        "obs": jnp.zeros((1,)), "action": jnp.int32(0),
+        "reward": jnp.float32(0.0), "next_obs": jnp.zeros((1,)),
+        "done": jnp.float32(0.0), "boundary": jnp.float32(0.0),
+    }
+    ring = ring_init(example, 16)
+    # two ticks of a 2-env batch: env0 rewards [100, 101], env1 [200, 201]
+    for t, (r0, r1) in enumerate([(100.0, 200.0), (101.0, 201.0)]):
+        ring = ring_write(ring, {
+            "obs": jnp.array([[float(t)], [10.0 + t]]),
+            "action": jnp.zeros(2, jnp.int32),
+            "reward": jnp.array([r0, r1]),
+            "next_obs": jnp.array([[float(t + 1)], [11.0 + t]]),
+            "done": jnp.zeros(2),
+            "boundary": jnp.zeros(2),
+        })
+    out = ring_nstep_gather(ring, jnp.array([0, 1]), 2, 1.0, stride=2)
+    # env0's window folds env0's rewards only (100 + 101), bootstrapping
+    # from env0's t=1 successor — never env1's
+    np.testing.assert_allclose(np.asarray(out["reward"]), [201.0, 401.0])
+    np.testing.assert_allclose(np.asarray(out["next_obs"]),
+                               [[2.0], [12.0]])
+    np.testing.assert_array_equal(np.asarray(out["steps"]), [2.0, 2.0])
+
+
+def test_engine_rounds_misaligned_nstep_buffer_up():
+    """n_step>1 needs capacity % num_envs == 0 (fold stride alignment across
+    wraparound); the engine rounds up instead of burdening callers."""
+    evo = _tiny_dqn(n_step=3, num_envs=5, buffer_size=64)
+    assert evo.buffer_size == 65
+    # defaults compose: the public no-kwargs constructors must not raise
+    s = evo.init_member(jax.random.PRNGKey(0))
+    assert s.ring.priorities.shape == (65,)
+
+
+def _tiny_dqn(**kw):
+    env = CartPole()
+    kind, enc = default_encoder_config(env.observation_space, latent_dim=16,
+                                       encoder_config={"hidden_size": (32,)})
+    cfg = NetworkConfig(encoder_kind=kind, encoder=enc,
+                        head=MLPConfig(num_inputs=16, num_outputs=2,
+                                       hidden_size=(32,)), latent_dim=16)
+    kw.setdefault("num_envs", 4)
+    kw.setdefault("steps_per_iter", 8)
+    kw.setdefault("buffer_size", 64)
+    kw.setdefault("batch_size", 8)
+    return EvoDQN(env, cfg, optax.adam(1e-3), **kw)
+
+
+def test_evolve_segments_running_returns():
+    """Regression for the fitness-semantics audit: after evolution the
+    carried per-env episode returns are zeroed, so the next generation's
+    fitness cannot credit the pre-mutation policy's partial episodes."""
+    evo = _tiny_dqn()
+    pop = evo.init_population(jax.random.PRNGKey(0), 4)
+    pop, fitness = jax.vmap(evo.member_iteration)(pop)
+    assert float(jnp.abs(pop.ep_ret).sum()) > 0  # episodes in flight
+    evolved = evo.evolve(pop, fitness, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(evolved.ep_ret),
+                                  np.zeros_like(np.asarray(evolved.ep_ret)))
+    # the ring and env state stay with the slot (not gathered)
+    np.testing.assert_array_equal(np.asarray(evolved.ring.size),
+                                  np.asarray(pop.ring.size))
+
+
+def test_censored_fitness_counts_inflight_episodes():
+    """A window where no episode finishes must still score the member by its
+    accrued partial returns (never zero, never an extrapolated leap)."""
+    evo = _tiny_dqn(steps_per_iter=4)  # far below CartPole episode length
+    pop = evo.init_population(jax.random.PRNGKey(0), 2)
+    pop, fitness = jax.vmap(evo.member_iteration)(pop)
+    f = np.asarray(fitness)
+    assert (f > 0).all()
+    assert (f <= 4.0 + 1e-6).all()  # bounded by the window, not the 500 cap
+
+
+def test_scan_run_emits_timeline_and_history():
+    from agilerl_tpu.observability import MetricsRegistry, RunTelemetry
+
+    reg = MetricsRegistry()
+    tel = RunTelemetry(registry=reg, lineage=False, name="anakin")
+    evo = _tiny_dqn()
+    run = ScanRun(evo, pop_size=2, seed=0, telemetry=tel)
+    hist = run.run(3)
+    assert hist.shape == (3, 2)
+    assert run.generation == 3
+    # first timeline call only arms the timer; the rest set the gauge
+    assert reg.gauge("anakin/env_steps_per_sec").value > 0
+
+
+def test_population_state_dict_roundtrip_bit_exact():
+    evo = _tiny_dqn()
+    pop = evo.init_population(jax.random.PRNGKey(3), 2)
+    pop, _ = jax.vmap(evo.member_iteration)(pop)
+    blob = population_state_dict(pop)
+    fresh = evo.init_population(jax.random.PRNGKey(99), 2)
+    restored = population_load_state_dict(fresh, blob)
+    for a, b in zip(jax.tree_util.tree_leaves(pop),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_population_state_dict_rejects_mismatched_shapes():
+    evo = _tiny_dqn()
+    pop2 = evo.init_population(jax.random.PRNGKey(0), 2)
+    pop4 = evo.init_population(jax.random.PRNGKey(0), 4)
+    blob = population_state_dict(pop2)
+    with pytest.raises(ValueError):
+        population_load_state_dict(pop4, blob)

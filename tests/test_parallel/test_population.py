@@ -137,9 +137,11 @@ def test_evoppo_pod_program_learns():
 @pytest.mark.slow
 def test_evodqn_learns_cartpole():
     """EvoDQN (the off-policy flagship) learns CartPole: ~123k env steps
-    (60 gens x 16 envs x 128 steps) must lift best fitness past 100 from a
-    ~35 random start (memory bar: >150 fitness within 20k steps for plain
-    DQN; the population best clears 100 with wide margin, observed ~174)."""
+    (60 gens x 16 envs x 128 steps) must clearly lift best fitness from the
+    random start. Fitness is the censored segment return (segmented at
+    generation boundaries — the ISSUE-8 semantics fix), so it is bounded
+    near steps_per_iter=128 rather than the 500 episode cap; calibration on
+    seed 0: early ~28, late ~89, peak ~108."""
     import optax
 
     from agilerl_tpu.parallel.off_policy import EvoDQN
@@ -161,9 +163,9 @@ def test_evodqn_learns_cartpole():
         best.append(float(np.asarray(fitness).max()))
     early = float(np.mean(best[:5]))
     late = float(np.mean(best[-10:]))
-    assert early < 100, f"random start suspiciously high: {early}"
-    assert late > 100, f"EvoDQN failed to learn: {early} -> {late}"
-    assert late > 2 * early, (early, late)
+    assert early < 60, f"random start suspiciously high: {early}"
+    assert late > 55, f"EvoDQN failed to learn: {early} -> {late}"
+    assert late > 1.8 * early, (early, late)
 
 
 def test_evo_dqn_on_device():
@@ -188,4 +190,4 @@ def test_evo_dqn_on_device():
         pop, fitness = gen(pop, jax.random.PRNGKey(i))
     assert np.asarray(fitness).shape == (4,)
     assert np.isfinite(np.asarray(fitness)).all()
-    assert int(pop.buf_size[0]) > 0
+    assert int(pop.ring.size[0]) > 0

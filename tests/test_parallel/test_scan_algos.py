@@ -1,0 +1,207 @@
+"""The scan-resident algorithm family: every program runs vmapped on one
+chip AND shard_mapped one-member-per-device (pod ≡ vmap equivalence on the
+8-device virtual mesh), with finite fitness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from agilerl_tpu.envs import (
+    CartPole,
+    MountainCarContinuous,
+    Pendulum,
+    SimpleSpreadJax,
+)
+from agilerl_tpu.modules.mlp import MLPConfig
+from agilerl_tpu.networks import distributions as D
+from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+from agilerl_tpu.networks.q_networks import RainbowConfig
+from agilerl_tpu.parallel import EvoDDPG, EvoDQN, EvoIPPO, EvoRainbow, EvoTD3
+
+pytestmark = pytest.mark.anakin
+
+
+def _net(env, outputs, latent=16, hidden=32, **head_kw):
+    kind, enc = default_encoder_config(env.observation_space, latent_dim=latent,
+                                       encoder_config={"hidden_size": (hidden,)})
+    return NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=latent, num_outputs=outputs,
+                       hidden_size=(hidden,), **head_kw),
+        latent_dim=latent,
+    )
+
+
+def _dqn(**kw):
+    env = CartPole()
+    kw.setdefault("num_envs", 4)
+    kw.setdefault("steps_per_iter", 8)
+    kw.setdefault("buffer_size", 64)
+    kw.setdefault("batch_size", 8)
+    return EvoDQN(env, _net(env, 2), optax.adam(1e-3), **kw)
+
+
+def _ddpg_cfgs(env, latent=16, hidden=32):
+    import numpy as _np
+
+    act_dim = int(_np.prod(env.action_space.shape))
+    kind, enc = default_encoder_config(env.observation_space, latent_dim=latent,
+                                       encoder_config={"hidden_size": (hidden,)})
+    actor = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=latent, num_outputs=act_dim,
+                       hidden_size=(hidden,), output_activation="Tanh"),
+        latent_dim=latent,
+    )
+    critic = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=latent + act_dim, num_outputs=1,
+                       hidden_size=(hidden,)),
+        latent_dim=latent,
+    )
+    return actor, critic
+
+
+def _ippo(num_envs=4, rollout_len=26):
+    env = SimpleSpreadJax(n_agents=2)
+    space = env.observation_spaces[env.agent_ids[0]]
+    kind, enc = default_encoder_config(space, latent_dim=16,
+                                       encoder_config={"hidden_size": (32,)})
+    actor = NetworkConfig(encoder_kind=kind, encoder=enc,
+                          head=MLPConfig(num_inputs=16, num_outputs=5,
+                                         hidden_size=(32,)), latent_dim=16)
+    critic = NetworkConfig(encoder_kind=kind, encoder=enc,
+                           head=MLPConfig(num_inputs=16, num_outputs=1,
+                                          hidden_size=(32,)), latent_dim=16)
+    dist = D.dist_config_from_space(env.action_spaces[env.agent_ids[0]])
+    return EvoIPPO(env, actor, critic, dist, optax.adam(3e-4),
+                   num_envs=num_envs, rollout_len=rollout_len,
+                   update_epochs=1, num_minibatches=2)
+
+
+def _mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest must provide 8 CPU devices"
+    return Mesh(np.asarray(devices), axis_names=("pop",))
+
+
+# --------------------------------------------------------------------------- #
+def test_evodqn_per_nstep_double_hard_target_runs():
+    evo = _dqn(per=True, n_step=3, double=True, target_every=4)
+    pop = evo.init_population(jax.random.PRNGKey(0), 4)
+    gen = evo.make_vmap_generation()
+    for i in range(2):
+        pop, fitness = gen(pop, jax.random.PRNGKey(i))
+    f = np.asarray(fitness)
+    assert f.shape == (4,) and np.isfinite(f).all()
+    assert int(pop.ring.size[0]) > 0
+    # PER actually moved priorities off their initial all-max plateau
+    pri = np.asarray(pop.ring.priorities[0][: int(pop.ring.size[0])])
+    assert len(np.unique(np.round(pri, 6))) > 1
+
+
+def test_evorainbow_runs():
+    env = CartPole()
+    kind, enc = default_encoder_config(env.observation_space, latent_dim=16,
+                                       encoder_config={"hidden_size": (32,)})
+    head = MLPConfig(num_inputs=16, num_outputs=2 * 11, hidden_size=(32,),
+                     noisy=True, layer_norm=True, output_vanish=False)
+    cfg = RainbowConfig(encoder_kind=kind, encoder=enc, head=head, latent_dim=16,
+                        num_atoms=11, num_actions=2, v_min=-50.0, v_max=50.0)
+    evo = EvoRainbow(env, cfg, optax.adam(1e-4), num_envs=4, steps_per_iter=8,
+                     buffer_size=64, batch_size=8)
+    pop = evo.init_population(jax.random.PRNGKey(0), 2)
+    gen = evo.make_vmap_generation()
+    pop, fitness = gen(pop, jax.random.PRNGKey(1))
+    assert np.isfinite(np.asarray(fitness)).all()
+
+
+def test_evoddpg_pendulum_runs():
+    env = Pendulum()
+    actor, critic = _ddpg_cfgs(env)
+    evo = EvoDDPG(env, actor, critic, num_envs=4, steps_per_iter=8,
+                  buffer_size=64, batch_size=8)
+    pop = evo.init_population(jax.random.PRNGKey(0), 2)
+    gen = evo.make_vmap_generation()
+    pop, fitness = gen(pop, jax.random.PRNGKey(1))
+    f = np.asarray(fitness)
+    assert np.isfinite(f).all() and (f < 0).all()  # pendulum cost is negative
+
+
+def test_evotd3_mountaincar_continuous_runs():
+    env = MountainCarContinuous()
+    actor, critic = _ddpg_cfgs(env)
+    evo = EvoTD3(env, actor, critic, num_envs=4, steps_per_iter=8,
+                 buffer_size=64, batch_size=8, n_step=2)
+    pop = evo.init_population(jax.random.PRNGKey(0), 2)
+    gen = evo.make_vmap_generation()
+    pop, fitness = gen(pop, jax.random.PRNGKey(1))
+    assert np.isfinite(np.asarray(fitness)).all()
+
+
+def test_evoippo_runs_and_improves_nothing_breaks():
+    ippo = _ippo()
+    pop = ippo.init_population(jax.random.PRNGKey(0), 2)
+    gen = ippo.make_vmap_generation()
+    for i in range(2):
+        pop, fitness = gen(pop, jax.random.PRNGKey(i))
+    f = np.asarray(fitness)
+    assert f.shape == (2,) and np.isfinite(f).all()
+    # shared-reward spread fitness is negative (sum of distances)
+    assert (f < 0).all()
+    # evolution segmented the carried returns
+    np.testing.assert_array_equal(np.asarray(pop.ep_ret), 0.0)
+
+
+# --------------------------------------------------------------------------- #
+# pod-path ≡ vmap-path on the 8-device virtual mesh
+# --------------------------------------------------------------------------- #
+
+
+def test_evodqn_pod_matches_vmap():
+    mesh = _mesh()
+    evo = _dqn()
+    pop_v = evo.init_population(jax.random.PRNGKey(10), 8)
+    pop_p = evo.init_population(jax.random.PRNGKey(10), 8)
+    gen_v = evo.make_vmap_generation()
+    gen_p = evo.make_pod_generation(mesh)
+    for i in range(2):
+        pop_v, fit_v = gen_v(pop_v, jax.random.PRNGKey(20 + i))
+        pop_p, fit_p = gen_p(pop_p, jax.random.PRNGKey(20 + i))
+    np.testing.assert_allclose(np.asarray(fit_v), np.asarray(fit_p),
+                               rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pop_v.learner.params),
+                    jax.tree_util.tree_leaves(pop_p.learner.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_evoippo_pod_matches_vmap():
+    mesh = _mesh()
+    ippo = _ippo(num_envs=2, rollout_len=13)
+    pop_v = ippo.init_population(jax.random.PRNGKey(11), 8)
+    pop_p = ippo.init_population(jax.random.PRNGKey(11), 8)
+    gen_v = ippo.make_vmap_generation()
+    gen_p = ippo.make_pod_generation(mesh)
+    pop_v, fit_v = gen_v(pop_v, jax.random.PRNGKey(30))
+    pop_p, fit_p = gen_p(pop_p, jax.random.PRNGKey(30))
+    np.testing.assert_allclose(np.asarray(fit_v), np.asarray(fit_p),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_evoddpg_pod_runs_two_members_per_device():
+    """The generic pod path supports >1 member per device (the old
+    EvoPPO-specific path assumed exactly one)."""
+    mesh = _mesh()
+    env = Pendulum()
+    actor, critic = _ddpg_cfgs(env)
+    evo = EvoDDPG(env, actor, critic, num_envs=2, steps_per_iter=6,
+                  buffer_size=32, batch_size=8)
+    pop = evo.init_population(jax.random.PRNGKey(0), 16)  # 2 per device
+    gen = evo.make_pod_generation(mesh)
+    pop, fitness = gen(pop, jax.random.PRNGKey(1))
+    assert np.asarray(fitness).shape == (16,)
+    assert np.isfinite(np.asarray(fitness)).all()
