@@ -560,51 +560,40 @@ class GRPO(EvolvableAlgorithm):
         self.fitness.append(fitness)
         return fitness
 
-    def to_mesh(self, mesh) -> None:
+    def to_mesh(self, mesh=None, plan=None) -> None:
         """Place base params, adapters and optimizer state with real GSPMD
-        shardings on a (dp, fsdp, tp) mesh — the one-call DeepSpeed-config
-        replacement (parity contrast: _configure_batch_size/ZeRO plumbing,
-        core/base.py:2961-3009)."""
-        from jax.sharding import NamedSharding
+        shardings — the one-call DeepSpeed-config replacement (parity
+        contrast: _configure_batch_size/ZeRO plumbing,
+        core/base.py:2961-3009).
 
-        from agilerl_tpu.parallel.mesh import (
-            filter_spec,
-            gpt_param_specs,
-            lora_specs,
-            shard_like,
-        )
+        Now a thin wrapper over the declarative rule engine: pass ``mesh``
+        to resolve through the built-in GRPO rule set
+        (``parallel/plan.grpo_plan_for_mesh``), or ``plan`` (a
+        :class:`~agilerl_tpu.parallel.plan.ShardingPlan` or registered plan
+        name) to use a custom layout — its mesh is built from the plan's
+        axis spec when ``mesh`` is omitted. Axes the mesh doesn't carry
+        (e.g. an sp-only long-context mesh) fall back to replication."""
+        from agilerl_tpu.parallel import plan as PL
+
+        if plan is None:
+            if mesh is None:
+                raise ValueError("to_mesh needs a mesh or a plan")
+            plan = PL.grpo_plan_for_mesh(mesh)
+        plan, mesh = PL.resolve_plan_and_mesh(plan, mesh)
 
         # cached logprob/update closures capture the OLD base_params (and, for
         # sp fns, the old mesh) — drop them so learn() rebuilds against the
         # re-placed params
         self._clear_jit_cache()
 
-        # axes absent from the mesh (e.g. an sp-only long-context mesh) fall
-        # back to replication for those dims
-        specs = jax.tree_util.tree_map(
-            lambda s: filter_spec(s, mesh),
-            gpt_param_specs(self.model_config),
-            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
-        )
-        self.base_params = jax.tree_util.tree_map(
-            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-            self.base_params, specs,
-        )
-        lspecs = jax.tree_util.tree_map(
-            lambda s: filter_spec(s, mesh),
-            lora_specs(self.actor.params),
-            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
-        )
-        place = lambda tree: jax.tree_util.tree_map(  # noqa: E731
-            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-            tree, lspecs,
-        )
-        self.actor.params = place(self.actor.params)
-        self.reference.params = place(self.reference.params)
-        self.optimizer.opt_state = shard_like(
-            self.optimizer.opt_state, self.actor.params, lspecs, mesh
+        self.base_params = plan.place("params", self.base_params, mesh)
+        self.actor.params = plan.place("lora", self.actor.params, mesh)
+        self.reference.params = plan.place("lora", self.reference.params, mesh)
+        self.optimizer.opt_state = plan.place(
+            "optimizer", self.optimizer.opt_state, mesh
         )
         self.mesh = mesh
+        self.sharding_plan = plan
 
     def clean_up(self) -> None:
         """Free cached jit executables (parity: core/base.py:2335 clean_up —

@@ -23,3 +23,49 @@ def shard_map(f, **kwargs):
     if _CHECK_KW is not None and "check_vma" in kwargs:
         kwargs[_CHECK_KW] = kwargs.pop("check_vma")
     return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` appeared after 0.4.x; ``psum(1, axis)`` is the
+    classic spelling and folds to the same compile-time constant inside
+    shard_map/pmap."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def def_partition(fn, *, partition, sharding_rule=None,
+                  need_replication_factors=None,
+                  infer_sharding_from_operands=None):
+    """``custom_partitioning.def_partition`` grew Shardy kwargs
+    (``sharding_rule``/``need_replication_factors``) after 0.4.x; the old
+    GSPMD pipeline wants ``infer_sharding_from_operands`` instead. Pass
+    both formulations; whichever the installed jax understands wins."""
+    try:
+        kwargs = {"partition": partition}
+        if sharding_rule is not None:
+            kwargs["sharding_rule"] = sharding_rule
+        if need_replication_factors is not None:
+            kwargs["need_replication_factors"] = need_replication_factors
+        return fn.def_partition(**kwargs)
+    except TypeError:
+        if infer_sharding_from_operands is None:
+            raise
+        return fn.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer_sharding_from_operands,
+        )
+
+
+def enable_x64(enabled: bool = True):
+    """``jax.enable_x64`` (new idiom) vs ``jax.experimental.enable_x64``
+    (0.4.x) — both are context managers toggling the x64 flag."""
+    import jax
+
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(enabled)

@@ -36,6 +36,8 @@ class Mutations:
         mutate_elite: bool = True,
         rand_seed: Optional[int] = None,
         lineage=None,
+        sharding: float = 0.0,
+        sharding_plans: Optional[List[Any]] = None,
     ):
         self.no_mut = float(no_mutation)
         self.architecture_mut = float(architecture)
@@ -51,6 +53,13 @@ class Mutations:
         #: optional observability.LineageTracker — records which mutation
         #: class landed on which child (genealogy fitness deltas)
         self.lineage = lineage
+        #: OPT-IN sharding-layout mutation (probability 0 by default):
+        #: swaps a member's ShardingPlan among the plans valid for the
+        #: current device count. Layout changes step time, never math —
+        #: fitness is untouched; tournament pressure sees the layout only
+        #: through StepTimeline step-time telemetry.
+        self.sharding_mut = float(sharding)
+        self.sharding_plans = sharding_plans
 
     # ------------------------------------------------------------------ #
     def mutation(self, population: List, pre_training_mut: bool = False) -> List:
@@ -62,6 +71,8 @@ class Mutations:
             (self.activation_mutation, self.activation_mut),
             (self.rl_hyperparam_mutation, self.rl_hp_mut),
         ]
+        if self.sharding_mut > 0:
+            options.append((self.sharding_mutation, self.sharding_mut))
         if pre_training_mut:
             # before training starts only HP/no mutations (parity: pre_training_mut)
             options = [
@@ -187,6 +198,78 @@ class Mutations:
         agent.reinit_optimizers()
         agent.mutation_hook()
         agent.mut = "act"
+        return agent
+
+    # ------------------------------------------------------------------ #
+    def _resolve_sharding_plans(self):
+        """The valid swap set: ``sharding_plans`` entries (names or
+        ShardingPlan objects) filtered to the live device count; with no
+        explicit list, the registry's plans for this topology (seeded with
+        the default GRPO layouts on first use)."""
+        from agilerl_tpu.parallel import plan as PL
+
+        n = len(jax.devices())
+        if self.sharding_plans is None:
+            PL.register_default_plans(n)
+            return PL.plans_for_device_count(n)
+        plans = [
+            PL.get_plan(p) if isinstance(p, str) else p
+            for p in self.sharding_plans
+        ]
+        return [p for p in plans if p.device_count == n]
+
+    def sharding_mutation(self, agent):
+        """Swap the member's sharding layout among the registered plans valid
+        for the current device count (OPT-IN via ``sharding > 0``). The swap
+        re-places params/optimizer via ``agent.to_mesh(plan=...)`` — a
+        layout-only change: step math, fitness and the RNG stream are
+        untouched, so tournament pressure can only feel it through
+        ``StepTimeline`` step-time telemetry."""
+        if not hasattr(agent, "to_mesh"):
+            agent.mut = "None"
+            return agent
+        plans = self._resolve_sharding_plans()
+        current = getattr(agent, "sharding_plan", None)
+        if current is not None:
+            plans = [p for p in plans if p.name != current.name]
+        if not plans:
+            agent.mut = "None"
+            return agent
+        plan = plans[int(self.rng.choice(len(plans)))]
+        # to_mesh re-places trees IN PLACE as it goes; a mid-placement
+        # failure (bad custom plan, OOM) would otherwise strand the agent
+        # with params on the new layout and opt_state on the old one.
+        # Placements are functional (device_put returns new trees), so
+        # holding the old references IS a full snapshot.
+        snapshot = {
+            "base_params": agent.base_params,
+            "actor": agent.actor.params,
+            "reference": agent.reference.params,
+            "opt_state": agent.optimizer.opt_state,
+            "mesh": getattr(agent, "mesh", None),
+            "plan": current,
+        }
+        try:
+            agent.to_mesh(plan=plan)
+        except Exception as e:
+            agent.base_params = snapshot["base_params"]
+            agent.actor.params = snapshot["actor"]
+            agent.reference.params = snapshot["reference"]
+            agent.optimizer.opt_state = snapshot["opt_state"]
+            if snapshot["mesh"] is not None:
+                agent.mesh = snapshot["mesh"]
+            agent.sharding_plan = snapshot["plan"]
+            import warnings
+
+            warnings.warn(
+                f"sharding mutation to plan {plan.name!r} rolled back "
+                f"(agent restored to its previous layout): {e!r}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            agent.mut = "None"
+            return agent
+        agent.mut = f"sharding:{plan.name}"
         return agent
 
     # ------------------------------------------------------------------ #

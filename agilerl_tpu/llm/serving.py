@@ -73,6 +73,41 @@ def _sampling_knobs(gen, greedy: bool, lora) -> Dict[str, Any]:
     )
 
 
+def _resolve_serving_plan(sharding_plan, mesh):
+    """Normalise the (plan, mesh) pair both generators accept (shared
+    ``plan.resolve_plan_and_mesh``). Passing neither keeps the
+    single-device fast path with zero plan machinery on it."""
+    if sharding_plan is None:
+        return None, mesh
+    from agilerl_tpu.parallel.plan import resolve_plan_and_mesh
+
+    return resolve_plan_and_mesh(sharding_plan, mesh)
+
+
+def _constrain_kv(gen, caches):
+    """Pin a KV-cache pytree to the plan's ``kv`` rules inside jit (no-op
+    without a plan). NamedSharding-based constraints need no enclosing mesh
+    context, so call sites stay context-free."""
+    if gen.sharding_plan is None:
+        return caches
+    return jax.tree_util.tree_map(
+        jax.lax.with_sharding_constraint,
+        caches,
+        gen.sharding_plan.shardings("kv", caches, gen.mesh),
+    )
+
+
+def _place_params(gen, params, lora=None):
+    """Place weight trees by the plan's ``params``/``lora`` rules — the host
+    side of serving under a plan (train and serve share one layout)."""
+    if gen.sharding_plan is None:
+        return (params, lora) if lora is not None else params
+    params = gen.sharding_plan.place("params", params, gen.mesh)
+    if lora is not None:
+        return params, gen.sharding_plan.place("lora", lora, gen.mesh)
+    return params
+
+
 def measured_cache_size(*jitted) -> int:
     """Total LIVE compiled-program count across jitted callables, read from
     the jit caches themselves (VERDICT r4 #4: a self-inserted signature set
@@ -114,11 +149,19 @@ class BucketedGenerator:
         min_new_tokens: Optional[int] = None,
         lora_scale: float = 2.0,
         metrics=None,
+        sharding_plan=None,
+        mesh=None,
     ):
         self.config = config
         # latency telemetry: TTFT / per-token decode / queue depth land in
         # this registry (process default unless a dedicated one is passed)
         self.metrics = metrics if metrics is not None else observability.get_registry()
+        # declarative serving layout (parallel/plan.py): the plan's "kv"
+        # rules pin the cache layout inside prefill (batch over (dp,fsdp),
+        # kv-heads over tp) and place_params places weight trees by the
+        # "params"/"lora" rules — one ShardingPlan covers train AND serve
+        self.sharding_plan, self.mesh = _resolve_serving_plan(
+            sharding_plan, mesh)
         self._pending_rows = 0
         self._pending_lock = threading.Lock()
         self.pad_id = int(pad_id)
@@ -146,11 +189,16 @@ class BucketedGenerator:
     def _knobs(self, greedy: bool, lora) -> Dict[str, Any]:
         return _sampling_knobs(self, greedy, lora)
 
+    def place_params(self, params, lora=None):
+        """Place weight trees by the construction-time plan's rules (no-op
+        without one)."""
+        return _place_params(self, params, lora)
+
     def _prefill_impl(self, params, lora, prompt, prompt_mask, row_valid,
                       key, greedy=False):
         B, P = prompt.shape
-        caches = M.init_caches(
-            self.config, B, P + self.n_chunks * self.decode_chunk)
+        caches = _constrain_kv(self, M.init_caches(
+            self.config, B, P + self.n_chunks * self.decode_chunk))
         return prefill_head(
             self.config, params, prompt, prompt_mask, caches, key,
             row_valid=row_valid, **self._knobs(greedy, lora),
@@ -508,9 +556,16 @@ class ContinuousGenerator:
         min_slo_samples: int = 20,
         free_block_watermark: float = 0.0,
         prefix_cache: bool = True,
+        sharding_plan=None,
+        mesh=None,
     ):
         self.config = config
         self.metrics = metrics if metrics is not None else observability.get_registry()
+        # declarative serving layout: the paged pool is placed by the plan's
+        # "kv" rules at allocation (kv-heads over tp; the pool has no batch
+        # dim so (dp,fsdp) entries filter away), weights via place_params
+        self.sharding_plan, self.mesh = _resolve_serving_plan(
+            sharding_plan, mesh)
         self.pad_id = int(pad_id)
         self.eos_id = eos_id
         self.prompt_buckets = tuple(sorted(prompt_buckets))
@@ -701,10 +756,20 @@ class ContinuousGenerator:
     def _occupancy(self) -> int:
         return sum(r is not None for r in self._slot_req)
 
+    def place_params(self, params, lora=None):
+        """Place weight trees by the construction-time plan's rules (no-op
+        without one)."""
+        return _place_params(self, params, lora)
+
     def _ensure_pool(self) -> None:
         if self._pool is None:
-            self._pool = M.init_paged_cache(
+            pool = M.init_paged_cache(
                 self.config, self.n_blocks, self.block_size)
+            if self.sharding_plan is not None:
+                # kv_paged, NOT kv: the pool's axis 1 is global block ids —
+                # the dense rules' (dp,fsdp) batch entry must never touch it
+                pool = self.sharding_plan.place("kv_paged", pool, self.mesh)
+            self._pool = pool
 
     def _chain_hashes(self, toks_row: np.ndarray,
                       mask_row: np.ndarray) -> List[bytes]:

@@ -17,6 +17,23 @@ from agilerl_tpu.parallel.mesh import (
     shard_params,
 )
 from agilerl_tpu.parallel.multi_agent import EvoIPPO, IPPOMemberState
+from agilerl_tpu.parallel.plan import (
+    ShardingPlan,
+    UnmatchedLeafError,
+    compile_step_with_plan,
+    get_plan,
+    grpo_plan_for_mesh,
+    load_plan,
+    make_grpo_plan,
+    make_population_plan,
+    match_partition_rules,
+    plans_for_device_count,
+    register_default_plans,
+    register_plan,
+    registered_plans,
+    resolve_plan_and_mesh,
+)
+from agilerl_tpu.parallel.tree_paths import named_tree_map, tree_path_to_string
 from agilerl_tpu.parallel.multihost import barrier, broadcast_seed, init_multihost
 from agilerl_tpu.parallel.off_policy import EvoDDPG, EvoDQN, EvoRainbow, EvoTD3
 from agilerl_tpu.parallel.population import EvoPPO, MemberState
@@ -29,4 +46,10 @@ __all__ = [
     "tournament_select", "gaussian_mutate",
     "make_vmap_generation", "make_pod_generation",
     "init_multihost", "broadcast_seed", "barrier",
+    "ShardingPlan", "UnmatchedLeafError", "compile_step_with_plan",
+    "match_partition_rules", "named_tree_map", "tree_path_to_string",
+    "make_grpo_plan", "make_population_plan", "grpo_plan_for_mesh",
+    "register_plan", "register_default_plans", "registered_plans",
+    "get_plan", "load_plan", "plans_for_device_count",
+    "resolve_plan_and_mesh",
 ]

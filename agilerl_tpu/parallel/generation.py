@@ -328,8 +328,10 @@ def make_pod_generation(
     extract: Callable,
     evolve_extracted: Callable,
     insert: Callable,
+    plan=None,
+    pop_axis: str = "pop",
 ) -> Callable:
-    """Pod-sharded: members shard over the ``"pop"`` mesh axis (any number
+    """Pod-sharded: members shard over the population mesh axis (any number
     per device); training runs locally, then fitness + ONLY the extracted
     learner subtree all-gather over ICI and evolution runs
     replicated-deterministically on every device. Replay rings and env
@@ -339,35 +341,58 @@ def make_pod_generation(
     ``extract(pop_local)`` picks the subtree evolution needs;
     ``evolve_extracted(gathered, fitness, key)`` returns the new ``[P, ...]``
     subtree; ``insert(pop_local, mine)`` splices this device's slice back
-    (and applies any boundary resets, e.g. ep_ret segmentation)."""
+    (and applies any boundary resets, e.g. ep_ret segmentation).
+
+    ``plan`` (a :class:`~agilerl_tpu.parallel.plan.ShardingPlan`, or a
+    registered name) declares the member layout: its mesh is used when
+    ``mesh`` is None, its population axis is the plan's last axis, and the
+    member specs come from its ``member`` rule group instead of the
+    hard-coded leading-axis split."""
     from agilerl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
-    assert "pop" in mesh.axis_names
+    if plan is not None:
+        from agilerl_tpu.parallel import plan as PL
+
+        plan, mesh = PL.resolve_plan_and_mesh(plan, mesh)
+        # the population axis is the plan's LAST mesh axis in build_mesh's
+        # canonical order (ordered_axes/AXIS_ORDER — raw dict order would
+        # disagree with the mesh the plan itself builds)
+        axis_candidates = [a for a, _ in plan.ordered_axes()
+                           if a in mesh.axis_names]
+        pop_axis = axis_candidates[-1] if axis_candidates else pop_axis
+    if mesh is None:
+        raise ValueError("make_pod_generation needs a mesh or a plan")
+    assert pop_axis in mesh.axis_names
+
+    def member_specs(pop):
+        if plan is not None and "member" in plan.rules:
+            return plan.resolve("member", pop, mesh)
+        return jax.tree_util.tree_map(lambda _: P(pop_axis), pop)
 
     def gen(pop, key: jax.Array):
         def per_device(pop_local, key):
             pop_local, fit_local = jax.vmap(member_iteration)(pop_local)
-            fit_all = jax.lax.all_gather(fit_local, "pop", tiled=True)
+            fit_all = jax.lax.all_gather(fit_local, pop_axis, tiled=True)
             gathered = jax.tree_util.tree_map(
-                lambda x: jax.lax.all_gather(x, "pop", tiled=True),
+                lambda x: jax.lax.all_gather(x, pop_axis, tiled=True),
                 extract(pop_local),
             )
             evolved = evolve_extracted(gathered, fit_all, key)
             n_local = jax.tree_util.tree_leaves(pop_local)[0].shape[0]
-            my = jax.lax.axis_index("pop")
+            my = jax.lax.axis_index(pop_axis)
             mine = jax.tree_util.tree_map(
                 lambda x: jax.lax.dynamic_slice_in_dim(x, my * n_local, n_local),
                 evolved,
             )
             return insert(pop_local, mine), fit_all
 
-        specs = P("pop")
+        specs = member_specs(pop)
         return shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
-            out_specs=(jax.tree_util.tree_map(lambda _: specs, pop), P()),
+            in_specs=(specs, P()),
+            out_specs=(specs, P()),
             check_vma=False,
         )(pop, key)
 
@@ -685,7 +710,7 @@ class ScanOffPolicy:
     def make_vmap_generation(self) -> Callable:
         return make_vmap_generation(self.member_iteration, self.evolve)
 
-    def make_pod_generation(self, mesh) -> Callable:
+    def make_pod_generation(self, mesh=None, plan=None) -> Callable:
         return make_pod_generation(
             mesh,
             self.member_iteration,
@@ -694,6 +719,7 @@ class ScanOffPolicy:
             insert=lambda pop, mine: pop._replace(
                 learner=mine, ep_ret=jnp.zeros_like(pop.ep_ret)
             ),
+            plan=plan,
         )
 
     # -- snapshots ------------------------------------------------------------ #
@@ -759,10 +785,16 @@ class ScanRun:
         mesh=None,
         telemetry=None,
         index: int = 0,
+        plan=None,
     ):
         self.engine = engine
         self.pop_size = int(pop_size)
+        if plan is not None:
+            from agilerl_tpu.parallel import plan as PL
+
+            plan, mesh = PL.resolve_plan_and_mesh(plan, mesh)
         self.mesh = mesh
+        self.plan = plan
         self.telemetry = telemetry
         self.index = index  # lineage/eval-facade compatibility
         key = jax.random.PRNGKey(int(seed))
@@ -775,7 +807,7 @@ class ScanRun:
     def _generation_fn(self) -> Callable:
         if self._gen_fn is None:
             self._gen_fn = (
-                self.engine.make_pod_generation(self.mesh)
+                self.engine.make_pod_generation(self.mesh, plan=self.plan)
                 if self.mesh is not None
                 else self.engine.make_vmap_generation()
             )

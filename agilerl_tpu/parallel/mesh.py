@@ -66,9 +66,35 @@ def make_multislice_mesh(dcn_dp: int, fsdp: int, tp: int = 1) -> Mesh:
 
 
 def gpt_param_specs(config: GPTConfig) -> Dict:
-    """PartitionSpec tree matching llm/model.init_params. MoE layers shard the
-    stacked expert weights on the ep axis (one all-to-all pair per layer,
-    inserted by GSPMD around the expert einsums in llm/moe.py)."""
+    """PartitionSpec tree matching llm/model.init_params.
+
+    DEPRECATED shim: the specs now come from the declarative rule engine
+    (``parallel/plan.gpt_param_rules`` resolved by ``match_partition_rules``)
+    — prefer ``ShardingPlan.resolve("params", params_tree)``. Output is
+    spec-identical to the original hand-built tree (gate:
+    tests/test_parallel/test_plan.py vs ``_handbuilt_gpt_param_specs``)."""
+    from agilerl_tpu.observability.facade import warn_once
+    from agilerl_tpu.parallel.plan import gpt_param_rules, match_partition_rules
+
+    warn_once(
+        "deprecated/gpt_param_specs",
+        "gpt_param_specs is a deprecated shim over the sharding-plan rule "
+        "engine; use parallel.plan.ShardingPlan.resolve('params', tree) "
+        "(docs/sharding.md)",
+    )
+    from agilerl_tpu.llm.model import init_params
+
+    shapes = jax.eval_shape(
+        lambda k: init_params(k, config), jax.random.PRNGKey(0)
+    )
+    return match_partition_rules(gpt_param_rules(), shapes)
+
+
+def _handbuilt_gpt_param_specs(config: GPTConfig) -> Dict:
+    """The original hand-written spec tree, kept VERBATIM as the equivalence
+    reference the rule engine is tested against (MoE layers shard the stacked
+    expert weights on the ep axis; one all-to-all pair per layer, inserted by
+    GSPMD around the expert einsums in llm/moe.py)."""
     dense_block = {
         "ln1": P(),
         "wq": P("fsdp", "tp"),
@@ -121,50 +147,49 @@ def filter_spec(spec: P, mesh: Mesh) -> P:
 
 
 def lora_specs(lora: Any) -> Any:
-    """LoRA: A row-sharded on fsdp, B col-sharded on tp."""
+    """LoRA: A row-sharded on fsdp, B col-sharded on tp.
 
-    def spec(path, leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name == "A":
-            return P("fsdp", None)
-        if name == "B":
-            return P(None, "tp")
-        return P()
+    DEPRECATED shim over the rule engine (``parallel/plan.lora_rules``) —
+    prefer ``ShardingPlan.resolve("lora", tree)``. Spec-identical output,
+    including the explicit trailing ``None`` entries."""
+    from agilerl_tpu.observability.facade import warn_once
+    from agilerl_tpu.parallel.plan import lora_rules, match_partition_rules
 
-    return jax.tree_util.tree_map_with_path(spec, lora)
+    warn_once(
+        "deprecated/lora_specs",
+        "lora_specs is a deprecated shim over the sharding-plan rule engine; "
+        "use parallel.plan.ShardingPlan.resolve('lora', tree) "
+        "(docs/sharding.md)",
+    )
+    return match_partition_rules(lora_rules(), lora)
 
 
 def shard_like(tree: Any, template: Any, template_specs: Any, mesh: Mesh) -> Any:
     """Place every leaf of `tree` whose shape matches the corresponding
     template leaf with that leaf's spec; everything else replicated.
-    Covers optimizer states (same-shaped moments) without bespoke rules."""
-    shapes_to_spec = {}
 
-    def record(spec, leaf):
-        shapes_to_spec.setdefault(leaf.shape, spec)
-        return leaf
+    DEPRECATED shim over ``parallel/plan.place_by_shape`` — optimizer states
+    are better served by name-matched rules (``optimizer_rules``: optax paths
+    embed the param path), which is what ``ShardingPlan.place("optimizer",
+    ...)`` resolves."""
+    from agilerl_tpu.observability.facade import warn_once
+    from agilerl_tpu.parallel.plan import place_by_shape
 
-    jax.tree_util.tree_map(record, template_specs, template)
-
-    def place(leaf):
-        spec = shapes_to_spec.get(getattr(leaf, "shape", None), P())
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
-
-    return jax.tree_util.tree_map(place, tree)
+    warn_once(
+        "deprecated/shard_like",
+        "shard_like is a deprecated shim; use parallel.plan.place_by_shape "
+        "or ShardingPlan.place('optimizer', tree, mesh) (docs/sharding.md)",
+    )
+    return place_by_shape(tree, template, template_specs, mesh)
 
 
 def shard_params(params: Any, config: GPTConfig, mesh: Mesh) -> Any:
-    # drop axes the mesh doesn't carry (e.g. MoE "ep" specs on a dp/fsdp/tp
-    # mesh — review finding: NamedSharding rejects unknown axis names)
-    specs = jax.tree_util.tree_map(
-        lambda s: filter_spec(s, mesh), gpt_param_specs(config),
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    return jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        params, specs,
-        is_leaf=lambda x: isinstance(x, jnp.ndarray) or hasattr(x, "shape"),
-    )
+    """Place a GPT param tree with the built-in rule set (axes the mesh
+    doesn't carry degrade to replication — review finding: NamedSharding
+    rejects unknown axis names)."""
+    from agilerl_tpu.parallel.plan import grpo_plan_for_mesh
+
+    return grpo_plan_for_mesh(mesh).place("params", params, mesh)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -181,33 +206,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
 # --------------------------------------------------------------------------- #
 
 
-def make_sharded_grpo_step(agent, mesh: Mesh):
+def make_sharded_grpo_step(agent, mesh: Mesh, plan=None):
     """Place the agent's params/opt-state with GSPMD shardings IN PLACE and
-    return the sharded update fn. The update is the same pure function GRPO
-    uses; sharding comes entirely from placing params/batch with NamedShardings
-    and letting GSPMD insert collectives. (Prefer agent.to_mesh(mesh) + the
-    normal learn() API; this builder returns the raw update for benchmarking.)"""
-    config = agent.model_config
-    specs = jax.tree_util.tree_map(
-        lambda s: filter_spec(s, mesh), gpt_param_specs(config),
-        is_leaf=lambda x: isinstance(x, P),
-    )
-    base = jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), agent.base_params, specs
-    )
-    lspecs = lora_specs(agent.actor.params)
-    lora = jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        agent.actor.params, lspecs,
-    )
-    agent.base_params = base
-    agent.actor.params = lora
-    agent.reference.params = jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        agent.reference.params, lspecs,
-    )
-    agent.optimizer.opt_state = shard_like(
-        agent.optimizer.opt_state, lora, lspecs, mesh
+    return the sharded update fn — now a thin wrapper over the built-in GRPO
+    rule set (``parallel/plan.grpo_plan_for_mesh``); pass ``plan`` to resolve
+    through a custom :class:`~agilerl_tpu.parallel.plan.ShardingPlan`
+    instead. The update is the same pure function GRPO uses; sharding comes
+    entirely from rule-resolved placements and GSPMD's inserted collectives.
+    (Prefer agent.to_mesh(mesh) + the normal learn() API; this builder
+    returns the raw update for benchmarking.)"""
+    from agilerl_tpu.parallel.plan import grpo_plan_for_mesh
+
+    if plan is None:
+        plan = grpo_plan_for_mesh(mesh)
+    agent.base_params = plan.place("params", agent.base_params, mesh)
+    agent.actor.params = plan.place("lora", agent.actor.params, mesh)
+    agent.reference.params = plan.place("lora", agent.reference.params, mesh)
+    agent.optimizer.opt_state = plan.place(
+        "optimizer", agent.optimizer.opt_state, mesh
     )
     update = agent.jit_fn("update", agent._update_fn)
     bsh = batch_sharding(mesh)

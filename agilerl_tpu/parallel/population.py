@@ -268,11 +268,13 @@ class EvoPPO:
         generation (measurable on the HBM/memory-bound hot loop)."""
         return make_vmap_generation(self.member_iteration, self.evolve)
 
-    def make_pod_generation(self, mesh: Mesh) -> Callable:
+    def make_pod_generation(self, mesh: Mesh = None, plan=None) -> Callable:
         """Pod-sharded: members shard over the 'pop' axis (any number per
         device); fitness and ONLY the evolution subtrees (actor, critic,
         optimizer) all-gather over ICI inside shard_map — env states stay
-        device-local (the pre-refactor path gathered the whole member)."""
+        device-local (the pre-refactor path gathered the whole member).
+        ``plan`` (ShardingPlan or registered name) supplies the mesh and the
+        member layout rules declaratively."""
         return make_pod_generation(
             mesh,
             self.member_iteration,
@@ -281,4 +283,5 @@ class EvoPPO:
             insert=lambda pop, mine: pop._replace(
                 actor=mine[0], critic=mine[1], opt_state=mine[2]
             ),
+            plan=plan,
         )

@@ -1,0 +1,64 @@
+"""Pytree path utilities for the declarative sharding engine.
+
+The rule engine (``parallel/plan.py``) matches regex rules against the
+``/``-joined key path of every leaf — the EasyLM/fmengine
+``named_tree_map`` lineage (SNIPPETS.md [1]/[2]) — so one ordered rule list
+covers params, optimizer moments (whose paths EMBED the param path, e.g.
+``0/mu/blocks/0/wq/A``), batches and KV caches without bespoke per-tree code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+
+PyTree = Any
+
+
+def path_entry_to_string(key: Any) -> str:
+    """One jax key-path entry -> its bare string name/index."""
+    if isinstance(key, jax.tree_util.SequenceKey):
+        return str(key.idx)
+    if isinstance(key, jax.tree_util.DictKey):
+        return str(key.key)
+    if isinstance(key, jax.tree_util.GetAttrKey):
+        return str(key.name)
+    if isinstance(key, jax.tree_util.FlattenedIndexKey):
+        return str(key.key)
+    return str(key)
+
+
+def tree_path_to_string(
+    path: Tuple[Any, ...], sep: Optional[str] = "/"
+) -> Union[str, Tuple[str, ...]]:
+    """jax key path -> ``sep``-joined string (or the tuple of names when
+    ``sep`` is None)."""
+    keys = tuple(path_entry_to_string(k) for k in path)
+    if sep is None:
+        return keys
+    return sep.join(keys)
+
+
+def named_tree_map(
+    f: Callable[..., Any],
+    tree: PyTree,
+    *rest: PyTree,
+    is_leaf: Optional[Callable[[Any], bool]] = None,
+    sep: Optional[str] = "/",
+) -> PyTree:
+    """``jax.tree_util.tree_map`` where ``f`` receives ``(name, leaf, *rest)``
+    with ``name`` the leaf's key path rendered through ``sep``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x, *r: f(tree_path_to_string(path, sep=sep), x, *r),
+        tree,
+        *rest,
+        is_leaf=is_leaf,
+    )
+
+
+def tree_paths(tree: PyTree, sep: Optional[str] = "/") -> list:
+    """All leaf paths of ``tree`` (rendered through ``sep``), in flatten
+    order — handy for debugging unmatched-rule errors."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [tree_path_to_string(path, sep=sep) for path, _ in flat]

@@ -584,6 +584,118 @@ def bench_anakin():
     }), flush=True)
 
 
+def bench_sharding():
+    """Sharding-plan engine bench (docs/sharding.md): times (a) rule
+    resolution — regex rules -> PartitionSpec trees for the llama3-8b
+    params/lora/optimizer/batch pytrees — and (b) the 7B fsdp16xtp4 plan
+    loaded from configs/sharding/*.yaml driving the production GRPO update
+    through compile_step_with_plan (AOT lower on 64 virtual CPU devices;
+    BENCH_SHARDING_COMPILE=1 adds the full GSPMD compile). Also re-emits the
+    standing 10/10 TPU AOT sweep provenance (benchmarking/tpu_aot_report.json,
+    captured via the real XLA:TPU compile-only topology) while the pool is
+    down. Run with BENCH_MODE=sharding."""
+    import subprocess
+    import sys
+
+    import jax
+
+    from agilerl_tpu.algorithms.core.optimizer import OptimizerWrapper
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.llm.presets import preset
+    from agilerl_tpu.parallel.plan import make_grpo_plan
+
+    backend = jax.default_backend()
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    # ---- (a) rule resolution timing (the pure-host cost a new mesh pays) -
+    cfg = preset("llama3-8b", max_seq_len=2048, use_flash_attention=False)
+    plan = make_grpo_plan(fsdp=16, tp=4)
+    base_shapes = jax.eval_shape(lambda k: M.init_params(k, cfg),
+                                 jax.random.PRNGKey(0))
+    lora_shapes = jax.eval_shape(lambda k: M.init_lora(k, cfg, 16),
+                                 jax.random.PRNGKey(0))
+    opt_shapes = jax.eval_shape(
+        OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1).tx.init,
+        lora_shapes)
+    n_leaves = sum(
+        len(jax.tree_util.tree_leaves(t))
+        for t in (base_shapes, lora_shapes, opt_shapes))
+    reps = int(os.environ.get("BENCH_SHARDING_REPEATS", 5))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        plan.resolve("params", base_shapes)
+        plan.resolve("lora", lora_shapes)
+        plan.resolve("optimizer", opt_shapes)
+    resolve_ms = (time.perf_counter() - t0) / reps * 1e3
+    log(f"bench_sharding: resolved {n_leaves} leaves in {resolve_ms:.1f}ms")
+
+    # ---- (b) the 7B plan end to end (subprocess: it must own XLA_FLAGS
+    # before the first backend touch to fake the 64-device topology) -------
+    compile_ = os.environ.get("BENCH_SHARDING_COMPILE") == "1"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("BENCH_CHILD", None)
+    args = [sys.executable,
+            os.path.join(repo, "benchmarking", "grpo_7b_plan.py")]
+    if compile_:
+        args.append("--compile")
+    plan7b = {"error": None}
+    try:
+        proc = subprocess.run(
+            args, env=env, cwd=repo, text=True, timeout=float(
+                os.environ.get("BENCH_SHARDING_7B_TIMEOUT", 600)),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        rep = json.loads(proc.stdout.strip().splitlines()[-1])
+        plan7b = {
+            "sharding_plan": rep.get("sharding_plan"),
+            "plan_source": rep.get("sharding_plan_source"),
+            "mesh": rep.get("mesh"),
+            "train_lower_seconds": rep.get("train_lower_seconds"),
+            "train_compile_seconds": rep.get("train_compile_seconds"),
+            "train_step_pflops": rep.get("train_step_pflops"),
+            "sharding_annotations": rep.get("train_sharding_annotations"),
+            "error": None,
+        }
+        log(f"bench_sharding: 7B plan {rep.get('sharding_plan')} lowered in "
+            f"{rep.get('train_lower_seconds')}s "
+            f"({rep.get('train_sharding_annotations')} annotations)")
+    except Exception as e:  # noqa: BLE001 — bench must always emit JSON
+        plan7b["error"] = f"{type(e).__name__}: {str(e)[:500]}"
+
+    # ---- (c) standing TPU AOT sweep provenance (pool-down re-emission) ---
+    aot = None
+    try:
+        with open(os.path.join(repo, "benchmarking",
+                               "tpu_aot_report.json")) as fh:
+            rep = json.load(fh)
+        targets = rep.get("targets", {})
+        aot = {
+            "targets_ok": sum(1 for t in targets.values() if t.get("ok")),
+            "targets_total": len(targets),
+            "device_kind": rep.get("device_kind"),
+            "provenance": ("standing compile-only XLA:TPU sweep "
+                           "(benchmarking/tpu_aot_compile.py; may predate "
+                           "HEAD — re-run in a TPU up-window to refresh)"),
+        }
+    except (OSError, json.JSONDecodeError):
+        pass
+
+    print(json.dumps({
+        "metric": ("sharding-plan engine: rule-resolution ms for the "
+                   f"llama3-8b param/lora/optimizer trees ({n_leaves} "
+                   "leaves) + 7B plan lowering through "
+                   "compile_step_with_plan"),
+        "value": round(resolve_ms, 1),
+        "unit": "ms/resolution",
+        "vs_baseline": None,
+        "plan_7b": plan7b,
+        "tpu_aot_sweep": aot,
+        "backend": backend,
+        "error": plan7b.get("error"),
+    }), flush=True)
+
+
 def _cpu_pinned() -> bool:
     """True iff JAX_PLATFORMS is an exact "cpu" pin. A fallback list like
     "axon,cpu" is NOT a pin — the accelerator should still be attempted."""
@@ -631,6 +743,8 @@ def child_main():
         bench_serving()
     elif mode == "anakin":
         bench_anakin()
+    elif mode == "sharding":
+        bench_sharding()
     else:
         bench_evoppo()
 
@@ -848,11 +962,12 @@ def parent_main():
         else "pipelined off-policy hot-loop env-steps/sec" if mode == "pipeline"
         else "serving-tier continuous vs batch-sync tokens/sec" if mode == "serving"
         else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
+        else "sharding-plan resolution + 7B plan compile" if mode == "sharding"
         else "evo-PPO aggregate env-steps/sec"
     )
     errors = []
 
-    if mode in ("pipeline", "serving", "anakin"):
+    if mode in ("pipeline", "serving", "anakin", "sharding"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
@@ -864,7 +979,9 @@ def parent_main():
             return 0
         print(json.dumps({
             "metric": metric, "value": 0,
-            "unit": "tokens/sec" if mode == "serving" else "env-steps/sec",
+            "unit": ("tokens/sec" if mode == "serving"
+                     else "ms/resolution" if mode == "sharding"
+                     else "env-steps/sec"),
             "vs_baseline": 0.0, "backend": None,
             "error": f"{mode} micro-bench: {err}",
         }), flush=True)

@@ -171,11 +171,43 @@ def scenarios_main(args):
     return out
 
 
+#: the canonical scenario's mesh now loads from the DECLARATIVE plan file —
+#: the hand-built fsdp16xtp4 spec scatter this module used to carry inline
+PLAN_YAML = {
+    "fsdp16xtp4": "grpo_7b_fsdp16xtp4.yaml",
+    "dp2xfsdp8xtp4": "grpo_7b_dp2xfsdp8xtp4.yaml",
+}
+
+
+def _load_or_build_plan(dp, fsdp, tp):
+    """Load the committed YAML plan matching this mesh shape, else build the
+    same rule set programmatically (any shape works — that is the point of
+    the rule engine)."""
+    from agilerl_tpu.parallel.plan import ShardingPlan, make_grpo_plan
+
+    mesh_name = (f"dp{dp}x" if dp > 1 else "") + f"fsdp{fsdp}xtp{tp}"
+    fname = PLAN_YAML.get(mesh_name)
+    if fname is not None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "configs", "sharding", fname)
+        if os.path.exists(path):
+            plan = ShardingPlan.from_yaml(path)
+            # the YAML's dcn block marks multi-slice axes, but this rehearsal
+            # runs on virtual CPU devices with no slice structure — build the
+            # mesh single-slice while keeping the rules
+            plan.dcn = {}
+            return plan, mesh_name, f"configs/sharding/{fname}"
+    return make_grpo_plan(dp=dp, fsdp=fsdp, tp=tp), mesh_name, "builtin rules"
+
+
 def plan_one(devices, tp, dp, batch, seq, prompt, new_tokens, preset_name,
              compile_=False):
     """Lower (and optionally compile) the production 7B GRPO train step and
     generation program for ONE (mesh, batch, seq) config; returns
-    (report, hbm_budget). All plan numbers derive from this single config."""
+    (report, hbm_budget). All plan numbers derive from this single config.
+    Shardings resolve through the declarative plan engine
+    (``parallel/plan.compile_step_with_plan``); the canonical fsdp16xtp4
+    layout loads from ``configs/sharding/grpo_7b_fsdp16xtp4.yaml``."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -186,72 +218,53 @@ def plan_one(devices, tp, dp, batch, seq, prompt, new_tokens, preset_name,
     from agilerl_tpu.llm import model as Mod
     from agilerl_tpu.llm.generate import generate
     from agilerl_tpu.llm.presets import preset
-    from agilerl_tpu.parallel.mesh import (
-        filter_spec, gpt_param_specs, lora_specs, make_mesh,
-    )
+    from agilerl_tpu.parallel.plan import compile_step_with_plan
     from agilerl_tpu.utils.hbm_budget import (
         GIB, grpo_hbm_budget, render_budget_md,
     )
 
     fsdp = devices // (tp * dp)
-    mesh = make_mesh(dp=dp, fsdp=fsdp, tp=tp,
-                     devices=jax.devices()[:devices])
+    plan, mesh_name, plan_src = _load_or_build_plan(dp, fsdp, tp)
+    mesh = plan.build_mesh(jax.devices()[:devices])
     cfg = preset(preset_name, max_seq_len=seq, use_flash_attention=False)
     B, T = batch, seq
-    mesh_name = (f"dp{dp}x" if dp > 1 else "") + f"fsdp{fsdp}xtp{tp}"
     lora_rank = 16
     report = {"preset": preset_name, "mesh": mesh_name,
-              "devices": devices, "batch": B, "seq": T}
+              "devices": devices, "batch": B, "seq": T,
+              "sharding_plan": plan.name, "sharding_plan_source": plan_src}
 
-    def abstract(tree, specs):
-        return jax.tree_util.tree_map(
-            lambda l, s: jax.ShapeDtypeStruct(
-                l.shape, l.dtype,
-                sharding=NamedSharding(mesh, filter_spec(s, mesh)),
-            ),
-            tree, specs, is_leaf=lambda x: isinstance(x, P),
-        )
-
-    # ---- abstract param/optimizer trees with the REAL shardings ----------
+    # ---- abstract param/optimizer trees with the RULE-RESOLVED shardings -
     base_shapes = jax.eval_shape(lambda k: Mod.init_params(k, cfg),
                                  jax.random.PRNGKey(0))
     lora_shapes = jax.eval_shape(
         lambda k: Mod.init_lora(k, cfg, lora_rank), jax.random.PRNGKey(0))
-    base_abs = abstract(base_shapes, gpt_param_specs(cfg))
-    lspecs = lora_specs(lora_shapes)
-    lora_abs = abstract(lora_shapes, lspecs)
-
     opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
     opt_shapes = jax.eval_shape(opt.tx.init, lora_shapes)
-    shape_to_spec = {}
-    jax.tree_util.tree_map(
-        lambda s, l: shape_to_spec.setdefault(l.shape, s), lspecs, lora_shapes)
-    opt_abs = jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(
-            l.shape, l.dtype,
-            sharding=NamedSharding(
-                mesh, filter_spec(shape_to_spec.get(l.shape, P()), mesh)),
-        ),
-        opt_shapes,
-    )
-
-    bspec = NamedSharding(mesh, P(("dp", "fsdp")))
-    batch_abs = {
-        "tokens": jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=bspec),
-        "mask": jax.ShapeDtypeStruct((B, T), jnp.int32, sharding=bspec),
-        "loss_mask": jax.ShapeDtypeStruct((B, T - 1), jnp.float32, sharding=bspec),
-        "old_lp": jax.ShapeDtypeStruct((B, T - 1), jnp.float32, sharding=bspec),
-        "ref_lp": jax.ShapeDtypeStruct((B, T - 1), jnp.float32, sharding=bspec),
-        "advantage": jax.ShapeDtypeStruct((B,), jnp.float32, sharding=bspec),
+    batch_shapes = {
+        "tokens": jax.ShapeDtypeStruct((B, T), jnp.int32),
+        "mask": jax.ShapeDtypeStruct((B, T), jnp.int32),
+        "loss_mask": jax.ShapeDtypeStruct((B, T - 1), jnp.float32),
+        "old_lp": jax.ShapeDtypeStruct((B, T - 1), jnp.float32),
+        "ref_lp": jax.ShapeDtypeStruct((B, T - 1), jnp.float32),
+        "advantage": jax.ShapeDtypeStruct((B,), jnp.float32),
     }
     scalar = jax.ShapeDtypeStruct((), jnp.float32)
 
-    # ---- 1. lower the production train step ------------------------------
+    # ---- 1. lower the production train step through the plan engine ------
     update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=False)
+    step = compile_step_with_plan(
+        update, plan,
+        ("params", "lora", "optimizer", "batch", None, None),
+        mesh=mesh,
+        # the underlying update already donates lora/opt_state; donation at
+        # the wrapper would double-donate under AOT lowering
+        constrain_inputs=False,
+    )
+    base_abs, lora_abs, opt_abs, batch_abs, _, _ = step.abstract_args(
+        base_shapes, lora_shapes, opt_shapes, batch_shapes, scalar, scalar)
     t0 = time.time()
-    with mesh:
-        lowered = update.lower(base_abs, lora_abs, opt_abs, batch_abs,
-                               scalar, scalar)
+    lowered = step.lower(base_abs, lora_abs, opt_abs, batch_abs,
+                         scalar, scalar)
     report["train_lower_seconds"] = round(time.time() - t0, 1)
     cost = lowered.cost_analysis()
     if isinstance(cost, (list, tuple)):
@@ -276,6 +289,7 @@ def plan_one(devices, tp, dp, batch, seq, prompt, new_tokens, preset_name,
     # ---- 2. lower the generation program ---------------------------------
     gen_B = 32
     report["gen_rows"] = gen_B
+    bspec = NamedSharding(mesh, P(("dp", "fsdp")))
     prompt_abs = jax.ShapeDtypeStruct((gen_B, prompt), jnp.int32,
                                       sharding=bspec)
     pmask_abs = jax.ShapeDtypeStruct((gen_B, prompt), jnp.int32,

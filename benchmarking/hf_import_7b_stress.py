@@ -151,18 +151,12 @@ def import_stage(args):
     _merge_report(sharded_forward="attempting")
     from jax.sharding import NamedSharding
 
-    from agilerl_tpu.parallel.mesh import (
-        filter_spec, gpt_param_specs, make_mesh,
-    )
+    from agilerl_tpu.parallel.mesh import make_mesh
+    from agilerl_tpu.parallel.plan import grpo_plan_for_mesh
 
     mesh = make_mesh(dp=1, fsdp=2, tp=2)
     t0 = time.time()
-    sharded = jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(
-            leaf, NamedSharding(mesh, filter_spec(spec, mesh))),
-        params, gpt_param_specs(config),
-        is_leaf=lambda x: not isinstance(x, dict),
-    )
+    sharded = grpo_plan_for_mesh(mesh).place("params", params, mesh)
     del params
     wq = sharded["blocks"]["0"]["wq"]
     assert len({s.device for s in wq.addressable_shards}) > 1

@@ -308,57 +308,47 @@ def main(argv=None):
     run("grpo_step_small", grpo_step_small)
 
     # ---- 7B GSPMD for the v5p pod topology ------------------------------
-    from agilerl_tpu.parallel.mesh import (
-        filter_spec, gpt_param_specs, lora_specs, make_mesh,
+    # shardings resolve through the DECLARATIVE plan engine: the same
+    # (regex -> PartitionSpec) rule set the whole repo uses, loaded from
+    # configs/sharding/*.yaml when a committed plan matches the topology.
+    from agilerl_tpu.parallel.plan import (
+        ShardingPlan, compile_step_with_plan, make_grpo_plan,
     )
-    from jax.sharding import Mesh
+
+    def _grpo_plan_for(fsdp, tp):
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "configs", "sharding", f"grpo_7b_fsdp{fsdp}xtp{tp}.yaml")
+        if os.path.exists(path):
+            return ShardingPlan.from_yaml(path), os.path.basename(path)
+        return make_grpo_plan(fsdp=fsdp, tp=tp), "builtin rules"
 
     def _pod_target(use_flash: bool):
         ptopo = topologies.get_topology_desc(args.pod, platform="tpu")
         n = len(ptopo.devices)
         tp = 4 if n % 4 == 0 else 1
         fsdp = n // tp
-        mesh = make_mesh(dp=1, fsdp=fsdp, tp=tp, devices=list(ptopo.devices))
+        plan, plan_src = _grpo_plan_for(fsdp, tp)
+        mesh = plan.build_mesh(list(ptopo.devices))
         cfg = preset("llama3-8b", max_seq_len=2048,
                      use_flash_attention=use_flash,
                      flash_shard_axes=((("dp", "fsdp"), "tp")
                                        if use_flash else None))
         Bt, Tt = (16, 512) if args.quick else (64, 2048)
 
-        def abstract(tree, specs):
-            return jax.tree_util.tree_map(
-                lambda l, sp: jax.ShapeDtypeStruct(
-                    l.shape, l.dtype,
-                    sharding=NamedSharding(mesh, filter_spec(sp, mesh))),
-                tree, specs, is_leaf=lambda x: isinstance(x, P))
-
         base_shapes = jax.eval_shape(lambda k: Mod.init_params(k, cfg),
                                      jax.random.PRNGKey(0))
         lora_shapes = jax.eval_shape(lambda k: Mod.init_lora(k, cfg, 16),
                                      jax.random.PRNGKey(0))
-        base_abs = abstract(base_shapes, gpt_param_specs(cfg))
-        lspecs = lora_specs(lora_shapes)
-        lora_abs = abstract(lora_shapes, lspecs)
         opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
         opt_shapes = jax.eval_shape(opt.tx.init, lora_shapes)
-        shape_to_spec = {}
-        jax.tree_util.tree_map(
-            lambda sp, l: shape_to_spec.setdefault(l.shape, sp),
-            lspecs, lora_shapes)
-        opt_abs = jax.tree_util.tree_map(
-            lambda l: jax.ShapeDtypeStruct(
-                l.shape, l.dtype,
-                sharding=NamedSharding(
-                    mesh, filter_spec(shape_to_spec.get(l.shape, P()), mesh))),
-            opt_shapes)
-        bspec = NamedSharding(mesh, P(("dp", "fsdp")))
-        batch_abs = {
-            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
-            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
-            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
-            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
-            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
-            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32, sharding=bspec),
+        batch_shapes = {
+            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32),
+            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32),
+            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32),
+            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32),
+            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32),
+            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32),
         }
         scalar = jax.ShapeDtypeStruct((), jnp.float32)
         # flash attention stays Pallas at pod scale (custom partitioning over
@@ -366,14 +356,20 @@ def main(argv=None):
         # tp-sharded path — see make_update_fn's use_fused_loss note
         update = make_update_fn(cfg, opt.tx, lora_scale=2.0,
                                 use_flash=use_flash, use_fused_loss=False)
+        step = compile_step_with_plan(
+            update, plan,
+            ("params", "lora", "optimizer", "batch", None, None),
+            mesh=mesh, constrain_inputs=False)
+        abs_args = step.abstract_args(base_shapes, lora_shapes, opt_shapes,
+                                      batch_shapes, scalar, scalar)
         from agilerl_tpu.utils.profiling import transformer_flops_per_token
         with mesh:
-            rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
-                                    scalar, scalar), args.pod, n,
+            rec = _compile(step._jit_fn, abs_args, args.pod, n,
                            analytic_flops=(transformer_flops_per_token(cfg)
                                            * Bt * Tt))
         rec["mesh"] = f"fsdp{fsdp}xtp{tp}"
         rec["batch"], rec["seq"] = Bt, Tt
+        rec["sharding_plan"], rec["sharding_plan_source"] = plan.name, plan_src
         return rec
 
     run("grpo_7b_gspmd", lambda: _pod_target(use_flash=False))
@@ -384,7 +380,8 @@ def main(argv=None):
     # dW cotangent psummed by the transpose) — the single-slice recipe
     def grpo_fsdp_fused():
         n = len(topo.devices)
-        mesh = make_mesh(dp=1, fsdp=n, tp=1, devices=list(topo.devices))
+        plan = make_grpo_plan(fsdp=n)
+        mesh = plan.build_mesh(list(topo.devices))
         cfg = Mod.GPTConfig(
             vocab_size=32768, n_layer=4, n_head=8, n_kv_head=4,
             d_model=512, d_ff=1408, max_seq_len=512,
@@ -394,45 +391,39 @@ def main(argv=None):
         Bt, Tt = (n, 128) if args.quick else (2 * n, 512)
         opt = OptimizerWrapper(optimizer="adamw", lr=5e-6, max_grad_norm=0.1)
 
-        def abstract(shapes, specs=None):
-            if specs is None:
-                return jax.tree_util.tree_map(
-                    lambda l: jax.ShapeDtypeStruct(
-                        l.shape, l.dtype,
-                        sharding=NamedSharding(mesh, P())), shapes)
-            return jax.tree_util.tree_map(
-                lambda l, sp: jax.ShapeDtypeStruct(
-                    l.shape, l.dtype,
-                    sharding=NamedSharding(mesh, filter_spec(sp, mesh))),
-                shapes, specs, is_leaf=lambda x: isinstance(x, P))
-
         base_shapes = jax.eval_shape(lambda k: Mod.init_params(k, cfg),
                                      jax.random.PRNGKey(0))
         lora_shapes = jax.eval_shape(lambda k: Mod.init_lora(k, cfg, 8),
                                      jax.random.PRNGKey(0))
-        base_abs = abstract(base_shapes, gpt_param_specs(cfg))
-        lora_abs = abstract(lora_shapes, lora_specs(lora_shapes))
-        opt_abs = abstract(jax.eval_shape(opt.tx.init, lora_shapes))
-        bspec = NamedSharding(mesh, P(("dp", "fsdp")))
-        batch_abs = {
-            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
-            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32, sharding=bspec),
-            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
-            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
-            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32, sharding=bspec),
-            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32, sharding=bspec),
+        opt_shapes = jax.eval_shape(opt.tx.init, lora_shapes)
+        batch_shapes = {
+            "tokens": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32),
+            "mask": jax.ShapeDtypeStruct((Bt, Tt), jnp.int32),
+            "loss_mask": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32),
+            "old_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32),
+            "ref_lp": jax.ShapeDtypeStruct((Bt, Tt - 1), jnp.float32),
+            "advantage": jax.ShapeDtypeStruct((Bt,), jnp.float32),
         }
         scalar = jax.ShapeDtypeStruct((), jnp.float32)
         update = make_update_fn(cfg, opt.tx, lora_scale=2.0, use_flash=True,
                                 use_fused_loss=True)
+        # NB: the plan's optimizer rules shard the adam moments like their
+        # params (the production layout); the pre-plan harness left the opt
+        # state replicated here, so this target's fingerprint moved once
+        step = compile_step_with_plan(
+            update, plan,
+            ("params", "lora", "optimizer", "batch", None, None),
+            mesh=mesh, constrain_inputs=False)
+        abs_args = step.abstract_args(base_shapes, lora_shapes, opt_shapes,
+                                      batch_shapes, scalar, scalar)
         from agilerl_tpu.utils.profiling import transformer_flops_per_token
         with mesh:
-            rec = _compile(update, (base_abs, lora_abs, opt_abs, batch_abs,
-                                    scalar, scalar), args.topology, n,
+            rec = _compile(step._jit_fn, abs_args, args.topology, n,
                            analytic_flops=(transformer_flops_per_token(cfg)
                                            * Bt * Tt))
         rec["mesh"] = f"fsdp{n}"
         rec["batch"], rec["seq"] = Bt, Tt
+        rec["sharding_plan"] = plan.name
         return rec
 
     run("grpo_fsdp_fused", grpo_fsdp_fused)

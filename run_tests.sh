@@ -21,6 +21,10 @@
 #   bash run_tests.sh anakin     # scan-native generation engine only (ring
 #                                # math, scan algos, pod≡vmap, cross-tier
 #                                # loss gates, scan snapshot/restore)
+#   bash run_tests.sh sharding   # declarative sharding-plan engine only
+#                                # (rule matcher, spec equivalence vs the
+#                                # hand-built trees, plan-compiled steps,
+#                                # YAML plans, layout mutation)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -58,6 +62,13 @@ for arg in "$@"; do
       # gates, autoreset edge cases, scan snapshot determinism)
       MARKER=(-m "anakin")
       SHARDS+=("tests/test_parallel tests/test_envs/test_jax_envs.py tests/test_resilience/test_scan_snapshot.py")
+      ;;
+    sharding)
+      # fast path: the declarative sharding-plan engine (rule matcher +
+      # spec equivalence gates, plan-compiled GRPO step grad parity, YAML
+      # round-trips, registry + opt-in layout mutation, serving KV rules)
+      MARKER=(-m "sharding")
+      SHARDS+=("tests/test_parallel/test_plan.py tests/test_parallel/test_mesh.py")
       ;;
     *) SHARDS+=("$arg") ;;
   esac

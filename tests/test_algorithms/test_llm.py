@@ -66,7 +66,14 @@ class TestGRPO:
         prompts = env.reset()
         comp, cmask = agent.get_action(prompts)
         ids, action_masks = env.assemble_learn_batch(comp, cmask)
-        _, rewards = env.step(comp, cmask)
+        env.step(comp, cmask)
+        # synthetic within-group reward SPREAD: sampled completions can all
+        # earn identical rewards (advantage == 0 -> zero gradient by GRPO
+        # construction), which would vacuously pass the base check and fail
+        # the lora one — the property under test is the parameter split,
+        # not sampling luck
+        rewards = np.linspace(0.0, 1.0, comp.shape[0], dtype=np.float32)
+        rewards = rewards.reshape(-1, agent.group_size)
         base_before = np.asarray(agent.base_params["blocks"]["0"]["wq"]).copy()
         lora_before = np.asarray(agent.actor.params["blocks"]["0"]["wq"]["B"]).copy()
         loss, _ = agent.learn((ids, action_masks, rewards))

@@ -14,6 +14,10 @@ from agilerl_tpu.parallel.mesh import (
     shard_like,
 )
 
+# the legacy hand-built placement surface is part of the sharding tier (its
+# deprecated shims must stay spec-identical to the rule engine)
+pytestmark = pytest.mark.sharding
+
 
 def test_mesh_construction():
     mesh = make_mesh(dp=1, fsdp=4, tp=2)

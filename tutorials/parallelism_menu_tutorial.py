@@ -23,8 +23,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from agilerl_tpu.llm import model as M
-from agilerl_tpu.parallel.mesh import filter_spec, gpt_param_specs, make_mesh
+from agilerl_tpu.parallel.mesh import make_mesh
 from agilerl_tpu.parallel.pipeline import pipeline_apply
+from agilerl_tpu.parallel.plan import grpo_plan_for_mesh, make_grpo_plan
 
 devices = jax.devices()[:8]
 print(f"devices: {len(devices)} x {devices[0].platform}")
@@ -47,11 +48,9 @@ mesh = make_mesh(dp=1, fsdp=4, tp=2, devices=devices)
 cfg = M.GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64,
                   max_seq_len=32, dtype=jnp.float32)
 params = M.init_params(jax.random.PRNGKey(0), cfg)
-specs = jax.tree_util.tree_map(lambda s: filter_spec(s, mesh),
-                               gpt_param_specs(cfg),
-                               is_leaf=lambda x: isinstance(x, P))
-params = jax.tree_util.tree_map(
-    lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), params, specs)
+# declarative: the built-in GRPO rule set resolved for this mesh (regex
+# rules -> PartitionSpecs; axes the mesh lacks degrade to replication)
+params = grpo_plan_for_mesh(mesh).place("params", params, mesh)
 with mesh:
     loss, grads = jax.jit(jax.value_and_grad(lambda p: ce_loss(cfg, p)))(params)
 print(f"1. fsdp=4 x tp=2 dense GPT: loss {float(loss):.4f} (grads sharded like params)")
@@ -62,9 +61,7 @@ moe_cfg = M.GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64,
                       max_seq_len=32, dtype=jnp.float32,
                       n_experts=8, expert_top_k=2)
 moe_params = M.init_params(jax.random.PRNGKey(1), moe_cfg)
-moe_params = jax.tree_util.tree_map(
-    lambda leaf, spec: jax.device_put(leaf, NamedSharding(ep_mesh, spec)),
-    moe_params, gpt_param_specs(moe_cfg))
+moe_params = make_grpo_plan(ep=8).place("params", moe_params, ep_mesh)
 with ep_mesh:
     moe_loss = jax.jit(lambda p: ce_loss(moe_cfg, p, aux_weight=moe_cfg.router_aux_weight))(moe_params)
 print(f"2. ep=8 MoE GPT (8 experts, top-2): loss+aux {float(moe_loss):.4f} "
