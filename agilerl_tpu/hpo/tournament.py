@@ -47,9 +47,18 @@ class TournamentSelection:
         )
         return int(entrants[np.argmax(fitnesses[entrants])])
 
-    def select(self, population: List) -> Tuple[object, List]:
+    def select(
+        self, population: List, target_size: Optional[int] = None
+    ) -> Tuple[object, List]:
         """Return (elite, next_generation). The elite is always cloned into the
-        next generation when elitism is on (parity: tournament.py:71)."""
+        next generation when elitism is on (parity: tournament.py:71).
+
+        ``target_size`` makes selection **resize-aware** (the elastic-PBT
+        path): the next generation is drawn at that size instead of
+        ``population_size`` — shrinking keeps the fittest via ordinary
+        tournament pressure, growing clones extra tournament winners — and
+        every selection is lineage-recorded as usual, so capacity changes
+        leave a genealogy trail instead of a silent population jump."""
         fitnesses = np.array([self._fitness(a) for a in population])
         elite_idx = int(np.argmax(fitnesses))
         elite = population[elite_idx]
@@ -57,6 +66,7 @@ class TournamentSelection:
             self.lineage.start_generation(
                 {a.index: f for a, f in zip(population, fitnesses)})
 
+        size = self.population_size if target_size is None else max(int(target_size), 1)
         max_id = max(a.index for a in population)
         new_population = []
         if self.elitism:
@@ -64,7 +74,7 @@ class TournamentSelection:
             if self.lineage is not None:
                 self.lineage.record_selection(
                     elite.index, elite.index, fitnesses[elite_idx], elite=True)
-        while len(new_population) < self.population_size:
+        while len(new_population) < size:
             winner_idx = self._tournament(fitnesses)
             winner = population[winner_idx]
             max_id += 1

@@ -34,7 +34,18 @@ from agilerl_tpu.parallel.plan import (
     resolve_plan_and_mesh,
 )
 from agilerl_tpu.parallel.tree_paths import named_tree_map, tree_path_to_string
-from agilerl_tpu.parallel.multihost import barrier, broadcast_seed, init_multihost
+from agilerl_tpu.parallel.elastic import (
+    ElasticPBTController,
+    EmulatedHost,
+    IslandConfig,
+    make_emulated_hosts,
+)
+from agilerl_tpu.parallel.multihost import (
+    barrier,
+    broadcast_seed,
+    call_with_collective_timeout,
+    init_multihost,
+)
 from agilerl_tpu.parallel.off_policy import EvoDDPG, EvoDQN, EvoRainbow, EvoTD3
 from agilerl_tpu.parallel.population import EvoPPO, MemberState
 
@@ -46,6 +57,9 @@ __all__ = [
     "tournament_select", "gaussian_mutate",
     "make_vmap_generation", "make_pod_generation",
     "init_multihost", "broadcast_seed", "barrier",
+    "call_with_collective_timeout",
+    "ElasticPBTController", "EmulatedHost", "IslandConfig",
+    "make_emulated_hosts",
     "ShardingPlan", "UnmatchedLeafError", "compile_step_with_plan",
     "match_partition_rules", "named_tree_map", "tree_path_to_string",
     "make_grpo_plan", "make_population_plan", "grpo_plan_for_mesh",

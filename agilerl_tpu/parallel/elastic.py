@@ -1,0 +1,1071 @@
+"""Elastic preemption-native pod-scale PBT (ROADMAP item 2).
+
+The :class:`ElasticPBTController` runs a scan-native population (``EvoPPO``,
+the ``ScanOffPolicy`` families, ``EvoIPPO`` — anything satisfying the
+``make_pod_generation`` contract) across preemptible multi-host slices and
+treats capacity as a **dynamic quantity** — the Podracer deployment story
+(Hessel et al., 2021) applied to Population Based Training (Jaderberg et
+al., 2017). Four pieces compose:
+
+1. **Membership** — every live host renews a lease through the shared
+   snapshot store (:class:`~agilerl_tpu.resilience.membership.HeartbeatStore`);
+   the leader is the lowest live host id. A vanished host surfaces as a
+   bounded timeout (``resilience/collective_timeouts_total``), never as a
+   hung fitness all-gather.
+2. **Recovery** — on membership change the surviving hosts re-form the mesh
+   by selecting a plan for the new device count from the PR-6 registry
+   (:func:`~agilerl_tpu.parallel.plan.plans_for_device_count`), restore the
+   lost members from the best-fitness
+   :class:`~agilerl_tpu.resilience.snapshot.CheckpointManager` snapshot onto
+   the surviving devices, and resume. Per-member RNG streams, replay rings
+   and env states ride inside the member pytree rows, so the resumed
+   fitness stream is bit-reproducible: surviving members continue their
+   exact stream, restored members replay deterministically from the
+   snapshot.
+3. **Elastic resize** — when capacity shrinks below the population, the
+   worst-fitness members are evicted; when capacity returns, the population
+   grows back by cloning + Gaussian-mutating tournament winners. Both leave
+   lineage events (``elastic_lineage`` records + LineageTracker entries for
+   clones) instead of a silent population jump, and the layout invariant is
+   **zero idle devices**: the population is always a multiple of the live
+   device count.
+4. **Island migration** — independent pods periodically exchange their
+   top-k members through the snapshot store: exports are atomic
+   (:func:`~agilerl_tpu.resilience.atomic.commit_dir`) with per-member
+   fitness at manifest level, imports are refusal-safe (hash-validated,
+   torn exports skipped with a warn and counted in
+   ``elastic/torn_imports_total``).
+
+**Emulation contract (tier-1).** On the CPU test mesh a single process
+drives N *emulated hosts*, each owning a contiguous slice of the local
+devices. Killing an emulated host stops its heartbeat (its lease expires
+within ``heartbeat_timeout``) and removes its devices from the next mesh —
+exactly the observable behaviour of SIGKILL on a real pod host, where the
+survivors' only signals are the stale lease and the collective that stops
+completing. On a real slice, run one controller per process with its own
+``hosts=[EmulatedHost(process_index, local_devices)]`` and the same shared
+``store_dir``; detection then rides :func:`multihost.barrier(timeout=...)
+<agilerl_tpu.parallel.multihost.barrier>` and recovery re-initializes the
+runtime before :meth:`ElasticPBTController.resume`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from agilerl_tpu.parallel.generation import (
+    gaussian_mutate,
+    population_load_state_dict,
+    population_state_dict,
+)
+from agilerl_tpu.parallel.multihost import call_with_collective_timeout
+from agilerl_tpu.resilience.atomic import (
+    TMP_DIR_SUFFIX,
+    CorruptSnapshotError,
+    commit_dir,
+    load_validated_pickle,
+    staged_pickle,
+    staged_write_bytes,
+)
+from agilerl_tpu.resilience.membership import (
+    HeartbeatStore,
+    MembershipChange,
+    MembershipEvent,
+)
+from agilerl_tpu.resilience.snapshot import (
+    CheckpointManager,
+    key_from_host,
+    key_to_host,
+    restore_np_generator,
+)
+
+PyTree = Any
+
+_EXPORT_PREFIX = "export_"
+
+
+class EmulatedHost:
+    """One logical host: an id plus the devices it owns. In tier-1 CPU
+    emulation a single process holds several; on a real pod each process
+    holds exactly one (its ``jax.process_index()`` and local devices)."""
+
+    __slots__ = ("host_id", "devices", "alive", "incarnation")
+
+    def __init__(self, host_id: int, devices: Sequence[Any], alive: bool = True):
+        self.host_id = int(host_id)
+        self.devices = tuple(devices)
+        self.alive = bool(alive)
+        self.incarnation = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        state = "up" if self.alive else "DOWN"
+        return f"EmulatedHost({self.host_id}, {len(self.devices)} devices, {state})"
+
+
+def make_emulated_hosts(
+    n_hosts: int,
+    devices: Optional[Sequence[Any]] = None,
+    devices_per_host: Optional[int] = None,
+) -> List[EmulatedHost]:
+    """Split the local device list into ``n_hosts`` contiguous groups (the
+    CPU pod emulation: conftest forces an 8-device virtual mesh)."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n_hosts = int(n_hosts)
+    if devices_per_host is None:
+        if len(devices) % n_hosts != 0:
+            raise ValueError(
+                f"{len(devices)} devices do not split evenly over "
+                f"{n_hosts} hosts; pass devices_per_host"
+            )
+        devices_per_host = len(devices) // n_hosts
+    need = n_hosts * int(devices_per_host)
+    if need > len(devices):
+        raise ValueError(
+            f"need {need} devices for {n_hosts}x{devices_per_host}, "
+            f"have {len(devices)}"
+        )
+    return [
+        EmulatedHost(h, devices[h * devices_per_host:(h + 1) * devices_per_host])
+        for h in range(n_hosts)
+    ]
+
+
+class IslandConfig:
+    """Island-model migration settings: this pod's identity in the shared
+    exchange directory, how many members to export, and the cadence (in
+    generations; 0 disables exchange)."""
+
+    def __init__(
+        self,
+        island_id: str,
+        exchange_dir: Union[str, Path],
+        top_k: int = 1,
+        every: int = 1,
+        keep_exports: int = 2,
+    ):
+        self.island_id = str(island_id)
+        self.exchange_dir = Path(exchange_dir)
+        self.top_k = max(int(top_k), 1)
+        self.every = int(every)
+        self.keep_exports = max(int(keep_exports), 1)
+
+
+def _export_generation(name: str) -> int:
+    try:
+        return int(name[len(_EXPORT_PREFIX):])
+    except ValueError:
+        return -1
+
+
+class ElasticPBTController:
+    """Drive a scan-native population across hosts that can disappear.
+
+    Parameters beyond the obvious:
+
+    engine:
+        Any population engine exposing ``init_population(key, pop_size)``
+        and ``make_pod_generation(mesh=, plan=)`` (``EvoPPO``, the
+        ``ScanOffPolicy`` family, ``EvoIPPO``).
+    store_dir:
+        The shared store: ``snapshots/`` (CheckpointManager) and
+        ``membership/`` (lease files) live under it. All pods of an island
+        group may share a filesystem but each needs its own ``store_dir``.
+    hosts / n_hosts:
+        The host topology — explicit :class:`EmulatedHost` list, or a count
+        to split ``jax.devices()`` evenly (tier-1 emulation).
+    heartbeat_timeout:
+        Lease timeout: a host whose lease is older drops out of the live
+        set. Detection latency is bounded by this.
+    generation_timeout:
+        Bounded wall-clock budget for one generation dispatch (the fitness
+        all-gather path). ``None`` disables the watchdog (single-host
+        runs).
+    snapshot_every:
+        Cadence (generations) of leader snapshots. Every snapshot records
+        per-member fitness + member ids at manifest level.
+    fault_injector:
+        A :class:`~agilerl_tpu.resilience.faults.FaultInjector` whose
+        ``kill_host_at`` schedule is consulted at each generation boundary
+        (the scripted host-loss mode of the tier-1 tests).
+    """
+
+    def __init__(
+        self,
+        engine,
+        pop_size: int,
+        store_dir: Union[str, Path],
+        *,
+        seed: int = 0,
+        hosts: Optional[List[EmulatedHost]] = None,
+        n_hosts: Optional[int] = None,
+        devices: Optional[Sequence[Any]] = None,
+        heartbeat_timeout: float = 2.0,
+        membership_poll_interval: float = 0.02,
+        generation_timeout: Optional[float] = None,
+        snapshot_every: int = 1,
+        keep_last: int = 3,
+        keep_best: bool = True,
+        max_dispatch_retries: int = 3,
+        island: Optional[IslandConfig] = None,
+        telemetry=None,
+        fault_injector=None,
+        max_members_per_device: Optional[int] = None,
+        resize_tournament_size: int = 2,
+        restore_from: str = "best",
+        registry=None,
+        clock=time.time,
+        manager: Optional[CheckpointManager] = None,
+    ):
+        if restore_from not in ("best", "latest"):
+            raise ValueError(
+                f"restore_from must be 'best' or 'latest', got {restore_from!r}"
+            )
+        self.engine = engine
+        self.target_pop = int(pop_size)
+        self.store_dir = Path(store_dir)
+        self.telemetry = telemetry
+        self.fault_injector = fault_injector
+        self.island = island
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.membership_poll_interval = float(membership_poll_interval)
+        self.generation_timeout = generation_timeout
+        self.snapshot_every = int(snapshot_every)
+        #: bound on recover-and-retry rounds within ONE generation: a
+        #: generation_timeout sized below the real generation time must
+        #: surface as an error, not livelock (each abandoned dispatch also
+        #: leaks its uncancellable daemon thread)
+        self.max_dispatch_retries = max(int(max_dispatch_retries), 1)
+        self.max_members_per_device = (
+            None if max_members_per_device is None else int(max_members_per_device)
+        )
+        self.resize_tournament_size = int(resize_tournament_size)
+        #: which snapshot supplies lost members: ``"best"`` (the ISSUE/PBT
+        #: default — lost members come back as their best-fitness selves,
+        #: deterministic but a boosted restart) or ``"latest"`` (the exact
+        #: boundary state — the WHOLE resumed stream is bit-identical to an
+        #: unkilled run when the kill lands on a snapshot boundary)
+        self.restore_from = restore_from
+        self._registry_override = registry
+
+        if hosts is None:
+            hosts = make_emulated_hosts(
+                n_hosts if n_hosts is not None else 1, devices
+            )
+        self.hosts = list(hosts)
+        if not self.hosts or not any(h.alive for h in self.hosts):
+            raise ValueError("need at least one live host")
+
+        self.manager = manager or CheckpointManager(
+            self.store_dir / "snapshots", keep_last=keep_last,
+            keep_best=keep_best, registry=registry,
+        )
+        self.membership = HeartbeatStore(
+            self.store_dir / "membership", lease_timeout=self.heartbeat_timeout,
+            registry=registry, clock=clock,
+        )
+        self._heartbeat()
+        self.membership.expect([h.host_id for h in self.hosts if h.alive])
+
+        D = len(self.live_devices())
+        if self.target_pop % D != 0:
+            raise ValueError(
+                f"pop_size {self.target_pop} must be a multiple of the "
+                f"initial live device count {D} (zero-idle-devices layout)"
+            )
+
+        key = jax.random.PRNGKey(int(seed))
+        init_key, self._key = jax.random.split(key)
+        #: resize/tournament RNG — captured and restored by snapshots so
+        #: shrink/grow decisions replay deterministically
+        self._np_rng: np.random.Generator = np.random.default_rng(int(seed))
+        self.pop: PyTree = engine.init_population(init_key, self.target_pop)
+        self.member_ids: List[int] = list(range(self.target_pop))
+        self._next_member_id = self.target_pop
+        self.fitness = np.full(self.target_pop, np.nan)
+        self.generation = 0
+        self.fitness_history: List[List[float]] = []
+        self.member_id_history: List[List[int]] = []
+        self._imported: Set[Tuple[str, str]] = set()
+        self._gen_fn = None
+        self._layout_devices: Tuple[Any, ...] = tuple(self.live_devices())
+        self._mesh: Optional[Mesh] = None
+        self._plan = None
+        self._mttr_started_at: Optional[float] = None
+        self._mttr_pending = False
+
+    # ------------------------------------------------------------------ #
+    # topology / membership
+    # ------------------------------------------------------------------ #
+    @property
+    def registry(self):
+        if self._registry_override is not None:
+            return self._registry_override
+        from agilerl_tpu.observability import get_registry
+
+        return get_registry()
+
+    def live_hosts(self) -> List[EmulatedHost]:
+        return [h for h in self.hosts if h.alive]
+
+    def live_devices(self) -> List[Any]:
+        return [d for h in self.live_hosts() for d in h.devices]
+
+    def layout(self) -> Dict[str, int]:
+        """The current placement: live devices, population size and
+        members-per-device. ``pop % devices == 0`` always holds — zero idle
+        devices."""
+        D = max(len(self._layout_devices), 1)
+        P = len(self.member_ids)
+        return {"devices": D, "pop": P, "members_per_device": P // D}
+
+    def _host(self, host_id: int) -> EmulatedHost:
+        for h in self.hosts:
+            if h.host_id == int(host_id):
+                return h
+        raise KeyError(f"unknown host {host_id}")
+
+    def _heartbeat(self) -> None:
+        for h in self.hosts:
+            if h.alive:
+                self.membership.beat(h.host_id, incarnation=h.incarnation)
+
+    def _is_leader(self) -> bool:
+        leader = self.membership.leader()
+        if leader is None:
+            return True  # degenerate (all leases stale): act rather than wedge
+        return any(h.host_id == leader for h in self.live_hosts())
+
+    def kill_host(self, host_id: int, graceful: bool = False) -> None:
+        """Emulate losing a host: it stops heartbeating (its lease expires
+        within ``heartbeat_timeout``) and its devices leave the next mesh.
+        ``graceful=True`` additionally writes a tombstone so detection is
+        immediate (the SIGTERM path)."""
+        h = self._host(host_id)
+        if not h.alive:
+            return
+        h.alive = False
+        if graceful:
+            self.membership.mark_dead(h.host_id)
+        if self._mttr_started_at is None:
+            self._mttr_started_at = time.perf_counter()
+        self.registry.emit(
+            "host_killed", host=h.host_id, graceful=bool(graceful),
+            generation=self.generation,
+        )
+
+    def revive_host(self, host_id: int) -> None:
+        """Capacity returns: the host rejoins with a new incarnation; the
+        next membership poll reports it as ``joined`` and the population
+        grows back onto it."""
+        h = self._host(host_id)
+        if h.alive:
+            return
+        h.alive = True
+        h.incarnation += 1
+        self.membership.beat(h.host_id, incarnation=h.incarnation)
+
+    # ------------------------------------------------------------------ #
+    # mesh / plan re-layout
+    # ------------------------------------------------------------------ #
+    def _plan_for(self, n_devices: int):
+        """A population plan for ``n_devices`` from the PR-6 registry —
+        recovery *selects a smaller plan* rather than hand-building specs;
+        a missing size is registered once and reused by later recoveries
+        (and by layout mutation)."""
+        from agilerl_tpu.parallel import plan as PL
+
+        candidates = [
+            p for p in PL.plans_for_device_count(int(n_devices))
+            if "member" in p.rules
+        ]
+        if candidates:
+            return candidates[0]
+        new = PL.make_population_plan(int(n_devices))
+        try:
+            return PL.register_plan(new)
+        except ValueError:
+            return PL.get_plan(new.name)
+
+    def _rebuild_generation(self) -> None:
+        devs = self.live_devices()
+        if not devs:
+            raise MembershipChange("no live devices left — cannot re-form mesh")
+        plan = self._plan_for(len(devs))
+        names = tuple(a for a, _ in plan.ordered_axes())
+        sizes = tuple(s for _, s in plan.ordered_axes())
+        mesh = Mesh(np.asarray(devs).reshape(sizes), names)
+        self._plan = plan
+        self._mesh = mesh
+        self._layout_devices = tuple(devs)
+        # re-place the population onto the NEW mesh per the plan's member
+        # rules: after a host loss the live arrays are still committed to the
+        # old (larger) device set, and jit would refuse to mix device sets
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if "member" in plan.rules:
+            specs = plan.resolve("member", self.pop, mesh)
+        else:
+            specs = jax.tree_util.tree_map(
+                lambda _: PartitionSpec(names[-1]), self.pop
+            )
+        self.pop = jax.device_put(
+            jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
+                                   self.pop),
+            jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs),
+        )
+        self._gen_fn = self.engine.make_pod_generation(mesh=mesh, plan=plan)
+        reg = self.registry
+        reg.gauge("elastic/live_hosts").set(len(self.live_hosts()))
+        reg.gauge("elastic/live_devices").set(len(devs))
+        reg.gauge("elastic/members_per_device").set(
+            len(self.member_ids) // len(devs)
+        )
+
+    def _target_pop_for(self, n_devices: int) -> int:
+        """Elastic layout policy: the population is the largest multiple of
+        the live device count that does not exceed ``max(target_pop, D)``
+        (optionally capped by ``max_members_per_device``) — capacity loss
+        packs members tighter or shrinks the population; returned capacity
+        grows it back. Always ≥ D, so no device idles."""
+        D = int(n_devices)
+        target = max(self.target_pop, D)
+        if self.max_members_per_device is not None:
+            target = min(target, D * self.max_members_per_device)
+        return max((target // D) * D, D)
+
+    # ------------------------------------------------------------------ #
+    # recovery
+    # ------------------------------------------------------------------ #
+    def _await_stable_membership(self) -> MembershipEvent:
+        """Wait (bounded) until the lease view agrees with the surviving
+        hosts — dead leases expire within ``heartbeat_timeout``. Returns the
+        accumulated membership diff."""
+        want = tuple(sorted(h.host_id for h in self.live_hosts()))
+        lost: Set[int] = set()
+        joined: Set[int] = set()
+        deadline = time.monotonic() + 3.0 * self.heartbeat_timeout + 5.0
+        while True:
+            self._heartbeat()
+            event = self.membership.poll()
+            if event is not None:
+                lost.update(event.lost)
+                joined.update(event.joined)
+            alive_now = tuple(sorted(self.membership.alive()))
+            if alive_now == want:
+                break
+            if time.monotonic() >= deadline:
+                self.registry.warn_once(
+                    "elastic:membership_settle_timeout",
+                    f"membership did not settle to {want} within the "
+                    f"deadline (saw {alive_now}) — recovering anyway",
+                )
+                break
+            time.sleep(self.membership_poll_interval)
+        leader = min(want) if want else None
+        return MembershipEvent(want, tuple(sorted(lost)), tuple(sorted(joined)),
+                               leader)
+
+    def _dead_slots(self) -> List[int]:
+        """Member slots that lived on now-dead devices under the layout the
+        population was last placed with. Pod sharding splits the leading pop
+        axis contiguously: with ``m`` members per device, device ``d`` owns
+        slots ``[d*m, (d+1)*m)``."""
+        old = self._layout_devices
+        if not old:
+            return []
+        live = set(self.live_devices())
+        P = len(self.member_ids)
+        m = max(P // len(old), 1)
+        return [i for i in range(P) if old[min(i // m, len(old) - 1)] not in live]
+
+    def _handle_membership_change(self, dispatch_failed: bool = False) -> None:
+        if self._mttr_started_at is None:
+            self._mttr_started_at = time.perf_counter()
+        event = self._await_stable_membership()
+        if dispatch_failed:
+            # the generation in flight died with the collective: its outputs
+            # are discarded (dispatch is pure — self.pop/self._key still
+            # hold the last boundary state). Prefer rolling back to the last
+            # committed snapshot so every surviving host restarts from the
+            # same bytes; with none committed yet, continue from the
+            # in-memory boundary state (valid in the emulation — on a real
+            # pod the donated input buffers of an abandoned dispatch may be
+            # gone, in which case the process should die and restart through
+            # resume() instead)
+            if not self.resume():
+                self.registry.warn_once(
+                    "elastic:dispatch_failed_no_snapshot",
+                    "generation dispatch timed out before any snapshot was "
+                    "committed — continuing from the in-memory boundary "
+                    "state",
+                )
+        self._recover(event)
+
+    def _recover(self, event: MembershipEvent) -> None:
+        t0 = time.perf_counter()
+        reg = self.registry
+        if not self.live_devices():
+            # raise BEFORE any resize math (a 0-device target would divide
+            # by zero) so callers catching MembershipChange get the clean
+            # all-hosts-lost signal
+            raise MembershipChange(
+                "all hosts lost — no live devices to re-form the mesh",
+                lost=event.lost, alive=event.alive,
+            )
+        dead_slots = self._dead_slots()
+        restored = self._restore_slots(dead_slots) if dead_slots else 0
+        P = len(self.member_ids)
+        target = self._target_pop_for(len(self.live_devices()))
+        if target < P:
+            self._shrink_to(target)
+        elif target > P:
+            self._grow_to(target)
+        self._rebuild_generation()
+        dt = time.perf_counter() - t0
+        reg.counter("resilience/recoveries_total").inc()
+        reg.gauge("resilience/recovery_time_s").set(dt)
+        reg.counter("elastic/members_restored_total").inc(restored)
+        reg.emit(
+            "elastic_recovery",
+            generation=self.generation,
+            lost=list(event.lost), joined=list(event.joined),
+            leader=event.leader,
+            dead_slots=dead_slots, restored=restored,
+            layout=self.layout(), recovery_time_s=dt,
+        )
+        self._mttr_pending = True
+
+    def _restore_slots(self, dead_slots: List[int]) -> int:
+        """Splice the lost members' rows back from the best-fitness snapshot
+        (manifest-level member ids locate each row without unpickling
+        anything else first; a member born after the snapshot gets the
+        snapshot's best member instead)."""
+        reg = self.registry
+        loaded = None
+        if self.restore_from == "best":
+            # best() does not validate and load(info) tries only that one
+            # candidate — a corrupt best snapshot must fall through to the
+            # validated newest-first walk, not to fresh re-initialization
+            best = self.manager.best()
+            if best is not None:
+                loaded = self.manager.load(best)
+        if loaded is None:
+            loaded = self.manager.load()  # newest-first, hash-validated walk
+        if loaded is None:
+            # degraded path: nothing committed yet — re-roll the lost slots
+            reg.warn_once(
+                "elastic:no_snapshot_for_restore",
+                "host loss before any committed snapshot — lost members are "
+                "re-initialized fresh, not restored",
+            )
+            self._key, k = jax.random.split(self._key)
+            fresh = jax.vmap(self.engine.init_member)(
+                jax.random.split(k, len(dead_slots))
+            )
+            rows = {slot: row for row, slot in enumerate(dead_slots)}
+            blob = population_state_dict(fresh)
+            self.pop = _splice_rows(self.pop, blob["leaves"], rows)
+            for slot in dead_slots:
+                self.member_ids[slot] = self._new_member_id()
+                self.fitness[slot] = np.nan
+            reg.counter("elastic/members_reinitialized_total").inc(len(dead_slots))
+            return 0
+        info, entries = loaded
+        blob = entries["population"]
+        snap_state = entries.get("elastic", {})
+        snap_ids = info.member_ids or snap_state.get("member_ids") or []
+        snap_fit = info.member_fitness or snap_state.get("fitness") or []
+        row_of = {int(mid): row for row, mid in enumerate(snap_ids)}
+        best_row = info.best_member_index()
+        slot_to_row: Dict[int, int] = {}
+        for slot in dead_slots:
+            row = row_of.get(self.member_ids[slot])
+            if row is None:
+                # unknown lineage (clone/import born after the snapshot):
+                # restore the snapshot's best member in its place
+                row = best_row if best_row is not None else 0
+                old_id = self.member_ids[slot]
+                self.member_ids[slot] = self._new_member_id()
+                reg.emit(
+                    "elastic_lineage", op="restore_best",
+                    slot=slot, previous_member=old_id,
+                    member=self.member_ids[slot],
+                    snapshot=str(info.path.name), row=row,
+                )
+            slot_to_row[slot] = int(row)
+        self.pop = _splice_rows(self.pop, blob["leaves"], slot_to_row)
+        for slot, row in slot_to_row.items():
+            f = snap_fit[row] if row < len(snap_fit) else None
+            self.fitness[slot] = np.nan if f is None else float(f)
+        reg.emit(
+            "elastic_restore", snapshot=str(info.path.name),
+            step=info.step, slots={s: r for s, r in slot_to_row.items()},
+        )
+        return len(slot_to_row)
+
+    # ------------------------------------------------------------------ #
+    # elastic resize
+    # ------------------------------------------------------------------ #
+    def _new_member_id(self) -> int:
+        mid = self._next_member_id
+        self._next_member_id += 1
+        return mid
+
+    def _lineage(self):
+        if self.telemetry is not None:
+            return getattr(self.telemetry, "lineage", None)
+        return None
+
+    def _shrink_to(self, n: int) -> None:
+        P = len(self.member_ids)
+        k = P - int(n)
+        fit = np.nan_to_num(self.fitness, nan=-np.inf)
+        # evict the k worst; ties evict the YOUNGER slot (higher index) so
+        # established members survive deterministic ties
+        order = np.lexsort((-np.arange(P), fit))
+        evict = sorted(int(i) for i in order[:k])
+        keep = [i for i in range(P) if i not in set(evict)]
+        evicted_ids = [self.member_ids[i] for i in evict]
+        evicted_fit = [float(self.fitness[i]) for i in evict]
+        idx = np.asarray(keep)
+        self.pop = jax.tree_util.tree_map(lambda x: x[idx], self.pop)
+        self.member_ids = [self.member_ids[i] for i in keep]
+        self.fitness = self.fitness[idx]
+        reg = self.registry
+        reg.counter("elastic/members_evicted_total").inc(k)
+        for mid, f in zip(evicted_ids, evicted_fit):
+            reg.emit("elastic_lineage", op="evict", member=mid,
+                     fitness=None if not np.isfinite(f) else f,
+                     generation=self.generation)
+        reg.emit("elastic_resize", op="shrink", generation=self.generation,
+                 evicted=evicted_ids, pop=len(self.member_ids))
+
+    def _grow_to(self, n: int) -> None:
+        P = len(self.member_ids)
+        k = int(n) - P
+        fit = np.nan_to_num(self.fitness, nan=-np.inf)
+        reg = self.registry
+        lineage = self._lineage()
+        clones: List[PyTree] = []
+        clone_records = []
+        for _ in range(k):
+            entrants = self._np_rng.choice(
+                P, size=min(self.resize_tournament_size, P), replace=False
+            )
+            parent = int(entrants[int(np.argmax(fit[entrants]))])
+            self._key, k_mut, k_member = jax.random.split(self._key, 3)
+            member = jax.tree_util.tree_map(
+                lambda x, p=parent: x[p:p + 1], self.pop
+            )
+            clones.append(self._mutate_clone(member, k_mut, k_member))
+            child_id = self._new_member_id()
+            clone_records.append((self.member_ids[parent], child_id,
+                                  float(self.fitness[parent])))
+            self.member_ids.append(child_id)
+        if clones:
+            self.pop = jax.tree_util.tree_map(
+                lambda x, *ys: jnp.concatenate((x,) + ys, axis=0),
+                self.pop, *clones,
+            )
+            self.fitness = np.concatenate(
+                [self.fitness, [pf for _, _, pf in clone_records]]
+            )
+        reg.counter("elastic/members_cloned_total").inc(k)
+        for parent_id, child_id, parent_fit in clone_records:
+            if lineage is not None:
+                lineage.record_selection(parent_id, child_id, parent_fit)
+                lineage.record_mutation(child_id, "elastic_clone")
+            reg.emit("elastic_lineage", op="clone", parent=parent_id,
+                     member=child_id, generation=self.generation)
+        reg.emit("elastic_resize", op="grow", generation=self.generation,
+                 cloned=[c for _, c, _ in clone_records],
+                 pop=len(self.member_ids))
+
+    def _mutate_clone(self, member: PyTree, k_mut, k_member) -> PyTree:
+        """Gaussian-mutate a cloned member (engine-aware: scan-tier learners
+        mutate their ``_mutate_fields``, actor-critic members mutate the
+        actor) and give it a fresh PRNG stream so the clone explores away
+        from its parent deterministically."""
+        sd = float(getattr(self.engine, "mutation_sd", 0.02))
+        keys = jax.random.split(k_mut, 1)
+        on = jnp.ones((1,))
+        if hasattr(member, "learner"):
+            fields = getattr(self.engine, "_mutate_fields", ("params",))
+            learner = member.learner._replace(**{
+                f: gaussian_mutate(getattr(member.learner, f), keys, on, sd)
+                for f in fields
+            })
+            member = member._replace(learner=learner)
+            if hasattr(member, "ep_ret"):
+                # scan-tier fitness is segmented at evolution boundaries —
+                # a clone must not inherit the parent's partial returns
+                member = member._replace(ep_ret=jnp.zeros_like(member.ep_ret))
+        elif hasattr(member, "actor"):
+            member = member._replace(
+                actor=gaussian_mutate(member.actor, keys, on, sd)
+            )
+        if hasattr(member, "key"):
+            member = member._replace(key=jax.random.split(k_member, 1))
+        return member
+
+    # ------------------------------------------------------------------ #
+    # snapshots
+    # ------------------------------------------------------------------ #
+    def save_snapshot(self, kind: str = "cadence") -> Path:
+        entries = {
+            "population": population_state_dict(self.pop),
+            "elastic": {
+                "member_ids": list(self.member_ids),
+                "next_member_id": self._next_member_id,
+                "generation": self.generation,
+                "fitness": [float(f) for f in self.fitness],
+                "fitness_history": [list(r) for r in self.fitness_history],
+                "member_id_history": [list(r) for r in self.member_id_history],
+                "key": key_to_host(self._key),
+                "np_rng": self._np_rng.bit_generator.state,
+                "target_pop": self.target_pop,
+                "imported": sorted(self._imported),
+            },
+        }
+        return self.manager.save(
+            entries, step=self.generation, kind=kind,
+            member_fitness=self.fitness, member_ids=self.member_ids,
+        )
+
+    def resume(self) -> bool:
+        """Restore the controller (population, per-member RNG streams inside
+        the member rows, resize RNG, histories) from the latest complete
+        snapshot. Returns False when none exists (fresh start)."""
+        loaded = self.manager.load()
+        if loaded is None:
+            return False
+        info, entries = loaded
+        st = entries["elastic"]
+        P = len(st["member_ids"])
+        if P != len(self.member_ids):
+            # rebuild a structure template at the snapshot's population size
+            # (leaf values are immediately overwritten by the restore)
+            self.pop = self.engine.init_population(jax.random.PRNGKey(0), P)
+            self.member_ids = [0] * P
+            self.fitness = np.full(P, np.nan)
+        self.pop = population_load_state_dict(self.pop, entries["population"])
+        self.member_ids = [int(i) for i in st["member_ids"]]
+        self._next_member_id = int(st["next_member_id"])
+        self.generation = int(st["generation"])
+        self.fitness = np.asarray(st["fitness"], dtype=float)
+        self.fitness_history = [list(r) for r in st["fitness_history"]]
+        self.member_id_history = [list(r) for r in st["member_id_history"]]
+        self._key = key_from_host(st["key"])
+        self._np_rng = restore_np_generator(st["np_rng"])
+        self.target_pop = int(st.get("target_pop", self.target_pop))
+        self._imported = {tuple(t) for t in st.get("imported", [])}
+        self._gen_fn = None  # device set may differ — rebuild lazily
+        self.registry.emit(
+            "elastic_resume", step=info.step, snapshot=str(info.path.name),
+            pop=P,
+        )
+        return True
+
+    # ------------------------------------------------------------------ #
+    # island migration
+    # ------------------------------------------------------------------ #
+    def _island_dir(self, island_id: str) -> Path:
+        return self.island.exchange_dir / f"island_{island_id}"
+
+    def _export_island(self) -> Optional[Path]:
+        cfg = self.island
+        P = len(self.member_ids)
+        k = min(cfg.top_k, P)
+        fit = np.nan_to_num(self.fitness, nan=-np.inf)
+        idx = np.argsort(fit)[::-1][:k]
+        pop_host = jax.device_get(self.pop)
+        leaves = [np.asarray(l)[idx]
+                  for l in jax.tree_util.tree_leaves(pop_host)]
+        payload = {"leaves": leaves}
+        dest = self._island_dir(cfg.island_id) / \
+            f"{_EXPORT_PREFIX}{self.generation:08d}"
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest.with_name(dest.name + TMP_DIR_SUFFIX)
+        if tmp.exists():
+            import shutil
+
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        sha, nbytes = staged_pickle(tmp / "members.pkl", payload)
+        manifest = {
+            "island": cfg.island_id,
+            "generation": self.generation,
+            "members": int(k),
+            "member_ids": [int(self.member_ids[i]) for i in idx],
+            "fitness": [
+                float(self.fitness[i]) if np.isfinite(self.fitness[i]) else None
+                for i in idx
+            ],
+            "sha256": sha,
+            "bytes": nbytes,
+        }
+        staged_write_bytes(
+            tmp / "manifest.json", json.dumps(manifest, indent=2).encode()
+        )
+        commit_dir(tmp, dest)
+        # prune old exports (numeric order — lexicographic would misrank)
+        exports = sorted(
+            (d for d in dest.parent.iterdir()
+             if d.is_dir() and d.name.startswith(_EXPORT_PREFIX)
+             and not d.name.endswith(TMP_DIR_SUFFIX)),
+            key=lambda d: _export_generation(d.name),
+        )
+        for old in exports[:-cfg.keep_exports]:
+            import shutil
+
+            shutil.rmtree(old, ignore_errors=True)
+        reg = self.registry
+        reg.counter("elastic/migrations_exported_total").inc()
+        reg.emit("island_export", island=cfg.island_id,
+                 generation=self.generation, members=int(k),
+                 path=str(dest))
+        return dest
+
+    def _import_islands(self) -> int:
+        cfg = self.island
+        root = cfg.exchange_dir
+        if not root.is_dir():
+            return 0
+        reg = self.registry
+        lineage = self._lineage()
+        imported = 0
+        my_dir = f"island_{cfg.island_id}"
+        for d in sorted(root.iterdir()):
+            if not d.is_dir() or d.name == my_dir or \
+                    not d.name.startswith("island_"):
+                continue
+            exports = sorted(
+                (e for e in d.iterdir()
+                 if e.is_dir() and e.name.startswith(_EXPORT_PREFIX)
+                 and not e.name.endswith(TMP_DIR_SUFFIX)),
+                key=lambda e: _export_generation(e.name),
+            )
+            if not exports:
+                continue
+            latest = exports[-1]
+            tag = (d.name, latest.name)
+            if tag in self._imported:
+                continue
+            try:
+                manifest = json.loads((latest / "manifest.json").read_text())
+            except (OSError, ValueError):
+                continue  # unreadable manifest: treat as not-yet-committed
+            try:
+                payload = load_validated_pickle(
+                    latest / "members.pkl", manifest.get("sha256")
+                )
+            except CorruptSnapshotError as e:
+                # refusal-safe import: a torn export is skipped with a warn,
+                # never loaded (the FaultInjector's torn-island-export mode
+                # exercises exactly this)
+                self._imported.add(tag)
+                reg.counter("elastic/torn_imports_total").inc()
+                reg.warn_once(
+                    f"elastic:torn_island_export:{d.name}/{latest.name}",
+                    f"island export {d.name}/{latest.name} failed hash "
+                    f"validation ({e}) — skipping it",
+                )
+                continue
+            self._imported.add(tag)
+            local_leaves = jax.tree_util.tree_leaves(self.pop)
+            foreign = payload.get("leaves", [])
+            if len(foreign) != len(local_leaves) or any(
+                tuple(f.shape[1:]) != tuple(l.shape[1:])
+                for f, l in zip(foreign, local_leaves)
+            ):
+                reg.warn_once(
+                    f"elastic:island_shape_mismatch:{d.name}",
+                    f"island {d.name} exports members of a different "
+                    "structure — skipping (engines must match across islands)",
+                )
+                continue
+            fitness = manifest.get("fitness") or []
+            ids = manifest.get("member_ids") or []
+            order = sorted(
+                range(len(fitness)),
+                key=lambda r: -np.inf if fitness[r] is None else fitness[r],
+                reverse=True,
+            )
+            for row in order:
+                f = fitness[row]
+                if f is None:
+                    continue
+                local = np.nan_to_num(self.fitness, nan=-np.inf)
+                worst = int(np.argmin(local))
+                if not f > local[worst]:
+                    break  # descending order: nothing further can beat us
+                old_id = self.member_ids[worst]
+                self.pop = _splice_rows(
+                    self.pop, foreign, {worst: row}
+                )
+                self.fitness[worst] = float(f)
+                child_id = self._new_member_id()
+                self.member_ids[worst] = child_id
+                parent_id = int(ids[row]) if row < len(ids) else -1
+                if lineage is not None:
+                    lineage.record_selection(parent_id, child_id, float(f))
+                    lineage.record_mutation(child_id, f"migrate:{d.name}")
+                reg.emit(
+                    "elastic_lineage", op="migrate", member=child_id,
+                    evicted=old_id, source_island=d.name,
+                    source_member=parent_id, fitness=float(f),
+                    generation=self.generation,
+                )
+                imported += 1
+        if imported:
+            reg.counter("elastic/migrations_imported_total").inc(imported)
+        return imported
+
+    # ------------------------------------------------------------------ #
+    # the generation loop
+    # ------------------------------------------------------------------ #
+    def _dispatch(self):
+        """Runs inside the collective watchdog thread: reads the boundary
+        state but mutates NOTHING on the controller — when the watchdog
+        abandons a hung dispatch, the leaked thread cannot race the
+        recovery/retry path, and ``self.pop`` / ``self._key`` still hold the
+        valid boundary state (the in-flight program's outputs are simply
+        discarded). The caller commits the returned triple only after a
+        successful join."""
+        key_next, k = jax.random.split(self._key)
+        pop, fitness = self._gen_fn(self.pop, k)
+        fitness = np.asarray(jax.block_until_ready(fitness))
+        return pop, key_next, fitness
+
+    def step_generation(self) -> np.ndarray:
+        """One elastic generation: scripted-fault check → heartbeat →
+        membership detection (+ recovery) → pod generation dispatch under
+        the collective watchdog → snapshot + island exchange."""
+        reg = self.registry
+        # scripted host loss at this boundary (FaultInjector host-loss mode)
+        if self.fault_injector is not None:
+            victim = self.fault_injector.host_to_kill(self.generation)
+            if victim is not None:
+                self.kill_host(victim)
+        self._heartbeat()
+        # a dead host still inside the current layout means the next fitness
+        # all-gather would hang on a real pod: surface it as the bounded
+        # collective timeout (same counter as the real watchdog) and recover
+        dead_in_layout = [
+            h for h in self.hosts
+            if not h.alive and any(d in self._layout_devices for d in h.devices)
+        ]
+        if dead_in_layout:
+            reg.counter("resilience/collective_timeouts_total").inc()
+            reg.emit(
+                "collective_timeout", name="fitness-all-gather",
+                emulated=True,
+                hosts=[h.host_id for h in dead_in_layout],
+            )
+            self._handle_membership_change()
+        else:
+            event = self.membership.poll()
+            if event is not None and (event.lost or event.joined):
+                self._handle_membership_change()
+        t0 = time.perf_counter()
+        for attempt in range(self.max_dispatch_retries + 1):
+            if self._gen_fn is None:
+                self._rebuild_generation()
+            try:
+                pop, key_next, fitness = call_with_collective_timeout(
+                    self._dispatch, self.generation_timeout,
+                    name="fitness-all-gather", registry=reg,
+                )
+                self.pop = pop
+                self._key = key_next
+                break
+            except MembershipChange:
+                # real-pod path: the dispatch itself timed out
+                if attempt >= self.max_dispatch_retries:
+                    raise MembershipChange(
+                        f"generation dispatch failed "
+                        f"{self.max_dispatch_retries + 1} times in a row — "
+                        "generation_timeout is likely below the real "
+                        "generation time, or the pod cannot stabilize"
+                    )
+                self._handle_membership_change(dispatch_failed=True)
+        dt = time.perf_counter() - t0
+        self.generation += 1
+        self.fitness = fitness.astype(float)
+        self.fitness_history.append([float(f) for f in fitness])
+        self.member_id_history.append(list(self.member_ids))
+        reg.gauge("elastic/population_size").set(len(self.member_ids))
+        if self._mttr_pending and self._mttr_started_at is not None:
+            # MTTR: kill/detection → first COMPLETED post-recovery generation
+            mttr = time.perf_counter() - self._mttr_started_at
+            reg.gauge("elastic/mttr_s").set(mttr)
+            reg.emit("elastic_mttr", mttr_s=mttr, generation=self.generation)
+            self._mttr_pending = False
+            self._mttr_started_at = None
+        if self.telemetry is not None:
+            espg = getattr(self.engine, "env_steps_per_generation", None)
+            if espg is None:
+                espg = getattr(self.engine, "num_envs", 0) * \
+                    getattr(self.engine, "rollout_len", 0)
+            self.telemetry.step(
+                env_steps=int(espg) * len(self.member_ids),
+                metrics={
+                    "fitness_best": float(np.nanmax(self.fitness)),
+                    "fitness_mean": float(np.nanmean(self.fitness)),
+                    "generation_time_s": dt,
+                    "population_size": len(self.member_ids),
+                },
+            )
+        if self.snapshot_every and \
+                self.generation % self.snapshot_every == 0 and self._is_leader():
+            self.save_snapshot()
+        if self.island is not None and self.island.every and \
+                self.generation % self.island.every == 0:
+            if self._is_leader():
+                self._export_island()
+            self._import_islands()
+        return fitness
+
+    def run(self, generations: int) -> List[List[float]]:
+        """Run N generations; returns this call's fitness history rows
+        (ragged across resizes — also appended to ``fitness_history``)."""
+        out = []
+        for _ in range(int(generations)):
+            out.append([float(f) for f in self.step_generation()])
+        return out
+
+def _splice_rows(
+    pop: PyTree, saved_leaves: Sequence[np.ndarray], slot_to_row: Dict[int, int]
+) -> PyTree:
+    """Overwrite population rows ``slot`` with ``saved_leaves`` rows ``row``
+    (leaf order is the treedef's, exactly as
+    :func:`~agilerl_tpu.parallel.generation.population_state_dict` stores
+    it). Leaf count and per-row shapes are validated — a structure mismatch
+    must fail loudly, not corrupt members."""
+    live = jax.tree_util.tree_leaves(pop)
+    treedef = jax.tree_util.tree_structure(pop)
+    if len(saved_leaves) != len(live):
+        raise ValueError(
+            f"snapshot has {len(saved_leaves)} leaves, live population has "
+            f"{len(live)}"
+        )
+    out = []
+    for l, s in zip(live, saved_leaves):
+        if tuple(np.asarray(s).shape[1:]) != tuple(l.shape[1:]):
+            raise ValueError(
+                f"snapshot member row shape {np.asarray(s).shape[1:]} != "
+                f"live {tuple(l.shape[1:])}"
+            )
+        arr = jnp.asarray(l)
+        for slot, row in slot_to_row.items():
+            arr = arr.at[slot].set(jnp.asarray(s[row], dtype=arr.dtype))
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)

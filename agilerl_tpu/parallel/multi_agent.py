@@ -320,7 +320,7 @@ class EvoIPPO:
     def make_vmap_generation(self) -> Callable:
         return make_vmap_generation(self.member_iteration, self.evolve)
 
-    def make_pod_generation(self, mesh) -> Callable:
+    def make_pod_generation(self, mesh=None, plan=None) -> Callable:
         return make_pod_generation(
             mesh,
             self.member_iteration,
@@ -330,6 +330,7 @@ class EvoIPPO:
                 actor=mine[0], critic=mine[1], opt_state=mine[2],
                 ep_ret=jnp.zeros_like(pop.ep_ret),
             ),
+            plan=plan,
         )
 
     # -- snapshots ------------------------------------------------------ #

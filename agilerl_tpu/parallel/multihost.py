@@ -13,9 +13,12 @@ Patterns preserved from the reference, redesigned:
 
 from __future__ import annotations
 
-from typing import Optional
+import threading
+from typing import Callable, Optional, TypeVar
 
 import numpy as np
+
+T = TypeVar("T")
 
 
 def init_multihost(
@@ -64,12 +67,76 @@ def broadcast_seed(seed: Optional[int] = None) -> int:
     return int(agreed[0])
 
 
-def barrier(name: str = "barrier") -> None:
-    """Cross-host sync point (parity: accelerator.wait_for_everyone)."""
+def _timeout_registry():
+    from agilerl_tpu.observability import get_registry
+
+    return get_registry()
+
+
+def call_with_collective_timeout(
+    fn: Callable[[], T],
+    timeout: Optional[float],
+    name: str = "collective",
+    registry=None,
+) -> T:
+    """Run a host-side dispatch that contains cross-host collectives (a
+    barrier, the population fitness all-gather) under a bounded timeout.
+
+    With ``timeout=None`` this is a plain call. Otherwise ``fn`` runs in a
+    worker thread; if it does not complete in ``timeout`` seconds the
+    ``resilience/collective_timeouts_total`` counter is bumped and a
+    :class:`~agilerl_tpu.resilience.membership.MembershipChange` is raised —
+    a lost host surfaces as a *detectable event* instead of an indefinitely
+    hung all-gather. The hung dispatch thread itself cannot be cancelled
+    (XLA collectives are not interruptible); it is left daemonized and the
+    caller is expected to recover via snapshot-resume and runtime
+    re-initialization, which is the only sound recovery for a desynced pod
+    (collectives deliberately fail fast — PR 3's design note)."""
+    if timeout is None:
+        return fn()
+    from agilerl_tpu.resilience.membership import MembershipChange
+
+    result: list = []
+    error: list = []
+
+    def target():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            error.append(e)
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"collective-{name}")
+    t.start()
+    t.join(float(timeout))
+    if t.is_alive():
+        reg = registry if registry is not None else _timeout_registry()
+        reg.counter("resilience/collective_timeouts_total").inc()
+        reg.emit("collective_timeout", name=str(name), timeout_s=float(timeout))
+        raise MembershipChange(
+            f"collective {name!r} timed out after {timeout}s — a participant "
+            "host is likely gone; recover via snapshot-resume"
+        )
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def barrier(name: str = "barrier", timeout: Optional[float] = None) -> None:
+    """Cross-host sync point (parity: accelerator.wait_for_everyone).
+
+    ``timeout`` (seconds) bounds the wait: instead of hanging forever on a
+    host that was preempted mid-generation, the barrier raises
+    :class:`~agilerl_tpu.resilience.membership.MembershipChange` and counts
+    ``resilience/collective_timeouts_total`` so the elastic controller can
+    re-form the pod."""
     import jax
 
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    call_with_collective_timeout(
+        lambda: multihost_utils.sync_global_devices(name),
+        timeout, name=f"barrier:{name}",
+    )

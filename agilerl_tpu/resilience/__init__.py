@@ -18,6 +18,11 @@ from agilerl_tpu.resilience.faults import (
     InjectedCrash,
     ScheduledFailureEnv,
 )
+from agilerl_tpu.resilience.membership import (
+    HeartbeatStore,
+    MembershipChange,
+    MembershipEvent,
+)
 from agilerl_tpu.resilience.preemption import PreemptionGuard
 from agilerl_tpu.resilience.retry import (
     DEFAULT_ENV_POLICY,
@@ -45,6 +50,7 @@ __all__ = [
     "RetryPolicy", "RetryingEnv", "call_with_retries", "with_retries",
     "DEFAULT_ENV_POLICY",
     "FaultInjector", "InjectedCrash", "ScheduledFailureEnv",
+    "HeartbeatStore", "MembershipChange", "MembershipEvent",
     "CorruptSnapshotError", "set_fault_hook",
     "atomic_write_bytes", "atomic_pickle", "commit_dir", "content_hash",
     "staged_write_bytes", "staged_pickle",

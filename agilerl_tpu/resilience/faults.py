@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import os
 from pathlib import Path
-from typing import Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 from agilerl_tpu.resilience.atomic import set_fault_hook
 
@@ -44,6 +44,17 @@ class FaultInjector:
     - ``truncate_at_ops``: at these matched-op indices, truncate the file
       involved to ``truncate_to`` of its size and continue silently —
       simulating corruption that only validation (content hashes) can catch.
+    - ``path_match``: when set, only ops whose path contains this substring
+      count — the **torn-island-export mode** is
+      ``FaultInjector(truncate_at_ops=[0], match=("wrote",),
+      path_match="members.pkl")``, which corrupts exactly the first island
+      export payload so refusal-safe import (hash validation +
+      skip-and-warn) is exercisable in tier-1 CPU tests.
+    - ``kill_host_at``: the **host-loss mode** — a ``{generation: host_id}``
+      schedule consumed by the elastic controller at generation boundaries
+      via :meth:`host_to_kill`: the named emulated host is killed (stops
+      heartbeating, its lease expires) at that boundary, exercising
+      membership-change detection and snapshot-restore recovery.
 
     Use as a context manager (or ``arm()``/``disarm()``); it installs itself
     as the process-wide fault hook and restores the previous hook on exit.
@@ -57,19 +68,37 @@ class FaultInjector:
         truncate_at_ops: Iterable[int] = (),
         truncate_to: float = 0.5,
         match: Tuple[str, ...] = ("write", "wrote", "commit"),
+        path_match: Optional[str] = None,
+        kill_host_at: Optional[Mapping[int, int]] = None,
     ):
         self.kill_at_op = kill_at_op
         self.truncate_at_ops = frozenset(int(i) for i in truncate_at_ops)
         self.truncate_to = float(truncate_to)
         self.match = tuple(match)
+        self.path_match = path_match
+        self.kill_host_at: Dict[int, int] = {
+            int(g): int(h) for g, h in (kill_host_at or {}).items()
+        }
+        self.hosts_killed: List[Tuple[int, int]] = []  # (generation, host)
         self.op_count = 0
         self.log: List[Tuple[int, str, str]] = []
         self._prev_hook = None
         self._armed = False
 
+    # -- host-loss schedule (consumed by the elastic controller) --------- #
+    def host_to_kill(self, generation: int) -> Optional[int]:
+        """The host scheduled to die at this generation boundary (once:
+        the schedule entry is consumed), else None."""
+        host = self.kill_host_at.pop(int(generation), None)
+        if host is not None:
+            self.hosts_killed.append((int(generation), int(host)))
+        return host
+
     # -- hook ----------------------------------------------------------- #
     def __call__(self, op: str, path: Path) -> None:
         if op not in self.match:
+            return
+        if self.path_match is not None and self.path_match not in str(path):
             return
         idx = self.op_count
         self.op_count += 1

@@ -1,0 +1,247 @@
+"""Heartbeat/lease membership for preemptible multi-host populations.
+
+On spot/preemptible TPU capacity, hosts *will* disappear mid-run — and a
+vanished host must surface as a **bounded, detectable event**, never as a
+fitness all-gather that hangs forever (the Podracer deployment problem,
+Hessel et al. 2021, applied to PBT). This module is the detection half of
+the elastic controller (:mod:`agilerl_tpu.parallel.elastic`):
+
+- every live host periodically writes a **lease file** into a directory on
+  the shared snapshot store (the same filesystem the
+  :class:`~agilerl_tpu.resilience.snapshot.CheckpointManager` commits to, so
+  no extra coordination service is needed);
+- a host whose lease goes stale past ``lease_timeout`` — or that wrote a
+  tombstone on graceful shutdown — drops out of the live set;
+- :meth:`HeartbeatStore.poll` diffs the live set against the last
+  observation and reports a :class:`MembershipEvent` (lost/joined hosts +
+  the new leader) while feeding the ``resilience/*`` membership counters;
+- the **leader** is simply the lowest live host id (deterministic on every
+  observer, no election protocol): leader-only duties are snapshot commits
+  and island exports, so a split-brain during a lease-expiry window can at
+  worst produce an extra atomic snapshot, never a torn one.
+
+Lease writes deliberately do NOT go through the atomic/fault-hook layer:
+leases are ephemeral liveness signals, not durability-critical state — an
+fsync per heartbeat would hammer the shared store, and routing beats through
+the fault hook would make the :class:`~agilerl_tpu.resilience.faults
+.FaultInjector`'s scheduled op indices timing-dependent. A torn lease read
+is treated as a missed beat (the next beat rewrites it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple, Union
+
+
+def _registry():
+    from agilerl_tpu.observability import get_registry
+
+    return get_registry()
+
+
+class MembershipChange(RuntimeError):
+    """The live host set changed (lease expiry, tombstone, or a collective
+    that timed out because a participant vanished).
+
+    Raised by :func:`agilerl_tpu.parallel.multihost.barrier` /
+    ``call_with_collective_timeout`` on timeout and by
+    :meth:`HeartbeatStore.wait_for` on a join deadline; the elastic
+    controller catches it and routes recovery through snapshot-resume
+    (collectives still fail fast — per PR 3's design note, a per-host retry
+    inside a collective would desync the pod)."""
+
+    def __init__(
+        self,
+        message: str,
+        lost: Sequence[int] = (),
+        joined: Sequence[int] = (),
+        alive: Sequence[int] = (),
+    ):
+        super().__init__(message)
+        self.lost: Tuple[int, ...] = tuple(int(h) for h in lost)
+        self.joined: Tuple[int, ...] = tuple(int(h) for h in joined)
+        self.alive: Tuple[int, ...] = tuple(int(h) for h in alive)
+
+
+class MembershipEvent(NamedTuple):
+    """One observed change of the live host set."""
+
+    alive: Tuple[int, ...]
+    lost: Tuple[int, ...]
+    joined: Tuple[int, ...]
+    leader: Optional[int]
+
+
+class HeartbeatStore:
+    """Filesystem lease files as the membership substrate.
+
+    Layout: ``<directory>/host_<id>.json`` holding ``{"host", "time",
+    "incarnation"}`` (or ``{"dead": true}`` as a graceful tombstone). Time
+    comes from the injectable ``clock`` (default ``time.time`` — leases are
+    compared across processes, so a wall clock is required; tests inject a
+    fake one).
+
+    ``incarnation`` distinguishes a host that died and came back from one
+    that never left: a rejoin after an observed loss is reported as
+    ``joined`` even if the id is the same.
+    """
+
+    def __init__(
+        self,
+        directory: Union[str, Path],
+        lease_timeout: float = 5.0,
+        registry=None,
+        clock=time.time,
+    ):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.lease_timeout = float(lease_timeout)
+        self._registry_override = registry
+        self.clock = clock
+        #: last observed view: host id -> incarnation (None until baselined)
+        self._last_view: Optional[Dict[int, int]] = None
+
+    @property
+    def registry(self):
+        return self._registry_override if self._registry_override is not None \
+            else _registry()
+
+    # -- lease I/O --------------------------------------------------------- #
+    def _lease_path(self, host_id: int) -> Path:
+        return self.directory / f"host_{int(host_id):04d}.json"
+
+    def _write(self, host_id: int, payload: dict) -> None:
+        # plain tmp+rename (no fsync, no fault hook): liveness signal, not
+        # durable state — see module docstring
+        path = self._lease_path(host_id)
+        tmp = path.with_name(path.name + f".{os.getpid()}.beat")
+        tmp.write_bytes(json.dumps(payload).encode())
+        os.replace(tmp, path)
+
+    def beat(self, host_id: int, incarnation: int = 0, meta: Optional[dict] = None) -> None:
+        """Renew ``host_id``'s lease (call once per generation/heartbeat
+        interval; must beat faster than ``lease_timeout`` to stay live)."""
+        payload = {
+            "host": int(host_id),
+            "time": float(self.clock()),
+            "incarnation": int(incarnation),
+        }
+        if meta:
+            payload["meta"] = meta
+        self._write(host_id, payload)
+
+    def mark_dead(self, host_id: int) -> None:
+        """Graceful tombstone: the host drops out of the live set immediately
+        instead of after a lease timeout (SIGTERM/shutdown path)."""
+        self._write(host_id, {"host": int(host_id), "dead": True,
+                              "time": float(self.clock())})
+
+    # -- observation ------------------------------------------------------- #
+    def leases(self) -> Dict[int, dict]:
+        """All readable, non-tombstoned lease payloads (fresh or stale)."""
+        out: Dict[int, dict] = {}
+        for p in sorted(self.directory.glob("host_*.json")):
+            try:
+                payload = json.loads(p.read_text())
+            except (OSError, ValueError):
+                continue  # torn/concurrent lease write == missed beat
+            if payload.get("dead"):
+                continue
+            try:
+                out[int(payload["host"])] = payload
+            except (KeyError, TypeError, ValueError):
+                continue
+        return out
+
+    def alive(self, now: Optional[float] = None) -> Dict[int, dict]:
+        """Hosts with a fresh lease (age ≤ ``lease_timeout``)."""
+        now = float(self.clock()) if now is None else float(now)
+        return {
+            h: payload for h, payload in self.leases().items()
+            if now - float(payload.get("time", -float("inf"))) <= self.lease_timeout
+        }
+
+    def leader(self, alive: Optional[Dict[int, dict]] = None) -> Optional[int]:
+        """Lowest live host id — deterministic on every observer."""
+        a = self.alive() if alive is None else alive
+        return min(a) if a else None
+
+    def expect(self, host_ids: Sequence[int]) -> None:
+        """Baseline the observed set explicitly (e.g. right after the join
+        barrier) so the first :meth:`poll` diffs against the real roster
+        rather than treating everyone as newly joined. Incarnations come
+        from the hosts' current leases (0 when a host has not beat yet)."""
+        leases = self.leases()
+        self._last_view = {
+            int(h): int(leases.get(int(h), {}).get("incarnation", 0))
+            for h in host_ids
+        }
+
+    def poll(self) -> Optional[MembershipEvent]:
+        """Diff the live view against the last observation. Returns ``None``
+        when nothing changed (the first poll baselines and reports nothing);
+        otherwise records membership metrics, emits a ``membership`` event
+        and returns the :class:`MembershipEvent`. A host whose lease carries
+        a NEW incarnation — it died and rejoined inside one lease window —
+        is reported in both ``lost`` and ``joined``."""
+        view = {
+            h: int(p.get("incarnation", 0)) for h, p in self.alive().items()
+        }
+        if self._last_view is None:
+            self._last_view = view
+            return None
+        if view == self._last_view:
+            return None
+        lost = tuple(sorted(
+            h for h, inc in self._last_view.items() if view.get(h) != inc
+        ))
+        joined = tuple(sorted(
+            h for h, inc in view.items() if self._last_view.get(h) != inc
+        ))
+        alive = tuple(sorted(view))
+        self._last_view = view
+        leader = min(alive) if alive else None
+        reg = self.registry
+        reg.counter("resilience/membership_changes_total").inc()
+        if lost:
+            reg.counter("resilience/hosts_lost_total").inc(len(lost))
+        if joined:
+            reg.counter("resilience/hosts_joined_total").inc(len(joined))
+        reg.emit(
+            "membership",
+            alive=[int(h) for h in alive],
+            lost=[int(h) for h in lost],
+            joined=[int(h) for h in joined],
+            leader=leader,
+        )
+        return MembershipEvent(alive, lost, joined, leader)
+
+    def wait_for(
+        self,
+        n_hosts: int,
+        timeout: float = 30.0,
+        interval: float = 0.05,
+        beat_as: Optional[Tuple[int, int]] = None,
+    ) -> Dict[int, dict]:
+        """Join barrier: block until ``n_hosts`` leases are live (optionally
+        renewing our own lease as ``(host_id, incarnation)`` while waiting).
+        Raises :class:`MembershipChange` on deadline — a bounded startup
+        instead of an indefinite wait for capacity that may never come."""
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            if beat_as is not None:
+                self.beat(*beat_as)
+            a = self.alive()
+            if len(a) >= int(n_hosts):
+                return a
+            if time.monotonic() >= deadline:
+                raise MembershipChange(
+                    f"membership join timed out after {timeout}s: "
+                    f"{len(a)}/{n_hosts} hosts live ({sorted(a)})",
+                    alive=sorted(a),
+                )
+            time.sleep(interval)

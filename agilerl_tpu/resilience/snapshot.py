@@ -373,6 +373,38 @@ class SnapshotInfo:
         f = self.manifest.get("fitness")
         return None if f is None else float(f)
 
+    @property
+    def member_fitness(self) -> Optional[List[Optional[float]]]:
+        """Per-member fitness recorded at save time (manifest-level, so
+        best-member restore and island top-k selection read it WITHOUT
+        unpickling the population entry). ``None`` when the snapshot
+        predates the field."""
+        mf = self.manifest.get("member_fitness")
+        if mf is None:
+            return None
+        return [None if f is None else float(f) for f in mf]
+
+    @property
+    def member_ids(self) -> Optional[List[int]]:
+        """Stable member (slot-lineage) ids aligned with ``member_fitness``,
+        for restoring a specific lost member from its snapshot row."""
+        ids = self.manifest.get("member_ids")
+        if ids is None:
+            return None
+        return [int(i) for i in ids]
+
+    def best_member_index(self) -> Optional[int]:
+        """Row index of the highest finite per-member fitness (None when the
+        manifest carries no usable member fitness)."""
+        mf = self.member_fitness
+        if not mf:
+            return None
+        finite = [(f, i) for i, f in enumerate(mf)
+                  if f is not None and np.isfinite(f)]
+        if not finite:
+            return None
+        return max(finite)[1]
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"SnapshotInfo(step={self.step}, kind={self.kind!r}, path={self.path})"
 
@@ -423,13 +455,34 @@ class CheckpointManager:
         kind: str = "cadence",
         fitness: Optional[float] = None,
         extra_meta: Optional[Dict[str, Any]] = None,
+        member_fitness: Optional[Any] = None,
+        member_ids: Optional[Any] = None,
     ) -> Path:
         """Commit one snapshot atomically. ``entries`` maps entry name →
         picklable object; each is written to ``<name>.pkl`` with its sha256
         recorded in the manifest, which is written last. Wrap a value in
         :class:`AsyncPytree` to save it through the orbax helpers instead
-        (sharded LLM-tier pytrees)."""
+        (sharded LLM-tier pytrees).
+
+        ``member_fitness`` / ``member_ids`` record the population's
+        per-member fitness at MANIFEST level (non-finite values stored as
+        null) so best-member restore and island top-k selection never have
+        to unpickle whole snapshots. When ``fitness`` is omitted it is
+        derived as the best finite member fitness, keeping ``keep_best``
+        retention consistent with the per-member field."""
         t0 = time.perf_counter()
+        if member_fitness is not None:
+            # element-wise, not np.asarray over the list: the input may be
+            # exactly what SnapshotInfo.member_fitness returned, nulls and
+            # all, and the round-trip must not crash on them
+            cleaned = []
+            for f in member_fitness:
+                f = None if f is None else float(f)
+                cleaned.append(f if f is not None and np.isfinite(f) else None)
+            member_fitness = cleaned
+            finite = [f for f in member_fitness if f is not None]
+            if fitness is None and finite:
+                fitness = max(finite)
         base = f"{_STEP_PREFIX}{int(step):012d}"
         # never overwrite a committed snapshot: a same-step resave (e.g. a
         # final snapshot right after a cadence one) commits under a suffixed
@@ -474,6 +527,10 @@ class CheckpointManager:
             "time": time.time(),
             "entries": manifest_entries,
         }
+        if member_fitness is not None:
+            manifest["member_fitness"] = member_fitness
+        if member_ids is not None:
+            manifest["member_ids"] = [int(i) for i in member_ids]
         if extra_meta:
             manifest.update(extra_meta)
         staged_write_bytes(

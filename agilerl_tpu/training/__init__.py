@@ -1,4 +1,5 @@
 from agilerl_tpu.training.train_bandits import train_bandits
+from agilerl_tpu.training.train_elastic import train_elastic_pbt
 from agilerl_tpu.training.train_multi_agent_off_policy import train_multi_agent_off_policy
 from agilerl_tpu.training.train_multi_agent_on_policy import train_multi_agent_on_policy
 from agilerl_tpu.training.train_off_policy import train_off_policy
@@ -10,6 +11,7 @@ __all__ = [
     "train_on_policy",
     "train_offline",
     "train_bandits",
+    "train_elastic_pbt",
     "train_multi_agent_off_policy",
     "train_multi_agent_on_policy",
 ]

@@ -16,8 +16,10 @@ an up-window. On a successful accelerator run the headline JSON line also
 carries the secondary metric + on-chip kernel validation in "extra_metrics".
 
 Env knobs: BENCH_MODE=grpo for the LLM metric; BENCH_MODE=pipeline / serving /
-anakin for the CPU A/B micro-benches (anakin: scan-resident generation engine
-vs the interop off-policy hot loop, per algorithm); BENCH_POP/ENVS/ROLLOUT/
+anakin / elastic for the CPU A/B micro-benches (anakin: scan-resident
+generation engine vs the interop off-policy hot loop, per algorithm;
+elastic: MTTR under a scripted host kill + heartbeat steady-state overhead
+on the pod emulation); BENCH_POP/ENVS/ROLLOUT/
 GENS and BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU
 attempt; BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
 """
@@ -696,6 +698,146 @@ def bench_sharding():
     }), flush=True)
 
 
+def bench_elastic():
+    """Elastic preemption-native PBT bench (docs/resilience.md): on the CPU
+    pod emulation (2 emulated hosts x 2 virtual devices, pop=4 EvoDQN),
+    measures (a) the steady-state overhead of the heartbeat/membership layer
+    — elastic controller with snapshots disabled vs the raw pod generation
+    loop on the same mesh — and (b) MTTR: a scripted FaultInjector host kill
+    at a generation boundary to the first COMPLETED post-recovery generation
+    (lease expiry + snapshot-restore of the lost members + mesh re-form +
+    recompile for the survivor layout included). Run with BENCH_MODE=elastic;
+    knobs BENCH_ELASTIC_GENS / BENCH_ELASTIC_ENVS / BENCH_ELASTIC_STEPS."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    from agilerl_tpu.envs import CartPole
+    from agilerl_tpu.modules.mlp import MLPConfig
+    from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+    from agilerl_tpu.observability.registry import MetricsRegistry
+    from agilerl_tpu.parallel import (
+        ElasticPBTController,
+        EvoDQN,
+        make_emulated_hosts,
+    )
+    from agilerl_tpu.resilience import FaultInjector
+
+    backend = jax.default_backend()
+    gens = int(os.environ.get("BENCH_ELASTIC_GENS", 6))
+    num_envs = int(os.environ.get("BENCH_ELASTIC_ENVS", 4))
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", 32))
+    heartbeat = float(os.environ.get("BENCH_ELASTIC_HEARTBEAT", 0.25))
+    devices = jax.devices()[:4]
+    if len(devices) < 4:
+        print(json.dumps({
+            "metric": "elastic PBT MTTR + heartbeat overhead",
+            "value": 0, "unit": "s", "vs_baseline": None,
+            "backend": backend,
+            "error": f"need 4 virtual devices, have {len(devices)} "
+                     "(set --xla_force_host_platform_device_count)",
+        }), flush=True)
+        return
+
+    def engine():
+        env = CartPole()
+        kind, enc = default_encoder_config(
+            env.observation_space, latent_dim=32,
+            encoder_config={"hidden_size": (32,)})
+        cfg = NetworkConfig(
+            encoder_kind=kind, encoder=enc,
+            head=MLPConfig(num_inputs=32, num_outputs=2, hidden_size=(32,)),
+            latent_dim=32)
+        return EvoDQN(env, cfg, optax.adam(1e-3), num_envs=num_envs,
+                      steps_per_iter=steps, buffer_size=32 * num_envs,
+                      batch_size=16)
+
+    work = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        # ---- (a) steady-state heartbeat overhead: controller (snapshots
+        # off, heartbeat+poll on) vs the raw pod generation loop ----------
+        reg = MetricsRegistry()
+        ctl = ElasticPBTController(
+            engine(), 4, os.path.join(work, "steady"), seed=0,
+            hosts=make_emulated_hosts(2, devices),
+            heartbeat_timeout=heartbeat, snapshot_every=0, registry=reg)
+        ctl.run(1)  # compile + warmup
+        t0 = time.perf_counter()
+        ctl.run(gens)
+        ctl_dt = (time.perf_counter() - t0) / gens
+
+        evo = engine()
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices), ("pop",))
+        gen = evo.make_pod_generation(mesh)
+        pop = evo.init_population(jax.random.PRNGKey(1), 4)
+        # TWO warmup calls: the first compiles for host-resident inputs, the
+        # second for the sharded donated outputs it hands itself — only the
+        # second executable is the steady-state one (the controller pre-
+        # places its population, so it never pays the first)
+        pop, f = gen(pop, jax.random.PRNGKey(2))
+        jax.block_until_ready(f)
+        pop, f = gen(pop, jax.random.PRNGKey(2))
+        jax.block_until_ready(f)
+        t0 = time.perf_counter()
+        for i in range(gens):
+            pop, f = gen(pop, jax.random.PRNGKey(3 + i))
+        jax.block_until_ready(f)
+        raw_dt = (time.perf_counter() - t0) / gens
+        overhead = (ctl_dt - raw_dt) / raw_dt if raw_dt > 0 else None
+        log(f"bench_elastic: steady-state {ctl_dt*1e3:.1f}ms/gen with "
+            f"heartbeat vs {raw_dt*1e3:.1f}ms/gen raw "
+            f"({overhead:+.1%} overhead)")
+
+        # ---- (b) MTTR: scripted host kill at a generation boundary ------
+        reg2 = MetricsRegistry()
+        kill_gen = 2
+        ctl2 = ElasticPBTController(
+            engine(), 4, os.path.join(work, "mttr"), seed=0,
+            hosts=make_emulated_hosts(2, devices),
+            heartbeat_timeout=heartbeat, snapshot_every=1,
+            fault_injector=FaultInjector(kill_host_at={kill_gen: 1}),
+            registry=reg2)
+        ctl2.run(kill_gen + 2)
+        mttr = reg2.gauge("elastic/mttr_s").value
+        recovered = reg2.counter("resilience/recoveries_total").value
+        restored = reg2.counter("elastic/members_restored_total").value
+        log(f"bench_elastic: MTTR {mttr:.2f}s (kill at gen boundary "
+            f"{kill_gen}, {int(restored)} members restored, layout "
+            f"{ctl2.layout()})")
+
+        print(json.dumps({
+            "metric": ("elastic PBT on the CPU pod emulation: MTTR "
+                       "(scripted host kill -> first post-recovery "
+                       "generation) + heartbeat steady-state overhead"),
+            "value": round(float(mttr), 3),
+            "unit": "s (MTTR)",
+            "vs_baseline": None,
+            "backend": backend,
+            "pop": 4, "hosts": 2, "devices": len(devices),
+            "generations": gens,
+            "heartbeat_timeout_s": heartbeat,
+            "steady_gen_s": round(ctl_dt, 4),
+            "raw_gen_s": round(raw_dt, 4),
+            "heartbeat_overhead_fraction": (
+                None if overhead is None else round(overhead, 4)),
+            "recoveries": int(recovered),
+            "members_restored": int(restored),
+            "post_recovery_layout": ctl2.layout(),
+            "error": None if np.isfinite(mttr) else "MTTR gauge is not finite",
+            "provenance": ("fresh CPU pod-emulation measurement at HEAD; "
+                           "MTTR includes lease expiry (heartbeat_timeout), "
+                           "best-snapshot member restore, plan-registry mesh "
+                           "re-form and the survivor-layout recompile"),
+        }), flush=True)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _cpu_pinned() -> bool:
     """True iff JAX_PLATFORMS is an exact "cpu" pin. A fallback list like
     "axon,cpu" is NOT a pin — the accelerator should still be attempted."""
@@ -745,6 +887,8 @@ def child_main():
         bench_anakin()
     elif mode == "sharding":
         bench_sharding()
+    elif mode == "elastic":
+        bench_elastic()
     else:
         bench_evoppo()
 
@@ -963,17 +1107,28 @@ def parent_main():
         else "serving-tier continuous vs batch-sync tokens/sec" if mode == "serving"
         else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
         else "sharding-plan resolution + 7B plan compile" if mode == "sharding"
+        else "elastic PBT MTTR + heartbeat overhead" if mode == "elastic"
         else "evo-PPO aggregate env-steps/sec"
     )
     errors = []
 
-    if mode in ("pipeline", "serving", "anakin", "sharding"):
+    if mode in ("pipeline", "serving", "anakin", "sharding", "elastic"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
         # no capture re-emission
         cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", 900))
-        result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout)
+        extra_env = None
+        if mode == "elastic":
+            # the pod emulation needs virtual CPU devices (conftest does the
+            # same for the test mesh)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                extra_env = {"XLA_FLAGS": (
+                    flags + " --xla_force_host_platform_device_count=4"
+                ).strip()}
+        result, err = _run_child({"JAX_PLATFORMS": "cpu"}, cpu_timeout,
+                                 extra_env=extra_env)
         if result is not None:
             print(json.dumps(result), flush=True)
             return 0
@@ -981,6 +1136,7 @@ def parent_main():
             "metric": metric, "value": 0,
             "unit": ("tokens/sec" if mode == "serving"
                      else "ms/resolution" if mode == "sharding"
+                     else "s (MTTR)" if mode == "elastic"
                      else "env-steps/sec"),
             "vs_baseline": 0.0, "backend": None,
             "error": f"{mode} micro-bench: {err}",

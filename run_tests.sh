@@ -25,6 +25,9 @@
 #                                # (rule matcher, spec equivalence vs the
 #                                # hand-built trees, plan-compiled steps,
 #                                # YAML plans, layout mutation)
+#   bash run_tests.sh elastic    # elastic preemption-native PBT only
+#                                # (membership leases, host-loss recovery,
+#                                # resize determinism, island migration)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -69,6 +72,13 @@ for arg in "$@"; do
       # round-trips, registry + opt-in layout mutation, serving KV rules)
       MARKER=(-m "sharding")
       SHARDS+=("tests/test_parallel/test_plan.py tests/test_parallel/test_mesh.py")
+      ;;
+    elastic)
+      # fast path: elastic preemption-native PBT (heartbeat/lease
+      # membership, scripted host-kill recovery bit-identity, shrink/grow
+      # resize determinism, island export/import incl. torn exports)
+      MARKER=(-m "elastic")
+      SHARDS+=("tests/test_parallel/test_elastic.py tests/test_resilience/test_membership.py tests/test_hpo/test_tournament_resize.py")
       ;;
     *) SHARDS+=("$arg") ;;
   esac

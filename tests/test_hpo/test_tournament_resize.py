@@ -1,0 +1,67 @@
+"""Resize-aware tournament selection (elastic-PBT satellite): ``select``
+can draw the next generation at a different size than the current one,
+with every selection lineage-recorded."""
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.hpo import TournamentSelection
+from agilerl_tpu.observability import LineageTracker
+
+pytestmark = pytest.mark.elastic
+
+
+class FakeAgent:
+    def __init__(self, index, fitness):
+        self.index = index
+        self.fitness = list(fitness)
+        self.cloned_from = None
+
+    def clone(self, index):
+        c = FakeAgent(index, self.fitness)
+        c.cloned_from = self.index
+        return c
+
+
+def _pop(fitnesses):
+    return [FakeAgent(i, [f]) for i, f in enumerate(fitnesses)]
+
+
+def test_grow_clones_extra_tournament_winners():
+    ts = TournamentSelection(tournament_size=2, elitism=True,
+                             population_size=4, eval_loop=1,
+                             rng=np.random.default_rng(0))
+    elite, new_pop = ts.select(_pop([1.0, 4.0, 2.0, 3.0]), target_size=6)
+    assert len(new_pop) == 6
+    assert elite.index == 1
+    assert new_pop[0].index == 1  # elite cloned in place
+    # every non-elite child is a tournament winner's clone with a fresh id
+    assert all(a.cloned_from is not None for a in new_pop[1:])
+    assert len({a.index for a in new_pop}) == 6
+
+
+def test_shrink_draws_fewer():
+    ts = TournamentSelection(tournament_size=2, elitism=True,
+                             population_size=4, eval_loop=1,
+                             rng=np.random.default_rng(0))
+    _, new_pop = ts.select(_pop([1.0, 4.0, 2.0, 3.0]), target_size=2)
+    assert len(new_pop) == 2
+    assert new_pop[0].index == 1  # elitism survives the shrink
+
+
+def test_default_size_unchanged():
+    ts = TournamentSelection(tournament_size=2, elitism=False,
+                             population_size=4, eval_loop=1,
+                             rng=np.random.default_rng(0))
+    _, new_pop = ts.select(_pop([1.0, 4.0, 2.0, 3.0]))
+    assert len(new_pop) == 4
+
+
+def test_resize_selections_are_lineage_recorded():
+    lineage = LineageTracker()
+    ts = TournamentSelection(tournament_size=2, elitism=True,
+                             population_size=2, eval_loop=1,
+                             rng=np.random.default_rng(0), lineage=lineage)
+    ts.select(_pop([1.0, 4.0]), target_size=5)
+    children = lineage.generations[-1]["children"]
+    assert len(children) == 5  # elite + 4 clones, no silent population jump

@@ -1,0 +1,400 @@
+"""Elastic preemption-native PBT: scripted host kills recover onto a smaller
+mesh with a bit-identical fitness stream, capacity changes resize the
+population with lineage events, and islands exchange members refusal-safely
+— all on the single-process CPU pod emulation (8 virtual devices)."""
+
+import json
+import pickle
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from agilerl_tpu.envs import CartPole
+from agilerl_tpu.modules.mlp import MLPConfig
+from agilerl_tpu.networks import distributions as D
+from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+from agilerl_tpu.observability.registry import MetricsRegistry
+from agilerl_tpu.parallel import (
+    ElasticPBTController,
+    EvoDQN,
+    EvoPPO,
+    IslandConfig,
+    make_emulated_hosts,
+)
+from agilerl_tpu.resilience import FaultInjector, MembershipChange
+from agilerl_tpu.training import train_elastic_pbt
+
+pytestmark = pytest.mark.elastic
+
+HEARTBEAT = 0.15  # tiny lease so loss detection stays fast in tests
+
+
+class ListSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, fields):
+        self.events.append((kind, dict(fields)))
+
+    def flush(self):
+        pass
+
+
+def _registry():
+    return MetricsRegistry(sink=ListSink())
+
+
+def _net(env, outputs, latent=16, hidden=32):
+    kind, enc = default_encoder_config(
+        env.observation_space, latent_dim=latent,
+        encoder_config={"hidden_size": (hidden,)},
+    )
+    return NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=latent, num_outputs=outputs,
+                       hidden_size=(hidden,)),
+        latent_dim=latent,
+    )
+
+
+def _dqn():
+    env = CartPole()
+    return EvoDQN(env, _net(env, 2), optax.adam(1e-3), num_envs=2,
+                  steps_per_iter=8, buffer_size=64, batch_size=4)
+
+
+def _ppo():
+    env = CartPole()
+    dist = D.dist_config_from_space(env.action_space)
+    return EvoPPO(env, _net(env, 2), _net(env, 1), dist, optax.adam(3e-4),
+                  num_envs=2, rollout_len=8, update_epochs=1,
+                  num_minibatches=2)
+
+
+def _controller(engine, store, *, n_hosts=2, n_devices=4, pop=4, seed=3,
+                **kw):
+    kw.setdefault("registry", _registry())
+    return ElasticPBTController(
+        engine, pop, store, seed=seed,
+        hosts=make_emulated_hosts(n_hosts, jax.devices()[:n_devices]),
+        heartbeat_timeout=HEARTBEAT, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def dqn_ref_hist(tmp_path_factory):
+    """Unkilled 4-generation reference stream (pop=4 over 2 hosts x 2
+    devices) — the comparison target for every kill scenario."""
+    ctl = _controller(_dqn(), tmp_path_factory.mktemp("dqn_ref"))
+    return ctl.run(4)
+
+
+# --------------------------------------------------------------------------- #
+# host loss at a generation boundary
+# --------------------------------------------------------------------------- #
+
+
+class TestHostLoss:
+    def test_kill_recovers_bit_identical_stream(self, tmp_path, dqn_ref_hist):
+        """The acceptance gate: host 1 dies at generation boundary 2; the
+        survivors re-form a 2-device mesh (2 members/device — zero idle
+        devices), the lost members come back from the boundary snapshot, and
+        the whole fitness stream is bit-identical to the unkilled run."""
+        reg = _registry()
+        inj = FaultInjector(kill_host_at={2: 1})
+        ctl = _controller(_dqn(), tmp_path, fault_injector=inj, registry=reg,
+                          restore_from="latest")
+        hist = ctl.run(4)
+        assert hist == dqn_ref_hist
+        assert inj.hosts_killed == [(2, 1)]
+        # zero idle devices: 4 members packed 2-per-device on the survivors
+        assert ctl.layout() == {"devices": 2, "pop": 4,
+                                "members_per_device": 2}
+        # loss surfaced as a bounded collective timeout, not a hang
+        assert reg.counter("resilience/collective_timeouts_total").value >= 1
+        assert reg.counter("resilience/hosts_lost_total").value == 1
+        assert reg.counter("elastic/members_restored_total").value == 2
+        assert reg.counter("resilience/recoveries_total").value == 1
+        # finite MTTR (kill -> first completed post-recovery generation)
+        assert np.isfinite(reg.gauge("elastic/mttr_s").value)
+        kinds = [k for k, _ in reg.sink.events]
+        assert "elastic_recovery" in kinds and "elastic_mttr" in kinds
+
+    def test_best_restore_survivors_identical_and_deterministic(
+            self, tmp_path, dqn_ref_hist):
+        """Default best-fitness restore: the survivors' stream is
+        bit-identical to the unkilled reference and the restored members
+        replay deterministically (two scripted runs agree exactly)."""
+        runs = []
+        for sub in ("a", "b"):
+            ctl = _controller(
+                _dqn(), tmp_path / sub,
+                fault_injector=FaultInjector(kill_host_at={2: 1}),
+            )
+            runs.append(ctl.run(4))
+        assert runs[0] == runs[1]  # restored members: deterministic
+        # survivors (host 0 slots 0-1 under the initial 1-member/device
+        # layout): bit-identical to the unkilled reference
+        for row_ref, row_kill in zip(dqn_ref_hist, runs[0]):
+            assert row_ref[:2] == row_kill[:2]
+
+    def test_kill_leader_host_fails_over(self, tmp_path):
+        """Killing host 0 (the leader) moves leadership to host 1 and the
+        run still snapshots + recovers."""
+        reg = _registry()
+        ctl = _controller(
+            _dqn(), tmp_path, registry=reg,
+            fault_injector=FaultInjector(kill_host_at={1: 0}),
+        )
+        hist = ctl.run(3)
+        assert len(hist) == 3
+        ctl._heartbeat()
+        assert ctl.membership.leader() == 1
+        # the new leader kept committing snapshots after the failover
+        assert ctl.manager.latest().step == 3
+
+    def test_corrupt_best_snapshot_falls_back_to_validated_walk(
+            self, tmp_path):
+        """A torn best-fitness snapshot must not discard recoverable state:
+        restore walks back to a validated snapshot instead of re-rolling
+        the lost members fresh."""
+        reg = _registry()
+        ctl = _controller(
+            _dqn(), tmp_path, registry=reg,
+            fault_injector=FaultInjector(kill_host_at={2: 1}),
+        )
+        ctl.run(2)
+        best = ctl.manager.best()
+        pkl = best.path / "population.pkl"
+        pkl.write_bytes(pkl.read_bytes()[: max(1, pkl.stat().st_size // 2)])
+        with pytest.warns(RuntimeWarning):  # snapshot-corrupt fallback warn
+            ctl.run(2)
+        assert reg.counter("elastic/members_restored_total").value == 2
+        assert reg.counter(
+            "elastic/members_reinitialized_total").value == 0
+        assert reg.counter("resilience/restore_fallbacks_total").value >= 1
+
+    def test_all_hosts_lost_raises_membership_change(self, tmp_path):
+        ctl = _controller(_dqn(), tmp_path)
+        ctl.run(1)
+        ctl.kill_host(0)
+        ctl.kill_host(1)
+        with pytest.raises(MembershipChange, match="all hosts lost"):
+            ctl.run(1)
+
+    def test_undersized_generation_timeout_errors_not_livelocks(
+            self, tmp_path, monkeypatch):
+        import time as _time
+
+        ctl = _controller(_dqn(), tmp_path, max_dispatch_retries=1)
+        ctl.run(1)  # compile + a committed snapshot at the boundary
+        ctl.generation_timeout = 0.05
+        monkeypatch.setattr(ctl, "_dispatch", lambda: _time.sleep(5))
+        with pytest.raises(MembershipChange, match="2 times in a row"):
+            ctl.step_generation()
+
+    def test_ppo_kill_recovers_bit_identical_stream(self, tmp_path):
+        """Same gate for the on-policy family (EvoPPO pod path)."""
+        ref = _controller(_ppo(), tmp_path / "ref")
+        ref_hist = ref.run(4)
+        ctl = _controller(
+            _ppo(), tmp_path / "kill",
+            fault_injector=FaultInjector(kill_host_at={2: 1}),
+            restore_from="latest",
+        )
+        assert ctl.run(4) == ref_hist
+        assert ctl.layout() == {"devices": 2, "pop": 4,
+                                "members_per_device": 2}
+
+
+# --------------------------------------------------------------------------- #
+# elastic resize
+# --------------------------------------------------------------------------- #
+
+
+class TestElasticResize:
+    def _run_shrink_grow(self, store):
+        reg = _registry()
+        ctl = _controller(_dqn(), store, n_hosts=4, n_devices=4, registry=reg)
+        ctl.run(2)
+        ids_before = list(ctl.member_ids)
+        fit_before = np.nan_to_num(np.asarray(ctl.fitness), nan=-np.inf)
+        ctl.kill_host(3)
+        ctl.run(1)  # shrink: 4 devices -> 3, pop 4 -> 3
+        shrink_layout = dict(ctl.layout())
+        ids_shrunk = list(ctl.member_ids)
+        ctl.revive_host(3)
+        ctl.run(1)  # grow: back to 4 devices, pop 3 -> 4
+        return reg, ctl, ids_before, fit_before, shrink_layout, ids_shrunk
+
+    def test_shrink_evicts_worst_then_grow_clones_winner(self, tmp_path):
+        reg, ctl, ids_before, fit_before, shrink_layout, ids_shrunk = \
+            self._run_shrink_grow(tmp_path)
+        assert shrink_layout == {"devices": 3, "pop": 3,
+                                 "members_per_device": 1}
+        # the evicted member is the worst-fitness one (ties evict the
+        # younger slot)
+        evicted = set(ids_before) - set(ids_shrunk)
+        assert len(evicted) == 1
+        worst = fit_before.min()
+        evicted_slot = ids_before.index(evicted.pop())
+        assert fit_before[evicted_slot] == worst
+        # growth: back to 4 members, the new one is a fresh lineage id
+        assert ctl.layout() == {"devices": 4, "pop": 4,
+                                "members_per_device": 1}
+        assert len(set(ctl.member_ids)) == 4
+        assert max(ctl.member_ids) >= len(ids_before)  # a new id was minted
+        assert reg.counter("elastic/members_evicted_total").value == 1
+        assert reg.counter("elastic/members_cloned_total").value == 1
+        # lineage events for BOTH directions
+        lineage = [f for k, f in reg.sink.events if k == "elastic_lineage"]
+        assert {e["op"] for e in lineage} >= {"evict", "clone"}
+        resizes = [f for k, f in reg.sink.events if k == "elastic_resize"]
+        assert [r["op"] for r in resizes] == ["shrink", "grow"]
+
+    def test_shrink_grow_is_deterministic(self, tmp_path):
+        _, c1, *_ = self._run_shrink_grow(tmp_path / "a")
+        _, c2, *_ = self._run_shrink_grow(tmp_path / "b")
+        assert c1.fitness_history == c2.fitness_history
+        assert c1.member_id_history == c2.member_id_history
+
+    def test_capacity_beyond_target_grows_population(self, tmp_path):
+        """More devices than the configured population: the controller grows
+        the population to fill them — never an idle device."""
+        ctl = _controller(_dqn(), tmp_path, n_hosts=2, n_devices=2, pop=2)
+        ctl.run(1)
+        ctl.hosts.extend(make_emulated_hosts(2, jax.devices()[2:4]))
+        for h in ctl.hosts[2:]:
+            h.host_id += 2  # ids 2, 3
+        ctl.run(1)
+        assert ctl.layout() == {"devices": 4, "pop": 4,
+                                "members_per_device": 1}
+
+
+# --------------------------------------------------------------------------- #
+# island migration
+# --------------------------------------------------------------------------- #
+
+
+class TestIslandMigration:
+    def test_export_import_roundtrip(self, tmp_path):
+        ex = tmp_path / "exchange"
+        reg_a, reg_b = _registry(), _registry()
+        a = _controller(_dqn(), tmp_path / "a", n_hosts=1, n_devices=2, pop=2,
+                        seed=1, registry=reg_a,
+                        island=IslandConfig("A", ex, top_k=1, every=1))
+        b = _controller(_dqn(), tmp_path / "b", n_hosts=1, n_devices=2, pop=2,
+                        seed=9, registry=reg_b,
+                        island=IslandConfig("B", ex, top_k=1, every=1))
+        a.run(1)  # exports A@1
+        # the export is atomic and self-describing: manifest carries
+        # per-member fitness + hash, readable without unpickling members
+        exports = list((ex / "island_A").iterdir())
+        assert len(exports) == 1
+        manifest = json.loads((exports[0] / "manifest.json").read_text())
+        assert manifest["island"] == "A" and manifest["members"] == 1
+        assert len(manifest["fitness"]) == 1
+        assert reg_a.counter("elastic/migrations_exported_total").value == 1
+
+        ids_before = list(b.member_ids)
+        b.run(1)  # exports B@1, imports A@1 when it beats B's worst
+        a_best = manifest["fitness"][0]
+        b_worst = min(
+            f for f in b.fitness_history[0]
+        )
+        if a_best is not None and a_best > b_worst:
+            assert reg_b.counter(
+                "elastic/migrations_imported_total").value == 1
+            new_ids = set(b.member_ids) - set(ids_before)
+            assert len(new_ids) == 1  # the migrant got a fresh lineage id
+            migrations = [f for k, f in reg_b.sink.events
+                          if k == "elastic_lineage" and f["op"] == "migrate"]
+            assert migrations and migrations[0]["source_island"] == "island_A"
+            # the imported member is the exported row, bit-exact
+            payload = pickle.loads((exports[0] / "members.pkl").read_bytes())
+            slot = b.member_ids.index(new_ids.pop())
+            live = [np.asarray(l)[slot]
+                    for l in jax.tree_util.tree_leaves(jax.device_get(b.pop))]
+            for mine, theirs in zip(live, payload["leaves"]):
+                np.testing.assert_array_equal(mine, np.asarray(theirs)[0])
+        else:  # pragma: no cover - seed-dependent branch, kept honest
+            assert reg_b.counter(
+                "elastic/migrations_imported_total").value == 0
+
+    def test_torn_export_skip_and_warn(self, tmp_path):
+        """FaultInjector torn-island-export mode: the corrupted export is
+        hash-rejected, counted, warned about — and never imported."""
+        ex = tmp_path / "exchange"
+        inj = FaultInjector(truncate_at_ops=[0], match=("wrote",),
+                            path_match="members.pkl")
+        with inj:
+            a = _controller(_dqn(), tmp_path / "a", n_hosts=1, n_devices=2,
+                            pop=2, seed=1,
+                            island=IslandConfig("A", ex, every=1))
+            a.run(1)  # export payload is silently truncated
+        reg_b = _registry()
+        b = _controller(_dqn(), tmp_path / "b", n_hosts=1, n_devices=2, pop=2,
+                        seed=9, registry=reg_b,
+                        island=IslandConfig("B", ex, every=1))
+        ids_before = list(b.member_ids)
+        with pytest.warns(RuntimeWarning, match="failed hash validation"):
+            b.run(1)
+        assert reg_b.counter("elastic/torn_imports_total").value == 1
+        assert reg_b.counter("elastic/migrations_imported_total").value == 0
+        assert b.member_ids == ids_before  # nothing was replaced
+
+    def test_same_export_imported_once(self, tmp_path):
+        ex = tmp_path / "exchange"
+        reg_b = _registry()
+        a = _controller(_dqn(), tmp_path / "a", n_hosts=1, n_devices=2, pop=2,
+                        seed=1, island=IslandConfig("A", ex, every=1))
+        a.run(1)
+        b = _controller(_dqn(), tmp_path / "b", n_hosts=1, n_devices=2, pop=2,
+                        seed=9, registry=reg_b,
+                        island=IslandConfig("B", ex, every=1))
+        b.run(2)  # sees A@1 twice; must import at most once
+        assert reg_b.counter(
+            "elastic/migrations_imported_total").value <= 1
+
+
+# --------------------------------------------------------------------------- #
+# restart-resume + entry point + guards
+# --------------------------------------------------------------------------- #
+
+
+class TestResumeAndWiring:
+    def test_restart_resume_continues_exact_stream(self, tmp_path):
+        """Full-pod preemption: a NEW controller process resumes from the
+        shared store and continues the same fitness stream."""
+        h1 = _controller(_dqn(), tmp_path / "run").run(3)
+        ctl = _controller(_dqn(), tmp_path / "run")
+        assert ctl.resume()
+        h2 = ctl.run(2)
+        ref = _controller(_dqn(), tmp_path / "ref").run(5)
+        assert h1 + h2 == ref
+
+    def test_train_elastic_pbt_entry_point(self, tmp_path):
+        ctl = train_elastic_pbt(
+            _dqn(), 4, 2, tmp_path,
+            hosts=make_emulated_hosts(2, jax.devices()[:4]),
+            heartbeat_timeout=HEARTBEAT, seed=3,
+        )
+        assert ctl.generation == 2
+        assert len(ctl.fitness_history) == 2
+        # resume=True on a fresh store is a clean start, then continues
+        ctl2 = train_elastic_pbt(
+            _dqn(), 4, 1, tmp_path,
+            hosts=make_emulated_hosts(2, jax.devices()[:4]),
+            heartbeat_timeout=HEARTBEAT, seed=3, resume=True,
+        )
+        assert ctl2.generation == 3
+
+    def test_layout_guards(self, tmp_path):
+        with pytest.raises(ValueError, match="multiple of"):
+            _controller(_dqn(), tmp_path, n_hosts=3, n_devices=3, pop=4)
+        with pytest.raises(ValueError, match="evenly"):
+            make_emulated_hosts(3, jax.devices()[:4])
+        with pytest.raises(ValueError, match="restore_from"):
+            _controller(_dqn(), tmp_path, restore_from="newest")

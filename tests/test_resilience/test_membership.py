@@ -1,0 +1,278 @@
+"""Membership layer: heartbeat leases, poll events, leader election, bounded
+collective timeouts, and the per-member-fitness snapshot manifest — the
+detection half of elastic PBT, exercised without any real multi-process
+runtime (fake clocks and monkeypatched collectives keep it tier-1)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.observability.registry import MetricsRegistry
+from agilerl_tpu.parallel.multihost import barrier, call_with_collective_timeout
+from agilerl_tpu.resilience import (
+    CheckpointManager,
+    HeartbeatStore,
+    MembershipChange,
+)
+
+pytestmark = pytest.mark.elastic
+
+
+class ListSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, fields):
+        self.events.append((kind, dict(fields)))
+
+    def flush(self):
+        pass
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+@pytest.fixture
+def registry():
+    return MetricsRegistry(sink=ListSink())
+
+
+# --------------------------------------------------------------------------- #
+# HeartbeatStore
+# --------------------------------------------------------------------------- #
+
+
+class TestHeartbeatStore:
+    def test_lease_lifecycle(self, tmp_path, registry):
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0)
+        store.beat(1)
+        assert sorted(store.alive()) == [0, 1]
+        clock.advance(4.0)
+        store.beat(0)  # 1 does not renew
+        clock.advance(2.0)  # host 1's lease is now 6s old
+        assert sorted(store.alive()) == [0]
+
+    def test_tombstone_is_immediate(self, tmp_path, registry):
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0)
+        store.beat(1)
+        store.mark_dead(1)  # graceful leave: no timeout wait
+        assert sorted(store.alive()) == [0]
+
+    def test_leader_is_lowest_live(self, tmp_path, registry):
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        assert store.leader() is None
+        store.beat(2)
+        store.beat(1)
+        assert store.leader() == 1
+        store.mark_dead(1)
+        assert store.leader() == 2
+
+    def test_torn_lease_is_a_missed_beat(self, tmp_path, registry):
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0)
+        (tmp_path / "host_0000.json").write_text('{"host": 0, "ti')  # torn
+        assert store.alive() == {}
+
+    def test_poll_reports_lost_and_joined(self, tmp_path, registry):
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0)
+        store.beat(1)
+        assert store.poll() is None  # first poll baselines
+        assert store.poll() is None  # no change
+        clock.advance(6.0)
+        store.beat(0)
+        store.beat(2)
+        event = store.poll()
+        assert event.lost == (1,)
+        assert event.joined == (2,)
+        assert event.alive == (0, 2)
+        assert event.leader == 0
+        assert registry.counter("resilience/membership_changes_total").value == 1
+        assert registry.counter("resilience/hosts_lost_total").value == 1
+        assert registry.counter("resilience/hosts_joined_total").value == 1
+        kinds = [k for k, _ in registry.sink.events]
+        assert "membership" in kinds
+
+    def test_rejoin_within_lease_window_detected_by_incarnation(
+            self, tmp_path, registry):
+        """A host that dies and comes back between two polls never shows a
+        stale lease — the bumped incarnation is the only signal, and poll
+        reports it as lost AND joined."""
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0, incarnation=0)
+        store.beat(1, incarnation=0)
+        assert store.poll() is None  # baseline
+        store.beat(1, incarnation=1)  # died + rejoined inside the window
+        event = store.poll()
+        assert event.lost == (1,) and event.joined == (1,)
+        assert event.alive == (0, 1)
+
+    def test_expect_baselines_roster(self, tmp_path, registry):
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0)
+        store.expect([0, 1])  # host 1 expected but never beat
+        event = store.poll()
+        assert event is not None and event.lost == (1,)
+
+    def test_wait_for_deadline_raises(self, tmp_path, registry):
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry)
+        store.beat(0)
+        with pytest.raises(MembershipChange) as exc:
+            store.wait_for(2, timeout=0.1, interval=0.02)
+        assert exc.value.alive == (0,)
+
+    def test_wait_for_succeeds_with_own_beat(self, tmp_path, registry):
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry)
+        store.beat(1)
+        alive = store.wait_for(2, timeout=1.0, beat_as=(0, 0))
+        assert sorted(alive) == [0, 1]
+
+
+# --------------------------------------------------------------------------- #
+# bounded collectives
+# --------------------------------------------------------------------------- #
+
+
+class TestCollectiveTimeout:
+    def test_passthrough_and_exception(self, registry):
+        assert call_with_collective_timeout(lambda: 7, None) == 7
+        assert call_with_collective_timeout(lambda: 7, 5.0,
+                                            registry=registry) == 7
+        with pytest.raises(KeyError):
+            call_with_collective_timeout(
+                lambda: (_ for _ in ()).throw(KeyError("x")), 5.0,
+                registry=registry,
+            )
+
+    def test_timeout_raises_membership_change(self, registry):
+        release = threading.Event()
+        try:
+            with pytest.raises(MembershipChange):
+                call_with_collective_timeout(
+                    lambda: release.wait(30), 0.05, name="fitness-all-gather",
+                    registry=registry,
+                )
+        finally:
+            release.set()
+        assert registry.counter(
+            "resilience/collective_timeouts_total").value == 1
+        assert any(k == "collective_timeout"
+                   for k, _ in registry.sink.events)
+
+    def test_barrier_timeout_surfaces_membership_change(self, monkeypatch):
+        """A lost host turns the barrier into a bounded MembershipChange
+        instead of an indefinite hang (satellite: multihost.barrier)."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        release = threading.Event()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "sync_global_devices",
+            lambda name: release.wait(30),
+        )
+        from agilerl_tpu.observability import get_registry
+
+        before = get_registry().counter(
+            "resilience/collective_timeouts_total").value
+        try:
+            with pytest.raises(MembershipChange):
+                barrier("gen-boundary", timeout=0.05)
+        finally:
+            release.set()
+        assert get_registry().counter(
+            "resilience/collective_timeouts_total").value == before + 1
+
+    def test_barrier_single_process_ignores_timeout(self):
+        barrier("noop", timeout=0.001)  # process_count()==1: plain return
+
+
+# --------------------------------------------------------------------------- #
+# per-member fitness at manifest level (satellite: CheckpointManager)
+# --------------------------------------------------------------------------- #
+
+
+class TestMemberFitnessManifest:
+    def test_manifest_records_members_without_unpickling(self, tmp_path,
+                                                         registry):
+        mgr = CheckpointManager(tmp_path, registry=registry)
+        mgr.save(
+            {"population": {"leaves": [np.zeros(3)]}}, step=1,
+            member_fitness=[1.0, np.nan, 3.0], member_ids=[10, 11, 12],
+        )
+        info = mgr.latest()
+        assert info.member_fitness == [1.0, None, 3.0]
+        assert info.member_ids == [10, 11, 12]
+        assert info.best_member_index() == 2
+        # run-level fitness derives from the best finite member, keeping
+        # keep_best retention consistent with the new field
+        assert info.fitness == 3.0
+
+    def test_member_fitness_none_round_trip(self, tmp_path, registry):
+        """Feeding SnapshotInfo.member_fitness (nulls included) back into
+        save() must not crash — the documented round-trip."""
+        mgr = CheckpointManager(tmp_path, registry=registry)
+        mgr.save({}, step=1, member_fitness=[1.0, np.nan], member_ids=[0, 1])
+        first = mgr.latest()
+        mgr.save({}, step=2, member_fitness=first.member_fitness,
+                 member_ids=first.member_ids)
+        assert mgr.latest().member_fitness == [1.0, None]
+
+    def test_explicit_fitness_wins(self, tmp_path, registry):
+        mgr = CheckpointManager(tmp_path, registry=registry)
+        mgr.save({}, step=1, fitness=9.0, member_fitness=[1.0, 2.0])
+        assert mgr.latest().fitness == 9.0
+
+    def test_keep_best_retention_uses_derived_fitness(self, tmp_path,
+                                                      registry):
+        mgr = CheckpointManager(tmp_path, keep_last=1, keep_best=True,
+                                registry=registry)
+        mgr.save({}, step=1, member_fitness=[5.0, 50.0])
+        mgr.save({}, step=2, member_fitness=[1.0, 2.0])
+        mgr.save({}, step=3, member_fitness=[0.5, 1.0])
+        best = mgr.best()
+        assert best is not None and best.step == 1  # survived retention
+        steps = [s.step for s in mgr.snapshots()]
+        assert steps == [1, 3]  # best + last
+
+    def test_all_nan_member_fitness(self, tmp_path, registry):
+        mgr = CheckpointManager(tmp_path, registry=registry)
+        mgr.save({}, step=1, member_fitness=[np.nan, np.nan])
+        info = mgr.latest()
+        assert info.member_fitness == [None, None]
+        assert info.fitness is None
+        assert info.best_member_index() is None
+
+    def test_old_manifest_has_no_member_fields(self, tmp_path, registry):
+        mgr = CheckpointManager(tmp_path, registry=registry)
+        mgr.save({}, step=1, fitness=1.0)
+        info = mgr.latest()
+        assert info.member_fitness is None
+        assert info.member_ids is None
+        assert info.best_member_index() is None
