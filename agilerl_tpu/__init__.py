@@ -19,6 +19,7 @@ __version__ = "0.1.0"
 
 from agilerl_tpu import (
     algorithms,
+    analysis,
     components,
     envs,
     hpo,
@@ -37,6 +38,7 @@ from agilerl_tpu import (
 
 __all__ = [
     "algorithms",
+    "analysis",
     "components",
     "envs",
     "hpo",

@@ -30,6 +30,7 @@ from agilerl_tpu.algorithms.core.registry import (
     OptimizerConfig,
 )
 from agilerl_tpu.utils.spaces import preprocess_observation
+from agilerl_tpu.utils.rng import global_seed
 
 # process-global compiled-function cache shared across population members
 _GLOBAL_JIT_CACHE: Dict[tuple, Callable] = {}
@@ -56,7 +57,7 @@ class EvolvableAlgorithm:
         self.scores: List[float] = []
         self.steps: List[int] = [0]
         self.mut = "None"  # last mutation applied, for logging (parity)
-        seed = seed if seed is not None else np.random.randint(0, 2**31 - 1)
+        seed = seed if seed is not None else global_seed()
         self._key = jax.random.PRNGKey(seed)
         self.rng = np.random.default_rng(seed)
         self._jit_cache: Dict[str, Callable] = {}

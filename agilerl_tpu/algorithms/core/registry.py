@@ -10,6 +10,7 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
+from agilerl_tpu.utils.rng import derive_rng
 
 
 @dataclasses.dataclass
@@ -53,7 +54,7 @@ class RLParameter:
 
     def mutate(self, value, rng: Optional[np.random.Generator] = None):
         """Randomly grow or shrink within [min, max] (parity: registry.py:135)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         factor = self.grow_factor if rng.random() < 0.5 else self.shrink_factor
         new = value * factor
         new = float(np.clip(new, self.min, self.max))
@@ -76,7 +77,7 @@ class HyperparameterConfig:
         return list(self.params.keys())
 
     def sample(self, rng: Optional[np.random.Generator] = None) -> Optional[str]:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if not self.params:
             return None
         return str(rng.choice(self.names()))

@@ -1,0 +1,60 @@
+"""graftcheck — JAX/TPU-aware static analysis + runtime compile/sync guards.
+
+Static half (the analysis pass itself is stdlib-only and runs in
+milliseconds; the CLI pays one parent-package import at startup)::
+
+    python -m agilerl_tpu.analysis                 # lint the package
+    python -m agilerl_tpu.analysis --list-rules    # rule catalogue
+
+Rules: GX001 host-sync in a hot loop, GX002 recompile hazards, GX003
+global-RNG draws, GX004 non-atomic durability writes, GX005 retry-wrapped
+collectives. Per-line ``# graftcheck: disable=GXnnn`` pragmas and a committed
+baseline (``analysis_baseline.json``) gate CI on NEW findings only.
+
+Runtime half (imported lazily — pulls in jax)::
+
+    with CompileGuard(step_fn):          # zero new compiled programs, or raise
+        for _ in range(n): step_fn(state)
+    with SyncGuard(registry=reg) as sg:  # count blocking device->host syncs
+        loop()
+    assert sg.syncs == 0
+
+See ``docs/static_analysis.md`` for the full catalogue and workflow.
+"""
+
+from __future__ import annotations
+
+from .baseline import (
+    BASELINE_FILENAME,
+    discover_baseline,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from .engine import Report, analyze, analyze_file, default_target, resolve_rules
+from .findings import Finding, assign_fingerprints
+from .rules import ALL_RULES, RULES_BY_ID
+
+__all__ = [
+    "ALL_RULES", "RULES_BY_ID", "Finding", "Report",
+    "analyze", "analyze_file", "assign_fingerprints", "default_target",
+    "resolve_rules",
+    "BASELINE_FILENAME", "discover_baseline", "load_baseline",
+    "split_baselined", "write_baseline",
+    # lazy (jax-importing) runtime guards:
+    "CompileGuard", "CompileGuardError", "SyncGuard", "SyncGuardError",
+]
+
+_RUNTIME_NAMES = {"CompileGuard", "CompileGuardError",
+                  "SyncGuard", "SyncGuardError"}
+
+
+def __getattr__(name):
+    """Lazy-load the runtime guards so the analysis modules themselves never
+    import jax (the parent package does on ``python -m``, but in-process
+    consumers of the linter API — tests, tooling — stay stdlib-fast)."""
+    if name in _RUNTIME_NAMES:
+        from . import runtime
+
+        return getattr(runtime, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,99 @@
+"""GX001 — host↔device sync inside a hot-path loop.
+
+``float()``/``int()``/``bool()`` on a device value, ``.item()``/``.tolist()``,
+and ``np.asarray``/``np.array`` all block the host until the device value is
+ready. Inside the loop body of a hot module (``training/``, ``parallel/``,
+``components/``, ``llm/serving.py``) that is a per-step pipeline stall — the
+exact bug class of PR 2's host-mirrored ``len()`` fix. The check is
+syntactic (no interprocedural dataflow): conversions whose argument is
+obviously host-side (a literal, ``len(...)``, ``time.time()``, ``os.environ``
+lookups, string parses) are skipped; everything else in a hot loop is flagged
+and either fixed, pragma'd with a justification, or baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..findings import Finding
+from .base import FileContext, Rule
+
+#: builtins that force a device scalar to host
+_SYNC_BUILTINS = {"float", "int", "bool"}
+#: methods that force a device array to host
+_SYNC_METHODS = {"item", "tolist"}
+#: numpy entry points that materialise a device array on host
+_SYNC_NUMPY = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+#: call roots whose results are host values — conversions of these are fine
+_HOST_CALLS = {"len", "time.time", "time.monotonic", "time.perf_counter",
+               "os.getenv", "str", "repr", "round", "min", "max", "sum",
+               "abs", "ord", "id", "hash"}
+_HOST_ROOTS = ("os.environ", "os.path", "math.")
+
+
+def _is_host_value(ctx: FileContext, node: ast.AST) -> bool:
+    """Cheap 'obviously not a device array' filter for conversion arguments."""
+    if isinstance(node, (ast.Constant, ast.JoinedStr, ast.Dict, ast.List,
+                         ast.Tuple, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        dotted = ctx.dotted(node.func)
+        if dotted and (dotted in _HOST_CALLS
+                       or any(dotted.startswith(r) for r in _HOST_ROOTS)):
+            return True
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+        dotted = ctx.dotted(node)
+        if dotted and any(dotted.startswith(r) for r in _HOST_ROOTS):
+            return True
+    if isinstance(node, ast.BinOp):
+        return (_is_host_value(ctx, node.left)
+                and _is_host_value(ctx, node.right))
+    return False
+
+
+class HostSyncInHotLoop(Rule):
+    id = "GX001"
+    name = "host-sync-in-hot-loop"
+    hint = ("keep the value on device (jnp ops / device-side reduction) or "
+            "move the sync to eval/generation cadence; host-mirror counters "
+            "like PR 2's len()")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.is_hot():
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.in_loop(node):
+                continue
+            # float(x) / int(x) / bool(x)
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _SYNC_BUILTINS
+                    and len(node.args) == 1 and not node.keywords
+                    and not _is_host_value(ctx, node.args[0])):
+                yield self.finding(
+                    ctx, node,
+                    f"{node.func.id}(...) in a hot loop blocks on a device "
+                    f"value (host↔device sync per iteration)")
+                continue
+            dotted = ctx.dotted(node.func)
+            # np.asarray / np.array
+            if dotted in _SYNC_NUMPY and node.args \
+                    and not _is_host_value(ctx, node.args[0]):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}(...) in a hot loop copies a device array to "
+                    f"host every iteration")
+                continue
+            # .item() / .tolist()
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_METHODS
+                    and not node.args and not node.keywords):
+                # dict.items() is ubiquitous; .item()/.tolist() are the jax /
+                # numpy spellings — skip receivers that are obviously host
+                if _is_host_value(ctx, node.func.value):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f".{node.func.attr}() in a hot loop forces a blocking "
+                    f"device→host transfer")

@@ -1,0 +1,75 @@
+"""GX002 — steady-state recompilation hazards.
+
+Three sub-patterns, all of which defeat XLA's compile-once model (ROADMAP
+item 5; three separate ad-hoc compile-count regression tests existed before
+this rule):
+
+- ``jax.jit(...)`` invoked inside a **loop body** — a fresh wrapper (and with
+  a fresh closure, a fresh cache) per iteration instead of one cached at
+  init/module scope.
+- ``jax.jit(lambda ...)`` inside a **function body** — every call of the
+  enclosing function builds a new lambda object, so the jit cache never hits
+  across calls. (Module-scope ``jit(lambda ...)`` binds once and is fine.)
+- ``jax.jit(step_like)`` with **no donation** on a known step-builder
+  signature (first arg named ``*step*``/``learn*``/``update_fn``): training
+  steps that re-bind their carry without ``donate_argnums`` double peak HBM.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..findings import Finding
+from .base import FileContext, Rule
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_STEP_ARG_RE = re.compile(r"(^|_)(step|learn|update)(_fn|_step)?$")
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+def _is_jit(ctx: FileContext, node: ast.Call) -> bool:
+    dotted = ctx.dotted(node.func)
+    return dotted in _JIT_NAMES
+
+
+class RecompileHazard(Rule):
+    id = "GX002"
+    name = "recompile-hazard"
+    hint = ("cache the jitted callable at init/module scope (one object for "
+            "the life of the program) and pass donate_argnums on step "
+            "signatures that re-bind their carry")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_jit(ctx, node):
+                continue
+            if ctx.in_loop(node):
+                yield self.finding(
+                    ctx, node,
+                    "jax.jit called inside a loop body — a fresh jitted "
+                    "wrapper per iteration recompiles instead of reusing one "
+                    "cached program")
+                continue
+            first = node.args[0] if node.args else None
+            if isinstance(first, ast.Lambda) and \
+                    ctx.enclosing_function(node) is not None:
+                yield self.finding(
+                    ctx, node,
+                    "jax.jit(lambda ...) inside a function body — each call "
+                    "creates a fresh closure, so the jit cache never hits "
+                    "across calls")
+                continue
+            if (isinstance(first, ast.Name)
+                    and _STEP_ARG_RE.search(first.id)
+                    and not any(kw.arg in _DONATE_KWARGS
+                                for kw in node.keywords)):
+                yield self.finding(
+                    ctx, node,
+                    f"jax.jit({first.id}) without donate_argnums/"
+                    f"donate_argnames — a step that re-binds its carry "
+                    f"doubles peak HBM without donation",
+                    hint=("pass donate_argnums for the carried state (or "
+                          "pragma the site if the step genuinely aliases "
+                          "its inputs)"))

@@ -1,0 +1,95 @@
+"""GX003 — global-RNG draws.
+
+Module-level ``np.random.*`` / stdlib ``random.*`` draws break kill-resume
+determinism (PR 3's evolution-cloning bug: a clone drew the *global* numpy
+stream, so a resumed run diverged unless the global state was captured too)
+and make seeded runs depend on hidden global stream positions. RNG must flow
+through threaded ``np.random.Generator`` objects or jax keys; the one
+sanctioned root draw lives in ``utils/rng.py`` (allowlisted) so the global
+stream is consumed in exactly one audited place.
+
+State management (``seed``/``get_state``/``set_state``) and constructor calls
+(``default_rng``/``Generator``/``SeedSequence``/``PRNGKey``) are not draws
+and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..findings import Finding
+from .base import FileContext, Rule, _endswith
+
+#: files allowed to draw the global stream (the audited derivation root)
+ALLOW_FILES = ("utils/rng.py",)
+
+_NUMPY_DRAWS = {
+    "beta", "binomial", "bytes", "chisquare", "choice", "dirichlet",
+    "exponential", "f", "gamma", "geometric", "gumbel", "hypergeometric",
+    "laplace", "logistic", "lognormal", "logseries", "multinomial",
+    "multivariate_normal", "negative_binomial", "noncentral_chisquare",
+    "noncentral_f", "normal", "pareto", "permutation", "poisson", "power",
+    "rand", "randint", "randn", "random", "random_integers", "random_sample",
+    "ranf", "rayleigh", "sample", "shuffle", "standard_cauchy",
+    "standard_exponential", "standard_gamma", "standard_normal", "standard_t",
+    "triangular", "uniform", "vonmises", "wald", "weibull", "zipf",
+}
+_STDLIB_DRAWS = {
+    "betavariate", "choice", "choices", "expovariate", "gammavariate",
+    "gauss", "getrandbits", "lognormvariate", "normalvariate",
+    "paretovariate", "randbytes", "randint", "random", "randrange", "sample",
+    "shuffle", "triangular", "uniform", "vonmisesvariate", "weibullvariate",
+}
+
+
+class GlobalRngDraw(Rule):
+    id = "GX003"
+    name = "global-rng-draw"
+    hint = ("thread an np.random.Generator (or jax key) through the call "
+            "path; derive unseeded fallbacks via utils/rng.py so the draw "
+            "is captured by the resilience RNG protocol")
+
+    @staticmethod
+    def _imported_stdlib_random(ctx: FileContext) -> bool:
+        """Only trust a ``random.*`` resolution when the file really imported
+        the stdlib module (``import random`` or ``from random import ...``) —
+        a local Generator variable that happens to be named ``random`` must
+        not trip the rule."""
+        return (ctx.module_aliases.get("random") == "random"
+                or any(v == "random" or v.startswith("random.")
+                       for v in ctx.from_imports.values()))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if _endswith(ctx.relpath, ALLOW_FILES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.dotted(node.func)
+            if not dotted:
+                continue
+            if dotted == "numpy.random.default_rng" and not node.args \
+                    and not node.keywords:
+                yield self.finding(
+                    ctx, node,
+                    "np.random.default_rng() with no seed — an OS-entropy "
+                    "Generator that escapes BOTH np.random.seed and the "
+                    "resilience snapshot (unseeded runs stay "
+                    "nondeterministic even when seeded)",
+                    hint=("derive the fallback via utils/rng.derive_rng so "
+                          "the seed comes from the captured global stream"))
+            elif dotted.startswith("numpy.random.") and \
+                    dotted.rsplit(".", 1)[1] in _NUMPY_DRAWS:
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}(...) draws the GLOBAL numpy stream — "
+                    f"kill-resume determinism depends on hidden global "
+                    f"state (PR 3 evolution-cloning bug class)")
+            elif dotted.startswith("random.") and \
+                    dotted.rsplit(".", 1)[1] in _STDLIB_DRAWS and \
+                    self._imported_stdlib_random(ctx):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}(...) draws the global stdlib random stream — "
+                    f"untracked by the threaded-Generator protocol")

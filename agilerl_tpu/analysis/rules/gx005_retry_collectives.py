@@ -1,0 +1,59 @@
+"""GX005 — retry-wrapped collectives.
+
+PR 3's collectives-fail-fast invariant: ``call_with_retries``/``RetryPolicy``
+must never wrap a ``multihost`` collective. A per-host retry desynchronises
+the pod (the other hosts already entered the collective once); the sanctioned
+recovery is snapshot-resume, and the sanctioned timeout wrapper is
+``call_with_collective_timeout`` (which raises ``MembershipChange`` instead
+of retrying). This rule flags any retry-entry-point call whose argument
+subtree references the multihost module or a name imported from it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..findings import Finding
+from .base import FileContext, Rule
+
+_RETRY_ENTRY_POINTS = {"call_with_retries", "RetryPolicy", "RetryingEnv"}
+_MULTIHOST_MODULE = "multihost"
+
+
+class RetryWrappedCollective(Rule):
+    id = "GX005"
+    name = "retry-wrapped-collective"
+    hint = ("collectives fail fast: use call_with_collective_timeout + "
+            "snapshot-resume (MembershipChange), never a per-host retry")
+
+    def _references_multihost(self, ctx: FileContext, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            dotted = ctx.dotted(sub) if isinstance(
+                sub, (ast.Attribute, ast.Name)) else None
+            if not dotted:
+                continue
+            parts = dotted.split(".")
+            if _MULTIHOST_MODULE in parts[:-1]:
+                return True  # multihost.barrier / parallel.multihost.psum
+            if isinstance(sub, ast.Name):
+                resolved = ctx.from_imports.get(sub.id, "")
+                if f".{_MULTIHOST_MODULE}." in f".{resolved}":
+                    return True  # from .multihost import barrier; barrier
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.dotted(node.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf not in _RETRY_ENTRY_POINTS:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(self._references_multihost(ctx, a) for a in args):
+                yield self.finding(
+                    ctx, node,
+                    f"{leaf}(...) wraps a multihost collective — a per-host "
+                    f"retry desynchronises the pod (collectives-fail-fast "
+                    f"invariant, PR 3)")

@@ -33,6 +33,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from agilerl_tpu.utils.rng import global_seed
 
 PyTree = Any
 
@@ -170,7 +171,7 @@ class ReplayBuffer:
         """(Re)seed the sampling PRNG (threaded from the training loops'
         ``seed=`` so runs are reproducible)."""
         if seed is None:
-            seed = np.random.randint(0, 2**31 - 1)
+            seed = global_seed()
         self._key = jax.random.PRNGKey(int(seed))
 
     def __len__(self) -> int:

@@ -16,6 +16,7 @@ from typing import Any, Dict, Iterator, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from agilerl_tpu.utils.rng import derive_key
 
 PyTree = Any
 
@@ -104,7 +105,7 @@ class RolloutBuffer:
         self.gae_lambda = float(gae_lambda)
         self.recurrent = recurrent
         self.state: Optional[RolloutState] = None
-        self._key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self._key = derive_key()
 
     @property
     def full(self) -> bool:

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from agilerl_tpu.utils.rng import derive_key, derive_rng
 
 
 
@@ -48,8 +49,11 @@ class Mutations:
         self.mutation_sd = float(mutation_sd)
         self.activation_selection = activation_selection or ["ReLU", "ELU", "GELU"]
         self.mutate_elite = bool(mutate_elite)
-        self.rng = np.random.default_rng(rand_seed)
-        self._key = jax.random.PRNGKey(rand_seed if rand_seed is not None else 0)
+        # unseeded fallbacks derive from the captured global stream —
+        # rand_seed=None previously meant OS-entropy np rng + a CONSTANT jax
+        # key shared by every unseeded Mutations instance (GX003 dogfood)
+        self.rng = derive_rng(seed=rand_seed)
+        self._key = derive_key(seed=rand_seed)
         #: optional observability.LineageTracker — records which mutation
         #: class landed on which child (genealogy fitness deltas)
         self.lineage = lineage

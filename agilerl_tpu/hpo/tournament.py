@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 import numpy as np
+from agilerl_tpu.utils.rng import derive_rng
 
 
 class TournamentSelection:
@@ -30,7 +31,7 @@ class TournamentSelection:
         self.elitism = bool(elitism)
         self.population_size = int(population_size)
         self.eval_loop = int(eval_loop)
-        self.rng = rng or np.random.default_rng()
+        self.rng = derive_rng(rng)
         #: optional observability.LineageTracker — records the generation's
         #: fitness distribution and every parent→child selection
         self.lineage = lineage

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from agilerl_tpu.typing import MutationMethod, MutationType
+from agilerl_tpu.utils.rng import derive_rng
 
 Params = Any
 
@@ -132,7 +133,7 @@ class EvolvableModule:
     ) -> str:
         """Sample a mutation method name, preferring node mutations
         (parity: base.py:687 — layer mutations chosen with prob new_layer_prob)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         layers = self.layer_mutation_methods()
         nodes = self.node_mutation_methods()
         if layers and (not nodes or rng.random() < new_layer_prob):

@@ -20,6 +20,8 @@ import numpy as np
 from agilerl_tpu.modules import layers as L
 from agilerl_tpu.modules.base import EvolvableModule, mutation
 from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,7 +95,7 @@ class EvolvableBERT(EvolvableModule):
         if config is None:
             config = BERTConfig(vocab_size=vocab_size, **kwargs)
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         self.min_layers = min_layers
         self.max_layers = max_layers
         self.min_d_model = min_d_model
@@ -182,7 +184,7 @@ class EvolvableBERT(EvolvableModule):
     # -- mutations ------------------------------------------------------ #
     @mutation(MutationType.LAYER)
     def add_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if bool(rng.integers(0, 2)) and cfg.n_encoder_layers < self.max_layers:
             self._morph(dataclasses.replace(cfg, n_encoder_layers=cfg.n_encoder_layers + 1))
@@ -194,7 +196,7 @@ class EvolvableBERT(EvolvableModule):
 
     @mutation(MutationType.LAYER, shrink_params=True)
     def remove_layer(self, rng: Optional[np.random.Generator] = None) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if bool(rng.integers(0, 2)) and cfg.n_encoder_layers > self.min_layers:
             self._morph(dataclasses.replace(cfg, n_encoder_layers=cfg.n_encoder_layers - 1))
@@ -208,7 +210,7 @@ class EvolvableBERT(EvolvableModule):
     def add_node(
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if numb_new_nodes is None:
             numb_new_nodes = cfg.n_head * int(rng.choice([4, 8]))
@@ -221,7 +223,7 @@ class EvolvableBERT(EvolvableModule):
     def remove_node(
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if numb_new_nodes is None:
             numb_new_nodes = cfg.n_head * int(rng.choice([4, 8]))

@@ -24,6 +24,8 @@ from agilerl_tpu.modules.base import (
     tuple_set,
 )
 from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +92,7 @@ class EvolvableCNN(EvolvableModule):
         if config is None:
             config = CNNConfig(input_shape=tuple(input_shape), num_outputs=num_outputs, **kwargs)
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         super().__init__(config, key)
 
     # ------------------------------------------------------------------ #
@@ -169,7 +171,7 @@ class EvolvableCNN(EvolvableModule):
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
         """Grow channels of a random conv layer (parity: cnn.py:707)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if hidden_layer is None:
             hidden_layer = int(rng.integers(0, len(cfg.channel_size)))
@@ -190,7 +192,7 @@ class EvolvableCNN(EvolvableModule):
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
         """Shrink channels of a random conv layer (parity: cnn.py:737)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if hidden_layer is None:
             hidden_layer = int(rng.integers(0, len(cfg.channel_size)))
@@ -211,7 +213,7 @@ class EvolvableCNN(EvolvableModule):
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
         """Mutate a kernel size (parity: cnn.py:675, MutableKernelSizes:55)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if len(cfg.channel_size) > 1:
             if hidden_layer is None:

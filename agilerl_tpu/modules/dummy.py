@@ -12,6 +12,7 @@ import jax
 import numpy as np
 
 from agilerl_tpu.modules.base import EvolvableModule
+from agilerl_tpu.utils.rng import derive_key
 
 
 class DummyEvolvable(EvolvableModule):
@@ -25,7 +26,7 @@ class DummyEvolvable(EvolvableModule):
         self._init_fn = init_fn
         self._apply_fn = apply_fn
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         super().__init__(config, key)
 
     def init_params(self, key, config):  # type: ignore[override]

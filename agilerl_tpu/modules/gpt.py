@@ -22,6 +22,8 @@ from agilerl_tpu.llm import model as M
 from agilerl_tpu.modules.base import EvolvableModule, mutation
 from agilerl_tpu.typing import MutationType
 from agilerl_tpu.utils.profiling import estimate_mfu as _estimate_mfu
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 
 class EvolvableGPT(EvolvableModule):
@@ -43,7 +45,7 @@ class EvolvableGPT(EvolvableModule):
         if config is None:
             config = M.GPTConfig(vocab_size=vocab_size, **kwargs)
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         self.min_layers = min_layers
         self.max_layers = max_layers
         self.min_d_model = min_d_model
@@ -92,7 +94,7 @@ class EvolvableGPT(EvolvableModule):
     def add_node(
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if numb_new_nodes is None:
             numb_new_nodes = cfg.n_head * int(rng.choice([4, 8, 16]))
@@ -105,7 +107,7 @@ class EvolvableGPT(EvolvableModule):
     def remove_node(
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if numb_new_nodes is None:
             numb_new_nodes = cfg.n_head * int(rng.choice([4, 8, 16]))

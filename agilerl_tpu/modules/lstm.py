@@ -18,6 +18,8 @@ import numpy as np
 from agilerl_tpu.modules import layers as L
 from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
 from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,7 +53,7 @@ class EvolvableLSTM(EvolvableModule):
         if config is None:
             config = LSTMConfig(num_inputs=num_inputs, num_outputs=num_outputs, **kwargs)
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         super().__init__(config, key)
 
     @staticmethod
@@ -124,7 +126,7 @@ class EvolvableLSTM(EvolvableModule):
     def add_node(
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if numb_new_nodes is None:
             numb_new_nodes = int(rng.choice([16, 32, 64]))
         cfg = self.config
@@ -136,7 +138,7 @@ class EvolvableLSTM(EvolvableModule):
     def remove_node(
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if numb_new_nodes is None:
             numb_new_nodes = int(rng.choice([16, 32, 64]))
         cfg = self.config

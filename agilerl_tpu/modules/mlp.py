@@ -19,6 +19,8 @@ import numpy as np
 from agilerl_tpu.modules import layers as L
 from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation, tuple_set
 from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,7 +60,7 @@ class EvolvableMLP(EvolvableModule):
         if config is None:
             config = MLPConfig(num_inputs=num_inputs, num_outputs=num_outputs, **kwargs)
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         super().__init__(config, key)
 
     # ------------------------------------------------------------------ #
@@ -148,7 +150,7 @@ class EvolvableMLP(EvolvableModule):
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
         """Grow a random hidden layer by {16,32,64} nodes (parity: mlp.py:255)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if hidden_layer is None:
             hidden_layer = int(rng.integers(0, len(cfg.hidden_size)))
@@ -169,7 +171,7 @@ class EvolvableMLP(EvolvableModule):
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
         """Shrink a random hidden layer (parity: mlp.py:285)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         if hidden_layer is None:
             hidden_layer = int(rng.integers(0, len(cfg.hidden_size)))

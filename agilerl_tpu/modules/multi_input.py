@@ -23,6 +23,8 @@ from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
 from agilerl_tpu.modules.cnn import CNNConfig, EvolvableCNN
 from agilerl_tpu.modules.mlp import EvolvableMLP, MLPConfig
 from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 # Sub-configs are stored in a tuple of (key, kind, config) so the whole config
 # stays hashable/static.
@@ -102,7 +104,7 @@ class EvolvableMultiInput(EvolvableModule):
                 sub_configs=sub_configs, num_outputs=num_outputs, **kwargs
             )
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         super().__init__(config, key)
 
     @staticmethod
@@ -134,7 +136,7 @@ class EvolvableMultiInput(EvolvableModule):
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
         """Grow the fusion latent dim (parity: multi_input.py:483)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if numb_new_nodes is None:
             numb_new_nodes = int(rng.choice([8, 16, 32]))
         cfg = self.config
@@ -150,7 +152,7 @@ class EvolvableMultiInput(EvolvableModule):
         self, numb_new_nodes: Optional[int] = None, rng: Optional[np.random.Generator] = None
     ) -> Dict:
         """Shrink the fusion latent dim (parity: multi_input.py:501)."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if numb_new_nodes is None:
             numb_new_nodes = int(rng.choice([8, 16, 32]))
         cfg = self.config
@@ -173,7 +175,7 @@ class EvolvableMultiInput(EvolvableModule):
         return self._mutate_sub("remove_layer", rng)
 
     def _mutate_sub(self, method: str, rng) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         cfg = self.config
         idx = int(rng.integers(0, len(cfg.sub_configs)))
         name, kind, sub_cfg = cfg.sub_configs[idx]

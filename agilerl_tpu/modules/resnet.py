@@ -18,6 +18,8 @@ import numpy as np
 from agilerl_tpu.modules import layers as L
 from agilerl_tpu.modules.base import EvolvableModule, config_replace, mutation
 from agilerl_tpu.typing import MutationType
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,7 +54,7 @@ class EvolvableResNet(EvolvableModule):
                 input_shape=tuple(input_shape), num_outputs=num_outputs, **kwargs
             )
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         super().__init__(config, key)
 
     @staticmethod
@@ -114,7 +116,7 @@ class EvolvableResNet(EvolvableModule):
         numb_new_channels: Optional[int] = None,
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if numb_new_channels is None:
             numb_new_channels = int(rng.choice([8, 16, 32]))
         cfg = self.config
@@ -132,7 +134,7 @@ class EvolvableResNet(EvolvableModule):
         numb_new_channels: Optional[int] = None,
         rng: Optional[np.random.Generator] = None,
     ) -> Dict:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         if numb_new_channels is None:
             numb_new_channels = int(rng.choice([8, 16, 32]))
         cfg = self.config

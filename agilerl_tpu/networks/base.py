@@ -36,6 +36,8 @@ from agilerl_tpu.modules.resnet import EvolvableResNet, ResNetConfig
 from agilerl_tpu.modules.simba import EvolvableSimBa, SimBaConfig
 from agilerl_tpu.typing import MutationType
 from agilerl_tpu.utils.spaces import image_shape_nhwc, is_image_space, obs_dim
+from agilerl_tpu.utils.rng import derive_rng
+from agilerl_tpu.utils.rng import derive_key
 
 ENCODER_TYPES = {
     "mlp": EvolvableMLP,
@@ -146,7 +148,7 @@ class EvolvableNetwork:
         config: Optional[NetworkConfig] = None,
     ):
         if key is None:
-            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            key = derive_key()
         self._key = key
         self.observation_space = observation_space
         if config is None:
@@ -259,7 +261,7 @@ class EvolvableNetwork:
     def sample_mutation_method(
         self, new_layer_prob: float = 0.2, rng: Optional[np.random.Generator] = None
     ) -> str:
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         enc_cls = ENCODER_TYPES[self.config.encoder_kind]
         layer_methods = [f"encoder.{n}" for n in enc_cls.layer_mutation_methods()]
         layer_methods += [f"head.{n}" for n in EvolvableMLP.layer_mutation_methods()]
@@ -272,7 +274,7 @@ class EvolvableNetwork:
 
     def apply_mutation(self, name: str, rng: Optional[np.random.Generator] = None) -> Dict:
         """Apply a mutation by namespaced name; returns mutation metadata."""
-        rng = rng or np.random.default_rng()
+        rng = derive_rng(rng)
         self.last_mutation_attr = name
         if name == "add_latent_node":
             return self._change_latent(+int(rng.choice([8, 16, 32])))

@@ -131,5 +131,10 @@ class LineageTracker:
         }
 
     def dump(self, path: str) -> None:
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(self.to_json(), fh, indent=2)
+        """Atomic write (tmp + fsync + replace): lineage dumps land next to
+        snapshots and get read by resume/analysis tooling — a kill mid-dump
+        must leave the previous genealogy, never a torn JSON (GX004)."""
+        from agilerl_tpu.resilience.atomic import atomic_write_bytes
+
+        atomic_write_bytes(
+            path, json.dumps(self.to_json(), indent=2).encode("utf-8"))

@@ -17,6 +17,7 @@ import threading
 from typing import Callable, Optional, TypeVar
 
 import numpy as np
+from agilerl_tpu.utils.rng import global_seed
 
 T = TypeVar("T")
 
@@ -57,11 +58,11 @@ def broadcast_seed(seed: Optional[int] = None) -> int:
     import jax
 
     if jax.process_count() == 1:
-        return seed if seed is not None else int(np.random.randint(0, 2**31 - 1))
+        return seed if seed is not None else global_seed()
     from jax.experimental import multihost_utils
 
     local = np.asarray(
-        [seed if seed is not None else np.random.randint(0, 2**31 - 1)], np.int64
+        [seed if seed is not None else global_seed()], np.int64
     )
     agreed = multihost_utils.broadcast_one_to_all(local)
     return int(agreed[0])

@@ -362,10 +362,16 @@ class ShardingPlan:
         )
 
     def to_yaml(self, path: str) -> None:
+        """Atomic write: plan YAMLs are committed layout artifacts (registry
+        exports, elastic re-form inputs) — a torn half-plan must never be
+        loadable (GX004)."""
         import yaml
 
-        with open(path, "w") as fh:
-            yaml.safe_dump(self.to_dict(), fh, sort_keys=False)
+        from agilerl_tpu.resilience.atomic import atomic_write_bytes
+
+        atomic_write_bytes(
+            path,
+            yaml.safe_dump(self.to_dict(), sort_keys=False).encode("utf-8"))
 
     @classmethod
     def from_yaml(cls, path: str) -> "ShardingPlan":

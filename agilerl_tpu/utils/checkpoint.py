@@ -119,8 +119,6 @@ def save_llm_checkpoint(agent, path: Union[str, Path], include_base: bool = Fals
     (parity: save_llm_checkpoint utils/utils.py:1021 / PEFT save_pretrained
     core/base.py:2125 — adapters-only is the default, exactly as the reference
     saves only the LoRA adapters)."""
-    import pickle
-
     path = Path(path).absolute()
     path.mkdir(parents=True, exist_ok=True)
     save_pytree(path / "actor_adapter", agent.actor.params)
@@ -133,8 +131,12 @@ def save_llm_checkpoint(agent, path: Union[str, Path], include_base: bool = Fals
         "fitness": agent.fitness,
         "steps": agent.steps,
     }
-    with open(path / "attributes.pkl", "wb") as f:
-        pickle.dump(attrs, f)
+    # atomic (tmp + fsync + replace): load_llm_checkpoint unpickles this file
+    # blindly — a kill mid-dump previously left a truncated pickle that a
+    # later restore would crash on (GX004)
+    from agilerl_tpu.resilience.atomic import atomic_pickle
+
+    atomic_pickle(path / "attributes.pkl", attrs)
 
 
 def load_llm_checkpoint(agent, path: Union[str, Path]) -> None:

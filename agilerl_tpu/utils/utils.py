@@ -160,7 +160,12 @@ def create_population(
         ctor_kwargs.setdefault("num_envs", num_envs)
 
     population = []
-    rng = np.random.default_rng(seed)
+    # seed=None must derive from the captured global stream, not OS entropy —
+    # otherwise two np.random.seed-ed runs build different populations and
+    # kill-resume diverges (GX003 bug class; see utils/rng.py)
+    from agilerl_tpu.utils.rng import derive_rng
+
+    rng = derive_rng(seed=seed)
     for idx in range(pop_size):
         population.append(
             algo_cls(

@@ -27,6 +27,7 @@ from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
+from agilerl_tpu.utils.rng import derive_key
 
 SUPPORTED_ACTIVATIONS = {
     "ReLU": "ReLU",
@@ -277,7 +278,7 @@ def MakeEvolvable(
     """Build an evolvable net by introspecting a torch module (network +
     input_tensor) or from a plain architecture description (kwargs)."""
     if key is None:
-        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        key = derive_key()
     if network is not None:
         if input_tensor is None:
             raise ValueError(

@@ -28,6 +28,9 @@
 #   bash run_tests.sh elastic    # elastic preemption-native PBT only
 #                                # (membership leases, host-loss recovery,
 #                                # resize determinism, island migration)
+#   bash run_tests.sh analysis   # graftcheck static-analysis suite only
+#                                # (rule fixtures, pragma/baseline gates,
+#                                # CompileGuard/SyncGuard, package clean)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -80,6 +83,13 @@ for arg in "$@"; do
       MARKER=(-m "elastic")
       SHARDS+=("tests/test_parallel/test_elastic.py tests/test_resilience/test_membership.py tests/test_hpo/test_tournament_resize.py")
       ;;
+    analysis)
+      # fast path: the graftcheck suite (per-rule positive/negative
+      # fixtures, pragma + baseline round-trips, runtime compile/sync
+      # guards, and the package-is-clean-vs-committed-baseline CI gate)
+      MARKER=(-m "analysis")
+      SHARDS+=("tests/test_analysis")
+      ;;
     *) SHARDS+=("$arg") ;;
   esac
 done
@@ -88,6 +98,7 @@ if [ ${#SHARDS[@]} -eq 0 ]; then
   # top-level test files form one shard; each test_* dir is its own shard
   SHARDS=(
     "tests/test_protocols.py tests/test_entry_surface.py"
+    tests/test_analysis
     tests/test_modules
     tests/test_networks
     tests/test_components

@@ -1,0 +1,20 @@
+"""GX002 negative: cached-at-init jits, module-scope lambda, donated step."""
+import jax
+
+# module scope binds ONE object for the life of the program — fine
+double = jax.jit(lambda v: v * 2)
+
+
+class Engine:
+    def __init__(self, step_fn):
+        # cached once at init with donation: the sanctioned pattern
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+
+    def run(self, state, xs):
+        for x in xs:
+            state = self._step(state, x)
+        return state
+
+
+def build(loss_fn):
+    return jax.jit(loss_fn)  # not a step-shaped name: no donation demand

@@ -1,0 +1,18 @@
+"""GX002 positive: recompile hazards (fires in any module — not hot-gated)."""
+import jax
+
+
+def hot_loop(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # jit in a loop body
+        outs.append(f(x))
+    return outs
+
+
+def fresh_closure(scale):
+    return jax.jit(lambda v: v * scale)  # jit(lambda) in a function body
+
+
+def build(train_step):
+    return jax.jit(train_step)  # step-shaped signature without donation

@@ -1,0 +1,15 @@
+"""GX003 negative: threaded Generators, jax keys, state management."""
+import jax
+import numpy as np
+
+
+def clone_population(pop, rng: np.random.Generator, key):
+    idx = rng.integers(0, len(pop))          # threaded Generator draw
+    k1, k2 = jax.random.split(key)           # jax keys
+    noise = jax.random.normal(k1, (3,))
+    seeded = np.random.default_rng(1234)     # seeded Generator: fine
+    state = np.random.get_state()            # state management, not a draw
+    np.random.set_state(state)
+    random = seeded                          # a VARIABLE named random
+    pick = random.choice(np.asarray(pop))    # ...is not the stdlib module
+    return idx, k2, noise, pick

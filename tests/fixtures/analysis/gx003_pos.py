@@ -1,0 +1,14 @@
+"""GX003 positive: global-RNG draws + unseeded default_rng."""
+import random
+
+import numpy as np
+
+
+def clone_population(pop):
+    idx = np.random.randint(0, len(pop))       # global numpy draw
+    noise = np.random.normal(size=3)           # global numpy draw
+    np.random.shuffle(pop)                     # global numpy draw
+    pick = random.choice(pop)                  # global stdlib draw
+    frac = random.random()                     # global stdlib draw
+    rng = np.random.default_rng()              # unseeded: escapes the protocol
+    return idx, noise, pick, frac, rng

@@ -1,0 +1,13 @@
+"""GX005 negative: sanctioned collective timeout + host-local retries."""
+from agilerl_tpu.parallel import multihost
+from agilerl_tpu.resilience.retry import call_with_retries
+from agilerl_tpu.resilience.membership import call_with_collective_timeout
+
+
+def sync_fitness(fitness, env):
+    # the sanctioned wrapper: bounded timeout -> MembershipChange, no retry
+    out = call_with_collective_timeout(
+        lambda: multihost.all_gather(fitness), timeout=30.0)
+    # host-local edges may retry freely
+    call_with_retries(env.reset, attempts=3)
+    return out

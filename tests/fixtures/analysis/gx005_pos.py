@@ -1,0 +1,12 @@
+"""GX005 positive: retry wrappers around multihost collectives."""
+from agilerl_tpu.parallel import multihost
+from agilerl_tpu.parallel.multihost import barrier
+from agilerl_tpu.resilience.retry import RetryPolicy, call_with_retries
+
+
+def sync_fitness(fitness):
+    # retrying a collective desyncs the pod: the other hosts entered once
+    call_with_retries(lambda: multihost.all_gather(fitness), attempts=3)
+    call_with_retries(barrier, "gen_end")              # imported-name form
+    policy = RetryPolicy(lambda: multihost.barrier("x"))
+    return policy

@@ -1,0 +1,19 @@
+"""GX004 negative: the atomic protocol, reads, and append-mode streams."""
+import json
+
+from agilerl_tpu.resilience.atomic import atomic_pickle, atomic_write_bytes
+
+
+def save_snapshot(state, path):
+    atomic_write_bytes(path, json.dumps(state).encode())
+    atomic_pickle(path + ".pkl", state)
+
+
+def read_snapshot(path):
+    with open(path) as fh:                   # read: fine
+        return json.load(fh)
+
+
+def append_event(path, event):
+    with open(path, "a") as fh:              # JSONL append stream: exempt
+        fh.write(json.dumps(event) + "\n")

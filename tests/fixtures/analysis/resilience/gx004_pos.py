@@ -1,0 +1,13 @@
+"""GX004 positive: bare durability writes in a durability module."""
+import json
+import os
+from pathlib import Path
+
+
+def save_snapshot(state, path):
+    with open(path, "w") as fh:              # bare truncating open
+        json.dump(state, fh)
+    Path(path).with_suffix(".manifest").write_text("{}")  # in-place write
+    os.replace(path + ".tmp", path)          # raw rename, no fsync protocol
+    with open(path, mode="wb") as fh:        # mode= kwarg spelling
+        fh.write(b"")

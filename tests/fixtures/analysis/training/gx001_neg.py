@@ -1,0 +1,18 @@
+"""GX001 negative: host-value conversions and out-of-loop syncs are fine."""
+import os
+import time
+
+import numpy as np
+
+
+def train(agent, env, steps):
+    for t in range(steps):
+        n = int(len(agent.buffer))            # len() is host-side
+        budget = float("inf")                 # literal
+        flush = int(os.getenv("FLUSH", "4"))  # env parse
+        started = float(time.time())          # host clock
+        _ = (n, budget, flush, started, t)
+    # out of the loop: one sync at eval cadence is the sanctioned pattern
+    final = float(agent.learn())
+    report = np.asarray(agent.returns)
+    return final, report

@@ -1,0 +1,16 @@
+"""GX001 positive: host syncs inside hot-path loop bodies."""
+import numpy as np
+
+
+def train(agent, env, steps):
+    losses = []
+    for _ in range(steps):
+        loss = agent.learn()
+        losses.append(float(loss))        # sync: float() on device value
+        arr = np.asarray(agent.q_values)  # sync: np.asarray on device array
+        flag = bool(loss > 0)             # sync: bool() on device comparison
+        scalar = loss.item()              # sync: .item()
+        rows = agent.q_values.tolist()    # sync: .tolist()
+        _ = (arr, flag, scalar, rows)
+    listcomp = [int(r) for r in agent.returns]  # sync inside comprehension
+    return losses, listcomp

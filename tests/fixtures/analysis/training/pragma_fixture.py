@@ -1,0 +1,20 @@
+"""Pragma fixture: every violation here is suppressed — a clean scan proves
+line pragmas, multi-line statement spans, disable=all, and file pragmas."""
+import random
+
+import numpy as np
+
+# graftcheck: disable-file=GX003
+
+
+def train(agent, steps):
+    for _ in range(steps):
+        loss = float(agent.learn())  # graftcheck: disable=GX001
+        arr = np.asarray(  # pragma may sit on any physical line of the stmt
+            agent.q_values
+        )  # graftcheck: disable=GX001
+        scalar = agent.q_values.item()  # graftcheck: disable=all
+        _ = (loss, arr, scalar)
+    pick = random.choice([1, 2, 3])  # file-level GX003 pragma covers this
+    seed = np.random.randint(0, 2 ** 31)  # ...and this
+    return pick, seed

@@ -1,0 +1,206 @@
+"""Pragma + baseline round-trip tests (ISSUE 11 satellite): line/file/all
+pragmas suppress, multi-line statements accept a pragma on any physical line,
+and the baseline ratchet accepts legacy findings while failing new ones —
+stable across line-number drift."""
+
+import json
+import pathlib
+
+import pytest
+
+from agilerl_tpu.analysis import (
+    analyze,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from agilerl_tpu.analysis.__main__ import main as cli_main
+from agilerl_tpu.analysis.pragmas import parse_pragmas
+
+pytestmark = pytest.mark.analysis
+
+FIXTURES = pathlib.Path(__file__).resolve().parents[1] / "fixtures" / "analysis"
+
+
+# -- pragmas ---------------------------------------------------------------- #
+
+def test_pragma_fixture_fully_suppressed():
+    """Every violation in the pragma fixture is silenced: line pragma,
+    multi-line-statement pragma, disable=all, and file-level pragma."""
+    report = analyze([FIXTURES])
+    assert not any("pragma_fixture" in f.path for f in report.findings)
+    assert report.suppressed >= 5
+
+
+def test_parse_pragmas_scopes_and_lists():
+    line, file_ = parse_pragmas(
+        "x = 1  # graftcheck: disable=GX001\n"
+        "y = 2  # graftcheck: disable=GX002, GX004\n"
+        "z = 3  # graftcheck: disable=all\n"
+        "w = 4  # graftcheck: disable=ALL\n"
+        "v = 5  # graftcheck: disable=gx001\n"
+        "# graftcheck: disable-file=GX003\n")
+    assert line[1] == {"GX001"}
+    assert line[2] == {"GX002", "GX004"}
+    assert "all" in line[3]
+    assert "all" in line[4]   # the sentinel is case-insensitive too
+    assert line[5] == {"GX001"}  # rule ids normalise to upper
+    assert file_ == {"GX003"}
+
+
+def test_body_pragma_does_not_suppress_compound_header(tmp_path):
+    """A pragma on a body line of a with/for block must NOT silence a
+    finding in the block's HEADER (review finding: span() previously covered
+    the whole compound statement)."""
+    dur = tmp_path / "resilience"
+    dur.mkdir()
+    (dur / "snap.py").write_text(
+        "import os\n"
+        "def save(state, path):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        fh.write(state)\n"
+        "        os.replace(path, path)  # graftcheck: disable=GX004\n")
+    report = analyze([tmp_path])
+    assert [f.text for f in report.findings] == ["with open(path, 'w') as fh:"]
+    assert report.suppressed == 1  # only the pragma'd body line
+
+
+def test_header_pragma_still_works_on_compound(tmp_path):
+    dur = tmp_path / "resilience"
+    dur.mkdir()
+    (dur / "snap.py").write_text(
+        "def save(state, path):\n"
+        "    with open(path, 'w') as fh:  # graftcheck: disable=GX004\n"
+        "        fh.write(state)\n")
+    report = analyze([tmp_path])
+    assert report.findings == [] and report.suppressed == 1
+
+
+def test_pragma_only_suppresses_named_rule(tmp_path):
+    """A GX001 pragma does NOT silence a GX003 finding on the same line."""
+    hot = tmp_path / "training"
+    hot.mkdir()
+    (hot / "mixed.py").write_text(
+        "import numpy as np\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        a = np.asarray(np.random.normal())"
+        "  # graftcheck: disable=GX001\n"
+        "    return a\n")
+    rules = {f.rule for f in analyze([tmp_path]).findings}
+    assert rules == {"GX003"}
+
+
+# -- baseline --------------------------------------------------------------- #
+
+def _scan(root):
+    return analyze([root]).findings
+
+
+def test_baseline_round_trip_accepts_legacy_fails_new(tmp_path):
+    hot = tmp_path / "pkg" / "training"
+    hot.mkdir(parents=True)
+    mod = hot / "loop.py"
+    mod.write_text("import numpy as np\n"
+                   "def f(xs):\n"
+                   "    for x in xs:\n"
+                   "        a = np.asarray(x)\n"
+                   "    return a\n")
+    baseline_file = tmp_path / "analysis_baseline.json"
+    findings = _scan(tmp_path / "pkg")
+    assert len(findings) == 1
+    write_baseline(baseline_file, findings)
+
+    # round-trip: the same scan is now fully baselined
+    baseline = load_baseline(baseline_file)
+    new, accepted, stale = split_baselined(_scan(tmp_path / "pkg"), baseline)
+    assert (len(new), len(accepted), stale) == (0, 1, [])
+
+    # unrelated drift above the finding keeps the baseline match
+    mod.write_text("# comment\n# comment\n" + mod.read_text())
+    new, accepted, _ = split_baselined(_scan(tmp_path / "pkg"), baseline)
+    assert (len(new), len(accepted)) == (0, 1)
+
+    # a NEW violation is not grandfathered
+    mod.write_text(mod.read_text().replace(
+        "    return a\n",
+        "        b = float(x)\n    return a, b\n"))
+    new, accepted, _ = split_baselined(_scan(tmp_path / "pkg"), baseline)
+    assert len(accepted) == 1
+    assert [f.text for f in new] == ["b = float(x)"]
+
+    # fixing the baselined line surfaces a STALE entry (ratchet tightens)
+    mod.write_text(mod.read_text().replace("        a = np.asarray(x)\n",
+                                           "        a = x\n"))
+    new, accepted, stale = split_baselined(_scan(tmp_path / "pkg"), baseline)
+    assert len(accepted) == 0
+    assert len(stale) == 1 and stale[0]["text"] == "a = np.asarray(x)"
+
+
+def test_baseline_version_guard(tmp_path):
+    bad = tmp_path / "analysis_baseline.json"
+    bad.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError, match="unsupported baseline version"):
+        load_baseline(bad)
+
+
+# -- CLI -------------------------------------------------------------------- #
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    hot = tmp_path / "pkg" / "training"
+    hot.mkdir(parents=True)
+    (hot / "loop.py").write_text("import numpy as np\n"
+                                 "def f(xs):\n"
+                                 "    for x in xs:\n"
+                                 "        a = np.asarray(x)\n"
+                                 "    return a\n")
+    pkg = str(tmp_path / "pkg")
+    baseline = str(tmp_path / "analysis_baseline.json")
+
+    # findings, no baseline -> exit 1, human output names rule + fix hint
+    assert cli_main([pkg, "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "GX001" in out and "[fix:" in out
+
+    # JSON format is machine-parseable and counts by rule
+    assert cli_main([pkg, "--no-baseline", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["by_rule"] == {"GX001": 1}
+    assert payload["findings"][0]["path"] == "training/loop.py"
+
+    # write-baseline accepts legacy -> exit 0 afterwards
+    assert cli_main([pkg, "--baseline", baseline, "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert cli_main([pkg, "--baseline", baseline]) == 0
+    assert "1 baselined" in capsys.readouterr().out
+
+    # rule filters: disabling the only firing rule -> clean
+    assert cli_main([pkg, "--no-baseline", "--disable", "GX001"]) == 0
+    capsys.readouterr()
+
+    # --write-baseline under a rule filter would erase the other rules'
+    # accepted entries: refused (review finding), baseline untouched
+    before = pathlib.Path(baseline).read_bytes()
+    assert cli_main([pkg, "--baseline", baseline, "--select", "GX002",
+                     "--write-baseline"]) == 2
+    assert pathlib.Path(baseline).read_bytes() == before
+    capsys.readouterr()
+
+    # usage errors -> exit 2
+    assert cli_main([pkg, "--select", "GX999"]) == 2
+    assert cli_main(["--list-rules"]) == 0
+    assert "GX001" in capsys.readouterr().out
+
+
+def test_cli_discovers_baseline_upward(tmp_path, capsys, monkeypatch):
+    """Default baseline discovery: nearest analysis_baseline.json walking up
+    from the scanned path — how CI runs from the repo root."""
+    hot = tmp_path / "pkg" / "training"
+    hot.mkdir(parents=True)
+    (hot / "loop.py").write_text("import numpy as np\n"
+                                 "def f(xs):\n"
+                                 "    return [np.asarray(x) for x in xs]\n")
+    findings = analyze([tmp_path / "pkg"]).findings
+    write_baseline(tmp_path / "analysis_baseline.json", findings)
+    assert cli_main([str(tmp_path / "pkg")]) == 0
+    assert "1 baselined" in capsys.readouterr().out

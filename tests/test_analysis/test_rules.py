@@ -1,0 +1,108 @@
+"""Fixture-based self-tests for every graftcheck rule: one positive and one
+negative snippet per rule id (ISSUE 11 satellite), plus precision checks on
+the sub-patterns each rule promises to catch."""
+
+import pathlib
+
+import pytest
+
+from agilerl_tpu.analysis import analyze
+
+pytestmark = pytest.mark.analysis
+
+FIXTURES = pathlib.Path(__file__).resolve().parents[1] / "fixtures" / "analysis"
+
+#: rule id -> (positive fixture, expected finding count, negative fixture)
+CASES = {
+    "GX001": ("training/gx001_pos.py", 6, "training/gx001_neg.py"),
+    "GX002": ("gx002_pos.py", 3, "gx002_neg.py"),
+    "GX003": ("gx003_pos.py", 6, "gx003_neg.py"),
+    "GX004": ("resilience/gx004_pos.py", 4, "resilience/gx004_neg.py"),
+    "GX005": ("gx005_pos.py", 3, "gx005_neg.py"),
+}
+
+
+def _findings(path, **kw):
+    """Scan from the fixture ROOT (so `training/`/`resilience/` segments
+    categorise, as they do for the real package) and filter to one file."""
+    report = analyze([FIXTURES], **kw)
+    return [f for f in report.findings if f.path == path]
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_positive_fixture_triggers_rule(rule):
+    pos, expected, _ = CASES[rule]
+    found = _findings(pos)
+    assert [f.rule for f in found] == [rule] * expected, (
+        f"{pos} expected {expected} x {rule}, got "
+        f"{[(f.rule, f.line, f.text) for f in found]}")
+    # every finding carries the contract fields: message, fix hint, source
+    # text, and a stable fingerprint
+    for f in found:
+        assert f.message and f.hint and f.text and f.fingerprint
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_negative_fixture_stays_clean(rule):
+    _, _, neg = CASES[rule]
+    found = _findings(neg)
+    assert found == [], (
+        f"{neg} must be clean, got "
+        f"{[(f.rule, f.line, f.text) for f in found]}")
+
+
+def test_gx001_only_fires_in_hot_modules(tmp_path):
+    """The same syncing loop outside a hot segment is NOT flagged — the rule
+    is about hot paths, not about float() in general."""
+    src = (FIXTURES / "training" / "gx001_pos.py").read_text()
+    cold = tmp_path / "cold_module.py"
+    cold.write_text(src)
+    assert analyze([cold]).findings == []
+
+
+def test_gx004_only_fires_in_durability_modules(tmp_path):
+    src = (FIXTURES / "resilience" / "gx004_pos.py").read_text()
+    cold = tmp_path / "anywhere.py"
+    cold.write_text(src)
+    assert analyze([cold]).findings == []
+
+
+def test_select_and_disable_filter_rules():
+    assert {f.rule for f in analyze([FIXTURES], select=["GX003"]).findings
+            } == {"GX003"}
+    assert not any(f.rule == "GX003"
+                   for f in analyze([FIXTURES], disable=["GX003"]).findings)
+    with pytest.raises(ValueError, match="unknown rule id"):
+        analyze([FIXTURES], select=["GX999"])
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    report = analyze([bad])
+    assert report.findings == []
+    assert len(report.errors) == 1 and "SyntaxError" in report.errors[0][1]
+
+
+def test_fingerprints_are_stable_and_occurrence_indexed(tmp_path):
+    """Two identical offending lines get DIFFERENT fingerprints (occurrence
+    index) and both survive re-analysis unchanged (stability) even when the
+    file shifts by unrelated lines."""
+    hot = tmp_path / "training"
+    hot.mkdir()
+    body = ("import numpy as np\n\n"
+            "def f(xs):\n"
+            "    for x in xs:\n"
+            "        a = np.asarray(x)\n"
+            "        b = np.asarray(x)\n"
+            "    return a, b\n")
+    mod = hot / "twice.py"
+    mod.write_text(body)
+    first = analyze([tmp_path]).findings
+    assert len(first) == 2
+    assert first[0].fingerprint != first[1].fingerprint
+    # shift the file down by a comment: same fingerprints
+    mod.write_text("# a new leading comment\n" + body)
+    second = analyze([tmp_path]).findings
+    assert [f.fingerprint for f in second] == [f.fingerprint for f in first]
+    assert [f.line for f in second] == [f.line + 1 for f in first]

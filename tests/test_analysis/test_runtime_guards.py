@@ -1,0 +1,186 @@
+"""CompileGuard / SyncGuard runtime tests: the dynamic half of graftcheck.
+
+CompileGuard is the ONE way steady-state no-recompile is asserted across the
+repo (the serving, dispatch-count and pod-generation regression tests all run
+through it — ISSUE 11 satellite); SyncGuard counts blocking device→host
+transfers and emits analysis/host_syncs_total."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from agilerl_tpu.analysis import (
+    CompileGuard,
+    CompileGuardError,
+    SyncGuard,
+    SyncGuardError,
+)
+from agilerl_tpu.observability import MetricsRegistry
+
+pytestmark = pytest.mark.analysis
+
+
+# -- CompileGuard: explicit jitted callables -------------------------------- #
+
+def test_compile_guard_passes_on_steady_state():
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones((4,)))  # warm
+    with CompileGuard(f) as guard:
+        for _ in range(3):
+            f(jnp.ones((4,)))
+    assert guard.new_compilations == 0
+
+
+def test_compile_guard_raises_on_recompile():
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones((4,)))
+    with pytest.raises(CompileGuardError, match="1 new compiled program"):
+        with CompileGuard(f, label="shape-churn"):
+            f(jnp.ones((5,)))  # new shape = new program
+
+
+def test_compile_guard_max_new_budget():
+    f = jax.jit(lambda x: x + 1)
+    with CompileGuard(f, max_new=1) as guard:
+        f(jnp.ones((2,)))  # first compile fits the budget
+    assert guard.new_compilations == 1
+
+
+def test_compile_guard_sizer_mode():
+    """sizer= adapts any live compiled-program count — the serving tier's
+    gen.compiled_programs plugs in directly."""
+    f = jax.jit(lambda x: x - 1)
+    f(jnp.ones((3,)))
+    sizer = lambda: f._cache_size()  # noqa: E731
+    with CompileGuard(sizer=sizer) as guard:
+        f(jnp.ones((3,)))
+    assert guard.new_compilations == 0
+    with pytest.raises(CompileGuardError):
+        with CompileGuard(sizer=sizer):
+            f(jnp.ones((6,)))
+
+
+def test_compile_guard_global_mode_counts_process_wide():
+    """No args: jax's backend-compile monitoring events are counted, so a
+    region that jits ANY new program trips the guard."""
+    with pytest.raises(CompileGuardError):
+        with CompileGuard():
+            jax.jit(lambda x: x * 3)(jnp.ones((7,)))
+    # steady state passes: everything below reuses live programs
+    g = jax.jit(lambda x: x * 5)
+    g(jnp.ones((2,)))
+    with CompileGuard() as guard:
+        g(jnp.ones((2,)))
+        g(jnp.ones((2,)))
+    assert guard.new_compilations == 0
+
+
+def test_compile_guard_does_not_mask_body_exception():
+    f = jax.jit(lambda x: x)
+    with pytest.raises(RuntimeError, match="body failed"):
+        with CompileGuard(f):
+            f(jnp.ones((9,)))  # would trip the guard...
+            raise RuntimeError("body failed")  # ...but the body error wins
+
+
+def test_compile_guard_fails_loudly_when_cache_shrinks():
+    """A cache reset inside the region (clear_caches / generator rebuild)
+    invalidates the accounting — the guard must raise, not silently pass
+    (review finding)."""
+    counts = iter([5, 2])
+    with pytest.raises(CompileGuardError, match="shrank 5→2"):
+        with CompileGuard(sizer=lambda: next(counts)):
+            pass
+
+
+def test_compile_guard_fails_loudly_on_exit_sentinel():
+    counts = iter([3, -1])
+    with pytest.raises(CompileGuardError, match="-1 sentinel at exit"):
+        with CompileGuard(sizer=lambda: next(counts)):
+            pass
+
+
+def test_compile_guard_rejects_both_modes():
+    f = jax.jit(lambda x: x)
+    with pytest.raises(ValueError, match="not both"):
+        CompileGuard(f, sizer=lambda: 0)
+
+
+def test_compile_guard_emits_registry_counter():
+    reg = MetricsRegistry()
+    f = jax.jit(lambda x: x / 2)
+    with pytest.raises(CompileGuardError):
+        with CompileGuard(f, registry=reg):
+            f(jnp.ones((11,)))
+    assert reg.counter("analysis/recompilations_total").value == 1
+
+
+# -- SyncGuard -------------------------------------------------------------- #
+
+def test_sync_guard_counts_each_conversion_kind():
+    x = jnp.asarray(1.5)
+    v = jnp.arange(3)
+    with SyncGuard() as sg:
+        float(x)
+        int(x)
+        bool(x > 0)
+        x.item()
+        v.tolist()
+    assert sg.syncs == 5
+    assert sg.by_kind == {"__float__": 1, "__int__": 1, "__bool__": 1,
+                          "item": 1, "tolist": 1}
+
+
+def test_sync_guard_zero_when_values_stay_on_device():
+    v = jnp.arange(8)
+    with SyncGuard(max_syncs=0) as sg:
+        w = v * 2 + 1
+        _ = jnp.sum(w)  # device-side reduction: no host sync
+    assert sg.syncs == 0
+
+
+def test_sync_guard_budget_raises_and_names_kinds():
+    x = jnp.asarray(2.0)
+    with pytest.raises(SyncGuardError, match="__float__"):
+        with SyncGuard(max_syncs=0, label="hot-loop"):
+            float(x)
+
+
+def test_sync_guard_emits_host_syncs_total():
+    reg = MetricsRegistry()
+    x = jnp.asarray(3.0)
+    with SyncGuard(registry=reg):
+        float(x)
+        int(x)
+    assert reg.counter("analysis/host_syncs_total").value == 2
+
+
+def test_sync_guard_restores_methods_and_counts_only_inside():
+    x = jnp.asarray(4.0)
+    impl_float_before = type(x).__float__
+    with SyncGuard() as sg:
+        float(x)
+    assert sg.syncs == 1
+    float(x)  # outside the region: not counted, methods restored
+    assert sg.syncs == 1
+    assert type(x).__float__ is impl_float_before
+
+
+def test_sync_guard_nests():
+    x = jnp.asarray(5.0)
+    with SyncGuard() as outer:
+        float(x)
+        with SyncGuard() as inner:
+            float(x)
+        float(x)
+    assert inner.syncs == 1
+    assert outer.syncs == 3
+
+
+def test_numpy_values_do_not_count():
+    with SyncGuard() as sg:
+        float(np.float32(1.0))
+        int(np.int64(3))
+        _ = np.arange(4).tolist()
+    assert sg.syncs == 0

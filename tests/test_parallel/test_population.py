@@ -59,6 +59,8 @@ def test_evolve_elitism_and_selection():
 
 
 def test_pod_generation_on_8_device_mesh():
+    from agilerl_tpu.analysis import CompileGuard
+
     devices = jax.devices()
     assert len(devices) == 8, "conftest must provide 8 CPU devices"
     mesh = Mesh(np.asarray(devices), axis_names=("pop",))
@@ -68,9 +70,16 @@ def test_pod_generation_on_8_device_mesh():
     pop, fitness = gen(pop, jax.random.PRNGKey(1))
     assert np.asarray(fitness).shape == (8,)
     assert np.isfinite(np.asarray(fitness)).all()
-    # second generation reuses compiled program
+    # the FIRST call compiled the host-input executable; the second compiles
+    # the mesh-placed-input one (inputs now live on pod devices) — same
+    # two-executable warmup the elastic bench documents. From the third call
+    # on, steady state is compile-free process-wide — asserted, not hoped
+    # (CompileGuard global mode, ISSUE 11).
     pop, fitness2 = gen(pop, jax.random.PRNGKey(2))
     assert np.isfinite(np.asarray(fitness2)).all()
+    with CompileGuard(label="pod generation steady state"):
+        pop, fitness3 = gen(pop, jax.random.PRNGKey(3))
+        assert np.isfinite(np.asarray(fitness3)).all()
 
 
 def test_evolution_deterministic_across_replicas():

@@ -101,6 +101,8 @@ def test_off_policy_hot_loop_dispatch_budget(dispatch_counter):
     """≤2 device dispatches per env step: action select (1/step) + flush and
     fused learn (amortised over learn_step). The legacy loop issued ≥4
     (add + sample + learn + priority round-trips)."""
+    from agilerl_tpu.analysis import CompileGuard
+
     env = HostVecEnv()
     pop = _population(env)
     for agent in pop:
@@ -119,6 +121,15 @@ def test_off_policy_hot_loop_dispatch_budget(dispatch_counter):
     )
     # sanity: the loop really ran (1 act dispatch per step at minimum)
     assert dispatch_counter["n"] >= iters
+    # steady state is also compile-free: a second pass over the SAME warmed
+    # population/buffer must reuse every live program (CompileGuard is the
+    # one no-recompile assertion repo-wide, ISSUE 11)
+    with CompileGuard(label="off-policy steady state"):
+        train_off_policy(
+            env, "host", "DQN", pop, memory,
+            max_steps=60, evo_steps=60, eval_steps=2, eval_loop=1,
+            verbose=False, seed=0, flush_every=4,
+        )
 
 
 def test_per_priority_write_back_needs_no_host_round_trip():
