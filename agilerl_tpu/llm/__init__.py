@@ -1,8 +1,15 @@
 from agilerl_tpu.llm import model
 from agilerl_tpu.llm.generate import generate, left_pad
-from agilerl_tpu.llm.serving import BucketedGenerator, ContinuousGenerator
+from agilerl_tpu.llm.serving import (
+    AdmissionPolicy,
+    BucketedGenerator,
+    ContinuousGenerator,
+)
+from agilerl_tpu.llm.fleet import KVTransferStore, PrefillWorker, ServingFleet
+from agilerl_tpu.llm.router import FleetRouter
 from agilerl_tpu.llm.model import GPTConfig, init_lora, init_params, merge_lora
 
 __all__ = ["model", "generate", "left_pad", "BucketedGenerator",
-           "ContinuousGenerator", "GPTConfig", "init_params", "init_lora",
-           "merge_lora"]
+           "ContinuousGenerator", "AdmissionPolicy", "ServingFleet",
+           "FleetRouter", "PrefillWorker", "KVTransferStore", "GPTConfig",
+           "init_params", "init_lora", "merge_lora"]

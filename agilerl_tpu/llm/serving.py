@@ -378,6 +378,103 @@ QUEUE_WAIT_BUCKETS = (0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
                       1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+def chain_hashes(toks_row: np.ndarray, mask_row: np.ndarray,
+                 block_size: int) -> List[bytes]:
+    """Block-hash chain over a LEFT-PADDED prompt layout — ONE home shared
+    by the generator's prefix cache and the fleet router's affinity map, so
+    the two tiers key the same prompt identically. The chain covers content
+    AND pad pattern, so a hit guarantees every real position's KV is
+    identical (causal attention: a block's KV depends only on content at
+    <= positions, i.e. on the chain prefix). Pad positions' stored KV never
+    matters — masked slots contribute exact zeros to every later softmax."""
+    hashes, h = [], b""
+    for i in range(toks_row.size // block_size):
+        m = hashlib.sha1()
+        m.update(h)
+        m.update(toks_row[i * block_size:(i + 1) * block_size].tobytes())
+        m.update(mask_row[i * block_size:(i + 1) * block_size].tobytes())
+        h = m.digest()
+        hashes.append(h)
+    return hashes
+
+
+class AdmissionPolicy:
+    """The admission decision as ONE reusable object, shared by
+    generator-level shedding (:meth:`ContinuousGenerator.submit`) and
+    router-level shedding (:class:`agilerl_tpu.llm.fleet.ServingFleet`).
+
+    Splitting *decide* (:meth:`reason` — pure, no counters) from *record*
+    (:meth:`shed` — increments ``serving/shed_requests_total`` exactly once)
+    is the point: a router that pre-checks every replica's policy and then
+    dispatches with ``no_shed=True`` can never double-count one request in
+    the shed counter, while a bare generator keeps the old submit()
+    behaviour through the same object."""
+
+    def __init__(
+        self,
+        max_queue: int = 256,
+        ttft_slo_s: Optional[float] = None,
+        min_slo_samples: int = 20,
+        free_block_watermark: float = 0.0,
+        metrics=None,
+    ):
+        self.max_queue = int(max_queue)
+        self.ttft_slo_s = ttft_slo_s
+        self.min_slo_samples = int(min_slo_samples)
+        self.free_block_watermark = float(free_block_watermark)
+        self._metrics = metrics
+
+    @property
+    def metrics(self):
+        return (self._metrics if self._metrics is not None
+                else observability.get_registry())
+
+    def bind_metrics(self, metrics) -> "AdmissionPolicy":
+        """Adopt an owner's registry when constructed without one — the
+        generator/fleet wiring, so shed counts land in the SAME registry
+        their ``latency_summary()`` reads. A policy built with an explicit
+        registry keeps it."""
+        if self._metrics is None:
+            self._metrics = metrics
+        return self
+
+    def reason(
+        self,
+        *,
+        queue_len: int,
+        recent_ttft: Sequence[float] = (),
+        available_blocks: Optional[int] = None,
+        n_blocks: Optional[int] = None,
+    ) -> Optional[str]:
+        """Why a request arriving NOW would be shed, or None to admit.
+        Pure read — no counter moves, so callers may probe candidates
+        freely (the router probes every replica per request)."""
+        if queue_len >= self.max_queue:
+            return "queue_full"
+        if self.free_block_watermark > 0 and available_blocks is not None:
+            watermark = int(self.free_block_watermark * int(n_blocks or 0))
+            if available_blocks < watermark:
+                return "free_block_watermark"
+        if self.ttft_slo_s is not None:
+            recent = list(recent_ttft)
+            if (len(recent) >= self.min_slo_samples
+                    and float(np.percentile(np.asarray(recent), 95))
+                    > self.ttft_slo_s):
+                return "ttft_slo"
+        return None
+
+    def shed(self, reason: str, *, source: str = "generator",
+             **fields: Any) -> None:
+        """Record ONE shed decision (counter + structured event). Exactly
+        one of generator or router calls this per dropped request — the
+        no-double-count contract."""
+        self.metrics.counter(
+            "serving/shed_requests_total",
+            help="requests dropped by admission control").inc()
+        self.metrics.emit("serving_shed", reason=reason, source=source,
+                          **fields)
+
+
 class BlockAllocator:
     """Host-side physical-block free list with a refcounted prefix cache.
 
@@ -501,6 +598,10 @@ class _Request:
     emits: List[np.ndarray] = dataclasses.field(default_factory=list)
     n_emitted: int = 0
     hashes: Optional[List[bytes]] = None  # chain hashes, computed once
+    #: externally prefilled prompt KV (disaggregated topology): dict with
+    #: k/v [L, Pb, KV, hd], tok0, done0, key_next — admission scatters it
+    #: into the pool instead of dispatching a local prefill
+    prefilled: Optional[Dict[str, Any]] = None
 
 
 class ContinuousGenerator:
@@ -558,6 +659,7 @@ class ContinuousGenerator:
         prefix_cache: bool = True,
         sharding_plan=None,
         mesh=None,
+        admission: Optional[AdmissionPolicy] = None,
     ):
         self.config = config
         self.metrics = metrics if metrics is not None else observability.get_registry()
@@ -596,10 +698,18 @@ class ContinuousGenerator:
         self.top_p = top_p
         self.min_new_tokens = min_new_tokens
         self.lora_scale = lora_scale
-        self.max_queue = int(max_queue)
-        self.ttft_slo_s = ttft_slo_s
-        self.min_slo_samples = int(min_slo_samples)
-        self.free_block_watermark = float(free_block_watermark)
+        # admission decisions live in ONE policy object (decide vs record
+        # split) so a fleet router can probe/shed without double-counting;
+        # the legacy kwargs construct a default policy when none is passed,
+        # and a registry-less custom policy adopts THIS registry so shed
+        # counts land where latency_summary() reads them
+        self.admission = (
+            admission.bind_metrics(self.metrics) if admission is not None
+            else AdmissionPolicy(
+                max_queue=max_queue, ttft_slo_s=ttft_slo_s,
+                min_slo_samples=min_slo_samples,
+                free_block_watermark=free_block_watermark,
+                metrics=self.metrics))
         self.prefix_cache = bool(prefix_cache)
 
         self._prefill = jax.jit(self._prefill_admit_impl,
@@ -609,6 +719,10 @@ class ContinuousGenerator:
                                static_argnames=("greedy",),
                                donate_argnums=(2,))
         self._copy_block = jax.jit(M.paged_copy_block, donate_argnums=(0,))
+        # decode-side import of a prefill worker's exported prompt KV
+        # (disaggregated topology): one program per prompt bucket
+        self._scatter_import = jax.jit(M.paged_scatter_prompt,
+                                       donate_argnums=(0,))
 
         # -- host scheduler state --
         # Threading contract: submit()/result() may be called from request
@@ -687,13 +801,16 @@ class ContinuousGenerator:
         must fit the bucket grid."""
         return n_rows > 0 and 0 < longest_prompt <= self.prompt_buckets[-1]
 
-    def submit(self, tokens, *, max_new: Optional[int] = None, key=None,
-               no_shed: bool = False) -> Optional[int]:
-        """Enqueue one request; returns a ticket, or None when admission
-        control sheds it (queue overflow / TTFT SLO breach / free-block
-        watermark). ``no_shed`` bypasses shedding — the training-rollout
-        mode, where dropping a rollout would corrupt the learn batch."""
-        tokens = np.asarray(tokens, np.int32).reshape(-1)
+    def _enqueue(self, tokens: np.ndarray, *, max_new: Optional[int],
+                 key, no_shed: bool, hashes: Optional[List[bytes]],
+                 arrival_s: Optional[float] = None,
+                 prefilled: Optional[Dict[str, Any]] = None,
+                 shed_source: str = "generator") -> Optional[int]:
+        """The shared admission preamble behind :meth:`submit` and
+        :meth:`submit_prefilled` — ONE home for bucket validation, the shed
+        probe/record, budget clamping, ticket allocation, key defaulting,
+        and the queue-depth telemetry, so the unified and disaggregated
+        entry points cannot drift."""
         if tokens.size == 0 or tokens.size > self.prompt_buckets[-1]:
             raise ValueError(
                 f"prompt of {tokens.size} tokens outside the bucket grid "
@@ -702,11 +819,8 @@ class ContinuousGenerator:
         if not no_shed:
             reason = self._shed_reason()
             if reason is not None:
-                self.metrics.counter(
-                    "serving/shed_requests_total",
-                    help="requests dropped by admission control").inc()
-                self.metrics.emit("serving_shed", reason=reason,
-                                  queue_len=len(self._queue))
+                self.admission.shed(reason, queue_len=len(self._queue),
+                                    source=shed_source)
                 return None
         if max_new is None:
             budget = self.max_new_tokens
@@ -723,28 +837,139 @@ class ContinuousGenerator:
             key = jax.random.PRNGKey(ticket)
         self._queue.append(_Request(
             ticket=ticket, tokens=tokens, key=np.asarray(key, np.uint32),
-            max_new=budget, arrival_s=time.perf_counter()))
+            max_new=budget,
+            arrival_s=(float(arrival_s) if arrival_s is not None
+                       else time.perf_counter()),
+            hashes=list(hashes) if hashes is not None else None,
+            prefilled=prefilled))
         self.metrics.histogram(
             "serving/queue_depth_rows", buckets=QUEUE_BUCKETS,
             help="rows in flight when a batch is admitted",
         ).observe(len(self._queue) + self._occupancy())
         return ticket
 
+    def submit(self, tokens, *, max_new: Optional[int] = None, key=None,
+               no_shed: bool = False,
+               hashes: Optional[List[bytes]] = None) -> Optional[int]:
+        """Enqueue one request; returns a ticket, or None when admission
+        control sheds it (queue overflow / TTFT SLO breach / free-block
+        watermark). ``no_shed`` bypasses shedding — the training-rollout
+        mode, where dropping a rollout would corrupt the learn batch.
+        ``hashes`` lets a router that already computed the prompt's block
+        chain (at THIS generator's bucket/block layout) skip the re-hash at
+        admission."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        return self._enqueue(tokens, max_new=max_new, key=key,
+                             no_shed=no_shed, hashes=hashes)
+
+    def submit_prefilled(
+        self,
+        tokens,
+        *,
+        k_prompt: np.ndarray,
+        v_prompt: np.ndarray,
+        tok0: int,
+        done0: bool,
+        key_next,
+        key=None,
+        max_new: Optional[int] = None,
+        arrival_s: Optional[float] = None,
+        no_shed: bool = False,
+        hashes: Optional[List[bytes]] = None,
+    ) -> Optional[int]:
+        """Enqueue a request whose prompt KV was already computed by a
+        prefill worker (the disaggregated topology's decode-side entry).
+
+        ``k_prompt``/``v_prompt`` are ``[L, Pb, KV, hd]`` at THIS
+        generator's prompt bucket — the worker must share the bucket grid
+        and decode sizing so the prefill cache extent matches (the
+        dense-parity contract). ``tok0``/``done0``/``key_next`` are the
+        prefill head's first sampled token, its EOS state, and the
+        continued RNG stream; admission seeds the slot with them exactly as
+        the local miss path would after its own prefill, so the decode
+        stream is token-for-token identical. ``key`` is the RAW request key,
+        kept so a prefix-cache HIT on an already-cached chain can resume the
+        same split stream without touching the import. ``arrival_s`` lets
+        the router carry the ORIGINAL arrival time across the transfer so
+        TTFT includes prefill + transfer latency. Decode-side admission
+        control (free-block watermark, queue, TTFT SLO) applies unless
+        ``no_shed``."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if key is None:
+            # the raw request key is load-bearing: a prefix-cache HIT on an
+            # already-cached chain bypasses the import and re-derives tok0
+            # from THIS key — a local-ticket default would silently diverge
+            # the sampled stream from the transferred prefill
+            raise ValueError(
+                "submit_prefilled needs the ORIGINAL request key (the one "
+                "the prefill worker sampled tok0/key_next from)")
+        # out-of-grid sizes fall through to _enqueue's friendlier error
+        if 0 < tokens.size <= self.prompt_buckets[-1]:
+            Pb = _round_up(tokens.size, self.prompt_buckets)
+            if k_prompt.shape[1] != Pb:
+                raise ValueError(
+                    f"imported prompt KV covers {k_prompt.shape[1]} "
+                    f"positions but this generator buckets the prompt to "
+                    f"{Pb}; prefill workers must share the decode "
+                    "replica's bucket grid")
+        return self._enqueue(
+            tokens, max_new=max_new, key=key, no_shed=no_shed,
+            hashes=hashes, arrival_s=arrival_s,
+            shed_source="decode_import",
+            prefilled=dict(
+                k=np.asarray(k_prompt), v=np.asarray(v_prompt),
+                tok0=int(tok0), done0=bool(done0),
+                key_next=np.asarray(key_next, np.uint32),
+            ))
+
     def _shed_reason(self) -> Optional[str]:
-        if len(self._queue) >= self.max_queue:
-            return "queue_full"
-        if self.free_block_watermark > 0:
-            watermark = int(self.free_block_watermark * self.n_blocks)
-            if self.allocator.available() < watermark:
-                return "free_block_watermark"
-        if self.ttft_slo_s is not None:
-            with self._submit_lock:  # scheduler thread appends concurrently
-                recent = list(self._recent_ttft)
-            if (len(recent) >= self.min_slo_samples
-                    and float(np.percentile(np.asarray(recent), 95))
-                    > self.ttft_slo_s):
-                return "ttft_slo"
-        return None
+        with self._submit_lock:  # scheduler thread appends concurrently
+            recent = list(self._recent_ttft)
+        return self.admission.reason(
+            queue_len=len(self._queue), recent_ttft=recent,
+            available_blocks=self.allocator.available(),
+            n_blocks=self.n_blocks)
+
+    def admission_reason(self) -> Optional[str]:
+        """Why a request arriving NOW would be shed, or None to admit —
+        the pure probe a fleet router uses to pick/skip this replica
+        without moving any shed counter."""
+        return self._shed_reason()
+
+    # legacy admission knobs delegate to the policy (runtime tuning like
+    # ``gen.ttft_slo_s = 0.5`` keeps taking effect on the next submit — a
+    # construction-time snapshot would silently freeze it)
+    @property
+    def max_queue(self) -> int:
+        return self.admission.max_queue
+
+    @max_queue.setter
+    def max_queue(self, v: int) -> None:
+        self.admission.max_queue = int(v)
+
+    @property
+    def ttft_slo_s(self) -> Optional[float]:
+        return self.admission.ttft_slo_s
+
+    @ttft_slo_s.setter
+    def ttft_slo_s(self, v: Optional[float]) -> None:
+        self.admission.ttft_slo_s = v
+
+    @property
+    def min_slo_samples(self) -> int:
+        return self.admission.min_slo_samples
+
+    @min_slo_samples.setter
+    def min_slo_samples(self, v: int) -> None:
+        self.admission.min_slo_samples = int(v)
+
+    @property
+    def free_block_watermark(self) -> float:
+        return self.admission.free_block_watermark
+
+    @free_block_watermark.setter
+    def free_block_watermark(self, v: float) -> None:
+        self.admission.free_block_watermark = float(v)
 
     def _observe_ttft(self, ttft_s: float) -> None:
         with self._submit_lock:
@@ -755,6 +980,11 @@ class ContinuousGenerator:
 
     def _occupancy(self) -> int:
         return sum(r is not None for r in self._slot_req)
+
+    def backlog(self) -> int:
+        """Queued + in-flight rows — the queue-depth load signal the fleet
+        router dispatches on."""
+        return len(self._queue) + self._occupancy()
 
     def place_params(self, params, lora=None):
         """Place weight trees by the construction-time plan's rules (no-op
@@ -773,22 +1003,9 @@ class ContinuousGenerator:
 
     def _chain_hashes(self, toks_row: np.ndarray,
                       mask_row: np.ndarray) -> List[bytes]:
-        """Block-hash chain over the LEFT-PADDED prompt layout. The chain
-        covers content AND pad pattern, so a hit guarantees every real
-        position's KV is identical (causal attention: a block's KV depends
-        only on content at <= positions, i.e. on the chain prefix). Pad
-        positions' stored KV never matters — masked slots contribute exact
-        zeros to every later softmax."""
-        hashes, h = [], b""
-        bs = self.block_size
-        for i in range(toks_row.size // bs):
-            m = hashlib.sha1()
-            m.update(h)
-            m.update(toks_row[i * bs:(i + 1) * bs].tobytes())
-            m.update(mask_row[i * bs:(i + 1) * bs].tobytes())
-            h = m.digest()
-            hashes.append(h)
-        return hashes
+        """Block-hash chain at this generator's block size (shared module
+        function — the fleet router keys its affinity map the same way)."""
+        return chain_hashes(toks_row, mask_row, self.block_size)
 
     def _admit(self, params, lora, greedy: bool) -> List[int]:
         """Fill free slots from the queue head; returns tickets completed AT
@@ -866,6 +1083,12 @@ class ContinuousGenerator:
                 self._mask[slot] = 0
                 self._mask[slot, :Pb] = mask_row
                 self._mask[slot, Pb - 1] = 0  # set by the first decode step
+            elif req.prefilled is not None:
+                # disaggregated import: the prompt KV arrived from a prefill
+                # worker — scatter it instead of dispatching a local prefill
+                # (helper method: keeps this loop body free of host syncs)
+                self._admit_import(slot, req, table, private, nb_p, n_dec,
+                                   Pb, plen, mask_row)
             else:
                 self.metrics.counter("serving/prefix_cache_misses_total").inc()
                 prompt_blocks, dec_blocks = private[:nb_p], private[nb_p:]
@@ -898,6 +1121,11 @@ class ContinuousGenerator:
             self._tables[slot] = table
             self._prev_ok[slot] = True
             self._slot_req[slot] = req
+            # the prompt KV (if any was imported) now lives in the pool —
+            # pinning the multi-MB host arrays for the decode lifetime
+            # would leak slots x transfer size per replica (hit path
+            # included: it carries the payload but never needed it)
+            req.prefilled = None
             self.metrics.counter("serving/requests_total").inc()
             self.metrics.counter("serving/rows_total").inc()
         # ONE sync pass over every prefill dispatched above
@@ -920,6 +1148,54 @@ class ContinuousGenerator:
         self.metrics.gauge("serving/free_blocks").set(
             self.allocator.available())
         return finished
+
+    def _admit_import(self, slot: int, req: _Request, table: np.ndarray,
+                      private: List[int], nb_p: int, n_dec: int, Pb: int,
+                      plen: int, mask_row: np.ndarray) -> None:
+        """Admit ONE externally prefilled request: scatter the imported
+        prompt KV into the assigned blocks and seed the slot exactly as the
+        miss path does after its local prefill returns (lengths=Pb,
+        step_idx=1, prev_tok=tok0, keys=key_next) — the decode stream
+        continues token-for-token as if the prefill had run here. Imported
+        prompt blocks enter the prefix cache like locally prefilled ones,
+        so repeats of the chain hit on this replica from now on (the
+        router's affinity contract)."""
+        pf = req.prefilled
+        prompt_blocks, dec_blocks = private[:nb_p], private[nb_p:]
+        self._pool = self._scatter_import(
+            self._pool, jnp.asarray(np.asarray(prompt_blocks, np.int32)),
+            jnp.asarray(pf["k"]), jnp.asarray(pf["v"]))
+        self.metrics.counter(
+            "serving/prefilled_imports_total",
+            help="admissions whose prompt KV was imported from a prefill "
+                 "worker").inc()
+        shared_blocks, dup_private = [], []
+        if self.prefix_cache:
+            for h, bid in zip(req.hashes[:nb_p], prompt_blocks):
+                (shared_blocks if self.allocator.register(h, bid)
+                 else dup_private).append(bid)
+        else:
+            dup_private = list(prompt_blocks)
+        table[:nb_p] = prompt_blocks
+        table[nb_p:nb_p + n_dec] = dec_blocks
+        self._slot_shared[slot] = shared_blocks
+        self._slot_private[slot] = list(dec_blocks) + dup_private
+        tok0 = int(pf["tok0"])
+        req.toks.append(np.asarray([tok0], np.int32))
+        req.emits.append(np.asarray([1], np.int32))
+        req.n_emitted = 1
+        # tok0 was produced by the prefill worker; it reaches the caller at
+        # import time — TTFT from the ORIGINAL arrival (spans the transfer)
+        req.ttft_observed = True
+        self._observe_ttft(time.perf_counter() - req.arrival_s)
+        self._lengths[slot] = Pb
+        self._pos[slot] = plen
+        self._step_idx[slot] = 1
+        self._prev_tok[slot] = tok0
+        self._done[slot] = bool(pf["done0"])
+        self._keys[slot] = np.asarray(pf["key_next"], np.uint32)
+        self._mask[slot] = 0
+        self._mask[slot, :Pb] = mask_row
 
     def _finish_slot(self, slot: int) -> int:
         """Assemble the result, release the slot's blocks to the free
@@ -1128,7 +1404,8 @@ class ContinuousGenerator:
     @property
     def compiled_programs(self) -> int:
         """Prefill (per prompt bucket) + decode chunk (ONE program) + block
-        copy — bounded by the grid, constant in request count/order (the
-        tier-1 regression test pins this; see measured_cache_size)."""
+        copy + import scatter (per prompt bucket, disaggregated only) —
+        bounded by the grid, constant in request count/order (the tier-1
+        regression test pins this; see measured_cache_size)."""
         return measured_cache_size(self._prefill, self._decode,
-                                   self._copy_block)
+                                   self._copy_block, self._scatter_import)

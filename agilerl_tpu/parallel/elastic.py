@@ -470,8 +470,10 @@ class ElasticPBTController:
                 break
             time.sleep(self.membership_poll_interval)
         leader = min(want) if want else None
+        # fresh meta dict: the NamedTuple default is one shared {} — a
+        # consumer annotating the event in place must not leak across events
         return MembershipEvent(want, tuple(sorted(lost)), tuple(sorted(joined)),
-                               leader)
+                               leader, {})
 
     def _dead_slots(self) -> List[int]:
         """Member slots that lived on now-dead devices under the layout the

@@ -33,8 +33,9 @@ from __future__ import annotations
 import json
 import os
 import time
+import types
 from pathlib import Path
-from typing import Dict, NamedTuple, Optional, Sequence, Tuple, Union
+from typing import Dict, Mapping, NamedTuple, Optional, Sequence, Tuple, Union
 
 
 def _registry():
@@ -68,12 +69,23 @@ class MembershipChange(RuntimeError):
 
 
 class MembershipEvent(NamedTuple):
-    """One observed change of the live host set."""
+    """One observed change of the live host set.
+
+    ``meta`` carries lease payload metadata (the small JSON dict passed to
+    :meth:`HeartbeatStore.beat` — e.g. ``{"role": "decode", "replica": 3}``
+    for a serving-fleet member) for every ALIVE and every LOST host — a
+    lost host's last (stale) lease is still readable, so observers can
+    tell a lost decode replica from a lost prefill worker. Hosts whose
+    lease is torn/unreadable map to ``{}``. The no-meta default is an
+    immutable empty mapping (a shared plain-dict default would let one
+    consumer's in-place annotation leak into every other default-
+    constructed event)."""
 
     alive: Tuple[int, ...]
     lost: Tuple[int, ...]
     joined: Tuple[int, ...]
     leader: Optional[int]
+    meta: Mapping[int, dict] = types.MappingProxyType({})
 
 
 class HeartbeatStore:
@@ -128,7 +140,11 @@ class HeartbeatStore:
 
     def beat(self, host_id: int, incarnation: int = 0, meta: Optional[dict] = None) -> None:
         """Renew ``host_id``'s lease (call once per generation/heartbeat
-        interval; must beat faster than ``lease_timeout`` to stay live)."""
+        interval; must beat faster than ``lease_timeout`` to stay live).
+        ``meta`` is a small JSON payload recorded in the lease — the serving
+        fleet writes ``{"role": "prefill"|"decode"|"unified", "replica": id}``
+        so :meth:`poll`/:meth:`roles` surface the topology, not just
+        liveness."""
         payload = {
             "host": int(host_id),
             "time": float(self.clock()),
@@ -174,6 +190,14 @@ class HeartbeatStore:
         a = self.alive() if alive is None else alive
         return min(a) if a else None
 
+    def roles(self, alive: Optional[Dict[int, dict]] = None) -> Dict[int, Optional[str]]:
+        """Role recorded in each live host's lease metadata (None when a
+        host beats without one) — the serving fleet's prefill/decode/unified
+        topology readout."""
+        a = self.alive() if alive is None else alive
+        return {int(h): (p.get("meta") or {}).get("role")
+                for h, p in a.items()}
+
     def expect(self, host_ids: Sequence[int]) -> None:
         """Baseline the observed set explicitly (e.g. right after the join
         barrier) so the first :meth:`poll` diffs against the real roster
@@ -191,10 +215,13 @@ class HeartbeatStore:
         otherwise records membership metrics, emits a ``membership`` event
         and returns the :class:`MembershipEvent`. A host whose lease carries
         a NEW incarnation — it died and rejoined inside one lease window —
-        is reported in both ``lost`` and ``joined``."""
-        view = {
-            h: int(p.get("incarnation", 0)) for h, p in self.alive().items()
-        }
+        is reported in both ``lost`` and ``joined``. Lease metadata (role,
+        replica id — whatever :meth:`beat` was given) rides on the event's
+        ``meta`` for alive AND lost hosts (a lost host's stale lease is
+        still readable) so fleet observers can tell a lost decode replica
+        from a lost prefill worker."""
+        live = self.alive()
+        view = {h: int(p.get("incarnation", 0)) for h, p in live.items()}
         if self._last_view is None:
             self._last_view = view
             return None
@@ -209,6 +236,15 @@ class HeartbeatStore:
         alive = tuple(sorted(view))
         self._last_view = view
         leader = min(alive) if alive else None
+        # lost hosts' STALE leases are still readable — their meta rides on
+        # the event too, so observers can classify WHAT was lost (a torn or
+        # tombstoned lease degrades to {})
+        stale = self.leases()
+        meta = {int(h): dict(live[h].get("meta") or {}) for h in alive}
+        meta.update({
+            int(h): dict(stale.get(int(h), {}).get("meta") or {})
+            for h in lost
+        })
         reg = self.registry
         reg.counter("resilience/membership_changes_total").inc()
         if lost:
@@ -221,8 +257,10 @@ class HeartbeatStore:
             lost=[int(h) for h in lost],
             joined=[int(h) for h in joined],
             leader=leader,
+            roles={int(h): m.get("role") for h, m in meta.items()
+                   if m.get("role") is not None},
         )
-        return MembershipEvent(alive, lost, joined, leader)
+        return MembershipEvent(alive, lost, joined, leader, meta)
 
     def wait_for(
         self,

@@ -90,6 +90,14 @@ for arg in "$@"; do
       MARKER=(-m "analysis")
       SHARDS+=("tests/test_analysis")
       ;;
+    fleet)
+      # fast path: the serving-fleet tier (router prefix affinity,
+      # fleet==single-generator token parity, replica-kill failover,
+      # disaggregated KV transfer incl. torn-skip, CompileGuard bound,
+      # lease-role membership)
+      MARKER=(-m "fleet")
+      SHARDS+=("tests/test_llm/test_fleet.py tests/test_resilience/test_membership.py")
+      ;;
     *) SHARDS+=("$arg") ;;
   esac
 done

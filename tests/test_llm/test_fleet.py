@@ -1,0 +1,532 @@
+"""Serving fleet (ISSUE 12 tentpole, ROADMAP item 1): a 2-replica
+``ServingFleet`` on a repeated-prompt trace completes every request
+token-for-token identical to a single ``ContinuousGenerator`` reference
+with affinity hits; replica-kill mid-trace (immediate and lease-expiry
+detection) still completes everything; prefill/decode disaggregation
+transfers hash-chained KV atomically with torn transfers skipped and
+recomputed; router-level shedding never double-counts; and the fleet's
+compiled-program set is bounded by (members x bucket grid)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.fleet import KVTransferStore, PrefillWorker, ServingFleet
+from agilerl_tpu.llm.router import FleetRouter
+from agilerl_tpu.llm.serving import AdmissionPolicy, ContinuousGenerator
+from agilerl_tpu.observability import MemorySink, MetricsRegistry
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+CFG = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+                  d_model=32, max_seq_len=256, dtype=jnp.float32)
+#: shared generator sizing — every fleet member and the single-generator
+#: reference must agree for the token-for-token A/B to be meaningful
+KW = dict(max_new_tokens=8, pad_id=0, eos_id=None, prompt_buckets=(32,),
+          slots=3, block_size=8, decode_chunk=4)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _trace(seed, n=8, repeat_every=3):
+    """Ragged prompts with periodic repeats (the prefix-affinity case)."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(3, 95, size=12).astype(np.int32)
+    seqs = []
+    for i in range(n):
+        if i % repeat_every == repeat_every - 1:
+            seqs.append(base)
+        else:
+            seqs.append(rng.integers(
+                3, 95, size=int(rng.integers(4, 28))).astype(np.int32))
+    return seqs
+
+
+def _reference(seqs, params, key=None):
+    """Single-generator reference stream (same per-row key fold as the
+    fleet's generate)."""
+    gen = ContinuousGenerator(CFG, metrics=MetricsRegistry(), **KW)
+    return gen.generate(seqs, key if key is not None else jax.random.PRNGKey(1),
+                        params, greedy=True)
+
+
+def _fleet(**over):
+    kw = dict(KW)
+    kw.update(over)
+    return ServingFleet(CFG, kw.pop("n_replicas", 2),
+                        metrics=kw.pop("metrics", MetricsRegistry()), **kw)
+
+
+# --------------------------------------------------------------------------- #
+# router unit behaviour
+# --------------------------------------------------------------------------- #
+
+
+def test_router_prefix_affinity_deterministic():
+    """Same hash chain -> same replica, repeatedly, even when the owner is
+    the MOST loaded candidate; after the owner dies the chain re-routes by
+    load and sticks to the survivor."""
+    r = FleetRouter(metrics=MetricsRegistry())
+    chain = [b"blk0", b"blk1", b"blk2"]
+    rid, hit = r.route(chain, {0: 5.0, 1: 0.0})
+    assert (rid, hit) == (1, False)  # cold: least-loaded
+    r.record(chain, rid)
+    for _ in range(5):
+        assert r.route(chain, {0: 0.0, 1: 99.0}) == (1, True)
+    assert r.owner_of(chain) == 1
+    assert r.forget_replica(1) == 1
+    rid2, hit2 = r.route(chain, {0: 3.0, 2: 3.0})
+    assert (rid2, hit2) == (0, False)  # tie -> lowest id, deterministic
+    r.record(chain, rid2)
+    assert r.route(chain, {0: 9.0, 2: 0.0}) == (0, True)
+
+
+def test_router_tail_hash_only_no_pad_prefix_herding():
+    """Two different prompts sharing only their all-pad leading block must
+    NOT develop affinity to one replica (the left-padded-layout trap: a
+    deepest-prefix walk would herd every short prompt onto the pad block's
+    owner)."""
+    r = FleetRouter(metrics=MetricsRegistry())
+    pad = b"all-pad-leading-block"
+    r.record([pad, b"prompt-A-tail"], 0)
+    rid, hit = r.route([pad, b"prompt-B-tail"], {0: 5.0, 1: 0.0})
+    assert (rid, hit) == (1, False)
+
+
+def test_router_lru_bound():
+    r = FleetRouter(metrics=MetricsRegistry(), max_entries=2)
+    for i in range(4):
+        r.record([b"h%d" % i], i)
+    assert r.entries == 2
+    assert r.owner_of([b"h0"]) is None  # evicted oldest
+    assert r.owner_of([b"h3"]) == 3
+
+
+# --------------------------------------------------------------------------- #
+# the acceptance A/B: fleet == single generator, token for token
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_ab_parity_with_single_generator(params):
+    """The tier-1 acceptance gate: a 2-replica fleet on a repeated-prompt
+    trace completes every request token-for-token identical to a single
+    ContinuousGenerator, with affinity hits > 0."""
+    seqs = _trace(0)
+    rcomp, rcmask, _ = _reference(seqs, params)
+    fleet = _fleet()
+    comp, cmask, info = fleet.generate(
+        seqs, jax.random.PRNGKey(1), params, greedy=True)
+    np.testing.assert_array_equal(comp, rcomp)
+    np.testing.assert_array_equal(cmask, rcmask)
+    assert info["affinity_hits"] > 0
+    assert fleet.metrics.counter("fleet/affinity_hits_total").value > 0
+    summary = fleet.latency_summary()
+    assert summary["fleet"]["replica_count"] == 2
+    assert summary["fleet"]["requests_total"] == len(seqs)
+    # per-replica rollup: both replicas served, each with its own SLO view
+    served = [s for s in summary["replicas"].values()
+              if s.get("requests_total", 0) > 0]
+    assert len(served) == 2
+
+
+def test_fleet_router_decisions_hit_the_jsonl_sink(params):
+    """Every dispatch emits a fleet_route event through the fleet registry's
+    sink — the router's decisions are observable, not folklore."""
+    sink = MemorySink()
+    fleet = _fleet(metrics=MetricsRegistry(sink=sink))
+    seqs = _trace(1, n=5)
+    fleet.generate(seqs, jax.random.PRNGKey(1), params, greedy=True)
+    routes = [e for e in sink.events if e["kind"] == "fleet_route"]
+    assert len(routes) == len(seqs)
+    assert all("replica" in e and "affinity" in e for e in routes)
+
+
+def test_fleet_affinity_routes_repeats_to_same_replica(params):
+    """Streamed repeats of one chain land on ONE replica (its allocator
+    owns the cached blocks — the whole point of affinity), while distinct
+    prompts spread by load."""
+    fleet = _fleet()
+    base = _trace(2)[2]
+    rids = []
+    for i in range(4):
+        t = fleet.submit(base, key=jax.random.fold_in(
+            jax.random.PRNGKey(1), i), no_shed=True)
+        rids.append(fleet._requests[t].rid)
+        fleet.run_until_drained(params, greedy=True)
+    assert len(set(rids)) == 1
+    # the owning replica saw prefix-cache hits for every repeat
+    owner = fleet._members[rids[0]].gen
+    assert owner.metrics.counter(
+        "serving/prefix_cache_hits_total").value == 3
+
+
+# --------------------------------------------------------------------------- #
+# failover
+# --------------------------------------------------------------------------- #
+
+
+def test_replica_kill_immediate_failover_completes_all(params):
+    """Kill a replica mid-trace (no heartbeat store: detection is
+    immediate) — every request still completes token-for-token identical to
+    the single-generator reference, and the rebalance is counted."""
+    seqs = _trace(3, n=10)
+    rcomp, rcmask, _ = _reference(seqs, params)
+    fleet = _fleet()
+    tickets = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i), no_shed=True)
+        for i, s in enumerate(seqs)]
+    fleet.step(params, greedy=True)  # both replicas mid-flight
+    victim = fleet.replica_ids[0]
+    fleet.kill_replica(victim)
+    assert victim not in fleet.replica_ids
+    fleet.run_until_drained(params, greedy=True)
+    for i, t in enumerate(tickets):
+        toks, emits = fleet.result(t)
+        np.testing.assert_array_equal(toks, rcomp[i])
+        np.testing.assert_array_equal(emits, rcmask[i])
+    assert fleet.metrics.counter("fleet/rebalanced_requests_total").value > 0
+    assert fleet.latency_summary()["fleet"]["replica_count"] == 1
+
+
+def test_replica_loss_detected_by_lease_expiry(params, tmp_path):
+    """The elastic path: membership via heartbeat leases (fake clock). A
+    killed replica stays in the fleet's belief until its lease expires;
+    the bounded-timeout detection then fails it over, and every request
+    completes identical to the reference."""
+    seqs = _trace(4, n=10)
+    rcomp, rcmask, _ = _reference(seqs, params)
+    clock = FakeClock()
+    fleet = _fleet(membership_dir=tmp_path / "hb", lease_timeout=5.0,
+                   clock=clock)
+    # roles are visible in the lease metadata from the very first beat
+    assert fleet.heartbeats.roles() == {0: "unified", 1: "unified"}
+    tickets = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i), no_shed=True)
+        for i, s in enumerate(seqs)]
+    fleet.step(params, greedy=True)
+    victim = fleet.replica_ids[0]
+    fleet.kill_replica(victim)
+    # lease still fresh: the loss is NOT yet detected (bounded, not magic)
+    fleet.step(params, greedy=True)
+    assert victim in fleet.replica_ids
+    clock.advance(6.0)  # past lease_timeout: next poll surfaces the loss
+    fleet.step(params, greedy=True)
+    assert victim not in fleet.replica_ids
+    fleet.run_until_drained(params, greedy=True)
+    for i, t in enumerate(tickets):
+        toks, emits = fleet.result(t)
+        np.testing.assert_array_equal(toks, rcomp[i])
+        np.testing.assert_array_equal(emits, rcmask[i])
+    assert fleet.metrics.counter("fleet/rebalanced_requests_total").value > 0
+
+
+def test_survivorless_loss_parks_until_scale_up(params):
+    """Losing the LAST replica parks its requests instead of dropping
+    them; scale_up() spawns a fresh replica and the parked work completes
+    token-for-token."""
+    seqs = _trace(5, n=4)
+    rcomp, rcmask, _ = _reference(seqs, params)
+    fleet = _fleet(n_replicas=1)
+    tickets = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i), no_shed=True)
+        for i, s in enumerate(seqs)]
+    fleet.kill_replica(fleet.replica_ids[0])
+    assert fleet.replica_ids == []
+    new_rid = fleet.scale_up()
+    assert fleet.replica_ids == [new_rid]
+    fleet.run_until_drained(params, greedy=True)
+    for i, t in enumerate(tickets):
+        toks, emits = fleet.result(t)
+        np.testing.assert_array_equal(toks, rcomp[i])
+        np.testing.assert_array_equal(emits, rcmask[i])
+
+
+# --------------------------------------------------------------------------- #
+# prefill/decode disaggregation
+# --------------------------------------------------------------------------- #
+
+
+def test_disaggregated_parity_and_transfers(params, tmp_path):
+    """Disaggregated topology: cold prompts prefill on a dedicated worker
+    and reach decode replicas through atomic KV transfers — outputs stay
+    token-for-token identical to the single-generator reference."""
+    seqs = _trace(6)
+    rcomp, rcmask, _ = _reference(seqs, params)
+    fleet = _fleet(topology="disaggregated", n_prefill=1,
+                   transfer_dir=tmp_path / "xfer")
+    comp, cmask, info = fleet.generate(
+        seqs, jax.random.PRNGKey(1), params, greedy=True)
+    np.testing.assert_array_equal(comp, rcomp)
+    np.testing.assert_array_equal(cmask, rcmask)
+    reg = fleet.metrics
+    assert reg.counter("fleet/kv_transfers_total").value > 0
+    assert reg.counter("fleet/kv_imports_total").value > 0
+    assert reg.counter("fleet/torn_kv_transfers_total").value == 0
+    # decode replicas really imported (prefilled admissions, not local
+    # prefills) for the cold chains
+    imports = sum(
+        m.gen.metrics.counter("serving/prefilled_imports_total").value
+        for m in fleet._serving_members().values())
+    assert imports > 0
+    assert fleet.heartbeats is None  # membership optional, orthogonal
+
+
+def test_disaggregated_warm_repeat_skips_prefill_worker(params, tmp_path):
+    """A repeat of an imported chain routes DIRECTLY to the owning decode
+    replica (affinity): no new transfer, and the replica's own prefix cache
+    serves it without prefill."""
+    fleet = _fleet(topology="disaggregated", n_prefill=1,
+                   transfer_dir=tmp_path / "xfer")
+    base = _trace(7)[2]
+    t0 = fleet.submit(base, key=jax.random.fold_in(jax.random.PRNGKey(1), 0),
+                      no_shed=True)
+    fleet.run_until_drained(params, greedy=True)
+    transfers_before = fleet.metrics.counter("fleet/kv_transfers_total").value
+    t1 = fleet.submit(base, key=jax.random.fold_in(jax.random.PRNGKey(1), 0),
+                      no_shed=True)
+    fleet.run_until_drained(params, greedy=True)
+    assert fleet.metrics.counter(
+        "fleet/kv_transfers_total").value == transfers_before
+    assert fleet.metrics.counter("fleet/affinity_hits_total").value == 1
+    rid = fleet._requests[t1].rid  # before result(): collection pops the record
+    # identical keys -> identical outputs, via two different paths
+    a, b = fleet.result(t0), fleet.result(t1)
+    np.testing.assert_array_equal(a[0], b[0])
+    assert fleet._members[rid].gen.metrics.counter(
+        "serving/prefix_cache_hits_total").value == 1
+    assert t0 not in fleet._requests and t1 not in fleet._requests
+
+
+def test_torn_kv_transfer_skipped_and_warned(params, tmp_path):
+    """Corrupt a committed transfer: the import is skipped (counted +
+    warned), NEVER loaded, and the request recomputes from its tokens on a
+    decode replica — delayed, but token-for-token correct."""
+    seqs = _trace(8, n=3, repeat_every=99)  # all cold: all transfer
+    rcomp, rcmask, _ = _reference(seqs, params)
+    fleet = _fleet(topology="disaggregated", n_prefill=1,
+                   transfer_dir=tmp_path / "xfer")
+    tickets = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i), no_shed=True)
+        for i, s in enumerate(seqs)]
+    # drive JUST the prefill+export stage, then corrupt the first transfer
+    # in the gap before import (the window a crash/bit-rot would hit)
+    fleet._step_prefill(params, None, True)
+    victim = fleet._transfers[0].transfer
+    payload = victim / "payload.pkl"
+    payload.write_bytes(payload.read_bytes()[:-7] + b"garbage")
+    fleet.run_until_drained(params, greedy=True)
+    assert fleet.metrics.counter("fleet/torn_kv_transfers_total").value == 1
+    for i, t in enumerate(tickets):
+        toks, emits = fleet.result(t)
+        np.testing.assert_array_equal(toks, rcomp[i])
+        np.testing.assert_array_equal(emits, rcmask[i])
+
+
+def test_transfer_store_round_trip_and_manifest(tmp_path):
+    store = KVTransferStore(tmp_path, metrics=MetricsRegistry())
+    payload = {"k": np.ones((2, 8)), "hashes": [b"\x01\x02"]}
+    path = store.export("transfer_000001", payload)
+    assert path.name == "transfer_000001"
+    assert (path / "manifest.json").exists()
+    loaded = store.load(path)
+    np.testing.assert_array_equal(loaded["k"], payload["k"])
+    assert loaded["hashes"] == [b"\x01\x02"]
+    store.consume(path)
+    assert not path.exists()
+    # a manifest-less directory (torn before commit would never be visible,
+    # but bit-rot can eat the manifest) is skipped, not crashed on
+    bad = tmp_path / "transfer_000002"
+    bad.mkdir()
+    assert store.load(bad) is None
+    assert store.metrics.counter("fleet/torn_kv_transfers_total").value == 1
+
+
+def test_prefill_worker_rejects_mismatched_bucket(params):
+    """submit_prefilled refuses KV whose extent does not match the decode
+    replica's bucket — a silently mis-bucketed import would decode against
+    the wrong cache layout."""
+    gen = ContinuousGenerator(CFG, metrics=MetricsRegistry(), **KW)
+    worker = PrefillWorker.matching(gen, metrics=MetricsRegistry())
+    tokens = np.arange(3, 9, dtype=np.int32)
+    req_key = jax.random.PRNGKey(0)
+    payload = worker.prefill(tokens, req_key, params, greedy=True)
+    assert payload["k"].shape[1] == 32  # the shared bucket
+    with pytest.raises(ValueError, match="bucket"):
+        gen.submit_prefilled(
+            tokens, k_prompt=payload["k"][:, :16], v_prompt=payload["v"][:, :16],
+            tok0=payload["tok0"], done0=payload["done0"],
+            key_next=payload["key_next"], key=req_key)
+    # the raw request key is load-bearing (hit-path stream resume): its
+    # absence is an error, not a silent local-ticket default
+    with pytest.raises(ValueError, match="ORIGINAL request key"):
+        gen.submit_prefilled(
+            tokens, k_prompt=payload["k"], v_prompt=payload["v"],
+            tok0=payload["tok0"], done0=payload["done0"],
+            key_next=payload["key_next"])
+
+
+def test_prefill_worker_loss_degrades_to_local_prefill(params, tmp_path):
+    """Killing every prefill worker must not stall the fleet: pending cold
+    prompts fall back to decode replicas' local prefill."""
+    seqs = _trace(9, n=4, repeat_every=99)
+    rcomp, _, _ = _reference(seqs, params)
+    fleet = _fleet(topology="disaggregated", n_prefill=1,
+                   transfer_dir=tmp_path / "xfer")
+    tickets = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i), no_shed=True)
+        for i, s in enumerate(seqs)]
+    worker_rid = [rid for rid, m in fleet._members.items()
+                  if m.role == "prefill"][0]
+    fleet.kill_replica(worker_rid)
+    fleet.run_until_drained(params, greedy=True)
+    assert fleet.metrics.counter("fleet/kv_transfers_total").value == 0
+    for i, t in enumerate(tickets):
+        toks, _ = fleet.result(t)
+        np.testing.assert_array_equal(toks, rcomp[i])
+
+
+# --------------------------------------------------------------------------- #
+# admission: the no-double-count contract
+# --------------------------------------------------------------------------- #
+
+
+def test_admission_policy_reason_is_pure_and_shed_counts_once():
+    reg = MetricsRegistry(sink=MemorySink())
+    pol = AdmissionPolicy(max_queue=2, free_block_watermark=0.5,
+                          metrics=reg)
+    for _ in range(5):  # probing moves no counters
+        assert pol.reason(queue_len=2) == "queue_full"
+        assert pol.reason(queue_len=0, available_blocks=3,
+                          n_blocks=10) == "free_block_watermark"
+        assert pol.reason(queue_len=0) is None
+    assert reg.counter("serving/shed_requests_total").value == 0
+    pol.shed("queue_full", source="router")
+    assert reg.counter("serving/shed_requests_total").value == 1
+    sheds = [e for e in reg.sink.events if e["kind"] == "serving_shed"]
+    assert len(sheds) == 1
+    assert sheds[0]["reason"] == "queue_full"
+    assert sheds[0]["source"] == "router"
+
+
+def test_router_shed_counts_each_drop_exactly_once(params):
+    """Flood a tiny fleet past every replica's queue bound: each dropped
+    request increments shed_requests_total exactly once (at the router),
+    and the replica-level counters stay at zero — the double-count the
+    AdmissionPolicy extraction exists to prevent."""
+    fleet = _fleet(slots=1, max_queue=1)
+    seqs = _trace(10, n=10, repeat_every=99)
+    outcomes = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i)) for i, s in enumerate(seqs)]
+    dropped = sum(t is None for t in outcomes)
+    assert dropped > 0  # 2 replicas x max_queue=1 admit at most 2 unstepped
+    summary = fleet.latency_summary()["fleet"]
+    assert summary["shed_requests_total"] == dropped
+    # router sheds, not the replicas: dispatch is no_shed by construction
+    for m in fleet._serving_members().values():
+        assert m.gen.metrics.counter(
+            "serving/shed_requests_total").value == 0
+    # admitted requests still complete
+    fleet.run_until_drained(params, greedy=True)
+    for t in outcomes:
+        if t is not None:
+            fleet.result(t)
+
+
+def test_generator_level_shedding_unchanged_without_router(params):
+    """A bare ContinuousGenerator keeps the old submit() shedding through
+    the same policy object (the extraction is a refactor, not a behaviour
+    change)."""
+    gen = ContinuousGenerator(CFG, metrics=MetricsRegistry(), max_queue=1,
+                              **KW)
+    assert gen.submit(np.arange(3, 9, dtype=np.int32)) is not None
+    assert gen.submit(np.arange(3, 9, dtype=np.int32)) is None  # queue full
+    assert gen.metrics.counter("serving/shed_requests_total").value == 1
+    assert gen.admission_reason() == "queue_full"  # pure probe
+    assert gen.metrics.counter("serving/shed_requests_total").value == 1
+
+
+def test_custom_admission_policy_adopts_owner_registry(params):
+    """A registry-less custom AdmissionPolicy adopts its owner's registry,
+    so shed counts land where latency_summary() reads them; an explicit
+    registry is kept."""
+    gen = ContinuousGenerator(CFG, metrics=MetricsRegistry(),
+                              admission=AdmissionPolicy(max_queue=1), **KW)
+    assert gen.admission.metrics is gen.metrics
+    gen.submit(np.arange(3, 9, dtype=np.int32))
+    assert gen.submit(np.arange(3, 9, dtype=np.int32)) is None
+    assert gen.latency_summary()["shed_requests_total"] == 1
+    own = MetricsRegistry()
+    gen2 = ContinuousGenerator(
+        CFG, metrics=MetricsRegistry(),
+        admission=AdmissionPolicy(max_queue=1, metrics=own), **KW)
+    assert gen2.admission.metrics is own
+
+
+def test_scale_down_guard_and_graceful_telemetry(params, tmp_path):
+    """scale_down refuses to retire the last FUNCTIONING replica (a
+    killed-but-undetected one is not a survivor), and a graceful
+    retirement does not pollute the unplanned-loss counter."""
+    clock = FakeClock()
+    fleet = _fleet(membership_dir=tmp_path / "hb", lease_timeout=5.0,
+                   clock=clock)
+    t = fleet.submit(_trace(14)[0], no_shed=True)
+    fleet.kill_replica(fleet.replica_ids[0])  # undetected: lease fresh
+    with pytest.raises(ValueError, match="last serving replica"):
+        fleet.scale_down(fleet.replica_ids[1])
+    fleet.scale_up()
+    fleet.scale_down(fleet.replica_ids[-2])  # planned: survivors exist
+    assert fleet.metrics.counter("fleet/replicas_lost_total").value == 0
+    clock.advance(6.0)
+    fleet.step(params, greedy=True)  # the kill IS an unplanned loss
+    assert fleet.metrics.counter("fleet/replicas_lost_total").value == 1
+    fleet.run_until_drained(params, greedy=True)
+    fleet.result(t)
+
+
+# --------------------------------------------------------------------------- #
+# compile discipline
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_compiled_programs_bounded_by_replicas_x_grid(params):
+    """CompileGuard regression: the fleet's program set is bounded by
+    (members x bucket grid) — constant in request count and routing order."""
+    from agilerl_tpu.analysis import CompileGuard
+
+    rng = np.random.default_rng(11)
+    fleet = _fleet()
+    warm = _trace(12, n=8)
+    fleet.generate(warm, jax.random.PRNGKey(0), params, greedy=True)
+    # grid bound: per replica <= prefill(1 bucket) + decode + copy + import
+    assert 0 < fleet.compiled_programs <= 2 * 4
+    # the prefix-hit block copy may appear once per replica; nothing else
+    with CompileGuard(sizer=lambda: fleet.compiled_programs, max_new=2,
+                      label="fleet waves"):
+        for wave in range(3):
+            order = rng.permutation(len(warm))
+            seqs = [warm[i] for i in order] + _trace(13 + wave, n=4)
+            fleet.generate(seqs, jax.random.PRNGKey(wave + 1), params,
+                           greedy=True)
+    # steady state: a repeat trace in a fresh shuffle compiles NOTHING new
+    with CompileGuard(sizer=lambda: fleet.compiled_programs, max_new=0,
+                      label="fleet steady state"):
+        order = rng.permutation(len(warm))
+        fleet.generate([warm[i] for i in order], jax.random.PRNGKey(9),
+                       params, greedy=True)

@@ -47,6 +47,11 @@ def registry():
     return MetricsRegistry(sink=ListSink())
 
 
+def membership_roles_of(registry):
+    return [f["roles"] for k, f in registry.sink.events
+            if k == "membership" and "roles" in f]
+
+
 # --------------------------------------------------------------------------- #
 # HeartbeatStore
 # --------------------------------------------------------------------------- #
@@ -114,6 +119,49 @@ class TestHeartbeatStore:
         assert registry.counter("resilience/hosts_joined_total").value == 1
         kinds = [k for k, _ in registry.sink.events]
         assert "membership" in kinds
+
+    @pytest.mark.fleet  # the serving fleet consumes lease roles
+    def test_lease_meta_roles_surface_in_poll(self, tmp_path, registry):
+        """Lease metadata (the serving fleet's role/replica payload) rides
+        on poll()'s MembershipEvent and the emitted membership event, so an
+        observer can tell a lost decode replica from a lost prefill
+        worker — and roles() reads it without an event."""
+        clock = FakeClock()
+        store = HeartbeatStore(tmp_path, lease_timeout=5.0, registry=registry,
+                               clock=clock)
+        store.beat(0, meta={"role": "prefill", "replica": 0})
+        store.beat(1, meta={"role": "decode", "replica": 1})
+        store.beat(2)  # no metadata: still a first-class member
+        store.expect([0, 1])  # baseline without 2 so poll reports a change
+        event = store.poll()
+        assert event.joined == (2,)
+        assert event.meta[0] == {"role": "prefill", "replica": 0}
+        assert event.meta[1] == {"role": "decode", "replica": 1}
+        assert event.meta[2] == {}
+        assert store.roles() == {0: "prefill", 1: "decode", 2: None}
+        membership = [f for k, f in registry.sink.events
+                      if k == "membership"]
+        assert membership[-1]["roles"] == {0: "prefill", 1: "decode"}
+        # a LOST host's role still rides on the event (its stale lease is
+        # readable) — observers can tell WHAT was lost, not just who
+        clock.advance(6.0)
+        store.beat(0, meta={"role": "prefill", "replica": 0})
+        store.beat(2)
+        event = store.poll()
+        assert event.lost == (1,)
+        assert event.meta[1] == {"role": "decode", "replica": 1}
+        assert membership_roles_of(registry)[-1] == {0: "prefill",
+                                                     1: "decode"}
+
+    def test_default_meta_is_immutable(self):
+        """The no-meta default is a shared read-only mapping: an annotating
+        consumer gets a TypeError instead of silently corrupting every
+        other default-constructed event."""
+        from agilerl_tpu.resilience.membership import MembershipEvent
+
+        ev = MembershipEvent((0,), (), (), 0)
+        with pytest.raises(TypeError):
+            ev.meta[0] = {"role": "decode"}
 
     def test_rejoin_within_lease_window_detected_by_incarnation(
             self, tmp_path, registry):
