@@ -51,13 +51,27 @@ def default_hp_config() -> HyperparameterConfig:
 
 def _grpo_loss_core(lp, batch, clip, beta):
     """Clipped-ratio + k3-KL GRPO loss from per-token logprobs
-    (parity: grpo.py:517 _grpo_loss_standard). Returns (loss, mean k3 KL)."""
+    (parity: grpo.py:517 _grpo_loss_standard). Returns (loss, mean k3 KL).
+
+    When the batch carries ``rho`` — the truncated per-token importance
+    weight ``min(exp(old_lp - behavior_lp), rho_clip)`` the online flywheel
+    computes between the learn-start policy (the ratio's anchor) and the
+    BEHAVIOR epoch's logprobs (IMPALA lineage: Espeholt et al., V-trace's
+    clipped behind-ness ratio) — it multiplies the policy-gradient term, so
+    the combined ``ratio * rho`` applies the full truncated pi/mu
+    correction exactly once and bounded-staleness off-policy data tilts
+    the update instead of biasing it. ``rho`` is computed outside the grad
+    (a constant under differentiation, like ``old_lp``); a batch without
+    the key compiles the exact on-policy program as before."""
     lp = lp * batch["loss_mask"]
     ratio = jnp.exp(lp - batch["old_lp"])
     adv = batch["advantage"][:, None]
     s1 = ratio * adv
     s2 = jnp.clip(ratio, 1 - clip, 1 + clip) * adv
     pg = -jnp.minimum(s1, s2)
+    rho = batch.get("rho")
+    if rho is not None:
+        pg = pg * rho
     # k3 KL estimator vs the reference adapter (parity: grpo.py:517)
     log_ratio_ref = batch["ref_lp"] - lp
     kl = jnp.exp(log_ratio_ref) - log_ratio_ref - 1.0
@@ -197,6 +211,11 @@ class GRPO(EvolvableAlgorithm):
         self._bucketed_gen_knobs = None
         self._continuous_gen = None
         self._continuous_gen_knobs = None
+        # continuous rollouts route through this ServingFleet (router +
+        # replicas) instead of a private bare generator when attached
+        # (attach_rollout_fleet) — the flywheel rollout tier. Not part of
+        # init_dict: clones/evolved children must be re-attached explicitly.
+        self.rollout_fleet = None
         self.last_generation_info = None
 
         if base_params is None:
@@ -299,6 +318,41 @@ class GRPO(EvolvableAlgorithm):
             self._continuous_gen_knobs = knobs
         return self._continuous_gen
 
+    def attach_rollout_fleet(self, fleet) -> None:
+        """Route continuous rollouts through a
+        :class:`~agilerl_tpu.llm.fleet.ServingFleet` — prefix-affinity
+        routing over N replicas instead of a private bare generator, the
+        flywheel rollout tier's horizontal-scale path. The fleet's sampling
+        recipe must match this agent's (same generate() key-fold contract,
+        so a fleet and a bare generator given the same key produce
+        identical streams); a mismatch would silently change the rollout
+        distribution, so it is rejected here. Sets ``continuous_decode``.
+        Pass None to detach (restores the pre-attach ``continuous_decode``
+        setting — detaching must not leave the agent on a private bare
+        generator it never used before)."""
+        if fleet is None:
+            if self.rollout_fleet is not None:
+                self.continuous_decode = self._pre_fleet_continuous_decode
+            self.rollout_fleet = None
+            return
+        ref = fleet._grid_ref()
+        theirs = dict(
+            max_new_tokens=ref.max_new_tokens, pad_id=ref.pad_id,
+            eos_id=ref.eos_id, temperature=ref.temperature,
+            top_k=ref.top_k, top_p=ref.top_p,
+            min_new_tokens=ref.min_new_tokens, lora_scale=ref.lora_scale,
+        )
+        mine = self._serving_knobs()
+        if theirs != mine:
+            raise ValueError(
+                f"fleet sampling recipe {theirs} does not match this "
+                f"agent's serving knobs {mine}; build the fleet from the "
+                "same recipe (ContinuousGenerator kwargs) as the agent")
+        if self.rollout_fleet is None:
+            self._pre_fleet_continuous_decode = self.continuous_decode
+        self.rollout_fleet = fleet
+        self.continuous_decode = True
+
     def get_action(self, prompts: Dict[str, np.ndarray], training: bool = True):
         """Generate group_size completions per prompt
         (parity: grpo.py:259; the vLLM wake/swap/gather dance collapses into one
@@ -325,7 +379,12 @@ class GRPO(EvolvableAlgorithm):
             self.last_generation_info = None
             return np.zeros((0, N), np.int32), np.zeros((0, N), np.int32)
         if self.continuous_decode:
-            gen = self._get_continuous_generator()
+            # fleet-attached rollouts go through the router (affinity +
+            # least-loaded over N replicas); same generate() contract and
+            # per-row key fold as the bare generator, so the streams are
+            # token-for-token identical (tests/test_llm/test_flywheel.py)
+            gen = (self.rollout_fleet if self.rollout_fleet is not None
+                   else self._get_continuous_generator())
             row_lens = mask_np.sum(axis=1)
             longest = int(row_lens.max()) if mask_np.size else 0
             # an all-pad row has no prompt to admit — dense path handles it
@@ -469,16 +528,23 @@ class GRPO(EvolvableAlgorithm):
         right-padded and T divisible by the axis size."""
         if len(experiences) == 4:
             ids, action_masks, rewards, attn = experiences
-            ids = jnp.asarray(ids)
-            mask = jnp.asarray(attn, jnp.int32)
         else:
             ids, action_masks, rewards = experiences
-            ids = jnp.asarray(ids)
-            mask = (ids != self.pad_token_id).astype(jnp.int32)
-        loss_mask = jnp.asarray(action_masks, jnp.float32)
+            attn = None
+        ids, mask, loss_mask = self._learn_masks(ids, action_masks, attn)
         rewards = jnp.asarray(rewards, jnp.float32)
         advantage = self._calculate_advantage(rewards)
 
+        logprobs, update = self._resolve_learn_fns(ids, mask)
+
+        old_lp = logprobs(self.actor.params, ids, mask) * loss_mask
+        ref_lp = logprobs(self.reference.params, ids, mask) * loss_mask
+        return self._run_update_epochs(
+            update, ids, mask, loss_mask, old_lp, ref_lp, advantage)
+
+    def _resolve_learn_fns(self, ids, mask):
+        """(logprobs, update) for the active parallelism mode, with the
+        sequence-parallel input contract validated against THIS batch."""
         if self.sequence_parallel_axis is not None:
             mesh, axis = self._require_sp_mesh()
             sp_size = mesh.shape[axis]
@@ -497,15 +563,18 @@ class GRPO(EvolvableAlgorithm):
                     "sequence_parallel_axis requires right-padded sequences "
                     "(attention mask must be non-increasing per row)"
                 )
-            logprobs = self.jit_fn("sp_logprobs", self._sp_logprob_fn)
-            update = self.jit_fn("sp_update", self._sp_update_fn)
-        else:
-            logprobs = self.jit_fn("logprobs", self._logprob_fn)
-            update = self.jit_fn("update", self._update_fn)
+            return (self.jit_fn("sp_logprobs", self._sp_logprob_fn),
+                    self.jit_fn("sp_update", self._sp_update_fn))
+        return (self.jit_fn("logprobs", self._logprob_fn),
+                self.jit_fn("update", self._update_fn))
 
-        old_lp = logprobs(self.actor.params, ids, mask) * loss_mask
-        ref_lp = logprobs(self.reference.params, ids, mask) * loss_mask
-
+    def _run_update_epochs(self, update, ids, mask, loss_mask, old_lp,
+                           ref_lp, advantage, rho=None):
+        """The shared minibatch-epoch engine behind :meth:`learn` and
+        :meth:`learn_from_trajectory` (one home for permutation order, the
+        donated-buffer bookkeeping, and the NaN guard — the two entry
+        points cannot drift). ``rho`` (per-token truncated importance
+        weights, or None) rides into each minibatch dict."""
         lora, opt_state = self.actor.params, self.optimizer.opt_state
         n_rows = ids.shape[0]
         total, total_kl, n_updates = 0.0, 0.0, 0
@@ -521,6 +590,8 @@ class GRPO(EvolvableAlgorithm):
                     "ref_lp": ref_lp[idx],
                     "advantage": advantage[idx],
                 }
+                if rho is not None:
+                    batch["rho"] = rho[idx]
                 lora, opt_state, loss, kl = update(
                     lora, opt_state, batch, jnp.float32(self.clip_coef),
                     jnp.float32(self.beta),
@@ -541,6 +612,81 @@ class GRPO(EvolvableAlgorithm):
         self.optimizer.opt_state = opt_state
         n = max(n_updates, 1)
         return total / n, total_kl / n
+
+    def _learn_masks(self, ids, action_masks, attention_mask):
+        """(ids, attention mask, loss mask) as jnp arrays — the shared batch
+        preamble of every learn surface."""
+        ids = jnp.asarray(ids)
+        if attention_mask is not None:
+            mask = jnp.asarray(attention_mask, jnp.int32)
+        else:
+            mask = (ids != self.pad_token_id).astype(jnp.int32)
+        return ids, mask, jnp.asarray(action_masks, jnp.float32)
+
+    def behavior_logprobs(self, ids, action_masks,
+                          attention_mask=None) -> np.ndarray:
+        """Per-token logprobs of ``ids`` under the CURRENT actor adapter,
+        masked to completion predictions — the behavior-policy record a
+        flywheel rollout pod captures at decode time and ships with each
+        trajectory batch, standing in for the on-policy path's recomputed
+        old logprobs (the learner recomputes nothing; llm/flywheel.py)."""
+        ids, mask, loss_mask = self._learn_masks(
+            ids, action_masks, attention_mask)
+        logprobs, _ = self._resolve_learn_fns(ids, mask)
+        return np.asarray(logprobs(self.actor.params, ids, mask) * loss_mask)
+
+    def learn_from_trajectory(
+        self,
+        ids,
+        action_masks,
+        rewards,
+        behavior_lp,
+        attention_mask=None,
+        rho_clip: Optional[float] = 2.0,
+    ) -> Tuple[float, float]:
+        """Staleness-aware off-policy GRPO update — the flywheel learner's
+        surface (llm/flywheel.py; ROADMAP item 3).
+
+        ``behavior_lp`` is the per-token completion logprob record captured
+        under the BEHAVIOR adapter (the weight epoch the completions were
+        decoded under; :meth:`behavior_logprobs`). The clipped-surrogate
+        anchor ``old_lp`` stays what it is on-policy — the CURRENT adapter's
+        logprobs recomputed at learn start (so the PPO ratio only meters
+        within-learn-step drift, exactly as :meth:`learn`) — and, unless
+        ``rho_clip`` is None, the decode→learn staleness is corrected ONCE
+        by the truncated per-token importance weight
+        ``rho = min(exp(old_lp - behavior_lp), rho_clip)`` (the IMPALA /
+        V-trace clipped behind-ness ratio between the learn-start policy
+        and the behavior epoch, computed once outside the grad) multiplying
+        the policy-gradient term. The combined weight ``ratio * rho`` is
+        the full truncated pi/mu correction applied exactly once —
+        anchoring the ratio at ``behavior_lp`` AND multiplying by rho would
+        double-count the staleness (rho^2 suppression of behind samples).
+        The learner never needs the behavior ADAPTER, only its shipped
+        logprob record. With the learner's adapter still AT the behavior
+        epoch (staleness 0), ``old_lp == behavior_lp`` and ``rho == 1``
+        exactly — the update reproduces :meth:`learn` on the same batch,
+        the flywheel's synchronous-mode equivalence contract. With
+        ``rho_clip=None`` the staleness is deliberately IGNORED (the
+        uncorrected ablation), not hidden behind a behavior-anchored
+        ratio."""
+        ids, mask, loss_mask = self._learn_masks(
+            ids, action_masks, attention_mask)
+        rewards = jnp.asarray(rewards, jnp.float32)
+        advantage = self._calculate_advantage(rewards)
+        logprobs, update = self._resolve_learn_fns(ids, mask)
+
+        old_lp = logprobs(self.actor.params, ids, mask) * loss_mask
+        ref_lp = logprobs(self.reference.params, ids, mask) * loss_mask
+        rho = None
+        if rho_clip is not None:
+            # re-masking is idempotent for a 0/1 mask — shipped records are
+            # already masked, but a hand-built batch may not be
+            behavior = jnp.asarray(behavior_lp, jnp.float32) * loss_mask
+            rho = jnp.minimum(jnp.exp(old_lp - behavior),
+                              jnp.float32(rho_clip))
+        return self._run_update_epochs(
+            update, ids, mask, loss_mask, old_lp, ref_lp, advantage, rho=rho)
 
     # ------------------------------------------------------------------ #
     def test(self, env) -> float:

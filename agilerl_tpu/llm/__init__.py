@@ -5,11 +5,23 @@ from agilerl_tpu.llm.serving import (
     BucketedGenerator,
     ContinuousGenerator,
 )
+from agilerl_tpu.llm.autoscale import AutoscalePolicy
 from agilerl_tpu.llm.fleet import KVTransferStore, PrefillWorker, ServingFleet
+from agilerl_tpu.llm.flywheel import (
+    LearnerPod,
+    OnlineGRPOFlywheel,
+    RolloutPod,
+    TrajectoryBatch,
+    TrajectoryStore,
+    WeightStore,
+)
 from agilerl_tpu.llm.router import FleetRouter
 from agilerl_tpu.llm.model import GPTConfig, init_lora, init_params, merge_lora
 
 __all__ = ["model", "generate", "left_pad", "BucketedGenerator",
            "ContinuousGenerator", "AdmissionPolicy", "ServingFleet",
-           "FleetRouter", "PrefillWorker", "KVTransferStore", "GPTConfig",
+           "FleetRouter", "PrefillWorker", "KVTransferStore",
+           "AutoscalePolicy", "OnlineGRPOFlywheel", "RolloutPod",
+           "LearnerPod", "WeightStore", "TrajectoryStore",
+           "TrajectoryBatch", "GPTConfig",
            "init_params", "init_lora", "merge_lora"]

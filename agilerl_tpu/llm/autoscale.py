@@ -1,0 +1,155 @@
+"""Autoscaling POLICY for the serving fleet (PR 9's open follow-up).
+
+``ServingFleet`` has had the mechanisms since PR 9 — ``scale_up()`` spawns
+a plan-compiled replica into the lease set, ``scale_down()`` retires one
+gracefully — but nothing decided WHEN to call them. This module is that
+decision, deliberately split the same way the admission controller is
+(:class:`~agilerl_tpu.llm.serving.AdmissionPolicy`): :meth:`decide` is a
+pure function of the fleet's existing SLO telemetry
+(:meth:`~agilerl_tpu.llm.fleet.ServingFleet.slo_signals` — rolling p95
+TTFT, per-replica backlog, shed counts), so it unit-tests with synthetic
+signals and a fake clock; :meth:`apply` adds the stateful parts (cooldown
+timers, shed-delta tracking) and actually calls the fleet.
+
+Thresholds follow the standard queue-theoretic shape: scale UP when
+sustained backlog / latency / shedding says the current replica set cannot
+drain arrivals, scale DOWN when the fleet is sustainedly idle — with
+asymmetric cooldowns (fast up, slow down) so a burst cannot flap the
+fleet. The flywheel's rollout tier drives one of these per rollout tick
+(``llm/flywheel.RolloutPod``)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from agilerl_tpu import observability
+
+
+class AutoscalePolicy:
+    """Threshold autoscaler over :meth:`ServingFleet.slo_signals`.
+
+    - ``backlog_high`` / ``backlog_low``: mean queued+in-flight rows per
+      replica that trigger up / permit down (the queue-depth telemetry).
+    - ``ttft_p95_high_s``: optional p95-TTFT SLO; breaching it triggers up
+      and blocks down (None disables the latency trigger).
+    - ``shed_rate_high``: optional shed-count delta between consecutive
+      :meth:`apply` calls that triggers up (shedding means admission
+      control is already refusing traffic — the strongest scale-up
+      signal); any shedding at all blocks down.
+    - ``up_cooldown_s`` / ``down_cooldown_s``: minimum spacing between
+      scale actions (per direction, measured on the injected ``clock``) so
+      one burst cannot add N replicas before the first one takes load.
+    """
+
+    def __init__(
+        self,
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        backlog_high: float = 8.0,
+        backlog_low: float = 1.0,
+        ttft_p95_high_s: Optional[float] = None,
+        shed_rate_high: Optional[float] = None,
+        up_cooldown_s: float = 10.0,
+        down_cooldown_s: float = 60.0,
+        clock=time.time,
+        metrics=None,
+    ):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.backlog_high = float(backlog_high)
+        self.backlog_low = float(backlog_low)
+        self.ttft_p95_high_s = ttft_p95_high_s
+        self.shed_rate_high = shed_rate_high
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.clock = clock
+        self.metrics = (metrics if metrics is not None
+                        else observability.get_registry())
+        self._last_up_s: Optional[float] = None
+        self._last_down_s: Optional[float] = None
+        self._last_shed_total: Optional[float] = None
+
+    # -- the pure decision -------------------------------------------------
+    def decide(self, signals: Dict[str, Any],
+               shed_delta: float = 0.0) -> Optional[str]:
+        """``"up"`` / ``"down"`` / None for one signal snapshot. Pure —
+        no clocks, no counters — so tests feed synthetic signals directly.
+        Cooldowns are :meth:`apply`'s job, not a reason to distort the
+        decision itself."""
+        replicas = int(signals.get("replicas", 0))
+        if replicas < self.min_replicas:
+            return "up"
+        mean_backlog = float(signals.get("mean_backlog", 0.0))
+        p95 = signals.get("p95_ttft_s")
+        # the TTFT window is count-bounded, not time-decayed: with zero
+        # outstanding work it FREEZES at the last burst's percentile, so a
+        # stale breach must neither pin an idle fleet hot (scale-up to max)
+        # nor block its scale-down forever
+        busy = (mean_backlog > 0.0
+                or float(signals.get("fleet_backlog", 0.0)) > 0.0)
+        hot = mean_backlog >= self.backlog_high
+        if self.ttft_p95_high_s is not None and p95 is not None and busy:
+            hot = hot or p95 >= self.ttft_p95_high_s
+        if self.shed_rate_high is not None:
+            hot = hot or shed_delta >= self.shed_rate_high
+        if hot:
+            return "up" if replicas < self.max_replicas else None
+        slow_ok = (self.ttft_p95_high_s is None or p95 is None
+                   or p95 < self.ttft_p95_high_s or not busy)
+        cold = (mean_backlog <= self.backlog_low and shed_delta <= 0.0
+                and float(signals.get("fleet_backlog", 0.0)) <= 0.0
+                and slow_ok)
+        if cold and replicas > self.min_replicas:
+            return "down"
+        return None
+
+    # -- the stateful actuator ---------------------------------------------
+    def apply(self, fleet) -> Optional[Tuple[str, int]]:
+        """Read the fleet's signals, decide, enforce cooldowns, and call
+        ``scale_up()`` / ``scale_down()``. Returns ``(action, replica_id)``
+        when an action fired, else None."""
+        signals = fleet.slo_signals()
+        shed_total = float(signals.get("shed_total", 0.0))
+        shed_delta = (shed_total - self._last_shed_total
+                      if self._last_shed_total is not None else 0.0)
+        action = self.decide(signals, shed_delta)
+        if action is None:
+            # no pressure: roll the shed window forward (delta is a rate
+            # per apply interval, not a lifetime accumulator)
+            self._last_shed_total = shed_total
+            return None
+        now = float(self.clock())
+        if action == "up":
+            if (self._last_up_s is not None
+                    and now - self._last_up_s < self.up_cooldown_s):
+                # cooldown-blocked: do NOT consume the shed window, or
+                # shedding observed during the cooldown could never
+                # trigger the scale-up once it expires
+                return None
+            self._last_shed_total = shed_total
+            rid = fleet.scale_up()
+            self._last_up_s = now
+        else:
+            if (self._last_down_s is not None
+                    and now - self._last_down_s < self.down_cooldown_s):
+                return None
+            self._last_shed_total = shed_total
+            rid = fleet.least_loaded_replica()
+            if rid is None:
+                return None
+            fleet.scale_down(rid)
+            self._last_down_s = now
+        self.metrics.counter(
+            f"fleet/autoscale_{action}_total",
+            help="autoscale policy actions taken").inc()
+        self.metrics.emit(
+            "fleet_autoscale", action=action, replica=int(rid),
+            mean_backlog=signals.get("mean_backlog"),
+            p95_ttft_s=signals.get("p95_ttft_s"), shed_delta=shed_delta,
+            replicas=signals.get("replicas"))
+        return action, int(rid)

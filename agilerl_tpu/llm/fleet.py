@@ -46,8 +46,6 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import json
-import shutil
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -72,8 +70,8 @@ from agilerl_tpu.llm.serving import (
     measured_cache_size,
 )
 from agilerl_tpu.observability import MetricsRegistry
-from agilerl_tpu.resilience import atomic
 from agilerl_tpu.resilience.membership import HeartbeatStore
+from agilerl_tpu.resilience.store import CommitDirStore
 
 #: lease roles a fleet member records in its heartbeat metadata
 ROLE_UNIFIED = "unified"
@@ -210,36 +208,33 @@ class PrefillWorker:
 class KVTransferStore:
     """Atomic prefill->decode KV handoff through a shared directory.
 
-    Same commit discipline as PR 7's island migration: the payload is
-    staged into a ``*.tmp`` directory with a manifest recording its sha256
-    and block-hash chain, then :func:`~agilerl_tpu.resilience.atomic
-    .commit_dir` publishes it atomically. A reader either sees a complete,
-    hash-valid transfer or nothing; torn/corrupt transfers are skipped with
-    a warning (``fleet/torn_kv_transfers_total``) and NEVER loaded — the
-    request is recomputed from its tokens instead, so a bad transfer can
-    cost latency but never wrong tokens."""
+    A thin wrapper over the generic commit-dir entry store
+    (:class:`~agilerl_tpu.resilience.store.CommitDirStore` — the same
+    publish/sha-validate/skip-torn discipline island migration and the
+    flywheel's weight/trajectory stores share). A reader either sees a
+    complete, hash-valid transfer or nothing; torn/corrupt transfers are
+    skipped with a warning (``fleet/torn_kv_transfers_total``) and NEVER
+    loaded — the request is recomputed from its tokens instead, so a bad
+    transfer can cost latency but never wrong tokens."""
 
     def __init__(self, directory: Union[str, Path], metrics=None):
-        self.directory = Path(directory)
-        self.directory.mkdir(parents=True, exist_ok=True)
-        self.metrics = metrics if metrics is not None else observability.get_registry()
+        self._store = CommitDirStore(
+            directory,
+            torn_counter="fleet/torn_kv_transfers_total",
+            torn_help="KV transfers skipped as torn/corrupt",
+            warn_prefix="torn-kv-transfer",
+            metrics=metrics,
+        )
+        self.directory = self._store.directory
+        self.metrics = self._store.metrics
 
     def export(self, name: str, payload: Dict[str, Any]) -> Path:
-        """Atomically publish one transfer; returns the committed path."""
-        final = self.directory / name
-        tmp = self.directory / (name + atomic.TMP_DIR_SUFFIX)
-        if tmp.exists():
-            shutil.rmtree(tmp)
-        tmp.mkdir(parents=True)
-        sha, size = atomic.staged_pickle(tmp / "payload.pkl", payload)
-        manifest = {
-            "payload_sha": sha,
-            "bytes": size,
+        """Atomically publish one transfer; returns the committed path. The
+        manifest carries the block-hash chain so routing provenance is
+        readable without unpickling the KV payload."""
+        final = self._store.publish(name, payload, manifest_extra={
             "hashes": [h.hex() for h in payload.get("hashes", [])],
-        }
-        atomic.staged_write_bytes(
-            tmp / "manifest.json", json.dumps(manifest).encode())
-        atomic.commit_dir(tmp, final)
+        })
         self.metrics.counter("fleet/kv_transfers_total",
                              help="prefill->decode KV transfers "
                                   "exported").inc()
@@ -249,24 +244,11 @@ class KVTransferStore:
         """Hash-validated import; returns None (after counting + warning)
         for a torn, truncated, or corrupt transfer — the skip-and-recompute
         contract."""
-        path = Path(path)
-        try:
-            manifest = json.loads((path / "manifest.json").read_text())
-            return atomic.load_validated_pickle(
-                path / "payload.pkl", manifest["payload_sha"])
-        except (OSError, ValueError, KeyError,
-                atomic.CorruptSnapshotError) as e:
-            self.metrics.counter(
-                "fleet/torn_kv_transfers_total",
-                help="KV transfers skipped as torn/corrupt").inc()
-            self.metrics.warn_once(
-                f"torn-kv-transfer-{path.name}",
-                f"skipping torn KV transfer {path.name}: {e}")
-            return None
+        return self._store.load(path)
 
     def consume(self, path: Union[str, Path]) -> None:
         """Delete an imported (or torn) transfer directory."""
-        shutil.rmtree(Path(path), ignore_errors=True)
+        self._store.consume(path)
 
 
 @dataclasses.dataclass
@@ -391,6 +373,16 @@ class ServingFleet:
         self._prefill_pending: "collections.deque[_FleetRequest]" = collections.deque()
         self._transfers: "collections.deque[_FleetRequest]" = collections.deque()
         self._parked: List[_FleetRequest] = []
+        # sheds recorded by members that have since left the fleet — the
+        # autoscaler's shed_total must stay monotonic across losses and
+        # retirements or its delta goes negative right when capacity shrank
+        self._departed_sheds = 0.0
+        # lifetime totals of members DELETED by scale_down (unplanned
+        # losses keep their tombstone and stay in the member sums):
+        # latency_summary's fleet rollups must not run backwards either
+        self._departed_totals = {"requests_total": 0.0,
+                                 "tokens_decoded_total": 0.0,
+                                 "shed_requests_total": 0.0}
         serving_role = ROLE_DECODE if topology == "disaggregated" else ROLE_UNIFIED
         for _ in range(int(n_replicas)):
             self._spawn(serving_role)
@@ -455,6 +447,9 @@ class ServingFleet:
         if not m.alive:
             return
         m.alive = False
+        if m.role != ROLE_PREFILL:
+            self._departed_sheds += float(
+                m.gen.metrics.counter("serving/shed_requests_total").value)
         dropped_affinity = self.router.forget_replica(m.rid)
         lost_tickets = list(m.tickets.values())
         m.tickets.clear()
@@ -527,6 +522,17 @@ class ServingFleet:
         self.metrics.emit("fleet_scale", action="down", replica=m.rid,
                           role=m.role)
         self._handle_loss(m, graceful=True)
+        # a PLANNED retirement's work is fully re-dispatched (finished
+        # results were already harvested into self._results at the step
+        # that finished them), so drop the member outright — an autoscaler
+        # cycling up/down would otherwise retain one dead generator's KV
+        # pool and jit caches per cycle, forever (unplanned losses keep
+        # their tombstone for MTTR accounting)
+        for key in self._departed_totals:
+            self._departed_totals[key] += float(
+                m.gen.metrics.counter(f"serving/{key}").value)
+        del self._members[m.rid]
+        self._update_replica_count()
 
     def _spawn(self, role: str, plan=None) -> _Member:
         rid = self._next_rid
@@ -857,6 +863,53 @@ class ServingFleet:
         return comp, cmask, info
 
     # -- telemetry -----------------------------------------------------------
+    def slo_signals(self) -> Dict[str, Any]:
+        """The rolled-up signal set an autoscaling policy thresholds on
+        (llm/autoscale.AutoscalePolicy) — all read from telemetry the
+        serving tier already keeps: live replica count, per-replica backlog
+        (queued + in-flight rows), rolling p95 TTFT across every replica's
+        recent-TTFT window (the same window admission control sheds on, so
+        the scaler and the shedder see one latency truth), and the
+        cumulative shed count (router + live replicas + members that have
+        since departed, so the total stays monotonic across losses and
+        retirements; router/replica counts disjoint by construction — see
+        latency_summary)."""
+        members = [m for m in self._serving_members(alive=True).values()
+                   if not m.killed]
+        backlogs = [float(m.gen.backlog()) for m in members]
+        recent = [t for m in members for t in list(m.gen._recent_ttft)]
+        # the shed SUM includes killed-but-undetected members (their
+        # history must not vanish for the detection window — alive=False
+        # hands it to _departed_sheds at _handle_loss); capacity signals
+        # (backlog/TTFT) rightly exclude them
+        shed = (
+            self.metrics.counter("serving/shed_requests_total").value
+            + self._departed_sheds
+            + sum(m.gen.metrics.counter("serving/shed_requests_total").value
+                  for m in self._serving_members(alive=True).values()))
+        return {
+            "replicas": len(members),
+            "mean_backlog": (sum(backlogs) / len(backlogs)
+                             if backlogs else 0.0),
+            "max_backlog": max(backlogs) if backlogs else 0.0,
+            "fleet_backlog": float(len(self._prefill_pending)
+                                   + len(self._transfers)
+                                   + len(self._parked)),
+            "p95_ttft_s": (float(np.percentile(np.asarray(recent), 95))
+                           if recent else None),
+            "shed_total": float(shed),
+        }
+
+    def least_loaded_replica(self) -> Optional[int]:
+        """The live serving replica with the smallest backlog (ties ->
+        HIGHEST id: retire the newest first, keeping low ids — the grid
+        reference and leader-election anchors — stable). None when the
+        fleet has at most one functioning replica (nothing retirable)."""
+        survivors = self._survivors()
+        if len(survivors) < 2:
+            return None
+        return min(survivors, key=lambda r: (survivors[r], -r))
+
     def latency_summary(self) -> Dict[str, Any]:
         """Fleet-level SLO rollup: every serving replica's
         ``latency_summary()`` (each on its own registry) plus the fleet
@@ -897,12 +950,17 @@ class ServingFleet:
             # so the sum is exact, never double-counted
             "shed_requests_total": (
                 reg.counter("serving/shed_requests_total").value
+                + self._departed_totals["shed_requests_total"]
                 + sum(m.gen.metrics.counter(
                     "serving/shed_requests_total").value for m in serving)),
-            "requests_total": sum(m.gen.metrics.counter(
-                "serving/requests_total").value for m in serving),
-            "tokens_decoded_total": sum(m.gen.metrics.counter(
-                "serving/tokens_decoded_total").value for m in serving),
+            "requests_total": (
+                self._departed_totals["requests_total"]
+                + sum(m.gen.metrics.counter(
+                    "serving/requests_total").value for m in serving)),
+            "tokens_decoded_total": (
+                self._departed_totals["tokens_decoded_total"]
+                + sum(m.gen.metrics.counter(
+                    "serving/tokens_decoded_total").value for m in serving)),
         }
         return {"replicas": replicas, "fleet": fleet}
 

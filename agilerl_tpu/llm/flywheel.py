@@ -1,0 +1,566 @@
+"""Online GRPO flywheel: disaggregated rollout/learner pods exchanging
+weights and trajectories through atomic commit-dir stores (ROADMAP item 3
+— the PR that closes the serving<->training loop).
+
+``finetune_llm_reasoning`` interleaves generate and learn in one process,
+so rollout generation dominates GRPO step time. The flywheel splits the
+two sides along the IMPALA / Podracer seam (Espeholt et al.: decoupled
+actor/learner with importance correction; decode-resident generation):
+
+- **Rollout pods** (:class:`RolloutPod`) drive GRPO group generation —
+  through the agent's serving tier (``ContinuousGenerator`` in no-shed
+  mode, or a router-fronted :class:`~agilerl_tpu.llm.fleet.ServingFleet`
+  via :meth:`GRPO.attach_rollout_fleet`, optionally autoscaled by
+  :class:`~agilerl_tpu.llm.autoscale.AutoscalePolicy`) — against the
+  freshest PUBLISHED adapter epoch, tag every group batch with the weight
+  epoch it was decoded under, record the behavior policy's per-token
+  logprobs, and publish the batch. Actors never block on the learner.
+- **Learner pods** (:class:`LearnerPod`) consume trajectory batches,
+  drop those staler than ``max_staleness_epochs`` (counted, never trained
+  on), and run the staleness-aware importance-corrected GRPO update
+  (:meth:`~agilerl_tpu.algorithms.grpo.GRPO.learn_from_trajectory` — the
+  V-trace-style clipped behind-ness ratio between the behavior epoch's
+  shipped logprobs and the current policy). Each update publishes a new
+  weight epoch.
+- **Stores** — :class:`WeightStore` (versioned adapter epochs, last-K GC)
+  and :class:`TrajectoryStore` (group batches with epoch + prompt
+  provenance), both thin wrappers over the shared commit-dir protocol
+  (:class:`~agilerl_tpu.resilience.store.CommitDirStore`, the PR 7
+  ``members.pkl`` mold): torn publishes are skipped with a warning and
+  NEVER loaded; readers recompute nothing.
+
+Staleness semantics: a batch decoded under weight epoch ``e`` consumed by
+a learner at epoch ``E`` has lag ``E - e``. ``max_staleness_epochs=0`` is
+the synchronous mode — the learner trains only on current-epoch batches,
+so the flywheel reproduces the interleaved loop's loss/param stream
+exactly (the tier-1 equivalence gate). Larger budgets let decode run
+ahead; the importance correction keeps bounded lag unbiased and the drop
+policy bounds it.
+
+:class:`OnlineGRPOFlywheel` is the single-process driver the CPU tests
+and bench use (the elastic tier's emulated-host precedent): it ticks both
+pods with flow control derived from the staleness budget, so "decode
+never blocks on learn" is an observable (``flywheel/decode_stall_s``), not
+a hope. A real deployment runs the pods as separate processes against the
+same store directories — every pod<->pod interaction already goes through
+the stores, never through shared memory.
+
+Prefix-cache coherence on weight swaps is inherited from the serving tier:
+adopting a published epoch rebinds the adapter tree, and every replica's
+``_check_weight_epoch`` flushes its prefix cache (and drops queued stale
+prefill imports) at its next step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu import observability
+from agilerl_tpu.resilience.store import CommitDirStore, entry_seq
+
+#: entry-name prefixes (the stores' GC and ordering key on these)
+_EPOCH_PREFIX = "epoch_"
+_BATCH_PREFIX = "batch_"
+
+
+class WeightStore:
+    """Versioned adapter epochs through the commit-dir protocol.
+
+    One entry per published epoch (``epoch_00000012/`` holding
+    ``weights.pkl`` + manifest), last-K GC on publish. Readers walk
+    newest-first and skip torn entries (``flywheel/torn_weight_publishes_
+    total``) — a torn publish is invisible to actors, which keep decoding
+    under the previous epoch instead of loading garbage."""
+
+    def __init__(self, directory: Union[str, Path], keep_last: int = 4,
+                 metrics=None):
+        self._store = CommitDirStore(
+            directory,
+            payload_name="weights.pkl",
+            prefix=_EPOCH_PREFIX,
+            keep_last=int(keep_last),
+            torn_counter="flywheel/torn_weight_publishes_total",
+            torn_help="weight epochs skipped as torn/corrupt",
+            warn_prefix="torn-weight-epoch",
+            metrics=metrics,
+        )
+        self.directory = self._store.directory
+        self.metrics = self._store.metrics
+
+    def publish(self, epoch: int, lora: Any,
+                meta: Optional[Dict[str, Any]] = None) -> Path:
+        """Atomically publish one adapter epoch (host copies — device
+        arrays are fetched here so a learner's donated buffers never leak
+        into the pickle)."""
+        payload = {"epoch": int(epoch), "lora": jax.device_get(lora)}
+        path = self._store.publish(
+            f"{_EPOCH_PREFIX}{int(epoch):08d}", payload,
+            manifest_extra={"epoch": int(epoch), **(meta or {})})
+        self.metrics.counter(
+            "flywheel/weight_epochs_published_total",
+            help="adapter epochs published by learner pods").inc()
+        return path
+
+    def epochs(self) -> List[int]:
+        """Committed epoch numbers, oldest first."""
+        return [s for s in (entry_seq(p.name) for p in self._store.entries())
+                if s is not None]
+
+    def latest_epoch(self) -> Optional[int]:
+        epochs = self.epochs()
+        return epochs[-1] if epochs else None
+
+    def load_latest(self) -> Optional[Tuple[int, Any]]:
+        """(epoch, adapter tree) of the newest LOADABLE epoch — torn
+        entries are counted, warned about, and walked past (never loaded);
+        None when nothing valid is committed yet."""
+        for path in reversed(self._store.entries()):
+            payload = self._store.load(path)
+            if payload is not None:
+                return int(payload["epoch"]), payload["lora"]
+        return None
+
+    def truncate_above(self, epoch: int) -> int:
+        """Delete committed epochs NEWER than ``epoch`` — the resume
+        protocol: a crash can leave post-snapshot epochs in the store, and
+        without truncation actors would adopt the PRE-crash adapter (and
+        last-K GC could collect the restored re-publish as the oldest
+        entry). Returns the number of entries removed."""
+        removed = 0
+        for path in self._store.entries():
+            seq = entry_seq(path.name)
+            if seq is not None and seq > int(epoch):
+                self._store.consume(path)
+                removed += 1
+        return removed
+
+
+@dataclasses.dataclass
+class TrajectoryBatch:
+    """One GRPO group batch with full decode provenance — everything the
+    learner needs to run the importance-corrected update WITHOUT
+    recomputing anything from the rollout side.
+
+    ``weight_epoch`` is the adapter epoch the completions were decoded
+    under (the staleness tag); ``behavior_lp`` is that epoch's per-token
+    completion logprob record (:meth:`GRPO.behavior_logprobs`);
+    ``data_epoch`` is the env's dataset-epoch counter at generation time
+    (it drives the learner's reference-adapter refresh, exactly as the
+    interleaved loop's ``set_reference_policy(env.num_epochs)`` did);
+    ``prompt_hashes`` is per-prompt provenance (sha1 of the prompt token
+    ids)."""
+
+    seq: int
+    actor_id: int
+    weight_epoch: int
+    data_epoch: int
+    ids: np.ndarray            # [B*G, P+N] prompt+completion sequences
+    action_masks: np.ndarray   # [B*G, P+N-1] completion-prediction mask
+    rewards: np.ndarray        # [B, G]
+    behavior_lp: np.ndarray    # [B*G, P+N-1] behavior-epoch logprobs, masked
+    prompt_hashes: List[str] = dataclasses.field(default_factory=list)
+    #: for EXTERNAL batch producers whose tokenizer's pad id collides with
+    #: a real vocab token (GRPO.learn's 4-tuple contract). RolloutPod never
+    #: ships one — the serving-tier envs derive the mask from pad ids,
+    #: exactly like the interleaved loop's 3-tuple learn path.
+    attention_mask: Optional[np.ndarray] = None
+
+
+class TrajectoryStore:
+    """GRPO group batches through the commit-dir protocol.
+
+    Writers publish ``batch_{actor:03d}_{seq:08d}`` entries; readers
+    :meth:`poll` committed entries in global seq order, consume (delete)
+    each after reading, and skip torn ones
+    (``flywheel/torn_trajectories_total``) — a torn batch costs one group
+    of rollouts, never a corrupted gradient."""
+
+    def __init__(self, directory: Union[str, Path], metrics=None):
+        self._store = CommitDirStore(
+            directory,
+            payload_name="trajectory.pkl",
+            prefix=_BATCH_PREFIX,
+            torn_counter="flywheel/torn_trajectories_total",
+            torn_help="trajectory batches skipped as torn/corrupt",
+            warn_prefix="torn-trajectory",
+            metrics=metrics,
+        )
+        self.directory = self._store.directory
+        self.metrics = self._store.metrics
+
+    def publish(self, batch: TrajectoryBatch) -> Path:
+        path = self._store.publish(
+            f"{_BATCH_PREFIX}{int(batch.actor_id):03d}_{int(batch.seq):08d}",
+            batch,
+            manifest_extra={
+                "seq": int(batch.seq),
+                "actor_id": int(batch.actor_id),
+                "weight_epoch": int(batch.weight_epoch),
+                "data_epoch": int(batch.data_epoch),
+                "rows": int(np.asarray(batch.ids).shape[0]),
+                "prompt_hashes": list(batch.prompt_hashes),
+            })
+        self.metrics.counter(
+            "flywheel/trajectories_published_total",
+            help="trajectory batches published by rollout pods").inc()
+        self.metrics.gauge(
+            "flywheel/trajectories_pending",
+            help="published-but-unconsumed trajectory batches").set(
+            self.pending())
+        return path
+
+    def pending(self) -> int:
+        return len(self._store.entries())
+
+    def clear(self) -> int:
+        """Consume every committed batch WITHOUT returning it — the resume
+        protocol: pre-crash leftovers reference a decode-epoch line and a
+        prompt-stream position the restored run no longer matches (and
+        their seq numbers would collide with the restarted rollout
+        counter). Returns the number of entries removed."""
+        removed = 0
+        for path in self._store.entries():
+            self._store.consume(path)
+            removed += 1
+        if removed:
+            self.metrics.gauge("flywheel/trajectories_pending").set(
+                self.pending())
+        return removed
+
+    def poll(self, max_batches: Optional[int] = None) -> List[TrajectoryBatch]:
+        """Read + consume committed batches in seq order. Torn entries are
+        counted, warned about, consumed (so they cannot wedge the queue),
+        and excluded from the result — never trained on."""
+        out: List[TrajectoryBatch] = []
+        entries = self._store.entries()
+        if max_batches is not None:
+            entries = entries[: int(max_batches)]
+        for path in entries:
+            payload = self._store.load(path)
+            self._store.consume(path)
+            if payload is None:
+                continue
+            self.metrics.counter(
+                "flywheel/trajectories_consumed_total",
+                help="trajectory batches consumed by learner pods").inc()
+            out.append(payload)
+        self.metrics.gauge("flywheel/trajectories_pending").set(
+            self.pending())
+        return out
+
+
+def _prompt_hashes(prompts: Dict[str, np.ndarray]) -> List[str]:
+    """Per-prompt sha1 provenance over the REAL (unpadded) token ids."""
+    ids = np.asarray(prompts["input_ids"])
+    mask = np.asarray(prompts["attention_mask"]).astype(bool)
+    return [hashlib.sha1(row[m].astype(np.int32).tobytes()).hexdigest()
+            for row, m in zip(ids, mask)]
+
+
+class RolloutPod:
+    """The decode side: generates GRPO groups under the freshest published
+    adapter epoch and publishes tagged trajectory batches. Never blocks on
+    the learner — flow control (if any) lives in the driver, where a stall
+    is counted, not hidden.
+
+    ``agent`` is a GRPO instance whose ``base_params`` match the
+    learner's (a clone, or the very same object in the colocated
+    emulation); only its ACTOR adapter is replaced on epoch adoption, so
+    its own optimizer/reference state is never touched. ``fleet`` routes
+    generation through a ServingFleet (attach_rollout_fleet — the router
+    path), and ``autoscaler`` is applied to that fleet once per rollout."""
+
+    def __init__(
+        self,
+        agent,
+        env,
+        weight_store: WeightStore,
+        traj_store: TrajectoryStore,
+        actor_id: int = 0,
+        metrics=None,
+        fleet=None,
+        autoscaler=None,
+    ):
+        self.agent = agent
+        self.env = env
+        self.weight_store = weight_store
+        self.traj_store = traj_store
+        self.actor_id = int(actor_id)
+        self.metrics = (metrics if metrics is not None
+                        else observability.get_registry())
+        self.fleet = fleet
+        self.autoscaler = autoscaler
+        if fleet is not None:
+            agent.attach_rollout_fleet(fleet)
+        self.weight_epoch = -1  # nothing adopted yet
+        self.seq = 0
+        self._prompts = None
+
+    def poll_weights(self) -> bool:
+        """Adopt the newest loadable published epoch if it is newer than
+        the one being decoded under. Rebinding the adapter tree is what
+        triggers the serving tier's prefix-cache invalidation on every
+        replica at its next step (identity change — PR 4's weight-epoch
+        contract)."""
+        latest = self.weight_store.latest_epoch()
+        if latest is None or latest <= self.weight_epoch:
+            return False
+        loaded = self.weight_store.load_latest()
+        if loaded is None or loaded[0] <= self.weight_epoch:
+            return False
+        epoch, lora = loaded
+        lora = jax.tree_util.tree_map(jnp.asarray, lora)
+        plan = getattr(self.agent, "sharding_plan", None)
+        mesh = getattr(self.agent, "mesh", None)
+        if plan is not None and mesh is not None:
+            # a mesh-placed agent (to_mesh — the colocated default when
+            # the learner runs plan-compiled) must adopt with the plan's
+            # GSPMD placement, not uncommitted default-device host copies
+            # that would retrace/reshard every subsequent learn step
+            lora = plan.place("lora", lora, mesh)
+        self.agent.actor.params = lora
+        self.weight_epoch = int(epoch)
+        self.metrics.gauge(
+            "flywheel/actor_weight_epoch",
+            help="adapter epoch the rollout pod decodes under").set(epoch)
+        self.metrics.emit("flywheel_adopt", actor=self.actor_id,
+                          weight_epoch=int(epoch))
+        return True
+
+    def rollout_once(self, greedy: bool = False) -> TrajectoryBatch:
+        """ONE group-batch rollout: generate ``group_size`` completions per
+        prompt, record the behavior logprobs, score rewards, publish the
+        tagged batch, and carry the env's next prompt batch (the same
+        cross-step prompt stream contract as the interleaved loop)."""
+        if self.weight_epoch < 0:
+            raise RuntimeError(
+                "rollout pod has no adopted weight epoch; the learner must "
+                "publish its initial adapter (epoch 0) and poll_weights() "
+                "must run before the first rollout")
+        if self.autoscaler is not None and self.fleet is not None:
+            self.autoscaler.apply(self.fleet)
+        t0 = time.perf_counter()
+        env, agent = self.env, self.agent
+        if self._prompts is None:
+            self._prompts = env.reset()
+        prompts = self._prompts
+        data_epoch = int(env.num_epochs)
+        completions, completion_mask = agent.get_action(
+            prompts, training=not greedy)
+        ids, action_masks = env.assemble_learn_batch(
+            completions, completion_mask)
+        behavior_lp = agent.behavior_logprobs(ids, action_masks)
+        next_prompts, rewards = env.step(completions, completion_mask)
+        self._prompts = next_prompts
+        batch = TrajectoryBatch(
+            seq=self.seq, actor_id=self.actor_id,
+            weight_epoch=self.weight_epoch, data_epoch=data_epoch,
+            ids=np.asarray(ids), action_masks=np.asarray(action_masks),
+            rewards=np.asarray(rewards), behavior_lp=behavior_lp,
+            prompt_hashes=_prompt_hashes(prompts))
+        self.seq += 1
+        self.traj_store.publish(batch)
+        self.metrics.counter(
+            "flywheel/rollout_tokens_total",
+            help="completion tokens decoded by rollout pods").inc(
+            int(np.asarray(completion_mask).sum()))
+        self.metrics.histogram("flywheel/rollout_s").observe(
+            time.perf_counter() - t0)
+        return batch
+
+
+class LearnerPod:
+    """The learn side: consumes trajectory batches, enforces the staleness
+    drop policy, runs the importance-corrected sharded update, and
+    publishes a new adapter epoch per learn step.
+
+    Pass ``plan``/``mesh`` to place the agent through the declarative
+    sharding engine (``agent.to_mesh`` — the plan-compiled learn step of
+    PR 6); the update then runs GSPMD-sharded with zero further changes
+    because ``learn_from_trajectory`` routes through the same jitted
+    update. ``importance_correction=False`` disables the rho term (ablation
+    knob); the staleness DROP policy still applies."""
+
+    def __init__(
+        self,
+        agent,
+        weight_store: WeightStore,
+        traj_store: TrajectoryStore,
+        max_staleness_epochs: int = 2,
+        rho_clip: float = 2.0,
+        importance_correction: bool = True,
+        metrics=None,
+        plan=None,
+        mesh=None,
+        publish_initial: bool = True,
+    ):
+        if max_staleness_epochs < 0:
+            raise ValueError("max_staleness_epochs must be >= 0")
+        self.agent = agent
+        self.weight_store = weight_store
+        self.traj_store = traj_store
+        self.max_staleness_epochs = int(max_staleness_epochs)
+        self.rho_clip = float(rho_clip)
+        self.importance_correction = bool(importance_correction)
+        self.metrics = (metrics if metrics is not None
+                        else observability.get_registry())
+        if plan is not None or mesh is not None:
+            agent.to_mesh(mesh=mesh, plan=plan)
+        self.epoch = 0
+        self.losses: List[float] = []
+        self.kls: List[float] = []
+        self.trained_seqs: List[int] = []
+        self.dropped_seqs: List[int] = []
+        self.tokens_trained = 0  # sequence tokens through learn steps
+        self._last_step_end: Optional[float] = None
+        if publish_initial:
+            # epoch 0 = the initial adapter: actors can adopt and decode
+            # before the first learn step ever runs
+            self.publish()
+
+    @property
+    def learn_calls(self) -> int:
+        return len(self.trained_seqs)
+
+    def publish(self) -> None:
+        self.weight_store.publish(self.epoch, self.agent.actor.params)
+        self.metrics.gauge(
+            "flywheel/learner_weight_epoch",
+            help="newest adapter epoch published by the learner").set(
+            self.epoch)
+
+    def step(self, max_batches: Optional[int] = None) -> int:
+        """Consume available batches (seq order): train on those within
+        the staleness budget (one learn step + weight publish each), drop
+        and count the rest. Returns the number of batches CONSUMED
+        (trained + dropped); 0 means the learner idled — that wall time is
+        accumulated in ``flywheel/learner_idle_s``."""
+        now0 = time.perf_counter()
+        batches = self.traj_store.poll(max_batches)
+        if not batches:
+            if self._last_step_end is not None:
+                self.metrics.counter(
+                    "flywheel/learner_idle_s",
+                    help="wall time the learner waited with no consumable "
+                         "trajectory batches").inc(
+                    now0 - self._last_step_end)
+            self._last_step_end = time.perf_counter()
+            return 0
+        consumed = 0
+        for b in sorted(batches, key=lambda b: (b.seq, b.actor_id)):
+            consumed += 1
+            lag = self.epoch - int(b.weight_epoch)
+            self.metrics.gauge(
+                "flywheel/weight_epoch_lag",
+                help="learner epoch minus the consumed batch's decode "
+                     "epoch").set(lag)
+            # negative lag (decoded under an epoch NEWER than the learner's
+            # — pre-crash leftovers, or a foreign weight line) is just as
+            # untrainable as over-budget lag: the behavior record doesn't
+            # belong to any epoch this learner can correct against
+            if lag < 0 or lag > self.max_staleness_epochs:
+                self.dropped_seqs.append(int(b.seq))
+                self.metrics.counter(
+                    "flywheel/trajectories_dropped_stale_total",
+                    help="batches dropped for lag outside "
+                         "[0, max_staleness_epochs] (never trained on)").inc()
+                self.metrics.emit(
+                    "flywheel_drop_stale", seq=int(b.seq),
+                    actor=int(b.actor_id), lag=int(lag),
+                    max_staleness=self.max_staleness_epochs)
+                continue
+            # reference refresh rides the batch's dataset-epoch tag — the
+            # disaggregated analogue of set_reference_policy(env.num_epochs)
+            self.agent.set_reference_policy(int(b.data_epoch))
+            loss, kl = self.agent.learn_from_trajectory(
+                b.ids, b.action_masks, b.rewards, b.behavior_lp,
+                attention_mask=b.attention_mask,
+                rho_clip=(self.rho_clip if self.importance_correction
+                          else None))
+            self.agent.steps[-1] += int(np.asarray(b.rewards).size)
+            self.tokens_trained += int(np.asarray(b.ids).size)
+            self.losses.append(float(loss))
+            self.kls.append(float(kl))
+            self.trained_seqs.append(int(b.seq))
+            self.metrics.counter(
+                "flywheel/learn_steps_total",
+                help="importance-corrected learn steps executed").inc()
+            self.epoch += 1
+            self.publish()
+        self._last_step_end = time.perf_counter()
+        return consumed
+
+
+class OnlineGRPOFlywheel:
+    """Single-process driver ticking one rollout pod against one learner
+    pod (the CPU emulation; real pods run the same objects in separate
+    processes against the same store directories).
+
+    Flow control: the actor is gated only when the store already holds
+    ``max_inflight`` unconsumed batches (default ``max_staleness_epochs +
+    1`` — anything more would be dropped as stale by construction, so
+    producing it is pure waste). A gated tick is a DECODE STALL: counted
+    (``flywheel/decode_stalls_total``) and timed
+    (``flywheel/decode_stall_s``), because "decode never blocks on learn"
+    is this subsystem's acceptance criterion, not an assumption. With
+    ``max_staleness_epochs=0`` the gate degenerates to lockstep — the
+    synchronous mode the equivalence gate runs."""
+
+    def __init__(self, rollout: RolloutPod, learner: LearnerPod,
+                 max_inflight: Optional[int] = None, metrics=None):
+        self.rollout = rollout
+        self.learner = learner
+        self.max_inflight = (int(max_inflight) if max_inflight is not None
+                             else learner.max_staleness_epochs + 1)
+        if self.max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        self.metrics = (metrics if metrics is not None
+                        else observability.get_registry())
+
+    def can_rollout(self) -> bool:
+        return self.rollout.traj_store.pending() < self.max_inflight
+
+    def run(self, max_epochs: int, greedy: bool = False,
+            max_ticks: int = 1_000_000) -> None:
+        """Tick until the learner has published ``max_epochs`` weight
+        epochs (i.e. executed that many learn steps past the initial
+        publish)."""
+        ticks = 0
+        while self.learner.epoch < max_epochs:
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"flywheel not converged after {max_ticks} ticks "
+                    f"(learner at epoch {self.learner.epoch}/{max_epochs})")
+            stalled = not self.can_rollout()
+            if stalled:
+                self.metrics.counter(
+                    "flywheel/decode_stalls_total",
+                    help="ticks the rollout pod was gated by the "
+                         "staleness-derived inflight bound").inc()
+                with self.metrics.timer(
+                        "flywheel/decode_stall_s",
+                        help="wall time decode spent gated on the "
+                             "learner"):
+                    consumed = self.learner.step()
+                # consumed==0 with the gate now OPEN means the poll drained
+                # torn entries (counted+consumed, never returned) — a torn
+                # batch costs one group of rollouts, it must not wedge the
+                # driver; only a still-gated no-consume is a real wedge
+                if consumed == 0 and not self.can_rollout():
+                    raise RuntimeError(
+                        "flywheel wedged: rollout gated at "
+                        f"{self.rollout.traj_store.pending()} in-flight "
+                        "batches but the learner consumed nothing")
+                continue
+            self.rollout.poll_weights()
+            self.rollout.rollout_once(greedy=greedy)
+            self.learner.step()

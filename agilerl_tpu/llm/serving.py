@@ -883,7 +883,12 @@ class ContinuousGenerator:
         ``k_prompt``/``v_prompt`` are ``[L, Pb, KV, hd]`` at THIS
         generator's prompt bucket — the worker must share the bucket grid
         and decode sizing so the prefill cache extent matches (the
-        dense-parity contract). ``tok0``/``done0``/``key_next`` are the
+        dense-parity contract). The import must be computed under the
+        weights of this generator's CURRENT/next step: the fleet driver
+        guarantees it by consuming transfers in the same ``step()`` that
+        prefilled them, and ``_check_weight_epoch`` drops queued imports
+        that a LATER weight swap strands — but a first-step import under
+        foreign weights is the caller's contract to uphold. ``tok0``/``done0``/``key_next`` are the
         prefill head's first sampled token, its EOS state, and the
         continued RNG stream; admission seeds the slot with them exactly as
         the local miss path would after its own prefill, so the decode
@@ -1233,17 +1238,40 @@ class ContinuousGenerator:
     def _check_weight_epoch(self, params, lora) -> None:
         """Cached prompt KV is a pure function of (weights, chain prefix):
         a NEW params/lora tree (GRPO swaps the actor adapter every learn
-        step; a server hot-swapping weights) invalidates every cached
-        block. Identity comparison is the contract — callers that mutate a
-        tree in place must call allocator.invalidate_cache() themselves."""
+        step; the flywheel adopting a published weight epoch; a server
+        hot-swapping weights) invalidates every cached block. Identity
+        comparison is the contract — callers that mutate a tree in place
+        must call allocator.invalidate_cache() themselves.
+
+        Queued requests carrying an EXTERNALLY prefilled prompt KV
+        (disaggregated imports) were computed under the OLD weights: their
+        payloads are dropped here so admission recomputes the prefill
+        locally under the new weights — without this, a weight bump landing
+        while an import waits for a free slot would scatter stale KV into
+        the pool AND register it in the fresh prefix cache (wrong tokens
+        for every future hit on that chain)."""
         if self._weights is not None and (self._weights[0] is params
                                           and self._weights[1] is lora):
             return
-        if self._weights is not None and self.prefix_cache:
-            self.allocator.invalidate_cache()
-            self.metrics.counter(
-                "serving/prefix_cache_invalidations_total",
-                help="prefix-cache flushes on weight updates").inc()
+        if self._weights is not None:
+            if self.prefix_cache:
+                self.allocator.invalidate_cache()
+                self.metrics.counter(
+                    "serving/prefix_cache_invalidations_total",
+                    help="prefix-cache flushes on weight updates").inc()
+            stale = 0
+            # snapshot: submit() may append from a request thread while
+            # the scheduler thread scans (in-place req mutation is fine,
+            # iterating a deque being appended to is not)
+            for req in list(self._queue):
+                if req.prefilled is not None:
+                    req.prefilled = None
+                    stale += 1
+            if stale:
+                self.metrics.counter(
+                    "serving/stale_imports_dropped_total",
+                    help="queued prefilled imports dropped on a weight "
+                         "update (recomputed by local prefill)").inc(stale)
         self._weights = (params, lora)
 
     def step(self, params, lora=None, greedy: bool = False) -> List[int]:

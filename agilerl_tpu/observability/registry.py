@@ -12,8 +12,10 @@ text exposition (:meth:`MetricsRegistry.prometheus_text`) for scrapers.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import threading
+import time as _time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 #: default latency-ish buckets (seconds): ~exponential 1ms .. 60s
@@ -178,6 +180,25 @@ class MetricsRegistry:
     def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
                   help: str = "") -> Histogram:
         return self._get_or_create(name, Histogram, buckets=buckets, help=help)
+
+    def timer(self, name: str, help: str = ""):
+        """Context manager accumulating the block's wall time into the
+        counter ``name`` (seconds) — the idiom behind the time-attribution
+        counters (``flywheel/learner_idle_s``, ``flywheel/decode_stall_s``,
+        ``pipeline/sync_wait_s``-style accounting): a counter, not a
+        histogram, because the question these answer is "how much of the
+        run was spent HERE", which is a sum."""
+        counter = self.counter(name, help=help)
+
+        @contextlib.contextmanager
+        def _timed():
+            t0 = _time.perf_counter()
+            try:
+                yield counter
+            finally:
+                counter.inc(_time.perf_counter() - t0)
+
+        return _timed()
 
     # -- events ------------------------------------------------------------
     def attach_sink(self, sink) -> None:

@@ -51,7 +51,6 @@ runtime before :meth:`ElasticPBTController.resume`.
 
 from __future__ import annotations
 
-import json
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
@@ -70,10 +69,13 @@ from agilerl_tpu.parallel.multihost import call_with_collective_timeout
 from agilerl_tpu.resilience.atomic import (
     TMP_DIR_SUFFIX,
     CorruptSnapshotError,
-    commit_dir,
     load_validated_pickle,
-    staged_pickle,
-    staged_write_bytes,
+)
+from agilerl_tpu.resilience.store import (
+    entry_seq,
+    gc_entries,
+    publish_entry,
+    read_manifest,
 )
 from agilerl_tpu.resilience.membership import (
     HeartbeatStore,
@@ -156,13 +158,6 @@ class IslandConfig:
         self.top_k = max(int(top_k), 1)
         self.every = int(every)
         self.keep_exports = max(int(keep_exports), 1)
-
-
-def _export_generation(name: str) -> int:
-    try:
-        return int(name[len(_EXPORT_PREFIX):])
-    except ValueError:
-        return -1
 
 
 class ElasticPBTController:
@@ -792,43 +787,29 @@ class ElasticPBTController:
         leaves = [np.asarray(l)[idx]
                   for l in jax.tree_util.tree_leaves(pop_host)]
         payload = {"leaves": leaves}
-        dest = self._island_dir(cfg.island_id) / \
-            f"{_EXPORT_PREFIX}{self.generation:08d}"
-        dest.parent.mkdir(parents=True, exist_ok=True)
-        tmp = dest.with_name(dest.name + TMP_DIR_SUFFIX)
-        if tmp.exists():
-            import shutil
-
-            shutil.rmtree(tmp)
-        tmp.mkdir(parents=True)
-        sha, nbytes = staged_pickle(tmp / "members.pkl", payload)
-        manifest = {
-            "island": cfg.island_id,
-            "generation": self.generation,
-            "members": int(k),
-            "member_ids": [int(self.member_ids[i]) for i in idx],
-            "fitness": [
-                float(self.fitness[i]) if np.isfinite(self.fitness[i]) else None
-                for i in idx
-            ],
-            "sha256": sha,
-            "bytes": nbytes,
-        }
-        staged_write_bytes(
-            tmp / "manifest.json", json.dumps(manifest, indent=2).encode()
+        # commit-dir protocol via the shared store helper (resilience/store
+        # .py) — the payload stays named members.pkl and the sha stays under
+        # "sha256" so existing exchange dirs and the FaultInjector's
+        # torn-island-export path_match keep working unchanged
+        dest = publish_entry(
+            self._island_dir(cfg.island_id),
+            f"{_EXPORT_PREFIX}{self.generation:08d}",
+            payload,
+            payload_name="members.pkl",
+            sha_key="sha256",
+            manifest_extra={
+                "island": cfg.island_id,
+                "generation": self.generation,
+                "members": int(k),
+                "member_ids": [int(self.member_ids[i]) for i in idx],
+                "fitness": [
+                    float(self.fitness[i]) if np.isfinite(self.fitness[i]) else None
+                    for i in idx
+                ],
+            },
         )
-        commit_dir(tmp, dest)
         # prune old exports (numeric order — lexicographic would misrank)
-        exports = sorted(
-            (d for d in dest.parent.iterdir()
-             if d.is_dir() and d.name.startswith(_EXPORT_PREFIX)
-             and not d.name.endswith(TMP_DIR_SUFFIX)),
-            key=lambda d: _export_generation(d.name),
-        )
-        for old in exports[:-cfg.keep_exports]:
-            import shutil
-
-            shutil.rmtree(old, ignore_errors=True)
+        gc_entries(dest.parent, _EXPORT_PREFIX, cfg.keep_exports)
         reg = self.registry
         reg.counter("elastic/migrations_exported_total").inc()
         reg.emit("island_export", island=cfg.island_id,
@@ -853,7 +834,10 @@ class ElasticPBTController:
                 (e for e in d.iterdir()
                  if e.is_dir() and e.name.startswith(_EXPORT_PREFIX)
                  and not e.name.endswith(TMP_DIR_SUFFIX)),
-                key=lambda e: _export_generation(e.name),
+                # same parser gc_entries orders by — the GC and the import
+                # walk must rank exports identically
+                key=lambda e: (-1 if entry_seq(e.name) is None
+                               else entry_seq(e.name)),
             )
             if not exports:
                 continue
@@ -862,8 +846,8 @@ class ElasticPBTController:
             if tag in self._imported:
                 continue
             try:
-                manifest = json.loads((latest / "manifest.json").read_text())
-            except (OSError, ValueError):
+                manifest = read_manifest(latest)
+            except CorruptSnapshotError:
                 continue  # unreadable manifest: treat as not-yet-committed
             try:
                 payload = load_validated_pickle(

@@ -206,30 +206,75 @@ def replicated(mesh: Mesh) -> NamedSharding:
 # --------------------------------------------------------------------------- #
 
 
-def make_sharded_grpo_step(agent, mesh: Mesh, plan=None):
-    """Place the agent's params/opt-state with GSPMD shardings IN PLACE and
-    return the sharded update fn — now a thin wrapper over the built-in GRPO
-    rule set (``parallel/plan.grpo_plan_for_mesh``); pass ``plan`` to resolve
-    through a custom :class:`~agilerl_tpu.parallel.plan.ShardingPlan`
-    instead. The update is the same pure function GRPO uses; sharding comes
-    entirely from rule-resolved placements and GSPMD's inserted collectives.
+def make_sharded_grpo_step(agent, mesh: Mesh, plan=None, place: bool = True):
+    """Place the agent's params/opt-state with GSPMD shardings IN PLACE
+    (via ``agent.to_mesh`` — one home for the rule-resolved placements) and
+    return the sharded update fn; pass ``plan`` to resolve through a custom
+    :class:`~agilerl_tpu.parallel.plan.ShardingPlan` instead of the
+    built-in GRPO rule set. The update is the same pure function GRPO uses;
+    sharding comes entirely from rule-resolved placements and GSPMD's
+    inserted collectives. Batch entries are placed generically by the
+    (dp, fsdp) data layout, so the staleness-corrected flywheel batches
+    (extra ``old_lp``-from-behavior + ``rho`` rows) shard the same way.
     (Prefer agent.to_mesh(mesh) + the normal learn() API; this builder
-    returns the raw update for benchmarking.)"""
-    from agilerl_tpu.parallel.plan import grpo_plan_for_mesh
-
-    if plan is None:
-        plan = grpo_plan_for_mesh(mesh)
-    agent.base_params = plan.place("params", agent.base_params, mesh)
-    agent.actor.params = plan.place("lora", agent.actor.params, mesh)
-    agent.reference.params = plan.place("lora", agent.reference.params, mesh)
-    agent.optimizer.opt_state = plan.place(
-        "optimizer", agent.optimizer.opt_state, mesh
-    )
+    returns the raw update for benchmarking.) ``place=False`` skips the
+    ``to_mesh`` call for an agent ALREADY placed on this mesh — re-placing
+    would clear its jit cache and force a full recompile."""
+    if place:
+        agent.to_mesh(mesh=mesh, plan=plan)
     update = agent.jit_fn("update", agent._update_fn)
-    bsh = batch_sharding(mesh)
+    bsh = batch_sharding(agent.mesh)
 
     def sharded_update(lora, opt_state, batch, clip, beta):
         batch = {k: jax.device_put(jnp.asarray(v), bsh) for k, v in batch.items()}
         return update(lora, opt_state, batch, clip, beta)
 
     return sharded_update
+
+
+def make_sharded_flywheel_step(agent, mesh: Optional[Mesh] = None, plan=None,
+                               rho_clip: float = 2.0):
+    """The flywheel learner pod's plan-compiled step: the SAME sharded
+    update as :func:`make_sharded_grpo_step`, driven by trajectory batches
+    carrying the BEHAVIOR epoch's logprob record. Mirrors
+    ``GRPO.learn_from_trajectory``'s decomposition exactly (the parity
+    test pins it): the clipped-surrogate anchor ``old_lp`` is the CURRENT
+    adapter's logprobs recomputed here via the agent's sharded logprob fn,
+    and the decode→learn staleness is corrected ONCE by ``rho =
+    min(exp(old_lp - behavior_lp), rho_clip)`` multiplying the pg term
+    (IMPALA's clipped behind-ness ratio between the learn-start policy and
+    the behavior epoch — see ``algorithms/grpo._grpo_loss_core``; anchoring
+    the ratio at ``behavior_lp`` AND multiplying by rho would double-count
+    the staleness). Returns ``step(lora, opt_state, batch, clip, beta)``
+    where ``batch`` carries
+    ``tokens / mask / loss_mask / behavior_lp / ref_lp / advantage``.
+    With neither ``mesh`` nor ``plan``, an agent already placed via
+    ``to_mesh`` keeps its existing placement AND its compiled executables
+    (no re-place, no jit-cache clear)."""
+    adopted = False
+    if mesh is None and plan is None:
+        mesh = getattr(agent, "mesh", None)
+        plan = getattr(agent, "sharding_plan", None)
+        adopted = mesh is not None or plan is not None
+    raw = make_sharded_grpo_step(agent, mesh, plan=plan, place=not adopted)
+    logprobs = agent.jit_fn("logprobs", agent._logprob_fn)
+    bsh = batch_sharding(agent.mesh)
+
+    def sharded_flywheel_update(lora, opt_state, batch, clip, beta):
+        # place the batch BEFORE the anchor forward — the extra logprob
+        # pass must run under the same (dp, fsdp) data layout as the
+        # update, not on compiler-placed host arrays (raw's device_put of
+        # already-placed arrays is a no-op)
+        batch = {k: jax.device_put(jnp.asarray(v), bsh)
+                 for k, v in batch.items()}
+        loss_mask = jnp.asarray(batch["loss_mask"], jnp.float32)
+        behavior = jnp.asarray(batch.pop("behavior_lp"),
+                               jnp.float32) * loss_mask
+        old_lp = logprobs(lora, batch["tokens"],
+                          batch["mask"]) * loss_mask
+        batch["old_lp"] = old_lp
+        batch["rho"] = jnp.minimum(jnp.exp(old_lp - behavior),
+                                   jnp.float32(rho_clip))
+        return raw(lora, opt_state, batch, clip, beta)
+
+    return sharded_flywheel_update

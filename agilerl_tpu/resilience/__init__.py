@@ -31,6 +31,14 @@ from agilerl_tpu.resilience.retry import (
     call_with_retries,
     with_retries,
 )
+from agilerl_tpu.resilience.store import (
+    CommitDirStore,
+    committed_entries,
+    gc_entries,
+    publish_entry,
+    read_entry,
+    read_manifest,
+)
 from agilerl_tpu.resilience.snapshot import (
     AsyncPytree,
     CheckpointManager,
@@ -54,6 +62,8 @@ __all__ = [
     "CorruptSnapshotError", "set_fault_hook",
     "atomic_write_bytes", "atomic_pickle", "commit_dir", "content_hash",
     "staged_write_bytes", "staged_pickle",
+    "CommitDirStore", "publish_entry", "read_entry", "read_manifest",
+    "committed_entries", "gc_entries",
     "capture_agent", "restore_agent",
     "capture_host_rng", "restore_host_rng",
     "capture_env_rng", "restore_env_rng",

@@ -1,5 +1,6 @@
 from agilerl_tpu.training.train_bandits import train_bandits
 from agilerl_tpu.training.train_elastic import train_elastic_pbt
+from agilerl_tpu.training.train_llm_online import finetune_llm_reasoning_online
 from agilerl_tpu.training.train_multi_agent_off_policy import train_multi_agent_off_policy
 from agilerl_tpu.training.train_multi_agent_on_policy import train_multi_agent_on_policy
 from agilerl_tpu.training.train_off_policy import train_off_policy
@@ -12,6 +13,7 @@ __all__ = [
     "train_offline",
     "train_bandits",
     "train_elastic_pbt",
+    "finetune_llm_reasoning_online",
     "train_multi_agent_off_policy",
     "train_multi_agent_on_policy",
 ]

@@ -16,11 +16,13 @@ an up-window. On a successful accelerator run the headline JSON line also
 carries the secondary metric + on-chip kernel validation in "extra_metrics".
 
 Env knobs: BENCH_MODE=grpo for the LLM metric; BENCH_MODE=pipeline / serving /
-fleet / anakin / elastic for the CPU A/B micro-benches (fleet: 1-replica vs
-2-replica ServingFleet on a repeated-prompt trace — composition cost +
-affinity hit rate; anakin: scan-resident generation engine vs the interop
-off-policy hot loop, per algorithm; elastic: MTTR under a scripted host
-kill + heartbeat steady-state overhead on the pod emulation); BENCH_POP/ENVS/ROLLOUT/
+fleet / flywheel / anakin / elastic for the CPU A/B micro-benches (fleet:
+1-replica vs 2-replica ServingFleet on a repeated-prompt trace — composition
+cost + affinity hit rate; flywheel: disaggregated online-GRPO flywheel vs the
+interleaved loop — rollout tokens/s + learner steps/s; anakin: scan-resident
+generation engine vs the interop off-policy hot loop, per algorithm; elastic:
+MTTR under a scripted host kill + heartbeat steady-state overhead on the pod
+emulation); BENCH_POP/ENVS/ROLLOUT/
 GENS and BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU
 attempt; BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
 """
@@ -523,6 +525,124 @@ def bench_fleet():
     }), flush=True)
 
 
+def bench_flywheel():
+    """CPU-backend A/B for the online GRPO flywheel (docs/flywheel.md): the
+    SAME model/env/recipe trained by (a) the interleaved single-process
+    loop (generate -> learn in lockstep, the finetune_llm_reasoning shape)
+    and (b) the disaggregated flywheel (rollout pod + learner pod
+    exchanging commit-dir stores, staleness budget 2, importance-corrected
+    learn). On one CPU core the pods timeshare, so this meters the
+    FLYWHEEL LAYER's cost (store round-trips, behavior-logprob capture,
+    rho correction) via rollout-tokens/s and learner steps/s — the decode-
+    never-blocks win itself needs separate hosts. Run with
+    BENCH_MODE=flywheel; knobs BENCH_FLY_STEPS / BENCH_FLY_DMODEL."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agilerl_tpu.algorithms.grpo import GRPO
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.llm.flywheel import (
+        LearnerPod, OnlineGRPOFlywheel, RolloutPod, TrajectoryStore,
+        WeightStore,
+    )
+    from agilerl_tpu.observability import MetricsRegistry
+    from agilerl_tpu.utils.llm_utils import CharTokenizer, ReasoningGym
+
+    backend = jax.default_backend()
+    n_steps = int(os.environ.get("BENCH_FLY_STEPS", 6))
+    d_model = int(os.environ.get("BENCH_FLY_DMODEL", 128))
+    tok = CharTokenizer()
+    cfg = M.GPTConfig(vocab_size=tok.vocab_size, n_layer=2, n_head=4,
+                      d_model=d_model, max_seq_len=128, dtype=jnp.float32)
+
+    def rows(n, seed):
+        rng = np.random.default_rng(seed)
+        return [{"question": f"{a}+{b}=", "answer": str(a + b)}
+                for a, b in rng.integers(0, 9, (n, 2))]
+
+    def make():
+        env = ReasoningGym(
+            rows(64, 0), rows(8, 1), tok,
+            reward_fn=lambda c, a, p: 0.1 * len(c)
+            + float(c.startswith(str(a))),
+            data_batch_size=4)
+        agent = GRPO(config=cfg, pad_token_id=tok.pad_token_id,
+                     eos_token_id=tok.eos_token_id, group_size=4,
+                     batch_size=16, max_output_tokens=16, seed=0)
+        return env, agent
+
+    # A: interleaved single-process loop (generate blocks learn and vice
+    # versa — the finetune_llm_reasoning shape)
+    env, agent = make()
+    prompts = env.reset()
+
+    def interleaved_step(prompts):
+        agent.set_reference_policy(env.num_epochs)
+        comp, cmask = agent.get_action(prompts)
+        ids, am = env.assemble_learn_batch(comp, cmask)
+        nxt, rewards = env.step(comp, cmask)
+        agent.learn((ids, am, rewards))
+        return nxt, int(np.asarray(cmask).sum())
+
+    prompts, _ = interleaved_step(prompts)  # warm the compile caches
+    t0 = time.perf_counter()
+    inter_tokens = 0
+    for _ in range(n_steps):
+        prompts, toks = interleaved_step(prompts)
+        inter_tokens += toks
+    inter_dt = time.perf_counter() - t0
+    inter_tps = inter_tokens / inter_dt
+    inter_sps = n_steps / inter_dt
+
+    # B: disaggregated flywheel (colocated emulation, staleness budget 2)
+    env2, agent2 = make()
+    reg = MetricsRegistry()
+    with tempfile.TemporaryDirectory() as d:
+        ws = WeightStore(os.path.join(d, "w"), metrics=reg)
+        ts = TrajectoryStore(os.path.join(d, "t"), metrics=reg)
+        learner = LearnerPod(agent2, ws, ts, max_staleness_epochs=2,
+                             metrics=reg)
+        rollout = RolloutPod(agent2, env2, ws, ts, metrics=reg)
+        fly = OnlineGRPOFlywheel(rollout, learner, metrics=reg)
+        fly.run(1)  # warm the compile caches
+        tok0 = reg.counter("flywheel/rollout_tokens_total").value
+        t0 = time.perf_counter()
+        fly.run(1 + n_steps)
+        fly_dt = time.perf_counter() - t0
+        fly_tokens = reg.counter("flywheel/rollout_tokens_total").value - tok0
+        fly_tps = fly_tokens / fly_dt
+        fly_sps = n_steps / fly_dt
+        stalls = reg.counter("flywheel/decode_stalls_total").value
+        dropped = reg.counter(
+            "flywheel/trajectories_dropped_stale_total").value
+    ratio = fly_tps / max(inter_tps, 1e-9)
+    log(f"bench_flywheel: interleaved {inter_tps:.0f} rollout-tokens/s "
+        f"{inter_sps:.2f} learn-steps/s vs flywheel {fly_tps:.0f} tok/s "
+        f"{fly_sps:.2f} steps/s ({ratio:.2f}x on one core; stalls "
+        f"{stalls:.0f}, dropped {dropped:.0f})")
+    print(json.dumps({
+        "metric": ("online-flywheel rollout tokens/sec, disaggregated "
+                   f"(staleness 2) vs interleaved GRPO ({n_steps} learn "
+                   "steps, group 4, colocated pods TIMESHARE one CPU core "
+                   "— vs_baseline meters flywheel-layer cost, not the "
+                   "decode-never-blocks win)"),
+        "value": round(fly_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(ratio, 3),
+        "interleaved_tokens_per_sec": round(inter_tps, 1),
+        "interleaved_learn_steps_per_sec": round(inter_sps, 3),
+        "flywheel_tokens_per_sec": round(fly_tps, 1),
+        "flywheel_learn_steps_per_sec": round(fly_sps, 3),
+        "decode_stalls": stalls,
+        "trajectories_dropped_stale": dropped,
+        "backend": backend,
+        "error": None,
+    }), flush=True)
+
+
 def bench_anakin():
     """CPU-backend A/B for the scan-native generation engine
     (docs/performance.md): per-algorithm env-steps/sec of the SCAN-RESIDENT
@@ -1001,6 +1121,8 @@ def child_main():
         bench_serving()
     elif mode == "fleet":
         bench_fleet()
+    elif mode == "flywheel":
+        bench_flywheel()
     elif mode == "anakin":
         bench_anakin()
     elif mode == "sharding":
@@ -1224,6 +1346,7 @@ def parent_main():
         else "pipelined off-policy hot-loop env-steps/sec" if mode == "pipeline"
         else "serving-tier continuous vs batch-sync tokens/sec" if mode == "serving"
         else "serving-fleet 2-replica vs 1-replica tokens/sec" if mode == "fleet"
+        else "flywheel vs interleaved GRPO rollout tokens/sec" if mode == "flywheel"
         else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
         else "sharding-plan resolution + 7B plan compile" if mode == "sharding"
         else "elastic PBT MTTR + heartbeat overhead" if mode == "elastic"
@@ -1231,8 +1354,8 @@ def parent_main():
     )
     errors = []
 
-    if mode in ("pipeline", "serving", "fleet", "anakin", "sharding",
-                "elastic"):
+    if mode in ("pipeline", "serving", "fleet", "flywheel", "anakin",
+                "sharding", "elastic"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
@@ -1254,7 +1377,7 @@ def parent_main():
             return 0
         print(json.dumps({
             "metric": metric, "value": 0,
-            "unit": ("tokens/sec" if mode in ("serving", "fleet")
+            "unit": ("tokens/sec" if mode in ("serving", "fleet", "flywheel")
                      else "ms/resolution" if mode == "sharding"
                      else "s (MTTR)" if mode == "elastic"
                      else "env-steps/sec"),

@@ -98,6 +98,14 @@ for arg in "$@"; do
       MARKER=(-m "fleet")
       SHARDS+=("tests/test_llm/test_fleet.py tests/test_resilience/test_membership.py")
       ;;
+    flywheel)
+      # fast path: the online GRPO flywheel (sync-mode equivalence gate,
+      # staleness drop policy, torn weight/trajectory publishes,
+      # fleet-routed rollouts + weight-epoch invalidation regressions,
+      # autoscale policy, entry point, sharded-step anchor parity)
+      MARKER=(-m "flywheel")
+      SHARDS+=("tests/test_llm/test_flywheel.py tests/test_llm/test_autoscale.py tests/test_train/test_train_llm_online.py tests/test_parallel/test_plan.py")
+      ;;
     *) SHARDS+=("$arg") ;;
   esac
 done

@@ -1,0 +1,216 @@
+"""Autoscaling policy for the serving fleet (PR 9's open follow-up):
+pure threshold decisions over the existing SLO telemetry, fake-clock
+cooldowns, and the scale_up/scale_down actuation on a real mini fleet."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.autoscale import AutoscalePolicy
+from agilerl_tpu.llm.fleet import ServingFleet
+from agilerl_tpu.observability import MetricsRegistry
+
+pytestmark = [pytest.mark.flywheel, pytest.mark.fleet]
+
+CFG = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+                  d_model=32, max_seq_len=256, dtype=jnp.float32)
+KW = dict(max_new_tokens=8, pad_id=0, eos_id=None, prompt_buckets=(32,),
+          slots=3, block_size=8, decode_chunk=4)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def signals(replicas=2, mean_backlog=0.0, p95=None, fleet_backlog=0.0,
+            shed_total=0.0):
+    return {"replicas": replicas, "mean_backlog": mean_backlog,
+            "max_backlog": mean_backlog, "fleet_backlog": fleet_backlog,
+            "p95_ttft_s": p95, "shed_total": shed_total}
+
+
+# --------------------------------------------------------------------------- #
+# pure decisions
+# --------------------------------------------------------------------------- #
+
+
+def test_decide_thresholds():
+    p = AutoscalePolicy(min_replicas=1, max_replicas=4, backlog_high=8,
+                        backlog_low=1, ttft_p95_high_s=0.5,
+                        shed_rate_high=3, metrics=MetricsRegistry())
+    assert p.decide(signals(mean_backlog=10)) == "up"       # queue depth
+    assert p.decide(signals(mean_backlog=1, p95=0.9)) == "up"  # TTFT breach
+    assert p.decide(signals(), shed_delta=3) == "up"        # shedding
+    assert p.decide(signals(mean_backlog=4)) is None        # in-band
+    assert p.decide(signals(mean_backlog=0.5)) == "down"    # sustained idle
+    # shedding or queued fleet work blocks down even when backlog is low
+    assert p.decide(signals(mean_backlog=0.5), shed_delta=1) is None
+    assert p.decide(signals(mean_backlog=0.5, fleet_backlog=2)) is None
+    # a breached SLO blocks down too (there is in-flight work)
+    assert p.decide(signals(mean_backlog=0.5, p95=0.9)) == "up"
+    # but a FROZEN p95 on a fully idle fleet (the count-bounded TTFT
+    # window never decays without traffic) neither pins the fleet hot
+    # nor blocks its scale-down
+    assert p.decide(signals(mean_backlog=0.0, p95=0.9)) == "down"
+
+
+def test_decide_respects_replica_bounds():
+    p = AutoscalePolicy(min_replicas=2, max_replicas=3,
+                        metrics=MetricsRegistry())
+    assert p.decide(signals(replicas=1)) == "up"             # below floor
+    assert p.decide(signals(replicas=3, mean_backlog=99)) is None  # at cap
+    assert p.decide(signals(replicas=2, mean_backlog=0)) is None   # at floor
+
+
+# --------------------------------------------------------------------------- #
+# cooldown actuation (fake clock, fake fleet)
+# --------------------------------------------------------------------------- #
+
+
+class FakeFleet:
+    def __init__(self, sig):
+        self.sig = dict(sig)
+        self.actions = []
+        self._next = 10
+
+    def slo_signals(self):
+        return dict(self.sig)
+
+    def scale_up(self):
+        self.actions.append("up")
+        self.sig["replicas"] += 1
+        self._next += 1
+        return self._next
+
+    def scale_down(self, rid):
+        self.actions.append(("down", rid))
+        self.sig["replicas"] -= 1
+
+    def least_loaded_replica(self):
+        return 3 if self.sig["replicas"] > 1 else None
+
+
+def test_apply_cooldowns_with_fake_clock():
+    clock = FakeClock()
+    p = AutoscalePolicy(max_replicas=8, backlog_high=8, up_cooldown_s=10,
+                        down_cooldown_s=60, clock=clock,
+                        metrics=MetricsRegistry())
+    fleet = FakeFleet(signals(replicas=2, mean_backlog=20))
+    assert p.apply(fleet) == ("up", 11)
+    assert p.apply(fleet) is None          # inside the up cooldown
+    clock.advance(11)
+    assert p.apply(fleet) == ("up", 12)    # cooldown elapsed
+    # load drains: down is its own (longer) cooldown line
+    fleet.sig["mean_backlog"] = 0.0
+    assert p.apply(fleet) == ("down", 3)
+    fleet.sig["replicas"] = 3
+    assert p.apply(fleet) is None          # down cooldown holds
+    clock.advance(61)
+    assert p.apply(fleet) == ("down", 3)
+
+
+def test_apply_shed_delta_triggers_up():
+    clock = FakeClock()
+    p = AutoscalePolicy(backlog_high=1e9, shed_rate_high=2, clock=clock,
+                        metrics=MetricsRegistry())
+    fleet = FakeFleet(signals(replicas=1, shed_total=0))
+    assert p.apply(fleet) is None          # first call just seeds the delta
+    fleet.sig["shed_total"] = 5.0          # 5 sheds since last look
+    assert p.apply(fleet) == ("up", 11)
+
+
+def test_shed_during_up_cooldown_not_swallowed():
+    """A cooldown-blocked apply must NOT consume the shed window — sheds
+    observed while the cooldown runs still trigger the scale-up once it
+    expires (shed traffic was refused, so backlog never shows it)."""
+    clock = FakeClock()
+    p = AutoscalePolicy(backlog_high=1e9, shed_rate_high=10,
+                        up_cooldown_s=10, clock=clock,
+                        metrics=MetricsRegistry())
+    fleet = FakeFleet(signals(replicas=1, shed_total=0))
+    assert p.apply(fleet) is None          # seed the window
+    fleet.sig["shed_total"] = 20.0
+    assert p.apply(fleet) == ("up", 11)    # shed-triggered up at t0
+    fleet.sig["shed_total"] = 70.0         # 50 more sheds during cooldown
+    clock.advance(5)
+    assert p.apply(fleet) is None          # blocked, window NOT consumed
+    clock.advance(6)                       # cooldown expired
+    assert p.apply(fleet) == ("up", 12)    # the blocked sheds still fire
+
+
+# --------------------------------------------------------------------------- #
+# real-fleet integration
+# --------------------------------------------------------------------------- #
+
+
+def test_shed_total_monotonic_across_retirement():
+    """A departed member's shed count folds into the fleet accumulator —
+    shed_total must not DROP on loss/retirement, or the autoscaler's delta
+    goes negative exactly when capacity shrank."""
+    fleet = ServingFleet(CFG, n_replicas=2, metrics=MetricsRegistry(), **KW)
+    rid = fleet.scale_up()
+    fleet._members[rid].gen.metrics.counter(
+        "serving/shed_requests_total").inc(50)
+    before = fleet.slo_signals()["shed_total"]
+    assert before >= 50
+    # killed-but-undetected window: history must not vanish either
+    fleet._members[rid].killed = True
+    assert fleet.slo_signals()["shed_total"] == before
+    fleet._members[rid].killed = False
+    fleet._members[rid].gen.metrics.counter("serving/requests_total").inc(9)
+    fleet._members[rid].gen.metrics.counter(
+        "serving/tokens_decoded_total").inc(123)
+    roll_before = fleet.latency_summary()["fleet"]
+    fleet.scale_down(rid)
+    assert fleet.slo_signals()["shed_total"] == before
+    # latency_summary's lifetime rollups must not run backwards either
+    roll_after = fleet.latency_summary()["fleet"]
+    for key in ("requests_total", "tokens_decoded_total",
+                "shed_requests_total"):
+        assert roll_after[key] == roll_before[key]
+
+
+def test_scale_down_releases_the_member():
+    """A planned retirement drops the member outright — an autoscaler
+    cycling up/down must not retain one dead generator (KV pool, jit
+    caches) per cycle."""
+    fleet = ServingFleet(CFG, n_replicas=1, metrics=MetricsRegistry(), **KW)
+    base = len(fleet._members)
+    for _ in range(3):
+        rid = fleet.scale_up()
+        fleet.scale_down(rid)
+    assert len(fleet._members) == base
+
+
+def test_autoscaler_grows_and_shrinks_a_real_fleet():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    fleet = ServingFleet(CFG, n_replicas=1, metrics=reg, **KW)
+    params = M.init_params(jax.random.PRNGKey(0), CFG)
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3, backlog_high=4,
+                             backlog_low=0.5, up_cooldown_s=0,
+                             down_cooldown_s=0, clock=clock, metrics=reg)
+    rng = np.random.default_rng(0)
+    for i in range(8):  # flood: backlog >> backlog_high on one replica
+        fleet.submit(rng.integers(3, 90, size=10).astype(np.int32),
+                     no_shed=True)
+    assert policy.apply(fleet)[0] == "up"
+    assert len(fleet.replica_ids) == 2
+    assert reg.counter("fleet/autoscale_up_total").value == 1
+    fleet.run_until_drained(params, greedy=True)
+    for t in list(fleet._results):
+        fleet.result(t)
+    assert policy.apply(fleet)[0] == "down"
+    assert len(fleet.replica_ids) == 1
+    # the floor holds: no further scale-down from min_replicas
+    assert policy.apply(fleet) is None

@@ -299,6 +299,70 @@ def test_plan_step_grad_parity_vs_make_sharded_grpo_step():
     assert a_sh.is_equivalent_to(NamedSharding(mesh, P("fsdp", None)), ndim=2)
 
 
+@pytest.mark.flywheel
+def test_flywheel_step_anchor_and_single_correction():
+    """make_sharded_flywheel_step mirrors learn_from_trajectory's
+    decomposition: the clipped-ratio anchor is the LEARN-START policy's
+    logprobs (recomputed, not the shipped behavior record) and the
+    staleness correction rho multiplies the pg term exactly once. At
+    staleness 0 the step is identical to make_sharded_grpo_step with the
+    on-policy anchor; a uniformly-stale behavior record scales the beta=0
+    loss by exactly exp(delta) — the behavior-anchored double correction
+    would clip the ratio instead."""
+    from agilerl_tpu.parallel.mesh import make_sharded_flywheel_step
+
+    mesh = make_mesh(dp=1, fsdp=4, tp=2)
+    kw = dict(config=CFG, pad_token_id=0, eos_token_id=1, group_size=2,
+              batch_size=8, seed=0)
+    agent = GRPO(**kw)
+    fly = make_sharded_flywheel_step(agent, mesh, rho_clip=2.0)
+    logprobs = agent.jit_fn("logprobs", agent._logprob_fn)
+    batch = _batch()
+    with mesh:
+        lp_cur = np.asarray(
+            logprobs(agent.actor.params, batch["tokens"], batch["mask"])
+            * batch["loss_mask"])
+
+    ref = GRPO(**kw)
+    ref_update = make_sharded_grpo_step(ref, mesh)
+    b_ref = dict(_batch())
+    b_ref["old_lp"] = jnp.asarray(lp_cur)  # the on-policy anchor
+    b_sync = dict(_batch())
+    b_sync.pop("old_lp")
+    b_sync["behavior_lp"] = jnp.asarray(lp_cur)  # staleness 0
+    with mesh:
+        _, _, f_loss, f_kl = fly(agent.actor.params,
+                                 agent.optimizer.opt_state, b_sync,
+                                 jnp.float32(0.2), jnp.float32(0.0))
+        _, _, r_loss, r_kl = ref_update(ref.actor.params,
+                                        ref.optimizer.opt_state, b_ref,
+                                        jnp.float32(0.2), jnp.float32(0.0))
+    np.testing.assert_allclose(float(f_loss), float(r_loss), rtol=1e-6)
+    np.testing.assert_allclose(float(f_kl), float(r_kl), rtol=1e-6,
+                               atol=1e-8)
+
+    # uniformly behind by 0.5 nats: rho = exp(0.5) < rho_clip on every
+    # masked token, ratio stays 1 at the anchor -> loss scales by exactly
+    # exp(0.5); the double correction would give clip(exp(0.5)) = 1.2
+    agent2 = GRPO(**kw)
+    fly2 = make_sharded_flywheel_step(agent2, mesh, rho_clip=2.0)
+    b_stale = dict(_batch())
+    b_stale.pop("old_lp")
+    b_stale["behavior_lp"] = jnp.asarray(lp_cur - 0.5)
+    with mesh:
+        _, _, s_loss, _ = fly2(agent2.actor.params,
+                               agent2.optimizer.opt_state, b_stale,
+                               jnp.float32(0.2), jnp.float32(0.0))
+    np.testing.assert_allclose(float(s_loss),
+                               float(np.exp(0.5)) * float(r_loss),
+                               rtol=1e-5)
+    # default args adopt an already-placed agent's mesh/plan WITHOUT
+    # re-placing (to_mesh clears the jit cache — a full recompile at scale)
+    placed_update = agent2.jit_fn("update", agent2._update_fn)
+    make_sharded_flywheel_step(agent2)
+    assert agent2.jit_fn("update", agent2._update_fn) is placed_update
+
+
 def test_plan_aot_lowering_carries_shardings():
     """compile_step_with_plan().lower over plan.abstract trees yields a
     module with real sharding annotations — the tpu_aot_compile.py /

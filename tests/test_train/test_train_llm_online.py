@@ -1,0 +1,174 @@
+"""The flywheel entry point: telemetry/resilience wiring, eval cadence,
+and kill-resume continuation of the learner epoch line."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.algorithms.grpo import GRPO
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.observability import MemorySink, MetricsRegistry, RunTelemetry
+from agilerl_tpu.training.train_llm_online import finetune_llm_reasoning_online
+from agilerl_tpu.utils.llm_utils import CharTokenizer, ReasoningGym
+
+pytestmark = pytest.mark.flywheel
+
+TOK = CharTokenizer()
+CFG = M.GPTConfig(vocab_size=TOK.vocab_size, n_layer=2, n_head=4, d_model=32,
+                  max_seq_len=64, dtype=jnp.float32)
+
+
+def reasoning_rows(n, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        {"question": f"{a}+{b}=", "answer": str(a + b)}
+        for a, b in rng.integers(0, 5, (n, 2))
+    ]
+
+
+def make_env():
+    return ReasoningGym(
+        reasoning_rows(16, 0), reasoning_rows(4, 1), TOK,
+        reward_fn=lambda c, a, p: 0.1 * len(c) + float(c.startswith(str(a))),
+        data_batch_size=4)
+
+
+def test_online_entry_point_runs_and_logs(tmp_path):
+    env = make_env()
+    agent = GRPO(config=CFG, pad_token_id=TOK.pad_token_id,
+                 eos_token_id=TOK.eos_token_id, group_size=2, batch_size=8,
+                 max_output_tokens=4, seed=0)
+    sink = MemorySink()
+    telem = RunTelemetry(registry=MetricsRegistry(sink=sink), lineage=False)
+    out, fitnesses = finetune_llm_reasoning_online(
+        agent, env, tmp_path, max_epochs=2, evaluation_interval=1,
+        max_staleness_epochs=0, verbose=False, telemetry=telem)
+    assert out is agent
+    assert len(fitnesses) == 2  # one eval per learner epoch at interval 1
+    losses = [e["train/loss"] for e in sink.events
+              if e["kind"] == "metrics" and "train/loss" in e]
+    assert len(losses) == 2 and all(np.isfinite(l) for l in losses)
+    reg = telem.registry
+    assert reg.counter("flywheel/learn_steps_total").value == 2
+    assert reg.counter("flywheel/trajectories_published_total").value == 2
+    assert reg.counter("flywheel/trajectories_consumed_total").value == 2
+    # the stores live under the workdir
+    assert (tmp_path / "weights").is_dir()
+    assert (tmp_path / "trajectories").is_dir()
+
+
+def test_online_resume_requires_resilience(tmp_path):
+    """resume=True without resilience= has no snapshot to define the epoch
+    line — it must fail fast, not drop-spin to max_ticks."""
+    agent = GRPO(config=CFG, pad_token_id=TOK.pad_token_id, seed=0)
+    with pytest.raises(ValueError, match="resume=True requires"):
+        finetune_llm_reasoning_online(
+            agent, make_env(), tmp_path, max_epochs=1, resume=True,
+            verbose=False)
+
+
+def test_fresh_run_on_reused_workdir_starts_clean(tmp_path):
+    """resume=False on a dirty workdir must purge the stores: a previous
+    run's newest epoch would out-number the fresh learner's, the rollout
+    pod would adopt the stale adapter, and every batch would drop with
+    negative lag until max_ticks."""
+    from agilerl_tpu.llm.flywheel import WeightStore
+
+    ws = WeightStore(tmp_path / "weights")
+    ws.publish(37, {"w": np.zeros(2, np.float32)})  # previous-run leftover
+    agent = GRPO(config=CFG, pad_token_id=TOK.pad_token_id,
+                 eos_token_id=TOK.eos_token_id, group_size=2, batch_size=8,
+                 max_output_tokens=4, seed=0)
+    _, fit = finetune_llm_reasoning_online(
+        agent, make_env(), tmp_path, max_epochs=1, evaluation_interval=1,
+        max_staleness_epochs=0, verbose=False)
+    assert len(fit) == 1
+    assert max(ws.epochs()) == 1  # stale epoch 37 purged, fresh line 0->1
+
+
+def test_online_resume_purges_precrash_store_state(tmp_path):
+    """Kill-resume continuation of the learner epoch line: a crash can
+    leave post-snapshot weight epochs and unconsumed trajectory batches in
+    the stores. Resume must purge both — otherwise actors adopt the
+    PRE-crash adapter (newer epoch number wins), last-K GC can collect the
+    restored re-publish as the oldest entry, and leftover batches train
+    with negative lag against the wrong weight line."""
+    from agilerl_tpu.llm.flywheel import (
+        TrajectoryBatch, TrajectoryStore, WeightStore)
+    from agilerl_tpu.resilience import Resilience
+
+    def make_agent():
+        return GRPO(config=CFG, pad_token_id=TOK.pad_token_id,
+                    eos_token_id=TOK.eos_token_id, group_size=2,
+                    batch_size=8, max_output_tokens=4, index=0, seed=0)
+
+    work = tmp_path / "run"
+    res = Resilience(tmp_path / "snaps", save_every=1, handle_signals=False)
+    agent, fit = finetune_llm_reasoning_online(
+        make_agent(), make_env(), work, max_epochs=2, evaluation_interval=1,
+        max_staleness_epochs=0, keep_weight_epochs=3, verbose=False,
+        resilience=res)
+    assert len(fit) == 2  # snapshots landed at done_epochs 1 and 2
+
+    # emulate the crash aftermath: post-snapshot epochs 3/4 and an
+    # unconsumed batch decoded under the pre-crash line
+    fake = {"w": np.zeros(4, np.float32)}
+    ws = WeightStore(work / "weights", keep_last=3)
+    ws.publish(3, fake)
+    ws.publish(4, fake)
+    ts = TrajectoryStore(work / "trajectories")
+    ts.publish(TrajectoryBatch(
+        seq=0, actor_id=0, weight_epoch=4, data_epoch=0,
+        ids=np.zeros((2, 4), np.int32), action_masks=np.ones((2, 3)),
+        rewards=np.zeros((1, 2)), behavior_lp=np.zeros((2, 3))))
+
+    # resume with max_epochs == restored done_epochs: the purge+republish
+    # runs, the training loop does not — the store state is inspectable
+    agent2 = make_agent()
+    res2 = Resilience(tmp_path / "snaps", save_every=1,
+                      handle_signals=False)
+    finetune_llm_reasoning_online(
+        agent2, make_env(), work, max_epochs=2, evaluation_interval=1,
+        max_staleness_epochs=0, keep_weight_epochs=3, verbose=False,
+        resilience=res2, resume=True)
+    assert ts.pending() == 0  # leftover batch cleared, never trained
+    epoch, lora = ws.load_latest()
+    assert epoch == 2 and max(ws.epochs()) == 2  # fake 3/4 truncated
+    for a, b in zip(jax.tree_util.tree_leaves(lora),
+                    jax.tree_util.tree_leaves(agent2.actor.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    # and the restored line continues the UNINTERRUPTED prompt stream: the
+    # resumed third epoch must match an unkilled 3-epoch reference (the
+    # snapshot carries the rollout pod's in-flight prompt batch — dropping
+    # it would re-reset the env, skip one batch, and diverge)
+    agent3 = make_agent()
+    res3 = Resilience(tmp_path / "snaps", save_every=1,
+                      handle_signals=False)
+    _, fit3 = finetune_llm_reasoning_online(
+        agent3, make_env(), work, max_epochs=3, evaluation_interval=1,
+        max_staleness_epochs=0, keep_weight_epochs=3, verbose=False,
+        resilience=res3, resume=True)
+    assert len(fit3) == 3 and max(ws.epochs()) == 3
+
+    res_ref = Resilience(tmp_path / "snaps_ref", save_every=1,
+                         handle_signals=False)
+    _, fit_ref = finetune_llm_reasoning_online(
+        make_agent(), make_env(), tmp_path / "ref", max_epochs=3,
+        evaluation_interval=1, max_staleness_epochs=0, keep_weight_epochs=3,
+        verbose=False, resilience=res_ref)
+    np.testing.assert_array_equal(np.asarray(fit_ref), np.asarray(fit3))
+
+
+def test_online_entry_point_mutation_guard(tmp_path):
+    from agilerl_tpu.hpo import Mutations
+
+    env = make_env()
+    agent = GRPO(config=CFG, pad_token_id=TOK.pad_token_id, seed=0)
+    bad = Mutations(no_mutation=0.5, architecture=0.5, parameters=0.0,
+                    activation=0.0, rl_hp=0.0)
+    with pytest.raises(AssertionError):
+        finetune_llm_reasoning_online(
+            agent, env, tmp_path, max_epochs=1, mutation=bad, verbose=False)
