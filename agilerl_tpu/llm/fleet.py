@@ -217,24 +217,30 @@ class KVTransferStore:
     loaded — the request is recomputed from its tokens instead, so a bad
     transfer can cost latency but never wrong tokens."""
 
-    def __init__(self, directory: Union[str, Path], metrics=None):
+    def __init__(self, directory: Union[str, Path], metrics=None,
+                 tracer=None):
         self._store = CommitDirStore(
             directory,
             torn_counter="fleet/torn_kv_transfers_total",
             torn_help="KV transfers skipped as torn/corrupt",
             warn_prefix="torn-kv-transfer",
             metrics=metrics,
+            tracer=tracer,
         )
         self.directory = self._store.directory
         self.metrics = self._store.metrics
 
     def export(self, name: str, payload: Dict[str, Any]) -> Path:
         """Atomically publish one transfer; returns the committed path. The
-        manifest carries the block-hash chain so routing provenance is
-        readable without unpickling the KV payload."""
-        final = self._store.publish(name, payload, manifest_extra={
+        manifest carries the block-hash chain (routing provenance) and the
+        exporting span's trace context — both readable without unpickling
+        the KV payload, so cross-process spans stitch off the manifest."""
+        extra: Dict[str, Any] = {
             "hashes": [h.hex() for h in payload.get("hashes", [])],
-        })
+        }
+        if payload.get("trace") is not None:
+            extra["trace"] = payload["trace"]
+        final = self._store.publish(name, payload, manifest_extra=extra)
         self.metrics.counter("fleet/kv_transfers_total",
                              help="prefill->decode KV transfers "
                                   "exported").inc()
@@ -267,6 +273,12 @@ class _FleetRequest:
     stage: str = "new"   # new|prefill_queue|transfer|decoding|done
     transfer: Optional[Path] = None
     dispatches: int = 0
+    #: root span of the request's trace (submit → ... → result) — manual
+    #: lifecycle, ended when the result is harvested
+    span: Any = None
+    #: the span covering the CURRENT decode dispatch; ended ok at finish,
+    #: ended with error status when the owning replica is lost
+    decode_span: Any = None
 
 
 @dataclasses.dataclass
@@ -331,6 +343,9 @@ class ServingFleet:
         sharding_plan=None,
         router: Optional[FleetRouter] = None,
         admission: Optional[AdmissionPolicy] = None,
+        tracer=None,
+        telemetry_dir: Optional[Union[str, Path]] = None,
+        telemetry_interval_s: float = 10.0,
         **gen_kwargs: Any,
     ):
         if topology not in ("unified", "disaggregated"):
@@ -344,6 +359,16 @@ class ServingFleet:
         self.config = config
         self.topology = topology
         self.metrics = metrics if metrics is not None else observability.get_registry()
+        self._tracer = tracer
+        #: cross-process telemetry plane: when set, every step() publishes
+        #: each member's registry (plus the fleet's) as a per-pod snapshot
+        #: through the commit-dir protocol, throttled to the interval — the
+        #: TelemetryAggregator's input (observability/export.py)
+        self._telemetry_dir = (Path(telemetry_dir)
+                               if telemetry_dir is not None else None)
+        self._telemetry_interval_s = float(telemetry_interval_s)
+        self._telemetry: Dict[str, Any] = {}
+        self._last_shed_span_s = float("-inf")  # shed-span 1/s throttle
         self.sharding_plan = sharding_plan
         self._gen_kwargs = dict(gen_kwargs)
         self.router = router if router is not None else FleetRouter(
@@ -362,7 +387,8 @@ class ServingFleet:
             HeartbeatStore(membership_dir, lease_timeout=lease_timeout,
                            registry=self.metrics, clock=clock)
             if membership_dir is not None else None)
-        self.store = (KVTransferStore(transfer_dir, metrics=self.metrics)
+        self.store = (KVTransferStore(transfer_dir, metrics=self.metrics,
+                                      tracer=tracer)
                       if transfer_dir is not None else None)
         self._members: Dict[int, _Member] = {}
         self._next_rid = 0
@@ -447,6 +473,14 @@ class ServingFleet:
         if not m.alive:
             return
         m.alive = False
+        # retire the member's telemetry publisher (rids are monotonic, so a
+        # cycling autoscaler would otherwise accumulate one publisher +
+        # retained dead registry per cycle — the PR 10 scale_down leak
+        # class); a final forced beat preserves its last state in the plane
+        pod = ("worker" if m.role == ROLE_PREFILL else "replica")
+        pub = self._telemetry.pop(f"{pod}_{m.rid}", None)
+        if pub is not None:
+            pub.publish(force=True)
         if m.role != ROLE_PREFILL:
             self._departed_sheds += float(
                 m.gen.metrics.counter("serving/shed_requests_total").value)
@@ -459,16 +493,37 @@ class ServingFleet:
                 "fleet_replica_lost", replica=m.rid, role=m.role,
                 rebalanced=len(lost_tickets),
                 affinity_dropped=dropped_affinity)
+        tr = self.tracer
         for ft in lost_tickets:
             fr = self._requests[ft]
             if fr.stage == "done":
                 continue
             fr.rid = None
             fr.replica_ticket = None
+            if fr.decode_span is not None:
+                if not graceful:
+                    # the decode dispatch died with the replica; a PLANNED
+                    # retirement re-dispatches too but is not an error —
+                    # the graceful path keeps the error channel clean,
+                    # exactly like replicas_lost_total
+                    fr.decode_span.set_error(f"replica {m.rid} lost")
+                fr.decode_span.end()
+                fr.decode_span = None
+            fail = None
+            if tr.enabled and not graceful:
+                # failover is an ANOMALY: always sampled (force), error
+                # status; the re-dispatch route/decode spans parent onto it
+                # so the recovery is causally linked to the loss
+                fail = tr.start_span(
+                    "fleet.failover", parent=fr.span, force=True,
+                    attributes={"replica": m.rid, "ticket": fr.ticket})
+                fail.set_error(f"replica {m.rid} lost; re-dispatching")
             self.metrics.counter(
                 "fleet/rebalanced_requests_total",
                 help="requests re-dispatched after replica loss").inc()
-            self._redispatch(fr)
+            self._redispatch(fr, parent=fail)
+            if fail is not None:
+                fail.end()
         self._update_replica_count()
 
     def kill_replica(self, rid: int, graceful: bool = False) -> None:
@@ -551,7 +606,7 @@ class ServingFleet:
         else:
             gen = ContinuousGenerator(
                 self.config, metrics=MetricsRegistry(), sharding_plan=plan,
-                **self._gen_kwargs)
+                tracer=self._tracer, **self._gen_kwargs)
         m = _Member(rid=rid, role=role, gen=gen)
         self._members[rid] = m
         return m
@@ -586,6 +641,37 @@ class ServingFleet:
             help="live serving replicas").set(len(serving))
         self.metrics.gauge("fleet/prefill_worker_count").set(
             len(self._prefill_members()))
+
+    # -- observability plane -------------------------------------------------
+    @property
+    def tracer(self):
+        """Distributed tracer (construction-time override, else the process
+        default — read lazily so late configuration still takes effect)."""
+        return (self._tracer if self._tracer is not None
+                else observability.get_tracer())
+
+    def _publish_telemetry(self) -> None:
+        """Publish each member's registry (and the fleet's) as a per-pod
+        snapshot through the shared commit-dir protocol; each publisher
+        throttles itself to ``telemetry_interval_s``."""
+        from agilerl_tpu.observability.export import TelemetryPublisher
+
+        clock = (self.heartbeats.clock if self.heartbeats is not None
+                 else time.time)
+        pods = [("fleet", self.metrics)]
+        for rid, m in self._members.items():
+            if m.alive and not m.killed:
+                prefix = ("worker" if m.role == ROLE_PREFILL else "replica")
+                pods.append((f"{prefix}_{rid}", m.gen.metrics))
+        for name, reg in pods:
+            pub = self._telemetry.get(name)
+            if pub is None:
+                pub = TelemetryPublisher(
+                    self._telemetry_dir, name, reg,
+                    interval_s=self._telemetry_interval_s, clock=clock,
+                    metrics=self.metrics, tracer=self._tracer)
+                self._telemetry[name] = pub
+            pub.publish()
 
     # -- submission / routing ----------------------------------------------
     def fits(self, n_rows: int, longest_prompt: int) -> bool:
@@ -624,6 +710,17 @@ class ServingFleet:
                             key=lambda r: (self._load_of(serving[r]), r))
                 fleet_reason = reasons[least]
             if fleet_reason is not None:
+                tr = self.tracer
+                now_s = time.perf_counter()
+                if tr.enabled and now_s - self._last_shed_span_s >= 1.0:
+                    # router-level shed: anomaly, always sampled — but
+                    # throttled to ~1/s (a shed storm is when this fires;
+                    # the shed counter/event stays exact)
+                    self._last_shed_span_s = now_s
+                    tr.start_span(
+                        "fleet.shed", force=True,
+                        attributes={"reason": fleet_reason,
+                                    "backlog": backlog}).end()
                 self.admission.shed(fleet_reason, source="router",
                                     backlog=backlog)
                 return None
@@ -642,6 +739,15 @@ class ServingFleet:
         fr = _FleetRequest(
             ticket=ticket, tokens=tokens, key=np.asarray(key, np.uint32),
             max_new=max_new, hashes=hashes, arrival_s=time.perf_counter())
+        tr = self.tracer
+        if tr.enabled:
+            # root span of the request's trace: submit → route → (prefill →
+            # KV transfer → import) → decode admission → result. Manual
+            # lifecycle — ended when step() harvests the result.
+            fr.span = tr.start_span(
+                "fleet.request",
+                attributes={"ticket": ticket,
+                            "prompt_tokens": int(tokens.size)})
         self._requests[ticket] = fr
         self._open += 1
         rid, affinity = self.router.route(fr.hashes, admittable)
@@ -652,6 +758,11 @@ class ServingFleet:
             # liveness are current)
             fr.stage = "prefill_queue"
             self._prefill_pending.append(fr)
+            if tr.enabled:
+                tr.start_span(
+                    "fleet.route", parent=fr.span,
+                    attributes={"stage": "prefill",
+                                "affinity": False}).end()
             self.metrics.emit("fleet_route", ticket=ticket, stage="prefill",
                               affinity=False)
         else:
@@ -659,17 +770,43 @@ class ServingFleet:
         return ticket
 
     def _dispatch_direct(self, fr: _FleetRequest, rid: int,
-                         affinity: bool) -> None:
-        """Hand a request to a serving replica (warm chains ride the
-        replica's own prefix cache; cold ones prefill locally)."""
+                         affinity: bool, parent: Any = None,
+                         submit=None, stage: Optional[str] = None) -> None:
+        """The ONE dispatch tail behind direct submits AND prefilled
+        imports (route/decode spans, replica submit, ticket/affinity/router
+        bookkeeping — shared so the two entry points cannot drift).
+
+        Direct path: warm chains ride the replica's own prefix cache; cold
+        ones prefill locally. ``parent`` overrides the span the
+        route/decode spans link under — the failover path passes its error
+        span so the re-dispatch is causally linked to the loss; the import
+        path passes its ``fleet.kv_import`` span. ``submit`` overrides the
+        replica call (``(gen, trace_ctx) -> replica ticket`` —
+        ``submit_prefilled`` for imports); ``stage`` tags the route
+        event."""
         m = self._members[rid]
         fr.rid, fr.stage = rid, "decoding"
         fr.dispatches += 1
-        # fr.hashes rides along (same bucket/block layout fleet-wide): the
-        # replica skips re-hashing the prompt at admission
-        fr.replica_ticket = m.gen.submit(
-            fr.tokens, max_new=fr.max_new, key=fr.key, no_shed=True,
-            hashes=fr.hashes)
+        tr = self.tracer
+        fr.decode_span = None
+        if tr.enabled:
+            link = parent if parent is not None else fr.span
+            tr.start_span(
+                "fleet.route", parent=link,
+                attributes={"replica": rid, "affinity": affinity,
+                            "dispatches": fr.dispatches}).end()
+            fr.decode_span = tr.start_span(
+                "fleet.decode", parent=link, attributes={"replica": rid})
+        ctx = (fr.decode_span.context()
+               if fr.decode_span is not None else None)
+        if submit is None:
+            # fr.hashes rides along (same bucket/block layout fleet-wide):
+            # the replica skips re-hashing the prompt at admission
+            fr.replica_ticket = m.gen.submit(
+                fr.tokens, max_new=fr.max_new, key=fr.key, no_shed=True,
+                hashes=fr.hashes, trace_ctx=ctx)
+        else:
+            fr.replica_ticket = submit(m.gen, ctx)
         m.tickets[fr.replica_ticket] = fr.ticket
         self.router.record(fr.hashes, rid)
         if affinity:
@@ -678,10 +815,11 @@ class ServingFleet:
                 help="requests routed to the replica owning their cached "
                      "prefix").inc()
         self.metrics.counter("fleet/routed_requests_total").inc()
+        extra = {} if stage is None else {"stage": stage}
         self.metrics.emit(
             "fleet_route", ticket=fr.ticket, replica=rid,
             affinity=affinity, dispatches=fr.dispatches,
-            load=self._load_of(m))
+            load=self._load_of(m), **extra)
 
     def _survivors(self) -> Dict[int, float]:
         """Serving replicas that can actually take work RIGHT NOW (alive
@@ -691,20 +829,24 @@ class ServingFleet:
                 for rid, m in self._serving_members(alive=True).items()
                 if not m.killed}
 
-    def _redispatch(self, fr: _FleetRequest) -> None:
+    def _redispatch(self, fr: _FleetRequest, parent: Any = None) -> None:
         """Dispatch a request straight to a serving replica, bypassing the
         prefill stage — the shared fallback for rebalance-after-loss, torn
         transfers, and no-prefill-capacity (all replay from the original
         tokens: no_shed, a ticketed request is a completion commitment;
         SLO shedding throttles NEW arrivals while the fleet re-forms).
-        With no survivors the request parks until :meth:`scale_up`."""
+        With no survivors the request parks until :meth:`scale_up`.
+        ``parent`` (a failover/torn-transfer anomaly span) causally links
+        the re-dispatch spans to the fault that forced it."""
         survivors = self._survivors()
         if not survivors:
             fr.stage = "parked"
             self._parked.append(fr)
+            if fr.span is not None:
+                fr.span.add_event("parked", reason="no survivors")
             return
         rid, affinity = self.router.route(fr.hashes, survivors)
-        self._dispatch_direct(fr, rid, affinity)
+        self._dispatch_direct(fr, rid, affinity, parent=parent)
 
     # -- the scheduler tick -------------------------------------------------
     def step(self, params, lora=None, greedy: bool = False) -> List[int]:
@@ -712,6 +854,8 @@ class ServingFleet:
         disaggregated prefill/transfer stages, then one decode chunk on
         every live replica. Returns fleet tickets finished this step."""
         self._poll_membership()
+        if self._telemetry_dir is not None:
+            self._publish_telemetry()
         if self.topology == "disaggregated":
             self._step_prefill(params, lora, greedy)
             self._step_imports()
@@ -726,6 +870,14 @@ class ServingFleet:
                 fr.stage = "done"
                 self._results[ft] = m.gen.result(rt)
                 self._open -= 1
+                if fr.decode_span is not None:
+                    fr.decode_span.end()
+                    fr.decode_span = None
+                if fr.span is not None:
+                    # the root span closes with the whole-request view
+                    fr.span.set_attribute("dispatches", fr.dispatches)
+                    fr.span.end()
+                    fr.span = None
                 finished.append(ft)
         return finished
 
@@ -740,13 +892,23 @@ class ServingFleet:
                 fr = self._prefill_pending.popleft()
                 self._redispatch(fr)
             return
+        tr = self.tracer
         for m in workers:
             if not self._prefill_pending:
                 break
             fr = self._prefill_pending.popleft()
+            psp = tr.start_span("fleet.prefill", parent=fr.span,
+                                attributes={"worker": m.rid})
             payload = m.gen.prefill(fr.tokens, fr.key, params, lora=lora,
                                     greedy=greedy, hashes=fr.hashes)
+            # the prefill span's context rides the transfer payload AND its
+            # manifest (KVTransferStore.export) so the decode side — this
+            # process or another — stitches its import span onto it
+            ctx = tr.inject(psp)
+            if ctx is not None:
+                payload["trace"] = ctx
             path = self.store.export(f"transfer_{fr.ticket:06d}", payload)
+            psp.end()
             fr.stage, fr.transfer = "transfer", path
             self._transfers.append(fr)
 
@@ -756,43 +918,60 @@ class ServingFleet:
         and the request recomputes from tokens on a replica's local
         prefill — wasted work, never wrong tokens."""
         pending, self._transfers = self._transfers, collections.deque()
+        tr = self.tracer
         for fr in pending:
             payload = self.store.load(fr.transfer)
             self.store.consume(fr.transfer)
             fr.transfer = None
             if payload is None:
-                self._redispatch(fr)
+                torn = None
+                if tr.enabled:
+                    # torn transfer: anomaly — always sampled, error status,
+                    # with the recompute dispatch causally linked under it
+                    torn = tr.start_span(
+                        "fleet.torn_transfer", parent=fr.span, force=True,
+                        attributes={"ticket": fr.ticket})
+                    torn.set_error(
+                        "torn KV transfer; recomputing from tokens")
+                self._redispatch(fr, parent=torn)
+                if torn is not None:
+                    torn.end()
                 continue
             candidates = self._survivors()
             if not candidates:
                 fr.stage = "parked"
                 self._parked.append(fr)
+                if fr.span is not None:
+                    fr.span.add_event("parked", reason="no survivors")
                 continue
             rid, affinity = self.router.route(fr.hashes, candidates)
-            m = self._members[rid]
-            fr.rid, fr.stage = rid, "decoding"
-            fr.dispatches += 1
-            fr.replica_ticket = m.gen.submit_prefilled(
-                payload["tokens"], k_prompt=payload["k"],
-                v_prompt=payload["v"], tok0=payload["tok0"],
-                done0=payload["done0"], key_next=payload["key_next"],
-                key=fr.key, max_new=fr.max_new, arrival_s=fr.arrival_s,
-                no_shed=True, hashes=fr.hashes)
-            m.tickets[fr.replica_ticket] = fr.ticket
-            self.router.record(fr.hashes, rid)
-            if affinity:
-                # two identical cold prompts racing through prefill: the
-                # second import lands where the first registered the chain
-                self.metrics.counter(
-                    "fleet/affinity_hits_total",
-                    help="requests routed to the replica owning their "
-                         "cached prefix").inc()
-            self.metrics.counter("fleet/routed_requests_total").inc()
+            # parent the import span on the context that RODE THE TRANSFER
+            # (manifest + payload) — that is what makes the trace stitch
+            # when prefill and decode run in different processes; the
+            # shared dispatch tail hangs its route/decode spans under it
+            isp = None
+            if tr.enabled:
+                isp = tr.start_span(
+                    "fleet.kv_import",
+                    parent=(payload.get("trace") or fr.span),
+                    attributes={"replica": rid})
+
+            def _submit_import(gen, ctx, payload=payload, fr=fr):
+                return gen.submit_prefilled(
+                    payload["tokens"], k_prompt=payload["k"],
+                    v_prompt=payload["v"], tok0=payload["tok0"],
+                    done0=payload["done0"], key_next=payload["key_next"],
+                    key=fr.key, max_new=fr.max_new, arrival_s=fr.arrival_s,
+                    no_shed=True, hashes=fr.hashes, trace_ctx=ctx)
+
+            # affinity here means two identical cold prompts raced through
+            # prefill and the second import lands where the first
+            # registered the chain (counted inside the shared tail)
+            self._dispatch_direct(fr, rid, affinity, parent=isp,
+                                  submit=_submit_import, stage="import")
+            if isp is not None:
+                isp.end()
             self.metrics.counter("fleet/kv_imports_total").inc()
-            self.metrics.emit(
-                "fleet_route", ticket=fr.ticket, replica=rid,
-                affinity=affinity, stage="import",
-                dispatches=fr.dispatches, load=self._load_of(m))
 
     # -- results ------------------------------------------------------------
     def result(self, ticket: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -81,7 +81,7 @@ class WeightStore:
     under the previous epoch instead of loading garbage."""
 
     def __init__(self, directory: Union[str, Path], keep_last: int = 4,
-                 metrics=None):
+                 metrics=None, tracer=None):
         self._store = CommitDirStore(
             directory,
             payload_name="weights.pkl",
@@ -91,19 +91,28 @@ class WeightStore:
             torn_help="weight epochs skipped as torn/corrupt",
             warn_prefix="torn-weight-epoch",
             metrics=metrics,
+            tracer=tracer,
         )
         self.directory = self._store.directory
         self.metrics = self._store.metrics
 
     def publish(self, epoch: int, lora: Any,
-                meta: Optional[Dict[str, Any]] = None) -> Path:
+                meta: Optional[Dict[str, Any]] = None,
+                trace_ctx: Optional[Dict[str, Any]] = None) -> Path:
         """Atomically publish one adapter epoch (host copies — device
         arrays are fetched here so a learner's donated buffers never leak
-        into the pickle)."""
+        into the pickle). ``trace_ctx`` (the publishing span's injected
+        context) rides the payload and manifest so an actor's adoption
+        span stitches onto the learn step that produced the epoch."""
         payload = {"epoch": int(epoch), "lora": jax.device_get(lora)}
+        if trace_ctx is not None:
+            payload["trace"] = trace_ctx
+        extra = {"epoch": int(epoch), **(meta or {})}
+        if trace_ctx is not None:
+            extra["trace"] = trace_ctx
         path = self._store.publish(
             f"{_EPOCH_PREFIX}{int(epoch):08d}", payload,
-            manifest_extra={"epoch": int(epoch), **(meta or {})})
+            manifest_extra=extra)
         self.metrics.counter(
             "flywheel/weight_epochs_published_total",
             help="adapter epochs published by learner pods").inc()
@@ -122,10 +131,18 @@ class WeightStore:
         """(epoch, adapter tree) of the newest LOADABLE epoch — torn
         entries are counted, warned about, and walked past (never loaded);
         None when nothing valid is committed yet."""
+        payload = self.load_latest_payload()
+        if payload is None:
+            return None
+        return int(payload["epoch"]), payload["lora"]
+
+    def load_latest_payload(self) -> Optional[Dict[str, Any]]:
+        """The newest loadable epoch's FULL payload (epoch, lora, and the
+        publisher's trace context when one rode along)."""
         for path in reversed(self._store.entries()):
             payload = self._store.load(path)
             if payload is not None:
-                return int(payload["epoch"]), payload["lora"]
+                return payload
         return None
 
     def truncate_above(self, epoch: int) -> int:
@@ -172,6 +189,10 @@ class TrajectoryBatch:
     #: ships one — the serving-tier envs derive the mask from pad ids,
     #: exactly like the interleaved loop's 3-tuple learn path.
     attention_mask: Optional[np.ndarray] = None
+    #: the rollout span's injected trace context: the learner's consume /
+    #: learn spans parent onto it, stitching the batch lifecycle across
+    #: the pod boundary
+    trace_ctx: Optional[Dict[str, Any]] = None
 
 
 class TrajectoryStore:
@@ -183,7 +204,8 @@ class TrajectoryStore:
     (``flywheel/torn_trajectories_total``) — a torn batch costs one group
     of rollouts, never a corrupted gradient."""
 
-    def __init__(self, directory: Union[str, Path], metrics=None):
+    def __init__(self, directory: Union[str, Path], metrics=None,
+                 tracer=None):
         self._store = CommitDirStore(
             directory,
             payload_name="trajectory.pkl",
@@ -192,22 +214,25 @@ class TrajectoryStore:
             torn_help="trajectory batches skipped as torn/corrupt",
             warn_prefix="torn-trajectory",
             metrics=metrics,
+            tracer=tracer,
         )
         self.directory = self._store.directory
         self.metrics = self._store.metrics
 
     def publish(self, batch: TrajectoryBatch) -> Path:
+        extra = {
+            "seq": int(batch.seq),
+            "actor_id": int(batch.actor_id),
+            "weight_epoch": int(batch.weight_epoch),
+            "data_epoch": int(batch.data_epoch),
+            "rows": int(np.asarray(batch.ids).shape[0]),
+            "prompt_hashes": list(batch.prompt_hashes),
+        }
+        if batch.trace_ctx is not None:
+            extra["trace"] = batch.trace_ctx
         path = self._store.publish(
             f"{_BATCH_PREFIX}{int(batch.actor_id):03d}_{int(batch.seq):08d}",
-            batch,
-            manifest_extra={
-                "seq": int(batch.seq),
-                "actor_id": int(batch.actor_id),
-                "weight_epoch": int(batch.weight_epoch),
-                "data_epoch": int(batch.data_epoch),
-                "rows": int(np.asarray(batch.ids).shape[0]),
-                "prompt_hashes": list(batch.prompt_hashes),
-            })
+            batch, manifest_extra=extra)
         self.metrics.counter(
             "flywheel/trajectories_published_total",
             help="trajectory batches published by rollout pods").inc()
@@ -288,6 +313,7 @@ class RolloutPod:
         metrics=None,
         fleet=None,
         autoscaler=None,
+        tracer=None,
     ):
         self.agent = agent
         self.env = env
@@ -296,6 +322,7 @@ class RolloutPod:
         self.actor_id = int(actor_id)
         self.metrics = (metrics if metrics is not None
                         else observability.get_registry())
+        self._tracer = tracer
         self.fleet = fleet
         self.autoscaler = autoscaler
         if fleet is not None:
@@ -303,6 +330,11 @@ class RolloutPod:
         self.weight_epoch = -1  # nothing adopted yet
         self.seq = 0
         self._prompts = None
+
+    @property
+    def tracer(self):
+        return (self._tracer if self._tracer is not None
+                else observability.get_tracer())
 
     def poll_weights(self) -> bool:
         """Adopt the newest loadable published epoch if it is newer than
@@ -313,10 +345,19 @@ class RolloutPod:
         latest = self.weight_store.latest_epoch()
         if latest is None or latest <= self.weight_epoch:
             return False
-        loaded = self.weight_store.load_latest()
-        if loaded is None or loaded[0] <= self.weight_epoch:
+        payload = self.weight_store.load_latest_payload()
+        if payload is None or int(payload["epoch"]) <= self.weight_epoch:
             return False
-        epoch, lora = loaded
+        epoch, lora = int(payload["epoch"]), payload["lora"]
+        tr = self.tracer
+        if tr.enabled:
+            # the adoption span parents onto the PUBLISHING learn step's
+            # context (rode the weight payload) — the cross-pod stitch of
+            # the weight half of the flywheel
+            tr.start_span(
+                "flywheel.adopt", parent=payload.get("trace"),
+                attributes={"actor": self.actor_id,
+                            "weight_epoch": int(epoch)}).end()
         lora = jax.tree_util.tree_map(jnp.asarray, lora)
         plan = getattr(self.agent, "sharding_plan", None)
         mesh = getattr(self.agent, "mesh", None)
@@ -349,25 +390,34 @@ class RolloutPod:
             self.autoscaler.apply(self.fleet)
         t0 = time.perf_counter()
         env, agent = self.env, self.agent
-        if self._prompts is None:
-            self._prompts = env.reset()
-        prompts = self._prompts
-        data_epoch = int(env.num_epochs)
-        completions, completion_mask = agent.get_action(
-            prompts, training=not greedy)
-        ids, action_masks = env.assemble_learn_batch(
-            completions, completion_mask)
-        behavior_lp = agent.behavior_logprobs(ids, action_masks)
-        next_prompts, rewards = env.step(completions, completion_mask)
-        self._prompts = next_prompts
-        batch = TrajectoryBatch(
-            seq=self.seq, actor_id=self.actor_id,
-            weight_epoch=self.weight_epoch, data_epoch=data_epoch,
-            ids=np.asarray(ids), action_masks=np.asarray(action_masks),
-            rewards=np.asarray(rewards), behavior_lp=behavior_lp,
-            prompt_hashes=_prompt_hashes(prompts))
-        self.seq += 1
-        self.traj_store.publish(batch)
+        tr = self.tracer
+        with tr.span("flywheel.rollout", actor=self.actor_id, seq=self.seq,
+                     weight_epoch=self.weight_epoch) as rsp:
+            if self._prompts is None:
+                self._prompts = env.reset()
+            prompts = self._prompts
+            data_epoch = int(env.num_epochs)
+            completions, completion_mask = agent.get_action(
+                prompts, training=not greedy)
+            ids, action_masks = env.assemble_learn_batch(
+                completions, completion_mask)
+            behavior_lp = agent.behavior_logprobs(ids, action_masks)
+            next_prompts, rewards = env.step(completions, completion_mask)
+            self._prompts = next_prompts
+            batch = TrajectoryBatch(
+                seq=self.seq, actor_id=self.actor_id,
+                weight_epoch=self.weight_epoch, data_epoch=data_epoch,
+                ids=np.asarray(ids), action_masks=np.asarray(action_masks),
+                rewards=np.asarray(rewards), behavior_lp=behavior_lp,
+                prompt_hashes=_prompt_hashes(prompts))
+            # existing provenance tags double as span attributes: the
+            # per-prompt sha1s and the epoch line the batch decoded under
+            rsp.set_attributes(data_epoch=data_epoch,
+                               prompt_sha1=list(batch.prompt_hashes))
+            self.seq += 1
+            with tr.span("flywheel.publish", seq=batch.seq) as psp:
+                batch.trace_ctx = tr.inject(psp)
+                self.traj_store.publish(batch)
         self.metrics.counter(
             "flywheel/rollout_tokens_total",
             help="completion tokens decoded by rollout pods").inc(
@@ -401,6 +451,7 @@ class LearnerPod:
         plan=None,
         mesh=None,
         publish_initial: bool = True,
+        tracer=None,
     ):
         if max_staleness_epochs < 0:
             raise ValueError("max_staleness_epochs must be >= 0")
@@ -412,6 +463,7 @@ class LearnerPod:
         self.importance_correction = bool(importance_correction)
         self.metrics = (metrics if metrics is not None
                         else observability.get_registry())
+        self._tracer = tracer
         if plan is not None or mesh is not None:
             agent.to_mesh(mesh=mesh, plan=plan)
         self.epoch = 0
@@ -430,8 +482,18 @@ class LearnerPod:
     def learn_calls(self) -> int:
         return len(self.trained_seqs)
 
+    @property
+    def tracer(self):
+        return (self._tracer if self._tracer is not None
+                else observability.get_tracer())
+
     def publish(self) -> None:
-        self.weight_store.publish(self.epoch, self.agent.actor.params)
+        tr = self.tracer
+        with tr.span("flywheel.weight_publish", epoch=self.epoch) as sp:
+            # the publish span's context rides the weight payload: the
+            # actor's adoption span stitches onto THIS learn step
+            self.weight_store.publish(self.epoch, self.agent.actor.params,
+                                      trace_ctx=tr.inject(sp))
         self.metrics.gauge(
             "flywheel/learner_weight_epoch",
             help="newest adapter epoch published by the learner").set(
@@ -466,7 +528,17 @@ class LearnerPod:
             # — pre-crash leftovers, or a foreign weight line) is just as
             # untrainable as over-budget lag: the behavior record doesn't
             # belong to any epoch this learner can correct against
+            tr = self.tracer
+            batch_ctx = getattr(b, "trace_ctx", None)
             if lag < 0 or lag > self.max_staleness_epochs:
+                if tr.enabled:
+                    # stale drop: anomaly — always sampled, parented onto
+                    # the rollout that produced the batch
+                    tr.start_span(
+                        "flywheel.drop_stale", parent=batch_ctx, force=True,
+                        attributes={"seq": int(b.seq), "lag": int(lag),
+                                    "max_staleness":
+                                        self.max_staleness_epochs}).end()
                 self.dropped_seqs.append(int(b.seq))
                 self.metrics.counter(
                     "flywheel/trajectories_dropped_stale_total",
@@ -477,24 +549,32 @@ class LearnerPod:
                     actor=int(b.actor_id), lag=int(lag),
                     max_staleness=self.max_staleness_epochs)
                 continue
-            # reference refresh rides the batch's dataset-epoch tag — the
-            # disaggregated analogue of set_reference_policy(env.num_epochs)
-            self.agent.set_reference_policy(int(b.data_epoch))
-            loss, kl = self.agent.learn_from_trajectory(
-                b.ids, b.action_masks, b.rewards, b.behavior_lp,
-                attention_mask=b.attention_mask,
-                rho_clip=(self.rho_clip if self.importance_correction
-                          else None))
-            self.agent.steps[-1] += int(np.asarray(b.rewards).size)
-            self.tokens_trained += int(np.asarray(b.ids).size)
-            self.losses.append(float(loss))
-            self.kls.append(float(kl))
-            self.trained_seqs.append(int(b.seq))
-            self.metrics.counter(
-                "flywheel/learn_steps_total",
-                help="importance-corrected learn steps executed").inc()
-            self.epoch += 1
-            self.publish()
+            with tr.span("flywheel.learn", parent=batch_ctx,
+                         seq=int(b.seq), actor=int(b.actor_id),
+                         lag=int(lag), weight_epoch=int(b.weight_epoch),
+                         data_epoch=int(b.data_epoch)) as lsp:
+                # reference refresh rides the batch's dataset-epoch tag —
+                # the disaggregated analogue of
+                # set_reference_policy(env.num_epochs)
+                self.agent.set_reference_policy(int(b.data_epoch))
+                loss, kl = self.agent.learn_from_trajectory(
+                    b.ids, b.action_masks, b.rewards, b.behavior_lp,
+                    attention_mask=b.attention_mask,
+                    rho_clip=(self.rho_clip if self.importance_correction
+                              else None))
+                self.agent.steps[-1] += int(np.asarray(b.rewards).size)
+                self.tokens_trained += int(np.asarray(b.ids).size)
+                self.losses.append(float(loss))
+                self.kls.append(float(kl))
+                self.trained_seqs.append(int(b.seq))
+                lsp.set_attribute("loss", self.losses[-1])
+                self.metrics.counter(
+                    "flywheel/learn_steps_total",
+                    help="importance-corrected learn steps executed").inc()
+                self.epoch += 1
+                # inside the learn span: the weight_publish span (and the
+                # trace context shipped with the epoch) parents onto it
+                self.publish()
         self._last_step_end = time.perf_counter()
         return consumed
 
@@ -515,7 +595,9 @@ class OnlineGRPOFlywheel:
     synchronous mode the equivalence gate runs."""
 
     def __init__(self, rollout: RolloutPod, learner: LearnerPod,
-                 max_inflight: Optional[int] = None, metrics=None):
+                 max_inflight: Optional[int] = None, metrics=None,
+                 telemetry_dir: Optional[Union[str, Path]] = None,
+                 telemetry_interval_s: float = 10.0):
         self.rollout = rollout
         self.learner = learner
         self.max_inflight = (int(max_inflight) if max_inflight is not None
@@ -524,6 +606,26 @@ class OnlineGRPOFlywheel:
             raise ValueError("max_inflight must be >= 1")
         self.metrics = (metrics if metrics is not None
                         else observability.get_registry())
+        self._last_stall_span_s = float("-inf")  # stall-span 1/s throttle
+        #: cross-process telemetry plane: per-pod snapshots of the rollout
+        #: and learner registries, merged fleet-wide by TelemetryAggregator
+        self._telemetry = []
+        if telemetry_dir is not None:
+            from agilerl_tpu.observability.export import TelemetryPublisher
+
+            pods = [(f"rollout_{rollout.actor_id}", rollout.metrics),
+                    ("learner", learner.metrics)]
+            seen = []
+            for name, reg in pods:
+                # colocated emulation: both pods may share one registry —
+                # publish it once, under the first pod name
+                if any(reg is r for _, r in seen):
+                    continue
+                seen.append((name, reg))
+                self._telemetry.append(TelemetryPublisher(
+                    telemetry_dir, name, reg,
+                    interval_s=float(telemetry_interval_s),
+                    metrics=self.metrics))
 
     def can_rollout(self) -> bool:
         return self.rollout.traj_store.pending() < self.max_inflight
@@ -533,6 +635,18 @@ class OnlineGRPOFlywheel:
         """Tick until the learner has published ``max_epochs`` weight
         epochs (i.e. executed that many learn steps past the initial
         publish)."""
+        try:
+            self._run_ticks(max_epochs, greedy, max_ticks)
+        finally:
+            # the final beat runs on EVERY exit — the failure paths (the
+            # not-converged RuntimeError, a pod raising mid-tick) are
+            # exactly when the aggregate's view of the end-state counters
+            # matters most for diagnosis
+            for pub in self._telemetry:
+                pub.publish(force=True)
+
+    def _run_ticks(self, max_epochs: int, greedy: bool,
+                   max_ticks: int) -> None:
         ticks = 0
         while self.learner.epoch < max_epochs:
             ticks += 1
@@ -540,8 +654,21 @@ class OnlineGRPOFlywheel:
                 raise RuntimeError(
                     f"flywheel not converged after {max_ticks} ticks "
                     f"(learner at epoch {self.learner.epoch}/{max_epochs})")
+            for pub in self._telemetry:
+                pub.publish()
             stalled = not self.can_rollout()
             if stalled:
+                tr = self.rollout.tracer
+                now_s = time.perf_counter()
+                if tr.enabled and now_s - self._last_stall_span_s >= 1.0:
+                    # a decode stall is an anomaly in "decode never blocks
+                    # on learn" — always sampled, but throttled to ~1/s
+                    # (the stall counter/timer stays exact)
+                    self._last_stall_span_s = now_s
+                    tr.start_span(
+                        "flywheel.decode_stall", force=True,
+                        attributes={"pending":
+                                    self.rollout.traj_store.pending()}).end()
                 self.metrics.counter(
                     "flywheel/decode_stalls_total",
                     help="ticks the rollout pod was gated by the "

@@ -602,6 +602,14 @@ class _Request:
     #: k/v [L, Pb, KV, hd], tok0, done0, key_next — admission scatters it
     #: into the pool instead of dispatching a local prefill
     prefilled: Optional[Dict[str, Any]] = None
+    #: distributed-tracing parent context (a SpanContext or injected dict)
+    #: — set by a fleet router so the decode-admission span stitches into
+    #: the fleet-level request trace
+    trace_ctx: Optional[Any] = None
+    #: the per-request root span a BARE generator opens when tracing is
+    #: configured and no upstream context was handed in (fleet-dispatched
+    #: requests carry trace_ctx instead; the fleet owns their lifecycle)
+    span: Any = None
 
 
 class ContinuousGenerator:
@@ -660,9 +668,11 @@ class ContinuousGenerator:
         sharding_plan=None,
         mesh=None,
         admission: Optional[AdmissionPolicy] = None,
+        tracer=None,
     ):
         self.config = config
         self.metrics = metrics if metrics is not None else observability.get_registry()
+        self._tracer = tracer
         # declarative serving layout: the paged pool is placed by the plan's
         # "kv" rules at allocation (kv-heads over tp; the pool has no batch
         # dim so (dp,fsdp) entries filter away), weights via place_params
@@ -730,6 +740,7 @@ class ContinuousGenerator:
         # this lock), but step()/run_until_drained()/generate() must be
         # driven by ONE scheduler thread — slot state is not locked.
         self._submit_lock = threading.Lock()
+        self._last_shed_span_s = float("-inf")  # shed-span 1/s throttle
         self.allocator = BlockAllocator(self.n_blocks)
         self._queue: "collections.deque[_Request]" = collections.deque()
         # shed decisions use a ROLLING window of recent TTFTs, not the
@@ -796,6 +807,18 @@ class ContinuousGenerator:
         return carry, (toks.T, emits.T)  # [slots, chunk]
 
     # -- host API ----------------------------------------------------------
+    @property
+    def tracer(self):
+        """The distributed tracer (construction-time override, else the
+        process default — read lazily so configuring tracing AFTER the
+        generator exists still takes effect)."""
+        return (self._tracer if self._tracer is not None
+                else observability.get_tracer())
+
+    @tracer.setter
+    def tracer(self, tracer) -> None:
+        self._tracer = tracer
+
     def fits(self, n_rows: int, longest_prompt: int) -> bool:
         """Row count is unbounded (the queue absorbs it); only the prompt
         must fit the bucket grid."""
@@ -805,7 +828,8 @@ class ContinuousGenerator:
                  key, no_shed: bool, hashes: Optional[List[bytes]],
                  arrival_s: Optional[float] = None,
                  prefilled: Optional[Dict[str, Any]] = None,
-                 shed_source: str = "generator") -> Optional[int]:
+                 shed_source: str = "generator",
+                 trace_ctx: Optional[Any] = None) -> Optional[int]:
         """The shared admission preamble behind :meth:`submit` and
         :meth:`submit_prefilled` — ONE home for bucket validation, the shed
         probe/record, budget clamping, ticket allocation, key defaulting,
@@ -819,6 +843,19 @@ class ContinuousGenerator:
         if not no_shed:
             reason = self._shed_reason()
             if reason is not None:
+                tr = self.tracer
+                now_s = time.perf_counter()
+                if tr.enabled and now_s - self._last_shed_span_s >= 1.0:
+                    # a shed is an ANOMALY: always sampled, even when
+                    # steady traffic isn't (force=True) — but a shed STORM
+                    # is exactly when admission control fires, so span
+                    # emission (a flushed JSONL write) is throttled to
+                    # ~1/s; the shed counter/event stays exact
+                    self._last_shed_span_s = now_s
+                    tr.start_span(
+                        "serving.shed", parent=trace_ctx, force=True,
+                        attributes={"reason": reason,
+                                    "source": shed_source}).end()
                 self.admission.shed(reason, queue_len=len(self._queue),
                                     source=shed_source)
                 return None
@@ -835,13 +872,24 @@ class ContinuousGenerator:
             self._next_ticket += 1
         if key is None:
             key = jax.random.PRNGKey(ticket)
+        span = None
+        if trace_ctx is None:
+            tr = self.tracer
+            if tr.enabled:
+                # bare-generator usage (no fleet upstream): this request IS
+                # the trace root; the generator ends it at _finish_slot
+                span = tr.start_span(
+                    "serving.request",
+                    attributes={"ticket": ticket,
+                                "prompt_tokens": int(tokens.size)})
+                trace_ctx = span.context()
         self._queue.append(_Request(
             ticket=ticket, tokens=tokens, key=np.asarray(key, np.uint32),
             max_new=budget,
             arrival_s=(float(arrival_s) if arrival_s is not None
                        else time.perf_counter()),
             hashes=list(hashes) if hashes is not None else None,
-            prefilled=prefilled))
+            prefilled=prefilled, trace_ctx=trace_ctx, span=span))
         self.metrics.histogram(
             "serving/queue_depth_rows", buckets=QUEUE_BUCKETS,
             help="rows in flight when a batch is admitted",
@@ -850,17 +898,21 @@ class ContinuousGenerator:
 
     def submit(self, tokens, *, max_new: Optional[int] = None, key=None,
                no_shed: bool = False,
-               hashes: Optional[List[bytes]] = None) -> Optional[int]:
+               hashes: Optional[List[bytes]] = None,
+               trace_ctx: Optional[Any] = None) -> Optional[int]:
         """Enqueue one request; returns a ticket, or None when admission
         control sheds it (queue overflow / TTFT SLO breach / free-block
         watermark). ``no_shed`` bypasses shedding — the training-rollout
         mode, where dropping a rollout would corrupt the learn batch.
         ``hashes`` lets a router that already computed the prompt's block
         chain (at THIS generator's bucket/block layout) skip the re-hash at
-        admission."""
+        admission. ``trace_ctx`` parents the decode-admission span onto an
+        upstream (fleet-level) trace; without one, a configured tracer
+        opens a per-request root span instead."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         return self._enqueue(tokens, max_new=max_new, key=key,
-                             no_shed=no_shed, hashes=hashes)
+                             no_shed=no_shed, hashes=hashes,
+                             trace_ctx=trace_ctx)
 
     def submit_prefilled(
         self,
@@ -876,6 +928,7 @@ class ContinuousGenerator:
         arrival_s: Optional[float] = None,
         no_shed: bool = False,
         hashes: Optional[List[bytes]] = None,
+        trace_ctx: Optional[Any] = None,
     ) -> Optional[int]:
         """Enqueue a request whose prompt KV was already computed by a
         prefill worker (the disaggregated topology's decode-side entry).
@@ -920,7 +973,7 @@ class ContinuousGenerator:
         return self._enqueue(
             tokens, max_new=max_new, key=key, no_shed=no_shed,
             hashes=hashes, arrival_s=arrival_s,
-            shed_source="decode_import",
+            shed_source="decode_import", trace_ctx=trace_ctx,
             prefilled=dict(
                 k=np.asarray(k_prompt), v=np.asarray(v_prompt),
                 tok0=int(tok0), done0=bool(done0),
@@ -1058,6 +1111,21 @@ class ContinuousGenerator:
             self.metrics.histogram(
                 "serving/queue_wait_s", buckets=QUEUE_WAIT_BUCKETS,
                 help="submit-to-admission wait").observe(now - req.arrival_s)
+            if req.trace_ctx is not None:
+                tr = self.tracer
+                if tr.enabled:
+                    # the decode-admission hop of the request trace (instant
+                    # span: the admission decision, not the decode itself)
+                    tr.start_span(
+                        "serving.admit", parent=req.trace_ctx,
+                        attributes={
+                            "slot": slot,
+                            "path": ("prefix_hit" if shared is not None
+                                     else "import"
+                                     if req.prefilled is not None
+                                     else "prefill"),
+                            "queue_wait_s": now - req.arrival_s,
+                        }).end()
             self._ensure_pool()
             plen = int(mask_row.sum())
             table = np.zeros(self.max_blocks, np.int32)
@@ -1220,6 +1288,11 @@ class ContinuousGenerator:
         self._results[req.ticket] = (toks, emits)
         self.metrics.counter("serving/tokens_decoded_total").inc(
             int(emits.sum()))
+        if req.span is not None:
+            # bare-generator root span: the request is complete
+            req.span.set_attribute("tokens_emitted", int(emits.sum()))
+            req.span.end()
+            req.span = None
         self.allocator.release_shared(self._slot_shared[slot])
         self.allocator.free(self._slot_private[slot])
         self._slot_shared[slot] = []

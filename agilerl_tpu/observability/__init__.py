@@ -1,11 +1,18 @@
 """Unified telemetry: metrics registry, JSONL events, step/MFU timelines,
-evolution lineage, serving latency histograms (see docs/observability.md)."""
+evolution lineage, serving latency histograms, distributed tracing, and the
+cross-process telemetry plane (see docs/observability.md)."""
 
 from agilerl_tpu.observability.events import (
     JsonlSink,
     MemorySink,
     NullSink,
     read_jsonl,
+)
+from agilerl_tpu.observability.export import (
+    TelemetryAggregator,
+    TelemetryPublisher,
+    TelemetrySchemaError,
+    merge_histogram_dumps,
 )
 from agilerl_tpu.observability.facade import (
     RunTelemetry,
@@ -25,6 +32,18 @@ from agilerl_tpu.observability.timeline import (
     StepTimeline,
     device_memory_stats,
 )
+from agilerl_tpu.observability.trace import (
+    Span,
+    SpanContext,
+    Tracer,
+    configure_tracer,
+    current_span,
+    export_perfetto,
+    get_tracer,
+    set_tracer,
+    span_records,
+    trace_tree,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -32,4 +51,9 @@ __all__ = [
     "StepTimeline", "PhaseTimer", "device_memory_stats",
     "LineageTracker",
     "RunTelemetry", "init_run_telemetry", "get_registry", "warn_once",
+    "Tracer", "Span", "SpanContext", "get_tracer", "set_tracer",
+    "configure_tracer", "current_span", "export_perfetto", "span_records",
+    "trace_tree",
+    "TelemetryPublisher", "TelemetryAggregator", "TelemetrySchemaError",
+    "merge_histogram_dumps",
 ]

@@ -40,7 +40,12 @@ def _jsonable(v: Any) -> Any:
 
 def _resume_seq(path: str) -> int:
     """Continue the monotone ``seq`` past an existing file's last event —
-    appending a second run must not restart at 0 (consumers order on seq)."""
+    appending a second run must not restart at 0 (consumers order on seq).
+
+    The FINAL line may be torn (a crash mid-write leaves a truncated tail;
+    append-mode JSONL tolerates that by design), so the scan walks
+    BACKWARDS through the tail window to the last *parseable* event — a
+    torn tail must not reset seq to 0 and break the monotone contract."""
     try:
         with open(path, "rb") as fh:
             fh.seek(0, 2)
@@ -48,8 +53,13 @@ def _resume_seq(path: str) -> int:
             if size == 0:
                 return 0
             fh.seek(max(0, size - 65536))
-            last = fh.read().splitlines()[-1]
-        return int(json.loads(last)["seq"]) + 1
+            lines = fh.read().splitlines()
+        for last in reversed(lines):
+            try:
+                return int(json.loads(last)["seq"]) + 1
+            except (ValueError, KeyError, TypeError):
+                continue
+        return 0
     except (OSError, ValueError, KeyError, IndexError, TypeError):
         return 0
 
@@ -62,6 +72,18 @@ class JsonlSink:
         self.path = str(path)
         self._seq = _resume_seq(self.path)
         self._fh = open(self.path, "a", encoding="utf-8")
+        # a torn final line (no trailing newline — crash mid-write) must
+        # not absorb the first appended record into its garbage: resume
+        # appending on a fresh line
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(0, 2)
+                if fh.tell() > 0:
+                    fh.seek(-1, 2)
+                    if fh.read(1) != b"\n":
+                        self._fh.write("\n")
+        except OSError:  # pragma: no cover - exotic filesystems
+            pass
         self._lock = threading.Lock()
 
     def emit(self, kind: str, payload: Dict[str, Any]) -> None:
@@ -142,11 +164,21 @@ class NullSink:
 
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:
-    """Load a JSONL event file (skipping blank lines)."""
+    """Load a JSONL event file, skipping blank AND unparseable lines.
+
+    Torn lines are possible BY DESIGN (a crash mid-write truncates the
+    tail; the restarted sink keeps it and appends on a fresh line), so the
+    post-crash reconstruction workflow — ``span_records(read_jsonl(...))``
+    → ``export_perfetto`` — must read past them, not raise on the exact
+    file the crash tooling exists for. Every parseable event is returned."""
     out = []
     with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except ValueError:
+                continue  # torn/garbage line: tolerated by design
     return out

@@ -30,6 +30,11 @@ TELEMETRY_ENV = "AGILERL_TPU_TELEMETRY"
 #: per-env-step loops with a JsonlSink should raise this — each step event
 #: is a flushed disk write. 0 disables step events; aggregates stay exact.
 STEP_EVERY_ENV = "AGILERL_TPU_TELEMETRY_STEP_EVERY"
+#: env var: distributed-tracing sample rate (a float in [0, 1]; 0 =
+#: anomaly-only — forced spans still record). Requires a live JSONL sink
+#: (``AGILERL_TPU_TELEMETRY`` or an explicit ``jsonl_path``): spans ride
+#: the same event stream. Unset = tracing stays a no-op.
+TRACE_ENV = "AGILERL_TPU_TRACE"
 
 _default_registry = MetricsRegistry()
 
@@ -70,6 +75,7 @@ class RunTelemetry:
         model_config=None,
         step_event_every: Optional[int] = None,
         project: str = "agilerl-tpu",
+        trace: Optional[float] = None,
     ):
         if step_event_every is None:
             step_event_every = int(os.environ.get(STEP_EVERY_ENV, "1") or 1)
@@ -100,6 +106,29 @@ class RunTelemetry:
         self.timeline = StepTimeline(
             self.registry, name=name, model_config=model_config,
             step_event_every=step_event_every)
+        # -- distributed tracing: spans ride the run's event sink. The
+        # configured tracer is ALSO installed as the process default so
+        # tracer-less components (fleet replicas, flywheel pods, elastic
+        # controllers) pick it up through trace.get_tracer(); close()
+        # restores the previous default.
+        if trace is None:
+            env_rate = os.environ.get(TRACE_ENV)
+            if env_rate:
+                trace = float(env_rate)
+        self.tracer = None
+        self._prev_tracer = None
+        # trace=0.0 is a VALID configuration (anomaly-only: forced spans
+        # still record) — only None/False leave tracing off
+        if trace is not None and trace is not False:
+            from agilerl_tpu.observability.trace import Tracer, set_tracer
+
+            rate = 1.0 if trace is True else float(trace)
+            sink = self.registry.sink
+            if sink is not None and not isinstance(sink, NullSink):
+                self.tracer = Tracer(sink=sink, sample_rate=rate,
+                                     pod=f"{name}-{os.getpid()}",
+                                     metrics=self.registry)
+                self._prev_tracer = set_tracer(self.tracer)
         self._wandb = None
         if wb:
             from agilerl_tpu.utils.utils import init_wandb
@@ -159,6 +188,14 @@ class RunTelemetry:
         if self._closed:
             return
         self._closed = True
+        if self.tracer is not None:
+            from agilerl_tpu.observability import trace as _trace
+
+            # only restore if this run's tracer is still the default (a
+            # later run may have installed its own — don't clobber it)
+            if _trace.get_tracer() is self.tracer:
+                _trace.set_tracer(self._prev_tracer)
+            self.tracer = None
         if self.lineage is not None:
             if lineage_path:
                 self.lineage.dump(lineage_path)

@@ -157,19 +157,37 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._sink = sink
         self._warned: set = set()
+        #: sanitized Prometheus name -> original name (collision guard)
+        self._sanitized: Dict[str, str] = {}
 
     # -- instruments -------------------------------------------------------
     def _get_or_create(self, name: str, cls, **kwargs):
+        collision: Optional[str] = None
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = cls(name, **kwargs)
                 self._metrics[name] = m
+                pname = _sanitize(name)
+                other = self._sanitized.setdefault(pname, name)
+                if other != name:
+                    collision = other
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as "
                     f"{type(m).__name__}, not {cls.__name__}")
-            return m
+        if collision is not None:
+            # outside the lock: warn_once re-enters it. Two DISTINCT metric
+            # names sanitizing to one Prometheus name would silently merge
+            # in prometheus_text() — scrapers would see two series under
+            # one name and aggregate garbage
+            self.warn_once(
+                f"sanitize-collision:{name}",
+                f"metric names {collision!r} and {name!r} both sanitize to "
+                f"Prometheus name {_sanitize(name)!r}; their exposition "
+                "lines will collide — rename one of them",
+                first=collision, second=name)
+        return m
 
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get_or_create(name, Counter, help=help)
@@ -239,6 +257,29 @@ class MetricsRegistry:
         out: Dict[str, Any] = {}
         for name, m in self._items():
             out[name] = m.summary() if isinstance(m, Histogram) else m.value
+        return out
+
+    def dump(self) -> Dict[str, Any]:
+        """FULL-resolution state for the cross-process telemetry plane
+        (``observability/export.py``): counters/gauges as raw values,
+        histograms as ``{bounds, counts, sum, count}`` — the mergeable
+        form (percentile summaries cannot be merged exactly; raw bucket
+        counts can, bucket-wise)."""
+        out: Dict[str, Any] = {"counters": {}, "gauges": {},
+                               "histograms": {}}
+        for name, m in self._items():
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            elif isinstance(m, Histogram):
+                with m._lock:
+                    out["histograms"][name] = {
+                        "bounds": list(m.bounds),
+                        "counts": list(m._counts),
+                        "sum": m._sum,
+                        "count": m._count,
+                    }
         return out
 
     def prometheus_text(self) -> str:

@@ -218,6 +218,7 @@ class ElasticPBTController:
         registry=None,
         clock=time.time,
         manager: Optional[CheckpointManager] = None,
+        tracer=None,
     ):
         if restore_from not in ("best", "latest"):
             raise ValueError(
@@ -249,6 +250,7 @@ class ElasticPBTController:
         #: unkilled run when the kill lands on a snapshot boundary)
         self.restore_from = restore_from
         self._registry_override = registry
+        self._tracer = tracer
 
         if hosts is None:
             hosts = make_emulated_hosts(
@@ -306,6 +308,16 @@ class ElasticPBTController:
         from agilerl_tpu.observability import get_registry
 
         return get_registry()
+
+    @property
+    def tracer(self):
+        """Distributed tracer (construction-time override, else the process
+        default — read lazily so late configuration still takes effect)."""
+        if self._tracer is not None:
+            return self._tracer
+        from agilerl_tpu.observability import get_tracer
+
+        return get_tracer()
 
     def live_hosts(self) -> List[EmulatedHost]:
         return [h for h in self.hosts if h.alive]
@@ -509,24 +521,34 @@ class ElasticPBTController:
     def _recover(self, event: MembershipEvent) -> None:
         t0 = time.perf_counter()
         reg = self.registry
-        if not self.live_devices():
-            # raise BEFORE any resize math (a 0-device target would divide
-            # by zero) so callers catching MembershipChange get the clean
-            # all-hosts-lost signal
-            raise MembershipChange(
-                "all hosts lost — no live devices to re-form the mesh",
-                lost=event.lost, alive=event.alive,
-            )
-        dead_slots = self._dead_slots()
-        restored = self._restore_slots(dead_slots) if dead_slots else 0
-        P = len(self.member_ids)
-        target = self._target_pop_for(len(self.live_devices()))
-        if target < P:
-            self._shrink_to(target)
-        elif target > P:
-            self._grow_to(target)
-        self._rebuild_generation()
-        dt = time.perf_counter() - t0
+        # recovery is ALWAYS sampled (force): it is the anomaly path, and
+        # host loss is recorded as an error-status span even though the
+        # recovery itself succeeds — the fault is the thing being traced
+        with self.tracer.span("elastic.recovery", force=True,
+                              generation=self.generation,
+                              lost=list(event.lost),
+                              joined=list(event.joined)) as rsp:
+            if event.lost:
+                rsp.set_error(f"host loss: {sorted(event.lost)}")
+            if not self.live_devices():
+                # raise BEFORE any resize math (a 0-device target would
+                # divide by zero) so callers catching MembershipChange get
+                # the clean all-hosts-lost signal
+                raise MembershipChange(
+                    "all hosts lost — no live devices to re-form the mesh",
+                    lost=event.lost, alive=event.alive,
+                )
+            dead_slots = self._dead_slots()
+            restored = self._restore_slots(dead_slots) if dead_slots else 0
+            P = len(self.member_ids)
+            target = self._target_pop_for(len(self.live_devices()))
+            if target < P:
+                self._shrink_to(target)
+            elif target > P:
+                self._grow_to(target)
+            self._rebuild_generation()
+            dt = time.perf_counter() - t0
+            rsp.set_attributes(restored=restored, recovery_time_s=dt)
         reg.counter("resilience/recoveries_total").inc()
         reg.gauge("resilience/recovery_time_s").set(dt)
         reg.counter("elastic/members_restored_total").inc(restored)
@@ -622,6 +644,11 @@ class ElasticPBTController:
         return None
 
     def _shrink_to(self, n: int) -> None:
+        with self.tracer.span("elastic.resize", op="shrink",
+                              generation=self.generation):
+            self._shrink_to_impl(n)
+
+    def _shrink_to_impl(self, n: int) -> None:
         P = len(self.member_ids)
         k = P - int(n)
         fit = np.nan_to_num(self.fitness, nan=-np.inf)
@@ -646,23 +673,33 @@ class ElasticPBTController:
                  evicted=evicted_ids, pop=len(self.member_ids))
 
     def _grow_to(self, n: int) -> None:
+        with self.tracer.span("elastic.resize", op="grow",
+                              generation=self.generation):
+            self._grow_to_impl(n)
+
+    def _grow_to_impl(self, n: int) -> None:
         P = len(self.member_ids)
         k = int(n) - P
         fit = np.nan_to_num(self.fitness, nan=-np.inf)
         reg = self.registry
         lineage = self._lineage()
+        tr = self.tracer
         clones: List[PyTree] = []
         clone_records = []
         for _ in range(k):
-            entrants = self._np_rng.choice(
-                P, size=min(self.resize_tournament_size, P), replace=False
-            )
-            parent = int(entrants[int(np.argmax(fit[entrants]))])
+            with tr.span("elastic.tournament",
+                         size=min(self.resize_tournament_size, P)):
+                entrants = self._np_rng.choice(
+                    P, size=min(self.resize_tournament_size, P), replace=False
+                )
+                parent = int(entrants[int(np.argmax(fit[entrants]))])
             self._key, k_mut, k_member = jax.random.split(self._key, 3)
             member = jax.tree_util.tree_map(
                 lambda x, p=parent: x[p:p + 1], self.pop
             )
-            clones.append(self._mutate_clone(member, k_mut, k_member))
+            with tr.span("elastic.mutation",
+                         parent_member=self.member_ids[parent]):
+                clones.append(self._mutate_clone(member, k_mut, k_member))
             child_id = self._new_member_id()
             clone_records.append((self.member_ids[parent], child_id,
                                   float(self.fitness[parent])))
@@ -934,7 +971,16 @@ class ElasticPBTController:
     def step_generation(self) -> np.ndarray:
         """One elastic generation: scripted-fault check → heartbeat →
         membership detection (+ recovery) → pod generation dispatch under
-        the collective watchdog → snapshot + island exchange."""
+        the collective watchdog → snapshot + island exchange. Each boundary
+        is one ``elastic.generation`` trace with dispatch / resize /
+        tournament / mutation / snapshot / island phases as child spans and
+        host-loss recovery as a forced error-status span."""
+        with self.tracer.span("elastic.generation",
+                              generation=self.generation,
+                              pop=len(self.member_ids)):
+            return self._step_generation_impl()
+
+    def _step_generation_impl(self) -> np.ndarray:
         reg = self.registry
         # scripted host loss at this boundary (FaultInjector host-loss mode)
         if self.fault_injector is not None:
@@ -966,10 +1012,11 @@ class ElasticPBTController:
             if self._gen_fn is None:
                 self._rebuild_generation()
             try:
-                pop, key_next, fitness = call_with_collective_timeout(
-                    self._dispatch, self.generation_timeout,
-                    name="fitness-all-gather", registry=reg,
-                )
+                with self.tracer.span("elastic.dispatch", attempt=attempt):
+                    pop, key_next, fitness = call_with_collective_timeout(
+                        self._dispatch, self.generation_timeout,
+                        name="fitness-all-gather", registry=reg,
+                    )
                 self.pop = pop
                 self._key = key_next
                 break
@@ -1012,12 +1059,16 @@ class ElasticPBTController:
             )
         if self.snapshot_every and \
                 self.generation % self.snapshot_every == 0 and self._is_leader():
-            self.save_snapshot()
+            with self.tracer.span("elastic.snapshot",
+                                  generation=self.generation):
+                self.save_snapshot()
         if self.island is not None and self.island.every and \
                 self.generation % self.island.every == 0:
-            if self._is_leader():
-                self._export_island()
-            self._import_islands()
+            with self.tracer.span("elastic.island_exchange",
+                                  generation=self.generation):
+                if self._is_leader():
+                    self._export_island()
+                self._import_islands()
         return fitness
 
     def run(self, generations: int) -> List[List[float]]:

@@ -180,6 +180,7 @@ class CommitDirStore:
         torn_help: str = "store entries skipped as torn/corrupt",
         warn_prefix: str = "torn-entry",
         metrics=None,
+        tracer=None,
     ):
         from agilerl_tpu import observability
 
@@ -194,6 +195,18 @@ class CommitDirStore:
         self.warn_prefix = warn_prefix
         self.metrics = (metrics if metrics is not None
                         else observability.get_registry())
+        #: like metrics: an explicit consumer tracer wins (multiple runs in
+        #: one process each keep their spans in their own sink); None reads
+        #: the process default lazily
+        self._tracer = tracer
+
+    @property
+    def tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from agilerl_tpu.observability import get_tracer
+
+        return get_tracer()
 
     def publish(self, name: str, payload: Any,
                 manifest_extra: Optional[Dict[str, Any]] = None) -> Path:
@@ -223,6 +236,16 @@ class CommitDirStore:
             self.metrics.warn_once(
                 f"{self.warn_prefix}-{path.name}",
                 f"skipping torn store entry {path.name}: {e}")
+            tracer = self.tracer
+            if tracer.enabled:
+                # torn entry: anomaly — always sampled, error status, one
+                # span per skip across EVERY store consumer (KV transfers,
+                # weight/trajectory stores, telemetry snapshots)
+                tracer.start_span(
+                    "store.torn_entry", force=True,
+                    attributes={"entry": path.name,
+                                "counter": self.torn_counter},
+                ).set_error(str(e)).end()
             return None
 
     def entries(self) -> List[Path]:

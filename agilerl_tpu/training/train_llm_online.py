@@ -54,6 +54,7 @@ def finetune_llm_reasoning_online(
     resume: bool = False,
     telemetry=None,
     resilience=None,
+    telemetry_export_dir=None,
 ) -> Tuple[object, List[float]]:
     """Disaggregated online GRPO over a ReasoningGym-style env.
 
@@ -77,8 +78,10 @@ def finetune_llm_reasoning_online(
     workdir = Path(workdir)
     reg = telem.registry
     weight_store = WeightStore(workdir / "weights",
-                               keep_last=keep_weight_epochs, metrics=reg)
-    traj_store = TrajectoryStore(workdir / "trajectories", metrics=reg)
+                               keep_last=keep_weight_epochs, metrics=reg,
+                               tracer=telem.tracer)
+    traj_store = TrajectoryStore(workdir / "trajectories", metrics=reg,
+                                 tracer=telem.tracer)
     if not resume:
         # a reused workdir's previous-run epochs would out-number the fresh
         # learner's: actors adopt the stale newest adapter, every batch
@@ -86,16 +89,22 @@ def finetune_llm_reasoning_online(
         # fresh runs start from clean stores (pass resume=True to continue)
         weight_store.truncate_above(-1)
         traj_store.clear()
+    # explicit tracer pass-through: a RunTelemetry built with trace=... (or
+    # AGILERL_TPU_TRACE) traces the batch lifecycle — rollout → trajectory
+    # publish → learner consume → learn → weight publish → actor adoption —
+    # even when several runs coexist in one process (the process-default
+    # tracer only covers the most recent run)
     learner = LearnerPod(
         agent, weight_store, traj_store,
         max_staleness_epochs=max_staleness_epochs, rho_clip=rho_clip,
         importance_correction=importance_correction, metrics=reg,
-        plan=plan, mesh=mesh)
+        plan=plan, mesh=mesh, tracer=telem.tracer)
     rollout = RolloutPod(
         actor_agent if actor_agent is not None else agent, env,
         weight_store, traj_store, metrics=reg, fleet=fleet,
-        autoscaler=autoscaler)
-    fly = OnlineGRPOFlywheel(rollout, learner, metrics=reg)
+        autoscaler=autoscaler, tracer=telem.tracer)
+    fly = OnlineGRPOFlywheel(rollout, learner, metrics=reg,
+                             telemetry_dir=telemetry_export_dir)
 
     fitnesses: List[float] = []
     done_epochs = 0

@@ -16,7 +16,7 @@ an up-window. On a successful accelerator run the headline JSON line also
 carries the secondary metric + on-chip kernel validation in "extra_metrics".
 
 Env knobs: BENCH_MODE=grpo for the LLM metric; BENCH_MODE=pipeline / serving /
-fleet / flywheel / anakin / elastic for the CPU A/B micro-benches (fleet:
+trace / fleet / flywheel / anakin / elastic for the CPU A/B micro-benches (fleet:
 1-replica vs 2-replica ServingFleet on a repeated-prompt trace — composition
 cost + affinity hit rate; flywheel: disaggregated online-GRPO flywheel vs the
 interleaved loop — rollout tokens/s + learner steps/s; anakin: scan-resident
@@ -405,6 +405,102 @@ def bench_serving():
             "prefix_cache_hits_total": c_sum["prefix_cache_hits_total"],
             "tokens_decoded_total": c_sum["tokens_decoded_total"],
         },
+        "backend": backend,
+        "error": None,
+    }), flush=True)
+
+
+def bench_trace():
+    """CPU-backend tracing-overhead A/B (docs/observability.md): the SAME
+    ragged serving trace replayed on two warmed ContinuousGenerators — one
+    with tracing unconfigured (the no-op default), one with a live tracer
+    at anomaly-only sampling (sample_rate=0: per-request root spans are
+    created with real ids, but nothing records except forced anomalies) —
+    and the overhead %% in the provenance JSON. The acceptance target is
+    <= ~2%% (tracing disabled must be a true hot-path no-op, and
+    anomaly-only sampling close to one). Run with BENCH_MODE=trace; knobs
+    BENCH_TRACE_REQS / BENCH_TRACE_REPEATS."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.llm.serving import ContinuousGenerator
+    from agilerl_tpu.observability import JsonlSink, MetricsRegistry, Tracer
+
+    backend = jax.default_backend()
+    n_reqs = int(os.environ.get("BENCH_TRACE_REQS", 24))
+    repeats = int(os.environ.get("BENCH_TRACE_REPEATS", 3))
+    d_model = int(os.environ.get("BENCH_TRACE_DMODEL", 256))
+    cfg = M.GPTConfig(vocab_size=512, n_layer=4, n_head=4, n_kv_head=2,
+                      d_model=d_model, max_seq_len=256, dtype=jnp.float32)
+    params = M.init_params(jax.random.PRNGKey(0), cfg)
+    max_new, chunk, rows = 64, 8, 8
+    budgets_cycle = (4, 8, 16, 64)
+
+    def make_trace(seed):
+        rng = np.random.default_rng(seed)
+        trace = []
+        for i in range(n_reqs):
+            prompt = rng.integers(
+                3, 500, size=int(rng.integers(4, 28))).astype(np.int32)
+            trace.append((prompt, budgets_cycle[i % len(budgets_cycle)]))
+        return trace
+
+    def make_gen(tracer=None):
+        return ContinuousGenerator(
+            cfg, max_new_tokens=max_new, pad_id=0, eos_id=None,
+            prompt_buckets=(32,), slots=rows, block_size=8,
+            decode_chunk=chunk, metrics=MetricsRegistry(), tracer=tracer)
+
+    span_path = os.path.join(tempfile.mkdtemp(prefix="bench_trace_"),
+                             "spans.jsonl")
+    tracer_on = Tracer(sink=JsonlSink(span_path), sample_rate=0.0,
+                       pod="bench", metrics=MetricsRegistry())
+    # a DISABLED tracer object (no sink) pins the no-op path explicitly —
+    # identical to leaving tracing unconfigured
+    gens = {"off": make_gen(Tracer()), "on": make_gen(tracer_on)}
+
+    def serve(gen, trace):
+        for i, (p, b) in enumerate(trace):
+            gen.submit(p, max_new=b, key=jax.random.fold_in(
+                jax.random.PRNGKey(0), i), no_shed=True)
+        gen.run_until_drained(params, greedy=True)
+
+    warm = make_trace(7)
+    for gen in gens.values():
+        serve(gen, warm)
+    traces = [make_trace(100 + r) for r in range(repeats)]
+    best = {}
+    for name, gen in gens.items():
+        for trace in traces:
+            delivered = sum(b for _, b in trace)
+            t0 = time.perf_counter()
+            serve(gen, trace)
+            tps = delivered / (time.perf_counter() - t0)
+            best[name] = max(best.get(name, 0.0), tps)
+    overhead_pct = 100.0 * (1.0 - best["on"] / max(best["off"], 1e-9))
+    spans_recorded = int(tracer_on.metrics.counter(
+        "trace/spans_total").value)
+    log(f"bench_trace: tracing-off {best['off']:.0f} vs anomaly-only "
+        f"{best['on']:.0f} delivered tokens/s "
+        f"(overhead {overhead_pct:+.2f}%, {spans_recorded} spans recorded)")
+    print(json.dumps({
+        "metric": ("serving delivered tokens/sec, tracing-off vs "
+                   f"tracing-on at anomaly-only sampling ({n_reqs} ragged "
+                   "requests; vs_baseline = on/off ratio, overhead_pct = "
+                   "the acceptance number, target <= ~2%)"),
+        "value": round(best["on"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(best["on"] / max(best["off"], 1e-9), 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "tracing_off_tokens_per_sec": round(best["off"], 1),
+        "tracing_on_sampled_tokens_per_sec": round(best["on"], 1),
+        # anomaly-only sampling on a healthy trace records NOTHING — a
+        # nonzero count here means steady spans leaked past the sampler
+        "spans_recorded": spans_recorded,
         "backend": backend,
         "error": None,
     }), flush=True)
@@ -1119,6 +1215,8 @@ def child_main():
         bench_pipeline()
     elif mode == "serving":
         bench_serving()
+    elif mode == "trace":
+        bench_trace()
     elif mode == "fleet":
         bench_fleet()
     elif mode == "flywheel":
@@ -1345,6 +1443,7 @@ def parent_main():
         "GRPO learn-step tokens/sec" if mode == "grpo"
         else "pipelined off-policy hot-loop env-steps/sec" if mode == "pipeline"
         else "serving-tier continuous vs batch-sync tokens/sec" if mode == "serving"
+        else "serving tracing-off vs anomaly-only-tracing tokens/sec" if mode == "trace"
         else "serving-fleet 2-replica vs 1-replica tokens/sec" if mode == "fleet"
         else "flywheel vs interleaved GRPO rollout tokens/sec" if mode == "flywheel"
         else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
@@ -1354,8 +1453,8 @@ def parent_main():
     )
     errors = []
 
-    if mode in ("pipeline", "serving", "fleet", "flywheel", "anakin",
-                "sharding", "elastic"):
+    if mode in ("pipeline", "serving", "trace", "fleet", "flywheel",
+                "anakin", "sharding", "elastic"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
@@ -1377,7 +1476,8 @@ def parent_main():
             return 0
         print(json.dumps({
             "metric": metric, "value": 0,
-            "unit": ("tokens/sec" if mode in ("serving", "fleet", "flywheel")
+            "unit": ("tokens/sec" if mode in ("serving", "trace", "fleet",
+                                              "flywheel")
                      else "ms/resolution" if mode == "sharding"
                      else "s (MTTR)" if mode == "elastic"
                      else "env-steps/sec"),

@@ -31,6 +31,9 @@
 #   bash run_tests.sh analysis   # graftcheck static-analysis suite only
 #                                # (rule fixtures, pragma/baseline gates,
 #                                # CompileGuard/SyncGuard, package clean)
+#   bash run_tests.sh tracing    # distributed tracing + telemetry plane
+#                                # (Tracer/Span, Perfetto export, fleet
+#                                # trace acceptance, snapshot merge math)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -97,6 +100,16 @@ for arg in "$@"; do
       # lease-role membership)
       MARKER=(-m "fleet")
       SHARDS+=("tests/test_llm/test_fleet.py tests/test_resilience/test_membership.py")
+      ;;
+    tracing)
+      # fast path: distributed tracing + cross-process telemetry plane
+      # (tracer/span units, sampling + forced anomaly spans, Perfetto
+      # export, registry dump/merge math incl. torn snapshots, the
+      # disaggregated fleet trace acceptance gate, flywheel store
+      # propagation, elastic generation/recovery spans, sink resume +
+      # sanitize-collision satellites)
+      MARKER=(-m "tracing")
+      SHARDS+=("tests/test_observability tests/test_llm/test_fleet_trace.py tests/test_llm/test_flywheel_trace.py tests/test_parallel/test_elastic_trace.py")
       ;;
     flywheel)
       # fast path: the online GRPO flywheel (sync-mode equivalence gate,

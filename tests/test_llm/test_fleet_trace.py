@@ -1,0 +1,256 @@
+"""ISSUE 14 acceptance: a disaggregated fleet request (cold prompt: route →
+prefill worker → KV transfer → decode replica → result) reconstructs as ONE
+complete parent-linked trace from the JSONL span records and exports to a
+Perfetto-loadable JSON file; a replica-kill failover appears as an
+error-status span with the re-dispatch spans causally linked (FakeClock
+lease-expiry harness); anomaly-only sampling keeps steady traffic silent
+while failovers still record; and trace context rides the KVTransferStore
+manifest."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.fleet import KVTransferStore, ServingFleet
+from agilerl_tpu.observability import (
+    JsonlSink,
+    MemorySink,
+    MetricsRegistry,
+    TelemetryAggregator,
+    Tracer,
+    export_perfetto,
+    read_jsonl,
+    span_records,
+    trace_tree,
+)
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet, pytest.mark.tracing]
+
+CFG = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+                  d_model=32, max_seq_len=256, dtype=jnp.float32)
+KW = dict(max_new_tokens=8, pad_id=0, eos_id=None, prompt_buckets=(32,),
+          slots=3, block_size=8, decode_chunk=4)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _prompt(seed=0, size=12):
+    return np.random.default_rng(seed).integers(
+        3, 95, size=size).astype(np.int32)
+
+
+def _fleet(tracer, **over):
+    kw = dict(KW)
+    kw.update(over)
+    return ServingFleet(CFG, kw.pop("n_replicas", 2), tracer=tracer,
+                        metrics=kw.pop("metrics", MetricsRegistry()), **kw)
+
+
+def test_disaggregated_request_reconstructs_one_parent_linked_trace(
+        params, tmp_path):
+    """The tentpole acceptance gate, JSONL records end to end."""
+    jsonl = str(tmp_path / "spans.jsonl")
+    sink = JsonlSink(jsonl)
+    tracer = Tracer(sink=sink, sample_rate=1.0, pod="fleet",
+                    metrics=MetricsRegistry())
+    fleet = _fleet(tracer, topology="disaggregated", n_prefill=1,
+                   transfer_dir=tmp_path / "kv")
+    ticket = fleet.submit(_prompt(), no_shed=True)
+    fleet.run_until_drained(params, greedy=True)
+    toks, emits = fleet.result(ticket)
+    assert emits.sum() > 0
+    sink.close()
+
+    spans = span_records(read_jsonl(jsonl))
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "fleet.request"
+    tid = roots[0]["trace_id"]
+    # ONE trace: every hop shares the trace id and links to a recorded
+    # parent — no orphans, no second root
+    assert all(s["trace_id"] == tid for s in spans)
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in by_id, f"orphan span {s['name']}"
+    names = {s["name"] for s in spans}
+    assert {"fleet.request", "fleet.route", "fleet.prefill",
+            "fleet.kv_import", "fleet.decode", "serving.admit"} <= names
+    # causal order along the disaggregated path: prefill under the root,
+    # import under the (manifest-carried) prefill context, decode under
+    # the import, admission under the decode dispatch
+    prefill = next(s for s in spans if s["name"] == "fleet.prefill")
+    kv_import = next(s for s in spans if s["name"] == "fleet.kv_import")
+    decode = next(s for s in spans if s["name"] == "fleet.decode")
+    admit = next(s for s in spans if s["name"] == "serving.admit")
+    assert prefill["parent_id"] == roots[0]["span_id"]
+    assert kv_import["parent_id"] == prefill["span_id"]
+    assert decode["parent_id"] == kv_import["span_id"]
+    assert admit["parent_id"] == decode["span_id"]
+    assert admit["attributes"]["path"] == "import"
+    tree = trace_tree(spans, tid)
+    assert tree[None][0]["name"] == "fleet.request"
+
+    # Perfetto export: loadable JSON, every span a complete X slice
+    out = str(tmp_path / "trace.perfetto.json")
+    export_perfetto(spans, out)
+    doc = json.loads(open(out).read())
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == len(spans)
+    assert all(e["args"]["trace_id"] == tid for e in slices)
+
+
+def test_replica_kill_failover_error_span_with_linked_redispatch(
+        params, tmp_path):
+    """Lease-expiry failover (the FakeClock harness): the loss appears as a
+    forced error-status ``fleet.failover`` span and the re-dispatch
+    route/decode spans are its CHILDREN — causally linked to the fault."""
+    sink = MemorySink()
+    tracer = Tracer(sink=sink, sample_rate=1.0, pod="fleet")
+    clock = FakeClock()
+    fleet = _fleet(tracer, membership_dir=tmp_path / "hb", lease_timeout=5.0,
+                   clock=clock, max_new_tokens=16)
+    seqs = [_prompt(s) for s in range(4)]
+    tickets = [fleet.submit(s, key=jax.random.fold_in(jax.random.PRNGKey(1), i),
+                            no_shed=True) for i, s in enumerate(seqs)]
+    fleet.step(params, greedy=True)  # requests now in flight
+    victim = next(rid for rid in fleet.replica_ids
+                  if fleet._members[rid].tickets)
+    fleet.kill_replica(victim)
+    clock.advance(6.0)  # past the lease: next step detects the loss
+    fleet.run_until_drained(params, greedy=True)
+    for t in tickets:
+        toks, emits = fleet.result(t)
+        assert emits.sum() > 0
+
+    spans = [e for e in sink.events if e["kind"] == "span"]
+    fails = [s for s in spans if s["name"] == "fleet.failover"]
+    assert fails, "no failover span recorded"
+    assert all(s["status"] == "error" for s in fails)
+    assert all("lost" in s["status_message"] for s in fails)
+    fail_ids = {s["span_id"] for s in fails}
+    # re-dispatch spans hang off the failover error span
+    relinked = [s for s in spans if s["parent_id"] in fail_ids]
+    assert {"fleet.route", "fleet.decode"} <= {s["name"] for s in relinked}
+    # the interrupted decode dispatch closed with error status too
+    dead_decodes = [s for s in spans
+                    if s["name"] == "fleet.decode" and s["status"] == "error"]
+    assert dead_decodes
+    # and the failover rides the SAME trace as its request's root span
+    roots = {s["span_id"]: s["trace_id"] for s in spans
+             if s["name"] == "fleet.request"}
+    for f in fails:
+        assert f["parent_id"] in roots
+        assert f["trace_id"] == roots[f["parent_id"]]
+
+
+def test_anomaly_only_sampling_records_failover_not_steady_traffic(
+        params, tmp_path):
+    """sample_rate=0.0: steady requests emit NOTHING; a replica kill still
+    records its forced error span (ids intact, pointing into the unsampled
+    request trace)."""
+    sink = MemorySink()
+    tracer = Tracer(sink=sink, sample_rate=0.0, pod="fleet")
+    fleet = _fleet(tracer, max_new_tokens=16)
+    t0 = fleet.submit(_prompt(0), no_shed=True)
+    fleet.run_until_drained(params, greedy=True)
+    fleet.result(t0)
+    assert [e for e in sink.events if e["kind"] == "span"] == []
+    tickets = [fleet.submit(_prompt(s), no_shed=True) for s in range(3)]
+    fleet.step(params, greedy=True)
+    victim = next(rid for rid in fleet.replica_ids
+                  if fleet._members[rid].tickets)
+    fleet.kill_replica(victim)  # no membership dir: immediate failover
+    fleet.run_until_drained(params, greedy=True)
+    for t in tickets:
+        fleet.result(t)
+    spans = [e for e in sink.events if e["kind"] == "span"]
+    fails = [s for s in spans if s["name"] == "fleet.failover"]
+    assert fails and all(s["status"] == "error" for s in fails)
+    # ONLY the anomaly subtree records: the forced failover span plus its
+    # causally-linked re-dispatch (children inherit the forced sampling) —
+    # steady-path spans (fleet.request roots, first dispatches) stay silent
+    assert {s["name"] for s in spans} <= {
+        "fleet.failover", "fleet.route", "fleet.decode", "serving.admit"}
+    assert not [s for s in spans if s["name"] == "fleet.request"]
+    # forced spans keep the (unsampled) request's ids: the anomaly still
+    # points INTO the trace that suffered it
+    assert all(s["parent_id"] is not None for s in fails)
+
+
+def test_trace_context_rides_the_kv_transfer_manifest(tmp_path):
+    """The cross-process stitch contract: the exporting span's context is
+    readable from the transfer MANIFEST without unpickling the payload."""
+    store = KVTransferStore(tmp_path / "kv", metrics=MetricsRegistry())
+    ctx = {"trace_id": "t1", "span_id": "s1", "sampled": True}
+    path = store.export("transfer_000001", {
+        "tokens": np.arange(4, dtype=np.int32),
+        "hashes": [b"abc"], "trace": ctx,
+    })
+    manifest = json.loads((path / "manifest.json").read_text())
+    assert manifest["trace"] == ctx
+    payload = store.load(path)
+    assert payload["trace"] == ctx
+
+
+def test_fleet_telemetry_plane_publishes_per_member_pods(params, tmp_path):
+    """telemetry_dir wiring: one pod stream per member plus the fleet's own
+    registry, aggregatable into one fleet-level snapshot."""
+    fleet = _fleet(None, telemetry_dir=tmp_path / "telem",
+                   telemetry_interval_s=0.0)
+    t = fleet.submit(_prompt(), no_shed=True)
+    fleet.run_until_drained(params, greedy=True)
+    fleet.result(t)
+    agg = TelemetryAggregator(tmp_path / "telem", metrics=MetricsRegistry())
+    assert agg.poll() >= 3  # fleet + 2 replicas
+    snap = agg.snapshot()
+    # per-replica counters merged by SUM: the fleet-level requests_total
+    # equals what latency_summary sums by hand
+    rollup = fleet.latency_summary()["fleet"]["requests_total"]
+    assert snap["serving/requests_total"] == rollup
+    assert snap["fleet/routed_requests_total"] >= 1
+
+
+def test_graceful_scale_down_emits_no_error_spans_and_drops_publisher(
+        params, tmp_path):
+    """Planned retirement keeps the error channel clean (no failover /
+    error-status spans — the graceful analogue of replicas_lost_total
+    staying untouched) and retires the member's telemetry publisher so an
+    autoscaler cycling up/down cannot accumulate one per cycle."""
+    sink = MemorySink()
+    tracer = Tracer(sink=sink, sample_rate=1.0, pod="fleet")
+    fleet = _fleet(tracer, telemetry_dir=tmp_path / "telem",
+                   telemetry_interval_s=0.0, max_new_tokens=16)
+    tickets = [fleet.submit(_prompt(s), no_shed=True) for s in range(3)]
+    fleet.step(params, greedy=True)
+    n_pubs = len(fleet._telemetry)
+    victim = max(fleet.replica_ids)
+    fleet.scale_down(victim)
+    fleet.run_until_drained(params, greedy=True)
+    for t in tickets:
+        toks, emits = fleet.result(t)
+        assert emits.sum() > 0
+    spans = [e for e in sink.events if e["kind"] == "span"]
+    assert not [s for s in spans if s["name"] == "fleet.failover"]
+    assert not [s for s in spans if s["status"] == "error"]
+    # the retired member's publisher is gone (final state force-published)
+    assert f"replica_{victim}" not in fleet._telemetry
+    assert len(fleet._telemetry) == n_pubs - 1

@@ -1,0 +1,81 @@
+"""Flywheel trace propagation at the store layer: the rollout span's
+context rides the TrajectoryBatch payload AND manifest, the learn span's
+context rides the weight epoch, and a torn store entry emits a forced
+error-status ``store.torn_entry`` span — all without needing a live GRPO
+agent (the stores ARE the pod boundary)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.llm.flywheel import (
+    TrajectoryBatch,
+    TrajectoryStore,
+    WeightStore,
+)
+from agilerl_tpu.observability import MemorySink, MetricsRegistry, Tracer
+from agilerl_tpu.observability.trace import set_tracer
+
+pytestmark = [pytest.mark.flywheel, pytest.mark.tracing]
+
+
+def _batch(seq=0, trace_ctx=None):
+    return TrajectoryBatch(
+        seq=seq, actor_id=0, weight_epoch=1, data_epoch=0,
+        ids=np.zeros((2, 6), np.int32),
+        action_masks=np.ones((2, 5), np.int32),
+        rewards=np.zeros((1, 2), np.float32),
+        behavior_lp=np.zeros((2, 5), np.float32),
+        prompt_hashes=["aa", "bb"], trace_ctx=trace_ctx)
+
+
+def test_trajectory_batch_carries_trace_ctx_through_store(tmp_path):
+    store = TrajectoryStore(tmp_path / "traj", metrics=MetricsRegistry())
+    ctx = {"trace_id": "t9", "span_id": "s9", "sampled": True}
+    path = store.publish(_batch(trace_ctx=ctx))
+    manifest = json.loads((path / "manifest.json").read_text())
+    assert manifest["trace"] == ctx  # readable without unpickling
+    [loaded] = store.poll()
+    assert loaded.trace_ctx == ctx
+
+
+def test_weight_epoch_carries_publisher_span_context(tmp_path):
+    ws = WeightStore(tmp_path / "w", metrics=MetricsRegistry())
+    sink = MemorySink()
+    tr = Tracer(sink=sink, pod="learner")
+    with tr.span("flywheel.weight_publish", epoch=3) as sp:
+        ws.publish(3, {"lora": np.zeros(2)}, trace_ctx=tr.inject(sp))
+    payload = ws.load_latest_payload()
+    assert payload["epoch"] == 3
+    publish_rec = [e for e in sink.events if e["kind"] == "span"][0]
+    assert payload["trace"]["span_id"] == publish_rec["span_id"]
+    # an actor-side adoption span parented on the carried context stitches
+    # onto the learner's publish span across the store boundary
+    actor_sink = MemorySink()
+    actor_tr = Tracer(sink=actor_sink, pod="actor")
+    actor_tr.start_span("flywheel.adopt", parent=payload["trace"]).end()
+    adopt = [e for e in actor_sink.events if e["kind"] == "span"][0]
+    assert adopt["trace_id"] == publish_rec["trace_id"]
+    assert adopt["parent_id"] == publish_rec["span_id"]
+    # load_latest keeps its (epoch, lora) contract
+    epoch, lora = ws.load_latest()
+    assert epoch == 3 and lora["lora"].shape == (2,)
+
+
+def test_torn_store_entry_emits_forced_error_span(tmp_path):
+    sink = MemorySink()
+    prev = set_tracer(Tracer(sink=sink, sample_rate=0.0, pod="learner"))
+    try:
+        store = TrajectoryStore(tmp_path / "traj",
+                                metrics=MetricsRegistry())
+        path = store.publish(_batch())
+        (path / "trajectory.pkl").write_bytes(b"torn")
+        assert store.poll() == []  # skipped, never loaded
+    finally:
+        set_tracer(prev)
+    spans = [e for e in sink.events if e["kind"] == "span"]
+    assert [s["name"] for s in spans] == ["store.torn_entry"]
+    assert spans[0]["status"] == "error"
+    assert spans[0]["attributes"]["counter"] == \
+        "flywheel/torn_trajectories_total"

@@ -1,0 +1,83 @@
+"""JsonlSink resume semantics: the monotone ``seq`` contract must survive a
+torn final line (crash mid-write) — resume continues from the last
+*parseable* event instead of silently restarting at 0."""
+
+import json
+
+import pytest
+
+from agilerl_tpu.observability import JsonlSink
+from agilerl_tpu.observability.events import _resume_seq
+
+pytestmark = pytest.mark.tracing
+
+
+def _write_events(path, n, torn_tail=None):
+    with open(path, "w", encoding="utf-8") as fh:
+        for i in range(n):
+            fh.write(json.dumps({"seq": i, "ts": 1.0, "kind": "x"}) + "\n")
+        if torn_tail is not None:
+            fh.write(torn_tail)  # no trailing newline: the torn write
+
+
+def test_resume_continues_past_complete_file(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _write_events(path, 3)
+    assert _resume_seq(path) == 3
+
+
+@pytest.mark.parametrize("tail", [
+    '{"seq": 3, "ts": 2.0, "ki',   # truncated mid-record
+    '{"seq": ',                    # truncated mid-value
+    "garbage not json",            # corrupted line
+])
+def test_torn_final_line_falls_back_to_last_parseable(tmp_path, tail):
+    """The regression: a torn tail used to fail the parse and restart seq
+    at 0, breaking the monotone ordering consumers sort on."""
+    path = str(tmp_path / "run.jsonl")
+    _write_events(path, 3, torn_tail=tail)
+    assert _resume_seq(path) == 3
+    sink = JsonlSink(path)
+    sink.emit("resumed", {"v": 1})
+    sink.close()
+    # the torn line itself stays torn; every parseable event keeps the
+    # monotone seq (the appended record starts on a FRESH line — it must
+    # not be absorbed into the torn tail's garbage)
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    assert [e["seq"] for e in events] == [0, 1, 2, 3]
+    assert events[-1]["kind"] == "resumed"
+
+
+def test_fully_torn_file_restarts_at_zero(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as fh:
+        fh.write("complete garbage\nmore garbage")
+    assert _resume_seq(path) == 0
+
+
+def test_missing_and_empty_files(tmp_path):
+    assert _resume_seq(str(tmp_path / "absent.jsonl")) == 0
+    empty = tmp_path / "empty.jsonl"
+    empty.touch()
+    assert _resume_seq(str(empty)) == 0
+
+
+def test_read_jsonl_reads_past_torn_midfile_line(tmp_path):
+    """The post-crash reconstruction workflow must read past a torn
+    mid-file line (possible by design) — every parseable event returns."""
+    from agilerl_tpu.observability import read_jsonl
+
+    path = str(tmp_path / "run.jsonl")
+    _write_events(path, 2, torn_tail='{"seq": 2, "ts')
+    sink = JsonlSink(path)  # resumes seq=2, appends on a fresh line
+    sink.emit("span", {"name": "x"})
+    sink.close()
+    events = read_jsonl(path)
+    assert [e["seq"] for e in events] == [0, 1, 2]
+    assert events[-1]["kind"] == "span"

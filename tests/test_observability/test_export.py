@@ -1,0 +1,270 @@
+"""Cross-process telemetry plane: per-pod commit-dir snapshots, fleet-level
+merge semantics (counters summed + restart-rebased, gauges last-beat-wins,
+histograms bucket-wise exact with schema checking), torn snapshots skipped
+and counted, and spec-shaped merged Prometheus exposition."""
+
+import re
+
+import numpy as np
+import pytest
+
+from agilerl_tpu.observability import (
+    MetricsRegistry,
+    TelemetryAggregator,
+    TelemetryPublisher,
+    TelemetrySchemaError,
+    merge_histogram_dumps,
+)
+
+pytestmark = pytest.mark.tracing
+
+BOUNDS = (0.1, 1.0, 10.0)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _publish(tmp_path, pod, registry, ts):
+    pub = TelemetryPublisher(tmp_path, pod, registry, interval_s=0.0,
+                             clock=lambda: float(ts))
+    assert pub.publish() is not None
+    return pub
+
+
+def _agg(tmp_path):
+    return TelemetryAggregator(tmp_path, metrics=MetricsRegistry())
+
+
+# --------------------------------------------------------------------------- #
+# merge math
+# --------------------------------------------------------------------------- #
+
+
+def test_merged_counters_equal_sum_of_per_pod_counters(tmp_path):
+    regs = [MetricsRegistry() for _ in range(3)]
+    per_pod = [3.0, 10.0, 0.5]
+    for reg, v in zip(regs, per_pod):
+        reg.counter("requests_total").inc(v)
+    regs[0].counter("only_pod0").inc(7)
+    for i, reg in enumerate(regs):
+        _publish(tmp_path, f"p{i}", reg, ts=100 + i)
+    agg = _agg(tmp_path)
+    assert agg.poll() == 3
+    snap = agg.snapshot()
+    assert snap["requests_total"] == pytest.approx(sum(per_pod))
+    assert snap["only_pod0"] == 7.0
+
+
+def test_histogram_merge_is_exact_vs_concatenated_observations(tmp_path):
+    """The acceptance gate: bucket-wise aggregation must equal a single
+    histogram fed the CONCATENATION of every pod's observations — count,
+    sum, per-bucket counts, and the derived percentiles."""
+    rng = np.random.default_rng(0)
+    obs_a = rng.uniform(0.01, 20.0, size=40)
+    obs_b = rng.uniform(0.01, 5.0, size=25)
+    ra, rb, ref = (MetricsRegistry() for _ in range(3))
+    for v in obs_a:
+        ra.histogram("latency_s", buckets=BOUNDS).observe(v)
+    for v in obs_b:
+        rb.histogram("latency_s", buckets=BOUNDS).observe(v)
+    for v in np.concatenate([obs_a, obs_b]):
+        ref.histogram("latency_s", buckets=BOUNDS).observe(v)
+    _publish(tmp_path, "a", ra, ts=1)
+    _publish(tmp_path, "b", rb, ts=2)
+    agg = _agg(tmp_path)
+    agg.poll()
+    merged = agg.merged_dump()["histograms"]["latency_s"]
+    expect = ref.dump()["histograms"]["latency_s"]
+    # bounds, per-bucket counts, count: EXACT; sum: bit-for-bit up to float
+    # summation order (per-pod partials vs one stream)
+    assert merged["bounds"] == expect["bounds"]
+    assert merged["counts"] == expect["counts"]
+    assert merged["count"] == expect["count"]
+    assert merged["sum"] == pytest.approx(expect["sum"], rel=1e-12)
+    ref_hist = ref.histogram("latency_s", buckets=BOUNDS)
+    snap = agg.snapshot()["latency_s"]
+    for q in (50, 95, 99):
+        assert snap[f"p{q}"] == pytest.approx(ref_hist.percentile(q))
+
+
+def test_mismatched_bucket_schema_raises(tmp_path):
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.histogram("h", buckets=(1.0, 2.0)).observe(0.5)
+    rb.histogram("h", buckets=(1.0, 3.0)).observe(0.5)
+    _publish(tmp_path, "a", ra, ts=1)
+    _publish(tmp_path, "b", rb, ts=2)
+    agg = _agg(tmp_path)
+    agg.poll()
+    with pytest.raises(TelemetrySchemaError, match="bucket schema"):
+        agg.merged_dump()
+    with pytest.raises(TelemetrySchemaError):
+        merge_histogram_dumps(
+            {"bounds": [1.0], "counts": [0, 0], "sum": 0.0, "count": 0},
+            {"bounds": [2.0], "counts": [0, 0], "sum": 0.0, "count": 0})
+
+
+def test_merged_prometheus_exposition_stays_spec_shaped(tmp_path):
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    for reg, vals in ((ra, (0.05, 5.0)), (rb, (0.5, 50.0))):
+        h = reg.histogram("latency_s", buckets=BOUNDS)
+        for v in vals:
+            h.observe(v)
+        reg.counter("reqs").inc(2)
+    _publish(tmp_path, "a", ra, ts=1)
+    _publish(tmp_path, "b", rb, ts=2)
+    agg = _agg(tmp_path)
+    agg.poll()
+    text = agg.prometheus_text()
+    # cumulative buckets, +Inf == _count, _sum present — the merged
+    # histogram must expose exactly like a single-registry one
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="1.0"} 2' in text
+    assert 'latency_s_bucket{le="10.0"} 3' in text
+    assert 'latency_s_bucket{le="+Inf"} 4' in text
+    assert "latency_s_count 4" in text
+    assert re.search(r"latency_s_sum 55\.5", text)
+    assert "reqs 4.0" in text
+
+
+def test_gauge_last_beat_wins(tmp_path):
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.gauge("replicas").set(2)
+    rb.gauge("replicas").set(5)
+    _publish(tmp_path, "a", ra, ts=200)   # a beats LATER
+    _publish(tmp_path, "b", rb, ts=100)
+    agg = _agg(tmp_path)
+    agg.poll()
+    assert agg.snapshot()["replicas"] == 2.0
+    # b beats again, later: its value takes over
+    rb.gauge("replicas").set(9)
+    _publish(tmp_path, "b", rb, ts=300)
+    agg.poll()
+    assert agg.snapshot()["replicas"] == 9.0
+
+
+def test_counter_restart_rebase_keeps_fleet_total_monotone(tmp_path):
+    clock = Clock()
+    reg = MetricsRegistry()
+    reg.counter("work_total").inc(10)
+    pub = TelemetryPublisher(tmp_path, "p", reg, interval_s=0.0, clock=clock)
+    pub.publish()
+    agg = _agg(tmp_path)
+    agg.poll()
+    assert agg.snapshot()["work_total"] == 10.0
+    # the pod restarts: a FRESH registry restarts the counter at 3 — the
+    # fleet total must bank the old high-water mark, never run backwards
+    reg2 = MetricsRegistry()
+    reg2.counter("work_total").inc(3)
+    reg2.histogram("h", buckets=(1.0,)).observe(0.5)
+    clock.advance(5)
+    pub2 = TelemetryPublisher(tmp_path, "p", reg2, interval_s=0.0,
+                              clock=clock)
+    pub2.publish()
+    agg.poll()
+    assert agg.snapshot()["work_total"] == 13.0
+
+
+# --------------------------------------------------------------------------- #
+# store behaviour
+# --------------------------------------------------------------------------- #
+
+
+def test_torn_snapshot_skipped_counted_never_loaded(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c").inc(4)
+    pub = TelemetryPublisher(tmp_path, "p", reg, interval_s=0.0,
+                             clock=Clock())
+    first = pub.publish()
+    reg.counter("c").inc(96)  # would read 100 if the torn entry loaded
+    second = pub.publish(force=True)
+    # tear the NEWEST snapshot (crash mid-write after commit-dir is
+    # emulated by truncating the payload post-hoc)
+    (second / "telemetry.pkl").write_bytes(b"torn")
+    agg_reg = MetricsRegistry()
+    agg = TelemetryAggregator(tmp_path, metrics=agg_reg)
+    assert agg.poll() == 1
+    # the torn entry was skipped (counted) and the WALK fell back to the
+    # previous loadable snapshot — never a partial load
+    assert agg.snapshot()["c"] == 4.0
+    assert agg_reg.counter("telemetry/torn_snapshots_total").value == 1
+    assert first.exists()
+
+
+def test_publisher_interval_throttle_and_force(tmp_path):
+    clock = Clock()
+    reg = MetricsRegistry()
+    pub = TelemetryPublisher(tmp_path, "p", reg, interval_s=10.0,
+                             clock=clock)
+    assert pub.publish() is not None
+    assert pub.publish() is None          # throttled
+    assert pub.publish(force=True) is not None
+    clock.advance(11)
+    assert pub.publish() is not None
+
+
+def test_poll_is_idempotent_between_beats(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c").inc(1)
+    pub = TelemetryPublisher(tmp_path, "p", reg, interval_s=0.0,
+                             clock=Clock())
+    pub.publish()
+    agg = _agg(tmp_path)
+    assert agg.poll() == 1
+    assert agg.poll() == 0  # same snapshot: nothing new to fold
+    assert agg.snapshot()["c"] == 1.0
+
+
+def test_restarted_publisher_resumes_seq_past_existing_entries(tmp_path):
+    """The review regression: a restarted pod reusing its telemetry dir
+    must resume the snapshot seq past committed entries — restarting at 0
+    made the fresh snapshot the GC's OLDEST entry (deleted on its own
+    publish), freezing the aggregator on pre-crash state forever."""
+    clock = Clock()
+    reg = MetricsRegistry()
+    pub = TelemetryPublisher(tmp_path, "p", reg, interval_s=0.0,
+                             clock=clock, keep_last=2)
+    for v in (5, 5, 5):  # seqs 1..3: the dir holds snap_2 + snap_3
+        reg.counter("work_total").inc(v)
+        clock.advance(1)
+        pub.publish()
+    agg = _agg(tmp_path)
+    agg.poll()
+    assert agg.snapshot()["work_total"] == 15.0
+    # pod restarts: fresh registry, fresh publisher, SAME dir
+    reg2 = MetricsRegistry()
+    reg2.counter("work_total").inc(2)
+    clock.advance(1)
+    pub2 = TelemetryPublisher(tmp_path, "p", reg2, interval_s=0.0,
+                              clock=clock, keep_last=2)
+    assert pub2.publish() is not None  # seq 4: survives its own GC pass
+    agg.poll()
+    # the restarted stream is visible immediately and the old high-water
+    # mark is banked: 15 (pre-crash) + 2 (new stream)
+    assert agg.snapshot()["work_total"] == 17.0
+
+
+def test_persistently_torn_newest_snapshot_counted_once(tmp_path):
+    """A static torn newest entry must not be re-validated (and re-counted,
+    and re-spammed as a forced anomaly span) on every poll."""
+    reg = MetricsRegistry()
+    reg.counter("c").inc(4)
+    pub = TelemetryPublisher(tmp_path, "p", reg, interval_s=0.0,
+                             clock=Clock())
+    pub.publish()
+    reg.counter("c").inc(1)
+    second = pub.publish(force=True)
+    (second / "telemetry.pkl").write_bytes(b"torn")  # never republished
+    agg_reg = MetricsRegistry()
+    agg = TelemetryAggregator(tmp_path, metrics=agg_reg)
+    for _ in range(5):
+        agg.poll()
+    assert agg.snapshot()["c"] == 4.0  # fell back to the loadable entry
+    assert agg_reg.counter("telemetry/torn_snapshots_total").value == 1

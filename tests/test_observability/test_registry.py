@@ -129,3 +129,51 @@ def test_snapshot_mixes_instrument_kinds():
     snap = reg.snapshot()
     assert snap["c"] == 1.0 and snap["g"] == 1.0
     assert snap["h"]["count"] == 1
+
+
+@pytest.mark.tracing
+def test_sanitize_collision_detected_and_warned(recwarn):
+    """Two DISTINCT metric names that sanitize to one Prometheus name would
+    silently merge in prometheus_text() — the registry must detect the
+    collision at creation and warn_once (the instruments stay distinct)."""
+    sink = MemorySink()
+    reg = MetricsRegistry(sink=sink)
+    reg.counter("a/b").inc(1)
+    reg.counter("a_b").inc(2)  # sanitizes to the same "a_b"
+    warnings_ = [e for e in sink.events if e["kind"] == "warning"]
+    assert len(warnings_) == 1
+    assert "a_b" in warnings_[0]["message"] and "a/b" in warnings_[0]["message"]
+    assert any("sanitize" in str(w.message) for w in recwarn.list)
+    # both instruments exist independently; exposition carries both lines
+    # (under the colliding name — exactly what the warning points at)
+    snap = reg.snapshot()
+    assert snap["a/b"] == 1.0 and snap["a_b"] == 2.0
+    assert reg.prometheus_text().count("a_b 1.0") + \
+        reg.prometheus_text().count("a_b 2.0") == 2
+    # re-requesting either name is silent (warn_once, get-or-create)
+    reg.counter("a/b").inc()
+    assert len([e for e in sink.events if e["kind"] == "warning"]) == 1
+
+
+@pytest.mark.tracing
+def test_no_collision_warning_for_distinct_sanitized_names():
+    sink = MemorySink()
+    reg = MetricsRegistry(sink=sink)
+    reg.counter("x/y").inc()
+    reg.counter("x/z").inc()
+    assert not [e for e in sink.events if e["kind"] == "warning"]
+
+
+@pytest.mark.tracing
+def test_dump_full_resolution_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(4)
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("h", buckets=[1.0, 2.0])
+    h.observe(0.5)
+    h.observe(5.0)
+    d = reg.dump()
+    assert d["counters"]["c"] == 4.0
+    assert d["gauges"]["g"] == 2.5
+    assert d["histograms"]["h"] == {
+        "bounds": [1.0, 2.0], "counts": [1, 0, 1], "sum": 5.5, "count": 2}

@@ -1,0 +1,223 @@
+"""Distributed-tracing core: ambient parenting, manual lifecycle,
+cross-process inject/extract, deterministic sampling with forced anomaly
+spans, error status, no-op-when-unconfigured, and the Perfetto exporter."""
+
+import json
+
+import pytest
+
+from agilerl_tpu.observability import (
+    JsonlSink,
+    MemorySink,
+    MetricsRegistry,
+    Tracer,
+    export_perfetto,
+    read_jsonl,
+    span_records,
+    trace_tree,
+)
+from agilerl_tpu.observability.trace import (
+    NOOP_SPAN,
+    SpanContext,
+    current_span,
+    get_tracer,
+    set_tracer,
+)
+
+pytestmark = pytest.mark.tracing
+
+
+def _spans(sink):
+    return [e for e in sink.events if e["kind"] == "span"]
+
+
+def test_unconfigured_tracer_is_a_true_noop():
+    tr = get_tracer()
+    assert not tr.enabled
+    # ONE shared no-op span object: no allocation on the disabled hot path
+    s1 = tr.span("a", x=1)
+    s2 = tr.start_span("b")
+    assert s1 is NOOP_SPAN and s2 is NOOP_SPAN
+    with s1 as s:
+        s.set_attribute("k", "v").add_event("e").set_error("nope")
+        assert s.context() is None
+    assert tr.inject(s1) is None
+    assert current_span() is None
+
+
+def test_ambient_nesting_parents_and_shared_trace_id():
+    sink = MemorySink()
+    tr = Tracer(sink=sink, pod="p0")
+    with tr.span("outer", stage="a") as outer:
+        assert current_span() is outer
+        with tr.span("inner") as inner:
+            assert current_span() is inner
+        assert current_span() is outer
+    assert current_span() is None
+    recs = {r["name"]: r for r in _spans(sink)}
+    assert recs["inner"]["trace_id"] == recs["outer"]["trace_id"]
+    assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+    assert recs["outer"]["parent_id"] is None
+    assert recs["outer"]["attributes"] == {"stage": "a"}
+    assert recs["outer"]["duration_s"] >= recs["inner"]["duration_s"] >= 0
+
+
+def test_manual_lifecycle_and_double_end_is_idempotent():
+    sink = MemorySink()
+    tr = Tracer(sink=sink)
+    sp = tr.start_span("req", attributes={"ticket": 1})
+    sp.set_attribute("tokens", 8)
+    sp.end()
+    sp.end()  # second end must not re-emit
+    recs = _spans(sink)
+    assert len(recs) == 1
+    assert recs[0]["attributes"] == {"ticket": 1, "tokens": 8}
+
+
+def test_inject_extract_round_trip_stitches_across_processes():
+    sink_a, sink_b = MemorySink(), MemorySink()
+    pod_a = Tracer(sink=sink_a, pod="a")
+    pod_b = Tracer(sink=sink_b, pod="b")
+    with pod_a.span("produce") as sp:
+        carried = pod_a.inject(sp)
+    # ... rides a manifest as a plain dict (JSON round-trip included) ...
+    carried = json.loads(json.dumps(carried))
+    ctx = pod_b.extract(carried)
+    assert isinstance(ctx, SpanContext) and ctx.sampled
+    pod_b.start_span("consume", parent=ctx).end()
+    a, b = _spans(sink_a)[0], _spans(sink_b)[0]
+    assert b["trace_id"] == a["trace_id"]
+    assert b["parent_id"] == a["span_id"]
+    assert b["pod"] == "b" and a["pod"] == "a"
+    # malformed contexts degrade to a fresh root, never raise
+    assert pod_b.extract(None) is None
+    assert pod_b.extract({"junk": 1}) is None
+
+
+def test_sampling_zero_rate_records_only_forced_spans():
+    sink = MemorySink()
+    tr = Tracer(sink=sink, sample_rate=0.0)
+    with tr.span("steady") as root:
+        # unsampled spans keep REAL ids so forced children stay linkable
+        ctx = root.context()
+        assert ctx is not None and not ctx.sampled
+        anomaly = tr.start_span("anomaly", parent=root, force=True)
+        anomaly.set_error("boom")
+        anomaly.end()
+    recs = _spans(sink)
+    assert [r["name"] for r in recs] == ["anomaly"]
+    assert recs[0]["trace_id"] == ctx.trace_id
+    assert recs[0]["parent_id"] == ctx.span_id
+    assert recs[0]["status"] == "error"
+    assert recs[0]["status_message"] == "boom"
+
+
+def test_sampling_is_deterministic_per_trace_id():
+    tr = Tracer(sink=MemorySink(), sample_rate=0.5)
+    verdicts = {tid: tr._sampled_root(tid, False)
+                for tid in (f"trace{i}" for i in range(64))}
+    # deterministic: the same ids sample the same way on a second pass
+    assert all(tr._sampled_root(t, False) == v for t, v in verdicts.items())
+    assert 0 < sum(verdicts.values()) < len(verdicts)
+
+
+def test_exception_marks_error_status():
+    sink = MemorySink()
+    tr = Tracer(sink=sink, metrics=(reg := MetricsRegistry()))
+    with pytest.raises(ValueError):
+        with tr.span("explodes"):
+            raise ValueError("kaboom")
+    rec = _spans(sink)[0]
+    assert rec["status"] == "error"
+    assert "kaboom" in rec["status_message"]
+    assert reg.counter("trace/error_spans_total").value == 1
+
+
+def test_spans_ride_the_jsonl_sink_with_monotone_seq(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    sink = JsonlSink(path)
+    tr = Tracer(sink=sink, pod="writer")
+    with tr.span("a"):
+        with tr.span("b"):
+            pass
+    sink.close()
+    events = read_jsonl(path)
+    spans = span_records(events)
+    assert [s["name"] for s in spans] == ["b", "a"]  # end order
+    assert [e["seq"] for e in events] == sorted(e["seq"] for e in events)
+
+
+def test_trace_tree_reconstruction():
+    sink = MemorySink()
+    tr = Tracer(sink=sink)
+    with tr.span("root") as root:
+        tid = root.context().trace_id
+        with tr.span("child1"):
+            with tr.span("leaf"):
+                pass
+        with tr.span("child2"):
+            pass
+    tree = trace_tree(_spans(sink), tid)
+    root_rec = tree[None][0]
+    assert root_rec["name"] == "root"
+    kids = [r["name"] for r in tree[root_rec["span_id"]]]
+    assert sorted(kids) == ["child1", "child2"]
+
+
+def test_export_perfetto_document_and_atomic_file(tmp_path):
+    sink = MemorySink()
+    tr = Tracer(sink=sink, pod="serve")
+    with tr.span("request", ticket=7):
+        with tr.span("decode"):
+            pass
+    err = tr.start_span("failover", force=True)
+    err.set_error("replica lost")
+    err.end()
+    out = str(tmp_path / "trace.perfetto.json")
+    doc = export_perfetto(_spans(sink), out)
+    loaded = json.loads(open(out).read())
+    assert loaded == doc
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == 3
+    for e in slices:
+        assert e["dur"] >= 1.0 and e["ts"] > 0
+        assert "trace_id" in e["args"] and "span_id" in e["args"]
+    req = next(e for e in slices if e["name"] == "request")
+    assert req["args"]["ticket"] == 7
+    fail = next(e for e in slices if e["name"] == "failover")
+    assert fail["cat"] == "error"
+    assert fail["args"]["status"] == "error"
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(m["name"] == "process_name"
+               and m["args"]["name"] == "serve" for m in meta)
+
+
+def test_set_tracer_install_and_restore():
+    before = get_tracer()
+    sink = MemorySink()
+    mine = Tracer(sink=sink)
+    prev = set_tracer(mine)
+    try:
+        assert get_tracer() is mine
+        assert prev is before
+    finally:
+        set_tracer(prev)
+    assert get_tracer() is before
+
+
+def test_two_tracers_same_pod_never_collide_ids():
+    """Two sequential runs reusing a pod name in one process append to the
+    same JSONL — their span/trace ids must not collide (per-process tracer
+    nonce in the id tag; a restarted counter would otherwise duplicate
+    run 1's ids exactly)."""
+    sink = MemorySink()
+    ids = set()
+    for _ in range(2):
+        tr = Tracer(sink=sink, pod="train-123")
+        with tr.span("a"):
+            with tr.span("b"):
+                pass
+    recs = _spans(sink)
+    assert len(recs) == 4
+    ids = {r["span_id"] for r in recs} | {r["trace_id"] for r in recs}
+    assert len(ids) == 6  # 4 span ids + 2 trace ids, all distinct

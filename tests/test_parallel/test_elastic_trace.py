@@ -1,0 +1,142 @@
+"""Elastic PBT tracing: each generation boundary is one
+``elastic.generation`` trace with dispatch/snapshot phase children, a
+scripted host kill surfaces as a FORCED error-status ``elastic.recovery``
+span (recorded even at sample_rate=0), and resize records
+tournament/mutation spans under the recovery."""
+
+import optax
+import pytest
+
+from agilerl_tpu.envs import CartPole
+from agilerl_tpu.modules.mlp import MLPConfig
+from agilerl_tpu.networks.base import NetworkConfig, default_encoder_config
+from agilerl_tpu.observability import MemorySink, MetricsRegistry, Tracer
+import jax
+
+from agilerl_tpu.parallel import (
+    ElasticPBTController,
+    EvoDQN,
+    make_emulated_hosts,
+)
+
+pytestmark = [pytest.mark.elastic, pytest.mark.tracing]
+
+HEARTBEAT = 0.15
+
+
+class ListSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, fields):
+        self.events.append((kind, dict(fields)))
+
+    def flush(self):
+        pass
+
+
+def _dqn():
+    env = CartPole()
+    kind, enc = default_encoder_config(
+        env.observation_space, latent_dim=16,
+        encoder_config={"hidden_size": (32,)})
+    net = NetworkConfig(
+        encoder_kind=kind, encoder=enc,
+        head=MLPConfig(num_inputs=16, num_outputs=2, hidden_size=(32,)),
+        latent_dim=16)
+    return EvoDQN(env, net, optax.adam(1e-3), num_envs=2,
+                  steps_per_iter=8, buffer_size=64, batch_size=4)
+
+
+def _spans(sink):
+    return [e for e in sink.events if e["kind"] == "span"]
+
+
+def test_generation_phases_and_host_loss_recovery_spans(tmp_path):
+    sink = MemorySink()
+    tracer = Tracer(sink=sink, sample_rate=1.0, pod="pbt0",
+                    metrics=MetricsRegistry())
+    ctrl = ElasticPBTController(
+        _dqn(), pop_size=4, store_dir=tmp_path / "store", seed=0,
+        hosts=make_emulated_hosts(2, jax.devices()[:4]),
+        heartbeat_timeout=HEARTBEAT,
+        snapshot_every=1, registry=MetricsRegistry(sink=ListSink()),
+        tracer=tracer,
+    )
+    ctrl.run(1)
+    spans = _spans(sink)
+    gens = [s for s in spans if s["name"] == "elastic.generation"]
+    assert len(gens) == 1 and gens[0]["parent_id"] is None
+    by_id = {s["span_id"]: s for s in spans}
+    dispatch = next(s for s in spans if s["name"] == "elastic.dispatch")
+    snap = next(s for s in spans if s["name"] == "elastic.snapshot")
+    # phases are CHILDREN of the generation root (ambient parenting)
+    assert by_id[dispatch["parent_id"]]["name"] == "elastic.generation"
+    assert by_id[snap["parent_id"]]["name"] == "elastic.generation"
+    assert all(s["status"] == "ok" for s in spans)
+
+    # kill a host between boundaries: the next generation's trace carries
+    # the recovery as an ERROR-status span (the fault is the traced thing;
+    # the recovery itself succeeds) with the re-dispatch in the same trace
+    sink.events.clear()
+    ctrl.kill_host(1)
+    ctrl.run(1)
+    spans = _spans(sink)
+    rec = next(s for s in spans if s["name"] == "elastic.recovery")
+    assert rec["status"] == "error"
+    assert "host loss" in rec["status_message"]
+    assert rec["attributes"]["lost"] == [1]
+    gen = next(s for s in spans if s["name"] == "elastic.generation")
+    assert rec["trace_id"] == gen["trace_id"]
+    assert gen["status"] == "ok"  # the generation completed post-recovery
+    dispatch = next(s for s in spans if s["name"] == "elastic.dispatch")
+    assert dispatch["trace_id"] == gen["trace_id"]
+
+
+def test_recovery_span_is_forced_at_zero_sample_rate(tmp_path):
+    sink = MemorySink()
+    tracer = Tracer(sink=sink, sample_rate=0.0, pod="pbt0")
+    ctrl = ElasticPBTController(
+        _dqn(), pop_size=4, store_dir=tmp_path / "store", seed=0,
+        hosts=make_emulated_hosts(2, jax.devices()[:4]),
+        heartbeat_timeout=HEARTBEAT,
+        snapshot_every=1, registry=MetricsRegistry(sink=ListSink()),
+        tracer=tracer,
+    )
+    ctrl.run(1)
+    assert _spans(sink) == []  # steady traffic: silent
+    ctrl.kill_host(1)
+    ctrl.run(1)
+    names = [s["name"] for s in _spans(sink)]
+    assert "elastic.recovery" in names  # the anomaly still records
+    rec = next(s for s in _spans(sink) if s["name"] == "elastic.recovery")
+    assert rec["status"] == "error"
+
+
+def test_grow_records_tournament_and_mutation_spans(tmp_path):
+    """Capacity returning grows the population back — the clone selection
+    and mutation record as spans UNDER the recovery span."""
+    sink = MemorySink()
+    tracer = Tracer(sink=sink, sample_rate=1.0, pod="pbt0")
+    ctrl = ElasticPBTController(
+        _dqn(), pop_size=8, store_dir=tmp_path / "store", seed=0,
+        hosts=make_emulated_hosts(2, jax.devices()[:4]),
+        heartbeat_timeout=HEARTBEAT,
+        snapshot_every=1, registry=MetricsRegistry(sink=ListSink()),
+        max_members_per_device=2, tracer=tracer,
+    )
+    ctrl.run(1)
+    ctrl.kill_host(1)   # 4 devices -> 2: shrink 8 -> 4
+    ctrl.run(1)
+    sink.events.clear()
+    ctrl.revive_host(1)  # capacity back: grow 4 -> 8 via clone+mutate
+    ctrl.run(1)
+    spans = _spans(sink)
+    by_id = {s["span_id"]: s for s in spans}
+    resize = [s for s in spans if s["name"] == "elastic.resize"]
+    assert any(s["attributes"]["op"] == "grow" for s in resize)
+    tournaments = [s for s in spans if s["name"] == "elastic.tournament"]
+    mutations = [s for s in spans if s["name"] == "elastic.mutation"]
+    assert len(tournaments) == 4 and len(mutations) == 4  # four clones
+    for s in tournaments + mutations:
+        assert by_id[s["parent_id"]]["name"] == "elastic.resize"
