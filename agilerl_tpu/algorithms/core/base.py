@@ -129,6 +129,7 @@ class EvolvableAlgorithm:
         name: str,
         factory: Callable[[], Callable],
         static_key: Optional[tuple] = None,
+        cacheable: bool = False,
     ) -> Callable:
         """Get-or-build a jitted function; dropped on architecture mutation.
 
@@ -138,7 +139,21 @@ class EvolvableAlgorithm:
         members with identical architectures reuse one XLA executable instead
         of compiling per member (the recompilation-economics answer to
         SURVEY.md §7 hard-part #1 — the reference re-instantiates torch modules
-        per member and pays full re-setup every clone)."""
+        per member and pays full re-setup every clone).
+
+        ``cacheable=True`` opts this function into the persistent executable
+        store (when the agent/env enabled one) and is a CONTRACT: the
+        factory's jit must bake NO static argnums/argnames (an AOT-loaded
+        program cannot accept them at call time, and their values could not
+        join the cache key — a jit's statics are not introspectable on this
+        jax) and should not close over large arrays (a captured constant's
+        literal lands in the lowered-HLO fingerprint — value skew is
+        correctly a miss, but hashing weight-sized literals is
+        prohibitive). No current factory qualifies: the batch_size-keyed
+        learn fns bake statics and the GRPO fns close over base weights —
+        the flag awaits the base-as-argument refactor (ROADMAP item 5
+        follow-up); the store-backed layout path today is
+        parallel/layout_search + compile_step_with_plan."""
         fn = self._jit_cache.get(name)
         if fn is None:
             if static_key is not None:
@@ -149,8 +164,47 @@ class EvolvableAlgorithm:
                     _GLOBAL_JIT_CACHE[gkey] = fn
             else:
                 fn = factory()
+            if cacheable:
+                fn = self._wrap_compile_cache(name, fn)
             self._jit_cache[name] = fn
-        return fn
+        return self._jit_cache[name]
+
+    def _wrap_compile_cache(self, name: str, fn: Callable) -> Callable:
+        """Route a jitted closure through the persistent executable store
+        when the agent opted in (``agent.compile_cache = store-or-path``,
+        or the ``AGILERL_TPU_COMPILE_CACHE`` env). This is what makes the
+        ``sharding=`` layout mutation load instead of recompile: to_mesh
+        clears the jit cache, the next learn() rebuilds through here, and
+        a layout the store has seen (a previous member's, a previous
+        process's, or a `parallel/layout_search` sweep's) resolves to the
+        stored executable. Non-jit closures (anything without ``.lower``)
+        pass through untouched."""
+        from agilerl_tpu.parallel.compile_cache import (
+            CachedFunction, resolve_cache)
+
+        store = resolve_cache(getattr(self, "compile_cache", None))
+        if store is None or not hasattr(fn, "lower") \
+                or isinstance(fn, CachedFunction):
+            return fn
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None and int(mesh.devices.size) > 1:
+            # the agent factories bake donation into their jits, and a
+            # DESERIALIZED executable whose multi-device outputs are
+            # donated back to it double-frees on this image's jaxlib
+            # (single-device aliasing is unaffected). Until the factories
+            # grow a donate flag (or jaxlib fixes the aliasing path),
+            # mesh-placed agents keep plain jit — layout sweeps go through
+            # parallel/layout_search, which compiles donation-free.
+            store.metrics.warn_once(
+                f"compile-cache-agent-mesh-{type(self).__name__}",
+                f"{type(self).__name__}.{name}: executable store skipped "
+                "for a mesh-placed agent (donating multi-device programs "
+                "are unsafe to persist on this jaxlib)")
+            return fn
+        return CachedFunction(
+            fn, name=f"{type(self).__name__}/{name}", store=store,
+            plan=getattr(self, "sharding_plan", None), mesh=mesh,
+        )
 
     def _clear_jit_cache(self) -> None:
         self._jit_cache = {}

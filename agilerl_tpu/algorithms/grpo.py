@@ -565,6 +565,15 @@ class GRPO(EvolvableAlgorithm):
                 )
             return (self.jit_fn("sp_logprobs", self._sp_logprob_fn),
                     self.jit_fn("sp_update", self._sp_update_fn))
+        # NOT cacheable (executable store): these factories close over the
+        # frozen base weights — a captured constant is fingerprint-SAFE
+        # (its literal lands in the lowered text, so value skew is a miss)
+        # but materialising that text at 7B scale is prohibitive, and
+        # _update_fn returns a plain closure with no .lower at all. The
+        # store-backed layout path is parallel/layout_search +
+        # compile_step_with_plan, where weights are ARGUMENTS; caching
+        # these fns awaits the base-as-argument factory refactor
+        # (ROADMAP item 5 follow-up).
         return (self.jit_fn("logprobs", self._logprob_fn),
                 self.jit_fn("update", self._update_fn))
 

@@ -32,7 +32,7 @@ HOT_FILES = ("llm/serving.py",)
 #: state and must route through resilience/atomic.py
 DURABILITY_SEGMENTS = ("resilience", "observability")
 DURABILITY_FILES = ("utils/checkpoint.py", "parallel/plan.py",
-                    "parallel/elastic.py")
+                    "parallel/elastic.py", "parallel/compile_cache.py")
 #: the protocol implementation itself is exempt from GX004
 DURABILITY_EXEMPT = ("resilience/atomic.py",)
 

@@ -78,6 +78,11 @@ ROLE_UNIFIED = "unified"
 ROLE_PREFILL = "prefill"
 ROLE_DECODE = "decode"
 
+#: scale_up() wall-time buckets: instant (warm compile cache) through the
+#: multi-minute cold compiles of 7B-scale replicas
+SCALE_UP_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0,
+                    5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
 
 class PrefillWorker:
     """Prefill-only worker for the disaggregated topology.
@@ -546,9 +551,18 @@ class ServingFleet:
         failovers) are re-dispatched onto the new capacity."""
         role = role or (ROLE_DECODE if self.topology == "disaggregated"
                         else ROLE_UNIFIED)
+        t0 = time.perf_counter()
         m = self._spawn(role, plan=plan)
         if self.heartbeats is not None:
             self._beat(m)
+        # spin-up latency = spawn + lease join; with a compile cache wired
+        # into the replicas the first-request compile moves into load — the
+        # histogram is how the autoscaler's reaction time is measured
+        # (latency_summary / the autoscale policy's telemetry)
+        self.metrics.histogram(
+            "fleet/scale_up_latency_s", buckets=SCALE_UP_BUCKETS,
+            help="wall time of scale_up(): replica spawn + lease join",
+        ).observe(time.perf_counter() - t0)
         self.metrics.emit("fleet_scale", action="up", replica=m.rid,
                           role=role)
         self._update_replica_count()
@@ -607,6 +621,16 @@ class ServingFleet:
             gen = ContinuousGenerator(
                 self.config, metrics=MetricsRegistry(), sharding_plan=plan,
                 tracer=self._tracer, **self._gen_kwargs)
+            if gen.compile_cache is not None:
+                # persistent executable store: spin-up LOADS the decode
+                # programs a previous process (or replica) published, so
+                # the member is request-ready before its first dispatch —
+                # the cost lands inside scale_up_latency_s where the
+                # autoscaler's reaction time is measured. only_cached: a
+                # COLD store stays lazy (no eager compile of sampling
+                # variants that may never be dispatched — spin-up must not
+                # be slower than the pre-store first request was)
+                gen.warm_start(only_cached=True)
         m = _Member(rid=rid, role=role, gen=gen)
         self._members[rid] = m
         return m
@@ -1140,6 +1164,9 @@ class ServingFleet:
                 self._departed_totals["tokens_decoded_total"]
                 + sum(m.gen.metrics.counter(
                     "serving/tokens_decoded_total").value for m in serving)),
+            "scale_up_latency_s": reg.histogram(
+                "fleet/scale_up_latency_s",
+                buckets=SCALE_UP_BUCKETS).summary(),
         }
         return {"replicas": replicas, "fleet": fleet}
 

@@ -669,6 +669,7 @@ class ContinuousGenerator:
         mesh=None,
         admission: Optional[AdmissionPolicy] = None,
         tracer=None,
+        compile_cache=None,
     ):
         self.config = config
         self.metrics = metrics if metrics is not None else observability.get_registry()
@@ -722,17 +723,61 @@ class ContinuousGenerator:
                 metrics=self.metrics))
         self.prefix_cache = bool(prefix_cache)
 
+        # persistent executable store (ROADMAP item 5): replica spin-up
+        # LOADS the plan-compiled decode-chunk + per-bucket prefill
+        # programs a previous process published instead of recompiling —
+        # the autoscaler's cold-start killer. Opt-in (compile_cache= /
+        # AGILERL_TPU_COMPILE_CACHE); programs stay bit-identical (tier-1
+        # gated) and compiled_programs keeps counting loaded executables
+        # through the same measured_cache_size contract.
+        from agilerl_tpu.parallel.compile_cache import (
+            CachedFunction, resolve_cache)
+
+        self.compile_cache = resolve_cache(
+            compile_cache, metrics=self.metrics, tracer=tracer)
+        # a persisted program must not donate buffers sharded over >1
+        # device: this image's jaxlib double-frees when a DESERIALIZED
+        # executable's multi-device outputs are donated back to it on the
+        # next chunk (the pool self-feed pattern). Single-device aliasing
+        # is unaffected, so the plan-less fast path keeps donation.
+        donate = (self.compile_cache is None or self.mesh is None
+                  or int(self.mesh.devices.size) <= 1)
         self._prefill = jax.jit(self._prefill_admit_impl,
                                 static_argnames=("greedy",),
-                                donate_argnums=(5,))
+                                donate_argnums=(5,) if donate else ())
         self._decode = jax.jit(self._decode_chunk_impl,
                                static_argnames=("greedy",),
-                               donate_argnums=(2,))
-        self._copy_block = jax.jit(M.paged_copy_block, donate_argnums=(0,))
+                               donate_argnums=(2,) if donate else ())
+        self._copy_block = jax.jit(
+            M.paged_copy_block, donate_argnums=(0,) if donate else ())
         # decode-side import of a prefill worker's exported prompt KV
         # (disaggregated topology): one program per prompt bucket
-        self._scatter_import = jax.jit(M.paged_scatter_prompt,
-                                       donate_argnums=(0,))
+        self._scatter_import = jax.jit(
+            M.paged_scatter_prompt, donate_argnums=(0,) if donate else ())
+        if self.compile_cache is not None:
+            if not donate:
+                self.metrics.warn_once(
+                    "serving/compile_cache_no_donation",
+                    "compile cache + mesh-sharded pool: serving programs "
+                    "compiled WITHOUT donation (deserialized multi-device "
+                    "donation is unsafe on this jaxlib) — peak pool memory "
+                    "doubles transiently per chunk")
+            wrap = dict(store=self.compile_cache, plan=self.sharding_plan,
+                        mesh=self.mesh, metrics=self.metrics, tracer=tracer)
+            self._prefill = CachedFunction(
+                self._prefill, name="serving/prefill_admit",
+                donate_argnums=(5,) if donate else (),
+                static_argnames=("greedy",), **wrap)
+            self._decode = CachedFunction(
+                self._decode, name="serving/decode_chunk",
+                donate_argnums=(2,) if donate else (),
+                static_argnames=("greedy",), **wrap)
+            self._copy_block = CachedFunction(
+                self._copy_block, name="serving/copy_block",
+                donate_argnums=(0,) if donate else (), **wrap)
+            self._scatter_import = CachedFunction(
+                self._scatter_import, name="serving/scatter_import",
+                donate_argnums=(0,) if donate else (), **wrap)
 
         # -- host scheduler state --
         # Threading contract: submit()/result() may be called from request
@@ -1058,6 +1103,80 @@ class ContinuousGenerator:
                 # the dense rules' (dp,fsdp) batch entry must never touch it
                 pool = self.sharding_plan.place("kv_paged", pool, self.mesh)
             self._pool = pool
+
+    def warm_start(self, params=None, lora=None,
+                   greedy: Optional[bool] = None,
+                   only_cached: bool = False) -> List[Dict[str, Any]]:
+        """Eagerly load-or-compile the decode-chunk program(s) from the
+        persistent executable store (no-op without ``compile_cache``) so a
+        freshly spawned replica is ready BEFORE its first request — the
+        autoscaler's spin-up path (``ServingFleet.scale_up``).
+
+        Warms the decode-chunk program(s) AND one prefill program per
+        prompt bucket, so the first request on any bucket pays neither a
+        compile nor a load in the request path.
+
+        ``params``/``lora`` may be the real weight trees or abstract
+        ``ShapeDtypeStruct`` trees; by default the config's ``init_params``
+        shapes are used (pass the real trees when serving differently-typed
+        weights). ``greedy=None`` warms both sampling variants.
+        ``only_cached=True`` loads what the store already has and leaves
+        misses LAZY (the fleet's spin-up mode: a cold store must not pay
+        eager compiles for variants/buckets that may never be dispatched).
+        Returns one load-or-compile info dict per warmed program."""
+        if self.compile_cache is None:
+            return []
+        self._ensure_pool()
+        if params is None:
+            params = jax.eval_shape(
+                lambda k: M.init_params(k, self.config),
+                jax.random.PRNGKey(0))
+
+        def _abs(leaf):
+            # keep mesh placements (they change the program), drop
+            # single-device/committed-ness (it doesn't — see
+            # compile_cache._sharding_desc)
+            from jax.sharding import NamedSharding
+
+            sh = getattr(leaf, "sharding", None)
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype,
+                sharding=sh if isinstance(sh, NamedSharding) else None)
+
+        params_abs = jax.tree_util.tree_map(_abs, params)
+        if self.sharding_plan is not None:
+            params_abs = self.sharding_plan.abstract(
+                "params", params_abs, self.mesh)
+        pool_abs = jax.tree_util.tree_map(_abs, self._pool)
+        S = self.max_blocks * self.block_size
+        a = jax.ShapeDtypeStruct
+        decode_args = (
+            a((self.slots, self.max_blocks), jnp.int32),   # tables
+            a((self.slots, S), jnp.int32),                 # slot mask
+            a((self.slots,), jnp.int32),                   # lengths
+            a((self.slots,), jnp.int32),                   # prev_tok
+            a((self.slots,), jnp.bool_),                   # prev_ok
+            a((self.slots,), jnp.int32),                   # pos
+            a((self.slots,), jnp.int32),                   # step_idx
+            a((self.slots,), jnp.bool_),                   # done
+            a((self.slots, 2), jnp.uint32),                # keys
+        )
+        infos = []
+        variants = [False, True] if greedy is None else [bool(greedy)]
+        for g in variants:
+            infos.append(self._decode.prepare(
+                params_abs, lora, pool_abs, *decode_args,
+                only_cached=only_cached, greedy=g))
+            for Pb in self.prompt_buckets:
+                # mirror the _admit dispatch exactly (line ~1200): bucketed
+                # prompt/mask, request key, pool, whole-prompt block list
+                infos.append(self._prefill.prepare(
+                    params_abs, lora,
+                    a((1, Pb), jnp.int32), a((1, Pb), jnp.int32),
+                    a((2,), jnp.uint32), pool_abs,
+                    a((Pb // self.block_size,), jnp.int32),
+                    only_cached=only_cached, greedy=g))
+        return infos
 
     def _chain_hashes(self, toks_row: np.ndarray,
                       mask_row: np.ndarray) -> List[bytes]:

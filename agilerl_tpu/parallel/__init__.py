@@ -33,6 +33,19 @@ from agilerl_tpu.parallel.plan import (
     registered_plans,
     resolve_plan_and_mesh,
 )
+from agilerl_tpu.parallel.compile_cache import (
+    CachedFunction,
+    ExecutableStore,
+    fingerprint_digest,
+    fingerprint_parts,
+    load_or_compile,
+    resolve_cache,
+)
+from agilerl_tpu.parallel.layout_search import (
+    LayoutCandidate,
+    LayoutSearchResult,
+    search_layouts,
+)
 from agilerl_tpu.parallel.tree_paths import named_tree_map, tree_path_to_string
 from agilerl_tpu.parallel.elastic import (
     ElasticPBTController,
@@ -66,4 +79,7 @@ __all__ = [
     "register_plan", "register_default_plans", "registered_plans",
     "get_plan", "load_plan", "plans_for_device_count",
     "resolve_plan_and_mesh",
+    "ExecutableStore", "CachedFunction", "load_or_compile", "resolve_cache",
+    "fingerprint_parts", "fingerprint_digest",
+    "LayoutCandidate", "LayoutSearchResult", "search_layouts",
 ]

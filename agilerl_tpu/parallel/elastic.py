@@ -219,6 +219,7 @@ class ElasticPBTController:
         clock=time.time,
         manager: Optional[CheckpointManager] = None,
         tracer=None,
+        compile_cache=None,
     ):
         if restore_from not in ("best", "latest"):
             raise ValueError(
@@ -251,6 +252,15 @@ class ElasticPBTController:
         self.restore_from = restore_from
         self._registry_override = registry
         self._tracer = tracer
+        #: persistent executable store (ROADMAP item 5): mesh re-formation
+        #: after a host loss LOADS the re-formed layout's pod-generation
+        #: program when a previous process (or a previous recovery) already
+        #: published it — recovery MTTR was recompile-dominated. Opt-in
+        #: (compile_cache= / AGILERL_TPU_COMPILE_CACHE).
+        from agilerl_tpu.parallel.compile_cache import resolve_cache
+
+        self.compile_cache = resolve_cache(
+            compile_cache, metrics=registry, tracer=tracer)
 
         if hosts is None:
             hosts = make_emulated_hosts(
@@ -428,7 +438,40 @@ class ElasticPBTController:
                                    self.pop),
             jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs),
         )
-        self._gen_fn = self.engine.make_pod_generation(mesh=mesh, plan=plan)
+        if self.compile_cache is not None:
+            from agilerl_tpu.parallel.compile_cache import CachedFunction
+
+            # load-or-compile per (plan, population signature, topology):
+            # a re-formed layout this store has seen — the cold run's, a
+            # previous recovery's, or another pod's — loads instead of
+            # recompiling. The fingerprint's lowered-HLO hash keys the
+            # engine's actual step maths, so two engines with identical
+            # shapes but different hyperparameter closures cannot collide.
+            # donate=False is REQUIRED on the persisted program: this
+            # image's jaxlib double-frees when a deserialized executable's
+            # multi-device outputs are donated back to it next generation
+            # (see make_pod_generation); an engine predating the flag
+            # falls back to uncached donating compiles — never a crash.
+            try:
+                gen_fn = self.engine.make_pod_generation(
+                    mesh=mesh, plan=plan, donate=False)
+            except TypeError:
+                self.registry.warn_once(
+                    "elastic:compile_cache_no_donate_flag",
+                    f"{type(self.engine).__name__}.make_pod_generation does "
+                    "not accept donate=; the executable store stays OFF for "
+                    "this engine (donating programs are unsafe to persist)")
+                gen_fn = self.engine.make_pod_generation(mesh=mesh, plan=plan)
+            else:
+                gen_fn = CachedFunction(
+                    gen_fn,
+                    name=f"pod_generation/{type(self.engine).__name__}",
+                    store=self.compile_cache, plan=plan, mesh=mesh,
+                    metrics=self._registry_override, tracer=self._tracer,
+                )
+        else:
+            gen_fn = self.engine.make_pod_generation(mesh=mesh, plan=plan)
+        self._gen_fn = gen_fn
         reg = self.registry
         reg.gauge("elastic/live_hosts").set(len(self.live_hosts()))
         reg.gauge("elastic/live_devices").set(len(devs))

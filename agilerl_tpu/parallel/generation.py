@@ -330,6 +330,7 @@ def make_pod_generation(
     insert: Callable,
     plan=None,
     pop_axis: str = "pop",
+    donate: bool = True,
 ) -> Callable:
     """Pod-sharded: members shard over the population mesh axis (any number
     per device); training runs locally, then fitness + ONLY the extracted
@@ -347,7 +348,14 @@ def make_pod_generation(
     registered name) declares the member layout: its mesh is used when
     ``mesh`` is None, its population axis is the plan's last axis, and the
     member specs come from its ``member`` rule group instead of the
-    hard-coded leading-axis split."""
+    hard-coded leading-axis split.
+
+    ``donate=False`` compiles without donating the population carry —
+    required when the program will be persisted through the executable
+    store (``parallel/compile_cache``): this image's jaxlib double-frees
+    when a DESERIALIZED executable's multi-device output buffers are
+    donated back to it on the next generation (the self-feed pattern);
+    the cost is one population copy of transient memory per generation."""
     from agilerl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -396,7 +404,7 @@ def make_pod_generation(
             check_vma=False,
         )(pop, key)
 
-    return jax.jit(gen, donate_argnums=(0,))
+    return jax.jit(gen, donate_argnums=(0,) if donate else ())
 
 
 # --------------------------------------------------------------------------- #
@@ -710,7 +718,8 @@ class ScanOffPolicy:
     def make_vmap_generation(self) -> Callable:
         return make_vmap_generation(self.member_iteration, self.evolve)
 
-    def make_pod_generation(self, mesh=None, plan=None) -> Callable:
+    def make_pod_generation(self, mesh=None, plan=None,
+                            donate: bool = True) -> Callable:
         return make_pod_generation(
             mesh,
             self.member_iteration,
@@ -720,6 +729,7 @@ class ScanOffPolicy:
                 learner=mine, ep_ret=jnp.zeros_like(pop.ep_ret)
             ),
             plan=plan,
+            donate=donate,
         )
 
     # -- snapshots ------------------------------------------------------------ #

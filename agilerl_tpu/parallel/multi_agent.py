@@ -320,7 +320,8 @@ class EvoIPPO:
     def make_vmap_generation(self) -> Callable:
         return make_vmap_generation(self.member_iteration, self.evolve)
 
-    def make_pod_generation(self, mesh=None, plan=None) -> Callable:
+    def make_pod_generation(self, mesh=None, plan=None,
+                            donate: bool = True) -> Callable:
         return make_pod_generation(
             mesh,
             self.member_iteration,
@@ -331,6 +332,7 @@ class EvoIPPO:
                 ep_ret=jnp.zeros_like(pop.ep_ret),
             ),
             plan=plan,
+            donate=donate,
         )
 
     # -- snapshots ------------------------------------------------------ #

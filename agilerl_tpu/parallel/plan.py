@@ -671,22 +671,71 @@ def load_plan(path: str, register: bool = True) -> ShardingPlan:
 class PlanCompiledStep:
     """A plan-compiled step: call it like the raw step (it enters the mesh
     context), or ``.lower(*args)`` for AOT tooling. ``mesh`` / ``plan`` /
-    ``in_shardings`` are exposed for placement and inspection."""
+    ``in_shardings`` are exposed for placement and inspection.
+
+    With a ``cache`` (:class:`~agilerl_tpu.parallel.compile_cache
+    .ExecutableStore`), calls route through per-signature load-or-compile:
+    the first call at a signature loads the persisted executable when the
+    strict fingerprint matches (plan hash, abstract signature, versions,
+    topology, lowered HLO) and compiles + republishes otherwise — the
+    compile-once discipline extended across process lifetimes."""
 
     def __init__(self, jit_fn, plan: ShardingPlan, mesh: Mesh,
-                 in_groups: Sequence[Optional[str]]):
+                 in_groups: Sequence[Optional[str]], *,
+                 cache=None, name: Optional[str] = None,
+                 donate_argnums: Tuple[int, ...] = (),
+                 static_argnums: Tuple[int, ...] = ()):
         self._jit_fn = jit_fn
         self.plan = plan
         self.mesh = mesh
         self.in_groups = tuple(in_groups)
+        self.cache = cache
+        self.name = name or f"plan_step/{plan.name}"
+        self.donate_argnums = tuple(donate_argnums)
+        self.static_argnums = tuple(static_argnums)
+        self._cached = None
+        if cache is not None:
+            from agilerl_tpu.parallel.compile_cache import CachedFunction
+
+            self._cached = CachedFunction(
+                jit_fn, name=self.name, store=cache, plan=plan, mesh=mesh,
+                donate_argnums=donate_argnums, static_argnums=static_argnums,
+                in_groups=self.in_groups,
+            )
 
     def __call__(self, *args, **kwargs):
         with self.mesh:
+            if self._cached is not None:
+                return self._cached(*args, **kwargs)
             return self._jit_fn(*args, **kwargs)
 
     def lower(self, *args, **kwargs):
         with self.mesh:
             return self._jit_fn.lower(*args, **kwargs)
+
+    def load_or_compile(self, *args, **kwargs):
+        """Explicit AOT load-or-compile for one signature. Returns
+        ``(compiled, info)`` — ``compiled`` is a ``jax.stages.Compiled``
+        (call with the same dynamic args), ``info`` records hit/miss,
+        fingerprint and load/compile timings. Works without a cache too
+        (degrades to plain AOT compile)."""
+        from agilerl_tpu.parallel import compile_cache as CC
+
+        with self.mesh:
+            return CC.load_or_compile(
+                self._jit_fn, args, kwargs, name=self.name,
+                store=self.cache, plan=self.plan, mesh=self.mesh,
+                in_groups=self.in_groups,
+                donate_argnums=self.donate_argnums,
+                static_args={f"argnum_{i}": args[i]
+                             for i in self.static_argnums
+                             if i < len(args)})
+
+    @property
+    def cache_info(self):
+        """Hit/miss info of the most recent cached load-or-compile (None
+        before the first call or without a cache)."""
+        return self._cached.last_info if self._cached is not None else None
 
     def abstract_args(self, *args):
         """Rule-resolved ``ShapeDtypeStruct`` trees for ``args`` (arrays or
@@ -721,6 +770,8 @@ def compile_step_with_plan(
     donate_argnums: Tuple[int, ...] = (),
     static_argnums: Tuple[int, ...] = (),
     constrain_inputs: bool = True,
+    cache=None,
+    name: Optional[str] = None,
 ) -> PlanCompiledStep:
     """Compile ``step_fn`` under ``plan``: each positional arg named in
     ``in_groups`` (a rule-group name, or None to leave untouched) is pinned
@@ -735,6 +786,13 @@ def compile_step_with_plan(
     dress rehearsal drive. Rules degrade on smaller meshes via
     ``filter_spec``, so the same call site serves the v5p pod and the
     8-device CPU test mesh.
+
+    ``cache`` opts into the persistent executable store
+    (:mod:`agilerl_tpu.parallel.compile_cache`): an
+    :class:`~agilerl_tpu.parallel.compile_cache.ExecutableStore`, a store
+    directory path, or None to consult ``AGILERL_TPU_COMPILE_CACHE``
+    (``False`` forces off). ``name`` labels the step in fingerprints and
+    cache telemetry (default ``plan_step/<plan name>/<fn name>``).
     """
     if isinstance(plan, str):
         plan = get_plan(plan)
@@ -755,7 +813,29 @@ def compile_step_with_plan(
             args = tuple(bound)
         return step_fn(*args, **kwargs)
 
+    from agilerl_tpu.parallel.compile_cache import resolve_cache
+
+    cache_store = resolve_cache(cache)
+    if cache_store is not None and donate_argnums \
+            and int(mesh.devices.size) > 1:
+        # a persisted program must not donate multi-device buffers: this
+        # image's jaxlib double-frees when a DESERIALIZED executable's
+        # sharded outputs are donated back to it on the next step (the
+        # carry self-feed pattern). The cost of dropping donation is one
+        # transient copy of the donated trees per step.
+        cache_store.metrics.warn_once(
+            "compile_cache/plan_step_no_donation",
+            f"plan step under {plan.name!r}: compile cache active — "
+            "donation dropped (deserialized multi-device donation is "
+            "unsafe on this jaxlib)")
+        donate_argnums = ()
     jit_fn = jax.jit(
         wrapped, donate_argnums=donate_argnums, static_argnums=static_argnums
     )
-    return PlanCompiledStep(jit_fn, plan, mesh, groups)
+    return PlanCompiledStep(
+        jit_fn, plan, mesh, groups,
+        cache=cache_store,
+        name=name or (f"plan_step/{plan.name}/"
+                      f"{getattr(step_fn, '__name__', 'step')}"),
+        donate_argnums=donate_argnums, static_argnums=static_argnums,
+    )

@@ -268,7 +268,8 @@ class EvoPPO:
         generation (measurable on the HBM/memory-bound hot loop)."""
         return make_vmap_generation(self.member_iteration, self.evolve)
 
-    def make_pod_generation(self, mesh: Mesh = None, plan=None) -> Callable:
+    def make_pod_generation(self, mesh: Mesh = None, plan=None,
+                            donate: bool = True) -> Callable:
         """Pod-sharded: members shard over the 'pop' axis (any number per
         device); fitness and ONLY the evolution subtrees (actor, critic,
         optimizer) all-gather over ICI inside shard_map — env states stay
@@ -284,4 +285,5 @@ class EvoPPO:
                 actor=mine[0], critic=mine[1], opt_state=mine[2]
             ),
             plan=plan,
+            donate=donate,
         )

@@ -22,7 +22,9 @@ cost + affinity hit rate; flywheel: disaggregated online-GRPO flywheel vs the
 interleaved loop — rollout tokens/s + learner steps/s; anakin: scan-resident
 generation engine vs the interop off-policy hot loop, per algorithm; elastic:
 MTTR under a scripted host kill + heartbeat steady-state overhead on the pod
-emulation); BENCH_POP/ENVS/ROLLOUT/
+emulation, plus a persistent-executable-store cold/warm MTTR A/B;
+compile_cache: serving replica spin-up with the executable store cold vs
+warm, best-of-N); BENCH_POP/ENVS/ROLLOUT/
 GENS and BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU
 attempt; BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
 """
@@ -1142,6 +1144,42 @@ def bench_elastic():
             f"{kill_gen}, {int(restored)} members restored, layout "
             f"{ctl2.layout()})")
 
+        # ---- (c) warm-store MTTR A/B (ISSUE 15): identical scripted kill,
+        # persistent executable store cold (empty — publishes) vs warm
+        # (loads the re-formed layout's pod generation instead of
+        # recompiling it). Same seed => bit-identical fitness streams; the
+        # delta is pure compile-vs-load.
+        cache_dir = os.path.join(work, "exe_store")
+
+        def mttr_run(workdir):
+            regn = MetricsRegistry()
+            ctl = ElasticPBTController(
+                engine(), 4, os.path.join(work, workdir), seed=0,
+                hosts=make_emulated_hosts(2, devices),
+                heartbeat_timeout=heartbeat, snapshot_every=1,
+                fault_injector=FaultInjector(kill_host_at={kill_gen: 1}),
+                registry=regn, compile_cache=cache_dir)
+            ctl.run(kill_gen + 2)
+            return {
+                "mttr_s": round(float(regn.gauge("elastic/mttr_s").value), 3),
+                "cache_hits": int(regn.counter(
+                    "compile_cache/hits_total").value),
+                "cache_misses": int(regn.counter(
+                    "compile_cache/misses_total").value),
+            }
+
+        jax.clear_caches()  # equal in-process footing for both store legs
+        cold_store = mttr_run("mttr_cold_store")
+        jax.clear_caches()
+        warm_store = mttr_run("mttr_warm_store")
+        warm_speedup = (cold_store["mttr_s"] / warm_store["mttr_s"]
+                        if warm_store["mttr_s"] > 0 else None)
+        log(f"bench_elastic: store A/B MTTR {cold_store['mttr_s']:.2f}s cold "
+            f"({cold_store['cache_misses']} compiles published) -> "
+            f"{warm_store['mttr_s']:.2f}s warm "
+            f"({warm_store['cache_hits']} loads, "
+            f"{warm_store['cache_misses']} misses)")
+
         print(json.dumps({
             "metric": ("elastic PBT on the CPU pod emulation: MTTR "
                        "(scripted host kill -> first post-recovery "
@@ -1160,11 +1198,114 @@ def bench_elastic():
             "recoveries": int(recovered),
             "members_restored": int(restored),
             "post_recovery_layout": ctl2.layout(),
+            "compile_cache": {
+                "cold_store": cold_store,
+                "warm_store": warm_store,
+                "mttr_warm_speedup": (round(warm_speedup, 2)
+                                      if warm_speedup else None),
+            },
             "error": None if np.isfinite(mttr) else "MTTR gauge is not finite",
             "provenance": ("fresh CPU pod-emulation measurement at HEAD; "
                            "MTTR includes lease expiry (heartbeat_timeout), "
                            "best-snapshot member restore, plan-registry mesh "
-                           "re-form and the survivor-layout recompile"),
+                           "re-form and the survivor-layout recompile; the "
+                           "compile_cache A/B reruns the same scripted kill "
+                           "with the persistent executable store empty vs "
+                           "warmed — the warm leg LOADS the re-formed "
+                           "layout's pod generation (jax.clear_caches "
+                           "between legs; same seed, bit-identical fitness "
+                           "stream)"),
+        }), flush=True)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def bench_compile_cache():
+    """Persistent executable store: serving replica spin-up cold vs warm
+    (ISSUE 15). Measures construction + warm_start + first completed
+    request for a ContinuousGenerator wired to the store, best-of-N, with
+    an EMPTY store (every program compiles and is published) vs the warmed
+    store (every program loads). jax.clear_caches() before every rep so
+    the in-process jit cache cannot fake a warm start. Run with
+    BENCH_MODE=compile_cache; knobs BENCH_CC_REPS / BENCH_CC_DMODEL."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.llm.serving import ContinuousGenerator
+    from agilerl_tpu.observability.registry import MetricsRegistry
+
+    backend = jax.default_backend()
+    reps = int(os.environ.get("BENCH_CC_REPS", 3))
+    d_model = int(os.environ.get("BENCH_CC_DMODEL", 64))
+    cfg = M.GPTConfig(vocab_size=256, n_layer=2, n_head=4, n_kv_head=2,
+                      d_model=d_model, d_ff=2 * d_model, max_seq_len=128)
+    params = M.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = list(range(1, 9))
+    work = tempfile.mkdtemp(prefix="bench_cc_")
+
+    def spin_up(store_dir):
+        reg = MetricsRegistry()
+        t0 = time.perf_counter()
+        gen = ContinuousGenerator(
+            cfg, max_new_tokens=16, decode_chunk=8, slots=4,
+            prompt_buckets=(16,), block_size=8, metrics=reg,
+            compile_cache=store_dir)
+        gen.warm_start(params=params, greedy=True)
+        spin_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        gen.generate([prompt], jax.random.PRNGKey(1), params, greedy=True)
+        first_req_s = time.perf_counter() - t0
+        return {
+            "spin_s": round(spin_s, 4),
+            "first_req_s": round(first_req_s, 4),
+            "total_s": round(spin_s + first_req_s, 4),
+            "cache_hits": int(reg.counter("compile_cache/hits_total").value),
+            "cache_misses": int(reg.counter(
+                "compile_cache/misses_total").value),
+        }
+
+    try:
+        cold = []
+        for i in range(reps):
+            jax.clear_caches()
+            cold.append(spin_up(os.path.join(work, f"cold_{i}")))
+        shared = os.path.join(work, "shared")
+        jax.clear_caches()
+        seed_rep = spin_up(shared)  # publishes into the shared store
+        warm = []
+        for i in range(reps):
+            jax.clear_caches()
+            warm.append(spin_up(shared))
+        cold_best = min(r["total_s"] for r in cold)
+        warm_best = min(r["total_s"] for r in warm)
+        speedup = cold_best / warm_best if warm_best > 0 else None
+        log(f"bench_compile_cache: spin-up+first-request best-of-{reps} "
+            f"{cold_best:.2f}s cold -> {warm_best:.2f}s warm "
+            f"({speedup:.2f}x)")
+        print(json.dumps({
+            "metric": ("serving replica spin-up + first request: executable "
+                       "store cold (compile+publish) vs warm (load)"),
+            "value": round(warm_best, 4),
+            "unit": "s (spin-up, warm store)",
+            "vs_baseline": None if speedup is None else round(speedup, 2),
+            "backend": backend,
+            "reps": reps,
+            "cold_best_s": round(cold_best, 4),
+            "warm_best_s": round(warm_best, 4),
+            "cold": cold,
+            "warm": warm,
+            "store_seed_rep": seed_rep,
+            "config": {"d_model": d_model, "n_layer": cfg.n_layer,
+                       "slots": 4, "max_new_tokens": 16},
+            "error": None,
+            "provenance": ("fresh CPU A/B at HEAD; cold reps use an empty "
+                           "per-rep store (programs compile and publish), "
+                           "warm reps a shared pre-warmed store (programs "
+                           "deserialize); jax.clear_caches() before every "
+                           "rep so only the on-disk store carries state"),
         }), flush=True)
     finally:
         shutil.rmtree(work, ignore_errors=True)
@@ -1227,6 +1368,8 @@ def child_main():
         bench_sharding()
     elif mode == "elastic":
         bench_elastic()
+    elif mode == "compile_cache":
+        bench_compile_cache()
     else:
         bench_evoppo()
 
@@ -1449,12 +1592,13 @@ def parent_main():
         else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
         else "sharding-plan resolution + 7B plan compile" if mode == "sharding"
         else "elastic PBT MTTR + heartbeat overhead" if mode == "elastic"
+        else "replica spin-up cold vs warm executable store" if mode == "compile_cache"
         else "evo-PPO aggregate env-steps/sec"
     )
     errors = []
 
     if mode in ("pipeline", "serving", "trace", "fleet", "flywheel",
-                "anakin", "sharding", "elastic"):
+                "anakin", "sharding", "elastic", "compile_cache"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
@@ -1480,6 +1624,7 @@ def parent_main():
                                               "flywheel")
                      else "ms/resolution" if mode == "sharding"
                      else "s (MTTR)" if mode == "elastic"
+                     else "s (spin-up)" if mode == "compile_cache"
                      else "env-steps/sec"),
             "vs_baseline": 0.0, "backend": None,
             "error": f"{mode} micro-bench: {err}",

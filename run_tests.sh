@@ -34,6 +34,10 @@
 #   bash run_tests.sh tracing    # distributed tracing + telemetry plane
 #                                # (Tracer/Span, Perfetto export, fleet
 #                                # trace acceptance, snapshot merge math)
+#   bash run_tests.sh compile_cache  # persistent executable store only
+#                                # (fingerprint misses, torn entries,
+#                                # load==compile gates, warm elastic/
+#                                # serving/layout-search paths)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -110,6 +114,14 @@ for arg in "$@"; do
       # sanitize-collision satellites)
       MARKER=(-m "tracing")
       SHARDS+=("tests/test_observability tests/test_llm/test_fleet_trace.py tests/test_llm/test_flywheel_trace.py tests/test_parallel/test_elastic_trace.py")
+      ;;
+    compile_cache)
+      # fast path: the persistent executable store (fingerprint skew =>
+      # miss, torn-entry skip-and-recompile, pod/plan/serving load==compile
+      # bit-equivalence gates under CompileGuard, layout-search warm sweep,
+      # fleet scale_up latency)
+      MARKER=(-m "compile_cache")
+      SHARDS+=("tests/test_parallel/test_compile_cache.py tests/test_llm/test_serving_cache.py")
       ;;
     flywheel)
       # fast path: the online GRPO flywheel (sync-mode equivalence gate,

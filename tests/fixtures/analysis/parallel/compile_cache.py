@@ -1,0 +1,13 @@
+"""GX004 positive for the parallel/compile_cache.py path category: the
+executable store is a durability module — a bare write here is a torn
+executable a warm process would try to load."""
+import os
+import pickle
+from pathlib import Path
+
+
+def publish_executable(path, payload):
+    with open(path, "wb") as fh:             # bare truncating open
+        pickle.dump(payload, fh)
+    Path(path).with_suffix(".json").write_text("{}")  # in-place manifest
+    os.replace(path + ".tmp", path)          # raw rename, no fsync+commit

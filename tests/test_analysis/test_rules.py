@@ -51,6 +51,18 @@ def test_negative_fixture_stays_clean(rule):
         f"{[(f.rule, f.line, f.text) for f in found]}")
 
 
+def test_gx004_gates_the_compile_cache_path(tmp_path):
+    """ISSUE 15: parallel/compile_cache.py joined GX004's durability set —
+    a bare write at that path is flagged exactly like one under
+    resilience/ (the executable store must publish through the commit-dir
+    protocol, or a kill mid-write leaves a torn executable a warm process
+    would trust)."""
+    found = _findings("parallel/compile_cache.py")
+    assert [f.rule for f in found] == ["GX004"] * 3, (
+        f"expected 3 x GX004, got "
+        f"{[(f.rule, f.line, f.text) for f in found]}")
+
+
 def test_gx001_only_fires_in_hot_modules(tmp_path):
     """The same syncing loop outside a hot segment is NOT flagged — the rule
     is about hot paths, not about float() in general."""

@@ -1,0 +1,141 @@
+"""Serving tier × persistent executable store (ISSUE 15): a replica spun up
+against a warm store serves token-identical output to the cold replica
+while compiling ZERO new XLA programs (decode chunk + per-bucket prefill
+both load), warm_start readies the decode program before the first request,
+and ServingFleet.scale_up records its spin-up latency histogram."""
+
+import jax
+import numpy as np
+import pytest
+
+from agilerl_tpu.analysis.runtime import CompileGuard
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.serving import ContinuousGenerator
+from agilerl_tpu.observability.registry import MetricsRegistry
+
+pytestmark = [pytest.mark.serving, pytest.mark.compile_cache]
+
+CFG = M.GPTConfig(vocab_size=128, n_layer=1, n_head=2, n_kv_head=2,
+                  d_model=32, d_ff=64, max_seq_len=128)
+PROMPTS = [list(range(1, 9)), list(range(3, 12))]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _gen(store_dir, reg=None):
+    return ContinuousGenerator(
+        CFG, max_new_tokens=8, decode_chunk=4, slots=4, prompt_buckets=(16,),
+        block_size=8, metrics=reg if reg is not None else MetricsRegistry(),
+        compile_cache=store_dir)
+
+
+class TestReplicaSpinUp:
+    def test_warm_replica_token_identical_zero_compiles(self, tmp_path,
+                                                        params):
+        reg_cold = MetricsRegistry()
+        cold = _gen(tmp_path, reg_cold)
+        comp_c, mask_c, _ = cold.generate(
+            PROMPTS, jax.random.PRNGKey(1), params, greedy=True)
+        assert reg_cold.counter("compile_cache/misses_total").value >= 2
+
+        # a fresh generator over the same store == a fresh process /
+        # autoscaler spin-up; keys are pre-built so the guard sees ONLY
+        # the serving path
+        reg_warm = MetricsRegistry()
+        warm = _gen(tmp_path, reg_warm)
+        keys = [jax.random.fold_in(jax.random.PRNGKey(1), i)
+                for i in range(len(PROMPTS))]
+        warm.warm_start(params=params, greedy=True)
+        with CompileGuard(label="warm-replica"):
+            tickets = [warm.submit(p, key=k, no_shed=True)
+                       for p, k in zip(PROMPTS, keys)]
+            warm.run_until_drained(params, greedy=True)
+        comp_w = np.stack([warm.result(t)[0] for t in tickets])
+        np.testing.assert_array_equal(comp_w[:, :comp_c.shape[1]], comp_c)
+        assert reg_warm.counter("compile_cache/hits_total").value >= 2
+        assert reg_warm.counter("compile_cache/misses_total").value == 0
+
+    def test_warm_start_prepares_decode_and_prefill(self, tmp_path, params):
+        cold = _gen(tmp_path)
+        infos = cold.warm_start(params=params, greedy=True)
+        # one decode chunk + one prefill per prompt bucket (here: one)
+        assert [i["hit"] for i in infos] == [False, False]
+        warm = _gen(tmp_path)
+        infos = warm.warm_start(params=params, greedy=True)
+        assert [i["hit"] for i in infos] == [True, True]
+        # only_cached on a COLD store probes without compiling
+        lazy = _gen(str(tmp_path) + "_cold")
+        infos = lazy.warm_start(params=params, greedy=True, only_cached=True)
+        assert all(not i["hit"] and i.get("skipped_compile")
+                   for i in infos)
+
+    def test_compiled_programs_counts_loaded_executables(self, tmp_path,
+                                                         params):
+        cold = _gen(tmp_path)
+        cold.generate(PROMPTS, jax.random.PRNGKey(1), params, greedy=True)
+        n = cold.compiled_programs
+        assert n >= 2  # decode chunk + the one prompt bucket's prefill
+        warm = _gen(tmp_path)
+        warm.generate(PROMPTS, jax.random.PRNGKey(1), params, greedy=True)
+        assert warm.compiled_programs == n
+
+    def test_cache_off_keeps_plain_jit(self, params):
+        gen = ContinuousGenerator(CFG, max_new_tokens=8, decode_chunk=4,
+                                  slots=4, prompt_buckets=(16,), block_size=8,
+                                  metrics=MetricsRegistry())
+        assert gen.compile_cache is None
+        assert gen.warm_start(params=params) == []  # no-op without a store
+
+
+class TestFleetScaleUp:
+    def test_scale_up_latency_histogram(self, tmp_path, params):
+        from agilerl_tpu.llm.fleet import ServingFleet
+
+        reg = MetricsRegistry()
+        fleet = ServingFleet(
+            CFG, 1, metrics=reg, max_new_tokens=8, decode_chunk=4, slots=4,
+            prompt_buckets=(16,), block_size=8,
+            compile_cache=str(tmp_path / "store"))
+        rid = fleet.scale_up()
+        summary = fleet.latency_summary()["fleet"]["scale_up_latency_s"]
+        assert summary["count"] == 1
+        assert summary["sum"] > 0
+        assert rid in fleet.replica_ids
+
+    def test_cold_store_spin_up_stays_lazy(self, tmp_path):
+        """A cold store must NOT make scale_up slower than the pre-store
+        lazy behavior: spin-up probes the store (only_cached) and leaves
+        misses to compile on first real use — zero eager backend compiles
+        beyond what replica construction always did."""
+        from agilerl_tpu.llm.fleet import ServingFleet
+
+        fleet = ServingFleet(
+            CFG, 1, metrics=MetricsRegistry(), max_new_tokens=8,
+            decode_chunk=4, slots=4, prompt_buckets=(16,), block_size=8,
+            compile_cache=str(tmp_path / "cold"))
+        rid = fleet.replica_ids[0]
+        m = fleet._members[rid]
+        assert m.gen.metrics.counter("compile_cache/hits_total").value == 0
+        assert m.gen.metrics.counter("compile_cache/misses_total").value == 0
+
+    def test_warm_store_speeds_scale_up(self, tmp_path, params):
+        """The autoscaling-reaction satellite: after fleet 1 SERVED (and so
+        published its programs), a second fleet's scale_up spins replicas
+        up by loading — zero new backend compiles inside the guard."""
+        from agilerl_tpu.llm.fleet import ServingFleet
+
+        store = str(tmp_path / "store")
+        kw = dict(max_new_tokens=8, decode_chunk=4, slots=4,
+                  prompt_buckets=(16,), block_size=8, compile_cache=store)
+        f1 = ServingFleet(CFG, 1, metrics=MetricsRegistry(), **kw)
+        f1.generate(PROMPTS, jax.random.PRNGKey(1), params, greedy=True)
+
+        f2 = ServingFleet(CFG, 1, metrics=MetricsRegistry(), **kw)
+        with CompileGuard(label="warm-scale-up"):
+            rid = f2.scale_up()
+        m = f2._members[rid]
+        assert m.gen.metrics.counter("compile_cache/hits_total").value >= 1
+        assert m.gen.metrics.counter("compile_cache/misses_total").value == 0
