@@ -73,6 +73,19 @@ class AutoscalePolicy:
         self._last_up_s: Optional[float] = None
         self._last_down_s: Optional[float] = None
         self._last_shed_total: Optional[float] = None
+        #: the last structured decision record :meth:`decide` built — what
+        #: :meth:`apply` enriches (cooldown state, actuation) and emits
+        self.last_decision: Optional[Dict[str, Any]] = None
+
+    def _thresholds(self) -> Dict[str, Any]:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "backlog_high": self.backlog_high,
+            "backlog_low": self.backlog_low,
+            "ttft_p95_high_s": self.ttft_p95_high_s,
+            "shed_rate_high": self.shed_rate_high,
+        }
 
     # -- the pure decision -------------------------------------------------
     def decide(self, signals: Dict[str, Any],
@@ -80,10 +93,16 @@ class AutoscalePolicy:
         """``"up"`` / ``"down"`` / None for one signal snapshot. Pure —
         no clocks, no counters — so tests feed synthetic signals directly.
         Cooldowns are :meth:`apply`'s job, not a reason to distort the
-        decision itself."""
+        decision itself.
+
+        Every call leaves a STRUCTURED record of what it saw and why in
+        :attr:`last_decision` (signals, thresholds, the triggers that
+        fired, the verdict); :meth:`apply` adds cooldown/actuation state
+        and emits it through the owner's sink as an ``autoscale_decision``
+        event — the record an SLO report joins against alert timestamps to
+        attribute ``fleet/scale_up_latency_s`` to the breach that triggered
+        the scale-up."""
         replicas = int(signals.get("replicas", 0))
-        if replicas < self.min_replicas:
-            return "up"
         mean_backlog = float(signals.get("mean_backlog", 0.0))
         p95 = signals.get("p95_ttft_s")
         # the TTFT window is count-bounded, not time-decayed: with zero
@@ -92,44 +111,102 @@ class AutoscalePolicy:
         # nor block its scale-down forever
         busy = (mean_backlog > 0.0
                 or float(signals.get("fleet_backlog", 0.0)) > 0.0)
-        hot = mean_backlog >= self.backlog_high
-        if self.ttft_p95_high_s is not None and p95 is not None and busy:
-            hot = hot or p95 >= self.ttft_p95_high_s
-        if self.shed_rate_high is not None:
-            hot = hot or shed_delta >= self.shed_rate_high
-        if hot:
-            return "up" if replicas < self.max_replicas else None
-        slow_ok = (self.ttft_p95_high_s is None or p95 is None
-                   or p95 < self.ttft_p95_high_s or not busy)
-        cold = (mean_backlog <= self.backlog_low and shed_delta <= 0.0
-                and float(signals.get("fleet_backlog", 0.0)) <= 0.0
-                and slow_ok)
-        if cold and replicas > self.min_replicas:
-            return "down"
-        return None
+        triggers = []
+        verdict: Optional[str] = None
+        if replicas < self.min_replicas:
+            triggers.append("below_min_replicas")
+            verdict = "up"
+        else:
+            if mean_backlog >= self.backlog_high:
+                triggers.append("backlog_high")
+            if (self.ttft_p95_high_s is not None and p95 is not None
+                    and busy and p95 >= self.ttft_p95_high_s):
+                triggers.append("ttft_p95_breach")
+            if (self.shed_rate_high is not None
+                    and shed_delta >= self.shed_rate_high):
+                triggers.append("shedding")
+            if triggers:
+                verdict = "up" if replicas < self.max_replicas else None
+                if verdict is None:
+                    triggers.append("at_max_replicas")
+            else:
+                slow_ok = (self.ttft_p95_high_s is None or p95 is None
+                           or p95 < self.ttft_p95_high_s or not busy)
+                cold = (mean_backlog <= self.backlog_low
+                        and shed_delta <= 0.0
+                        and float(signals.get("fleet_backlog", 0.0)) <= 0.0
+                        and slow_ok)
+                if cold and replicas > self.min_replicas:
+                    triggers.append("sustained_idle")
+                    verdict = "down"
+        self.last_decision = {
+            "verdict": verdict,
+            "triggers": triggers,
+            "signals": {k: signals.get(k) for k in (
+                "replicas", "mean_backlog", "max_backlog", "fleet_backlog",
+                "p95_ttft_s", "shed_total")},
+            "shed_delta": float(shed_delta),
+            "thresholds": self._thresholds(),
+        }
+        return verdict
 
     # -- the stateful actuator ---------------------------------------------
+    def _cooldown_state(self, now: float) -> Dict[str, Any]:
+        up_rem = (max(0.0, self.up_cooldown_s - (now - self._last_up_s))
+                  if self._last_up_s is not None else 0.0)
+        down_rem = (max(0.0, self.down_cooldown_s - (now - self._last_down_s))
+                    if self._last_down_s is not None else 0.0)
+        return {"up_remaining_s": round(up_rem, 6),
+                "down_remaining_s": round(down_rem, 6)}
+
+    def _emit_decision(self, decision: Dict[str, Any]) -> None:
+        """One structured ``autoscale_decision`` event through the owner's
+        sink per non-trivial decision: everything the policy saw (signals,
+        thresholds, triggers), its verdict, the cooldown state, and whether
+        it actually actuated — the SLO report's attribution record (which
+        breach triggered the scale-up whose ``fleet/scale_up_latency_s``
+        sample the report grades)."""
+        self.metrics.counter(
+            "fleet/autoscale_decisions_total",
+            help="structured autoscale decisions emitted").inc()
+        self.metrics.emit("autoscale_decision", **decision)
+
     def apply(self, fleet) -> Optional[Tuple[str, int]]:
         """Read the fleet's signals, decide, enforce cooldowns, and call
         ``scale_up()`` / ``scale_down()``. Returns ``(action, replica_id)``
-        when an action fired, else None."""
+        when an action fired, else None. Every decision with a non-None
+        verdict — actuated or cooldown-blocked — is emitted as a structured
+        ``autoscale_decision`` event (quiet no-pressure ticks are recorded
+        in :attr:`last_decision` but not emitted: at step cadence they
+        would be sink spam)."""
         signals = fleet.slo_signals()
         shed_total = float(signals.get("shed_total", 0.0))
         shed_delta = (shed_total - self._last_shed_total
                       if self._last_shed_total is not None else 0.0)
         action = self.decide(signals, shed_delta)
+        decision = self.last_decision
+        now = float(self.clock())
+        decision["cooldown"] = self._cooldown_state(now)
+        decision["actioned"] = False
+        decision["replica"] = None
         if action is None:
             # no pressure: roll the shed window forward (delta is a rate
             # per apply interval, not a lifetime accumulator)
             self._last_shed_total = shed_total
+            if decision["triggers"]:
+                # a trigger fired but actuation is impossible (at max
+                # replicas): still worth an attribution record
+                decision["blocked_by"] = "replica_bounds"
+                self._emit_decision(decision)
             return None
-        now = float(self.clock())
         if action == "up":
             if (self._last_up_s is not None
                     and now - self._last_up_s < self.up_cooldown_s):
                 # cooldown-blocked: do NOT consume the shed window, or
                 # shedding observed during the cooldown could never
                 # trigger the scale-up once it expires
+                decision["blocked_by"] = "up_cooldown"
+                self._emit_decision(decision)
                 return None
             self._last_shed_total = shed_total
             rid = fleet.scale_up()
@@ -137,16 +214,23 @@ class AutoscalePolicy:
         else:
             if (self._last_down_s is not None
                     and now - self._last_down_s < self.down_cooldown_s):
+                decision["blocked_by"] = "down_cooldown"
+                self._emit_decision(decision)
                 return None
             self._last_shed_total = shed_total
             rid = fleet.least_loaded_replica()
             if rid is None:
+                decision["blocked_by"] = "no_retirable_replica"
+                self._emit_decision(decision)
                 return None
             fleet.scale_down(rid)
             self._last_down_s = now
         self.metrics.counter(
             f"fleet/autoscale_{action}_total",
             help="autoscale policy actions taken").inc()
+        decision["actioned"] = True
+        decision["replica"] = int(rid)
+        self._emit_decision(decision)
         self.metrics.emit(
             "fleet_autoscale", action=action, replica=int(rid),
             mean_backlog=signals.get("mean_backlog"),

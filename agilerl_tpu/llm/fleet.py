@@ -351,6 +351,7 @@ class ServingFleet:
         tracer=None,
         telemetry_dir: Optional[Union[str, Path]] = None,
         telemetry_interval_s: float = 10.0,
+        bucket_overrides: Optional[Dict[str, Sequence[float]]] = None,
         **gen_kwargs: Any,
     ):
         if topology not in ("unified", "disaggregated"):
@@ -364,6 +365,17 @@ class ServingFleet:
         self.config = config
         self.topology = topology
         self.metrics = metrics if metrics is not None else observability.get_registry()
+        #: histogram bucket configuration applied to the fleet registry AND
+        #: every member registry this fleet spawns — the one knob that keeps
+        #: bucket bounds identical fleet-wide (an SLO spec aligning edges
+        #: with its thresholds must configure ALL pods identically, or the
+        #: telemetry aggregator's exact bucket-wise merge raises
+        #: TelemetrySchemaError — by design)
+        self._bucket_overrides = {
+            name: tuple(sorted(float(b) for b in bounds))
+            for name, bounds in (bucket_overrides or {}).items()}
+        for name, bounds in self._bucket_overrides.items():
+            self.metrics.configure_buckets(name, bounds)
         self._tracer = tracer
         #: cross-process telemetry plane: when set, every step() publishes
         #: each member's registry (plus the fleet's) as a per-pod snapshot
@@ -414,6 +426,11 @@ class ServingFleet:
         self._departed_totals = {"requests_total": 0.0,
                                  "tokens_decoded_total": 0.0,
                                  "shed_requests_total": 0.0}
+        # full registry dumps of those same deleted members, merged: the
+        # bank behind merged_dump() — without it a retirement would make
+        # fleet-wide counters/histograms run BACKWARDS mid-SLO-window
+        self._departed_metrics: Dict[str, Any] = {"counters": {},
+                                                  "histograms": {}}
         serving_role = ROLE_DECODE if topology == "disaggregated" else ROLE_UNIFIED
         for _ in range(int(n_replicas)):
             self._spawn(serving_role)
@@ -600,8 +617,25 @@ class ServingFleet:
         for key in self._departed_totals:
             self._departed_totals[key] += float(
                 m.gen.metrics.counter(f"serving/{key}").value)
+        self._bank_departed(m)
         del self._members[m.rid]
         self._update_replica_count()
+
+    def _bank_departed(self, m: _Member) -> None:
+        """Fold a to-be-deleted member's full registry dump into the
+        departed bank so :meth:`merged_dump` stays monotone across planned
+        retirements (the restart-rebase the cross-process aggregator does,
+        applied in-process)."""
+        from agilerl_tpu.observability.export import merge_histogram_dumps
+
+        dump = m.gen.metrics.dump()
+        bank_c = self._departed_metrics["counters"]
+        for name, v in (dump.get("counters") or {}).items():
+            bank_c[name] = bank_c.get(name, 0.0) + float(v)
+        bank_h = self._departed_metrics["histograms"]
+        for name, h in (dump.get("histograms") or {}).items():
+            bank_h[name] = (merge_histogram_dumps(bank_h[name], h, name)
+                            if name in bank_h else h)
 
     def _spawn(self, role: str, plan=None) -> _Member:
         rid = self._next_rid
@@ -615,11 +649,16 @@ class ServingFleet:
             plan = self.sharding_plan
         if role == ROLE_PREFILL:
             gen = PrefillWorker.matching(
-                self._grid_ref(), metrics=MetricsRegistry(),
+                self._grid_ref(),
+                metrics=MetricsRegistry(
+                    bucket_overrides=self._bucket_overrides),
                 sharding_plan=plan)
         else:
             gen = ContinuousGenerator(
-                self.config, metrics=MetricsRegistry(), sharding_plan=plan,
+                self.config,
+                metrics=MetricsRegistry(
+                    bucket_overrides=self._bucket_overrides),
+                sharding_plan=plan,
                 tracer=self._tracer, **self._gen_kwargs)
             if gen.compile_cache is not None:
                 # persistent executable store: spin-up LOADS the decode
@@ -1169,6 +1208,71 @@ class ServingFleet:
                 buckets=SCALE_UP_BUCKETS).summary(),
         }
         return {"replicas": replicas, "fleet": fleet}
+
+    def merged_dump(self, counters: Optional[Sequence[str]] = None,
+                    histograms: Optional[Sequence[str]] = None
+                    ) -> Dict[str, Any]:
+        """One fleet-wide metric dump: the fleet registry ⊕ every member
+        registry (tombstoned unplanned losses included — their state is
+        history, not noise) ⊕ the banked dumps of scale_down-deleted
+        members. The in-process analogue of
+        ``TelemetryAggregator.merged_dump()`` — same bucket-exact histogram
+        merge (``TelemetrySchemaError`` on bounds skew, which
+        ``bucket_overrides`` exists to prevent) without the commit-dir
+        round-trip — and the source the SLO evaluator grades in-process
+        (``observability/slo.SLOEvaluator``; pass the spec's
+        ``metric_names()`` as the ``counters``/``histograms`` filters to
+        keep the per-step read off the full-dump path)."""
+        from agilerl_tpu.observability.export import merge_histogram_dumps
+        from agilerl_tpu.observability.registry import (Counter, Gauge,
+                                                        Histogram)
+
+        cset = set(counters) if counters is not None else None
+        hset = set(histograms) if histograms is not None else None
+        unfiltered = cset is None and hset is None
+        out: Dict[str, Any] = {"counters": {}, "gauges": {},
+                               "histograms": {}}
+        for name, v in self._departed_metrics["counters"].items():
+            if cset is None or name in cset:
+                out["counters"][name] = float(v)
+        for name, h in self._departed_metrics["histograms"].items():
+            if hset is None or name in hset:
+                out["histograms"][name] = {
+                    "bounds": list(h["bounds"]),
+                    "counts": list(h["counts"]),
+                    "sum": float(h["sum"]), "count": int(h["count"])}
+        regs = [self.metrics] + [
+            m.gen.metrics for m in self._members.values()
+            if getattr(m.gen, "metrics", None) is not None
+            and m.gen.metrics is not self.metrics]
+        for reg in regs:
+            for name, inst in list(reg._metrics.items()):
+                if isinstance(inst, Counter):
+                    if cset is None or name in cset:
+                        out["counters"][name] = (
+                            out["counters"].get(name, 0.0) + inst.value)
+                elif isinstance(inst, Histogram):
+                    if hset is None or name in hset:
+                        with inst._lock:
+                            h = {"bounds": list(inst.bounds),
+                                 "counts": list(inst._counts),
+                                 "sum": inst._sum, "count": inst._count}
+                        prev = out["histograms"].get(name)
+                        out["histograms"][name] = (
+                            merge_histogram_dumps(prev, h, name)
+                            if prev is not None else h)
+                elif isinstance(inst, Gauge) and unfiltered:
+                    # fleet-registry value wins (regs[0]); members only
+                    # fill gauges the fleet itself does not keep
+                    out["gauges"].setdefault(name, inst.value)
+        return out
+
+    @property
+    def open_requests(self) -> int:
+        """Fleet tickets submitted but not yet finished (queued, prefilling,
+        in transfer, decoding, or parked) — the load-generator drain signal
+        (``benchmarking/traffic.py``)."""
+        return int(self._open)
 
     @property
     def replica_ids(self) -> List[int]:

@@ -21,6 +21,18 @@ from agilerl_tpu.observability.facade import (
     warn_once,
 )
 from agilerl_tpu.observability.lineage import LineageTracker
+from agilerl_tpu.observability.slo import (
+    AlertPolicy,
+    Objective,
+    SLOEvaluator,
+    SLOSpec,
+    aligned_buckets,
+    attribute_scale_ups,
+    load_slo_spec,
+    registry_source,
+    save_slo_spec,
+    write_report,
+)
 from agilerl_tpu.observability.registry import (
     Counter,
     Gauge,
@@ -56,4 +68,7 @@ __all__ = [
     "trace_tree",
     "TelemetryPublisher", "TelemetryAggregator", "TelemetrySchemaError",
     "merge_histogram_dumps",
+    "SLOSpec", "Objective", "AlertPolicy", "SLOEvaluator",
+    "load_slo_spec", "save_slo_spec", "aligned_buckets",
+    "attribute_scale_ups", "registry_source", "write_report",
 ]

@@ -152,13 +152,21 @@ class MetricsRegistry:
     the single funnel through which structured events reach disk.
     """
 
-    def __init__(self, sink=None):
+    def __init__(self, sink=None,
+                 bucket_overrides: Optional[Dict[str, Sequence[float]]] = None):
         self._metrics: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._sink = sink
         self._warned: set = set()
         #: sanitized Prometheus name -> original name (collision guard)
         self._sanitized: Dict[str, str] = {}
+        #: histogram name -> configured bucket bounds (takes precedence over
+        #: the call site's ``buckets=`` so an SLO spec can align bucket
+        #: edges with its thresholds — interpolated percentiles are exact at
+        #: an edge and an estimate inside a bucket)
+        self._bucket_overrides: Dict[str, Tuple[float, ...]] = {}
+        for name, bounds in (bucket_overrides or {}).items():
+            self.configure_buckets(name, bounds)
 
     # -- instruments -------------------------------------------------------
     def _get_or_create(self, name: str, cls, **kwargs):
@@ -195,9 +203,63 @@ class MetricsRegistry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(name, Gauge, help=help)
 
+    def configure_buckets(self, name: str,
+                          buckets: Sequence[float]) -> None:
+        """Pin the bucket bounds future :meth:`histogram` calls for ``name``
+        will use, overriding the call site's ``buckets=`` argument. This is
+        how an SLO spec aligns bucket edges with its thresholds BEFORE the
+        instrumented code path first observes (``observability.slo.
+        SLOSpec.apply_buckets``). Configuring after the instrument exists
+        with different bounds cannot rebin live data — it warns once and
+        keeps the live instrument (every pod must be configured identically
+        BEFORE traffic, or the cross-process aggregator's exact-merge check
+        will raise ``TelemetrySchemaError``)."""
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("configure_buckets needs at least one bound")
+        with self._lock:
+            live = self._metrics.get(name)
+        if isinstance(live, Histogram) and live.bounds != bounds:
+            self.warn_once(
+                f"bucket-config-late:{name}",
+                f"configure_buckets({name!r}) after the histogram exists "
+                f"with different bounds — live data cannot be rebinned; "
+                "keeping the live bounds (configure before first observe)",
+                configured=list(bounds), live=list(live.bounds))
+            return
+        self._bucket_overrides[name] = bounds
+
+    def bucket_bounds(self, name: str) -> Optional[Tuple[float, ...]]:
+        """The effective bucket bounds for ``name``: the live instrument's
+        if created, else the configured override, else None."""
+        with self._lock:
+            live = self._metrics.get(name)
+        if isinstance(live, Histogram):
+            return live.bounds
+        return self._bucket_overrides.get(name)
+
     def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
                   help: str = "") -> Histogram:
-        return self._get_or_create(name, Histogram, buckets=buckets, help=help)
+        override = self._bucket_overrides.get(name)
+        if override is not None:
+            buckets = override
+        h = self._get_or_create(name, Histogram, buckets=buckets, help=help)
+        # fast path: call sites pass the same module-constant tuple every
+        # time, so an elementwise equality short-circuits before the
+        # sort+float normalization (this runs per observe on hot paths)
+        if (override is None and tuple(buckets) != h.bounds
+                and h.bounds != tuple(sorted(float(b) for b in buckets))):
+            # two call sites disagree on bounds: the first one won (get-or-
+            # create semantics), and silent skew would make interpolated
+            # percentiles — and the aggregator's exact bucket-wise merge —
+            # quietly wrong for whichever site loses
+            self.warn_once(
+                f"bucket-skew:{name}",
+                f"histogram {name!r} requested with bucket bounds that "
+                f"differ from the live instrument's — the first creation "
+                "won; align the call sites (or configure_buckets up front)",
+                live=list(h.bounds))
+        return h
 
     def timer(self, name: str, help: str = ""):
         """Context manager accumulating the block's wall time into the

@@ -24,7 +24,8 @@ generation engine vs the interop off-policy hot loop, per algorithm; elastic:
 MTTR under a scripted host kill + heartbeat steady-state overhead on the pod
 emulation, plus a persistent-executable-store cold/warm MTTR A/B;
 compile_cache: serving replica spin-up with the executable store cold vs
-warm, best-of-N); BENCH_POP/ENVS/ROLLOUT/
+warm, best-of-N; traffic: synthetic-load scenarios graded against an SLO
+spec, with a fault-injected burst + autoscaler run); BENCH_POP/ENVS/ROLLOUT/
 GENS and BENCH_GRPO_BATCH/SEQ for scale; BENCH_FORCE_CPU=1 to skip the TPU
 attempt; BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT / BENCH_PROBE_TIMEOUT (seconds).
 """
@@ -1347,6 +1348,203 @@ def probe_main():
     print(f"PROBE_OK {jax.default_backend()}", flush=True)
 
 
+def bench_traffic():
+    """Traffic harness + SLO engine (docs/serving.md, docs/observability.md):
+    drive a 2-replica ``ServingFleet`` through the four standing synthetic-
+    load scenarios (steady heavy-tail, diurnal, flash-crowd, prefix-skew;
+    ``agilerl_tpu/benchmarking/traffic.py``) with the SLO evaluator
+    (``configs/slo/traffic_cpu.yaml``) ticking every scheduler step, then a
+    FAULT-INJECTED flash crowd — one replica killed mid-burst with the
+    autoscaler live — to show the burn-rate alert fire (forced span), the
+    graded scale-up, and the alert clear after recovery. Emits ONE scored
+    JSON line: per-scenario SLO grades + degraded-run attribution +
+    generation provenance (every trace is regenerable from spec+seed, or
+    replayable from BENCH_TRAFFIC_TRACE). Run with BENCH_MODE=traffic;
+    knobs BENCH_TRAFFIC_DURATION_S / _RPS / _STEPS_PER_S / _SEED / _SLO."""
+    import jax
+    import jax.numpy as jnp
+
+    from agilerl_tpu.benchmarking.traffic import (
+        ScenarioSpec, TrafficDriver, generate_trace, load_trace,
+        scenario_suite)
+    from agilerl_tpu.llm import model as M
+    from agilerl_tpu.llm.autoscale import AutoscalePolicy
+    from agilerl_tpu.llm.fleet import (SCALE_UP_BUCKETS, ServingFleet)
+    from agilerl_tpu.llm.serving import (AdmissionPolicy, DECODE_BUCKETS,
+                                         TTFT_BUCKETS)
+    from agilerl_tpu.observability import (MemorySink, MetricsRegistry,
+                                           SLOEvaluator, aligned_buckets,
+                                           attribute_scale_ups,
+                                           load_slo_spec)
+    from agilerl_tpu.observability.trace import Tracer
+    from agilerl_tpu.resilience.faults import FaultInjector
+
+    backend = jax.default_backend()
+    duration = float(os.environ.get("BENCH_TRAFFIC_DURATION_S", 10.0))
+    rate = float(os.environ.get("BENCH_TRAFFIC_RPS", 5.0))
+    steps_per_s = float(os.environ.get("BENCH_TRAFFIC_STEPS_PER_S", 8.0))
+    seed = int(os.environ.get("BENCH_TRAFFIC_SEED", 0))
+    spec_path = os.environ.get("BENCH_TRAFFIC_SLO",
+                               os.path.join(os.path.dirname(
+                                   os.path.abspath(__file__)),
+                                   "configs", "slo", "traffic_cpu.yaml"))
+    slo_spec = load_slo_spec(spec_path)
+    # align fleet-wide bucket bounds with the spec's thresholds so every
+    # burn-rate fraction is an exact bucket-count delta (satellite contract:
+    # identical bounds on every member registry or the telemetry
+    # aggregator's exact merge refuses)
+    base_bounds = {"serving/ttft_s": TTFT_BUCKETS,
+                   "serving/decode_time_per_token_s": DECODE_BUCKETS,
+                   "fleet/scale_up_latency_s": SCALE_UP_BUCKETS}
+    overrides = {name: aligned_buckets(base_bounds.get(name, ()), edges)
+                 for name, edges in slo_spec.bucket_overrides().items()}
+    cfg = M.GPTConfig(vocab_size=128, n_layer=2, n_head=4, n_kv_head=2,
+                      d_model=64, max_seq_len=256, dtype=jnp.float32)
+    params = M.init_params(jax.random.PRNGKey(0), cfg)
+    kw = dict(max_new_tokens=16, pad_id=0, eos_id=None, prompt_buckets=(32,),
+              slots=4, block_size=8, decode_chunk=4)
+
+    class VClock:
+        """Virtual-time clock fed by the driver — burn windows and
+        autoscale cooldowns run on scenario time, not host speed."""
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    def run_one(name, trace, *, fault=None, autoscale=None,
+                max_queue=256, member_queue=None):
+        sink = MemorySink()
+        kw_run = dict(kw)
+        if member_queue is not None:
+            kw_run["max_queue"] = member_queue
+        fleet = ServingFleet(
+            cfg, 2, metrics=MetricsRegistry(sink=sink),
+            admission=AdmissionPolicy(max_queue=max_queue),
+            bucket_overrides=overrides,
+            tracer=Tracer(sink=MemorySink(), sample_rate=0.0), **kw_run)
+        # warm the compile cache outside the graded run
+        t = fleet.submit(trace[0].tokens, max_new=2, no_shed=True)
+        fleet.run_until_drained(params, greedy=True)
+        fleet.result(t)
+        vclock = VClock()
+        tracer = Tracer(sink=MemorySink(), sample_rate=0.0,
+                        metrics=fleet.metrics, clock=vclock)
+        policy = None
+        if autoscale:
+            policy = AutoscalePolicy(
+                min_replicas=2, max_replicas=4, backlog_high=6.0,
+                shed_rate_high=1.0, up_cooldown_s=3.0, down_cooldown_s=1e9,
+                clock=vclock, metrics=fleet.metrics)
+        # fleet-wide source: filtered merged dump (fleet registry + every
+        # member registry + departed bank), so the per-step read only
+        # touches the instruments the spec grades
+        cnames, hnames = slo_spec.metric_names()
+
+        def source():
+            return fleet.merged_dump(counters=cnames, histograms=hnames)
+
+        ev = SLOEvaluator(slo_spec, source, clock=vclock,
+                          metrics=fleet.metrics, tracer=tracer)
+        ev_s = [0.0]
+
+        def on_step(step, vnow):
+            vclock.t = vnow
+            t0 = time.perf_counter()
+            ev.evaluate(now=vnow)
+            ev_s[0] += time.perf_counter() - t0
+
+        drv = TrafficDriver(fleet, mode="open", steps_per_s=steps_per_s,
+                            seed=seed, autoscale=policy,
+                            fault_injector=fault, on_step=on_step)
+        res = drv.run(trace, params, scenario=name)
+        ev.evaluate(now=vclock.t + 1.0 / steps_per_s)  # final tick
+        report = ev.grade(scenario=name, extra={
+            "run": res.to_dict(),
+            "replicas_end": len(fleet.replica_ids),
+            # per-step continuous evaluation cost attributed against the
+            # run's wall clock — the ~1% overhead budget, measured
+            "slo_eval_overhead_frac": round(ev_s[0] / max(res.wall_s, 1e-9),
+                                            5),
+            "forced_spans": sum(
+                1 for s in tracer.sink.events
+                if str(s.get("name", "")).startswith("slo.")),
+            "attribution": attribute_scale_ups(sink.events),
+        })
+        return res, report
+
+    trace_path = os.environ.get("BENCH_TRAFFIC_TRACE")
+    reports = {}
+    if trace_path:
+        trace = load_trace(trace_path)
+        res, rep = run_one("replayed_trace", trace)
+        reports["replayed_trace"] = rep
+    else:
+        for spec in scenario_suite(vocab=cfg.vocab_size, duration_s=duration,
+                                   base_rate_rps=rate, max_prompt=28,
+                                   max_new=kw["max_new_tokens"]):
+            trace = generate_trace(spec, seed)
+            res, rep = run_one(spec.name, trace)
+            reports[spec.name] = rep
+            log(f"bench_traffic: {spec.name} score {rep['score']} "
+                f"({res.completed}/{res.n_requests} served, {res.shed} shed, "
+                f"{res.wall_s:.1f}s wall)")
+
+    # the degraded run: flash crowd + replica kill mid-burst + autoscaler —
+    # small admission queues (router AND member) make the burst actually
+    # shed, which is the burn-rate breach the alert must catch
+    deg_spec = ScenarioSpec(
+        name="degraded_burst", kind="flash_crowd", duration_s=duration,
+        base_rate_rps=rate, burst_start_s=0.3 * duration,
+        burst_duration_s=0.25 * duration, burst_x=8.0,
+        vocab=cfg.vocab_size, max_prompt=28, max_new=kw["max_new_tokens"])
+    deg_trace = generate_trace(deg_spec, seed + 2)
+    kill_at = int(0.35 * duration) + 1
+    res_deg, rep_deg = run_one(
+        "degraded_burst", deg_trace,
+        fault=FaultInjector(kill_host_at={kill_at: 1}),
+        autoscale=True, max_queue=8, member_queue=4)
+    fires = [a for a in rep_deg["alerts"] if a["phase"] == "fire"]
+    clears = [a for a in rep_deg["alerts"] if a["phase"] == "clear"]
+    scale_ups = [e for e in res_deg.scale_events if e["action"] == "up"]
+    log(f"bench_traffic: degraded_burst score {rep_deg['score']}, "
+        f"{len(fires)} alert(s) fired / {len(clears)} cleared, "
+        f"{len(scale_ups)} scale-up(s), kill at t={kill_at}s, "
+        f"shed {res_deg.shed}")
+
+    scores = [r["score"] for r in reports.values()]
+    mean_score = sum(scores) / max(len(scores), 1)
+    overheads = [r["slo_eval_overhead_frac"]
+                 for r in list(reports.values()) + [rep_deg]]
+    overhead = sum(overheads) / len(overheads)
+    print(json.dumps({
+        "metric": ("traffic-harness SLO score, mean over synthetic-load "
+                   "scenarios (steady heavy-tail / diurnal / flash-crowd / "
+                   "prefix-skew) on a 2-replica ServingFleet; vs_baseline "
+                   "is the fault-injected flash-crowd (replica kill "
+                   "mid-burst, autoscaler live) relative to the healthy "
+                   "mean"),
+        "value": round(mean_score, 1),
+        "unit": "slo-score",
+        "vs_baseline": round(rep_deg["score"] / max(mean_score, 1e-9), 3),
+        "scenarios": reports,
+        "degraded": rep_deg,
+        "degraded_alert_fired": bool(fires),
+        "degraded_alert_cleared": bool(clears),
+        "degraded_scale_ups": scale_ups,
+        "slo_eval_overhead_frac": round(overhead, 4),
+        "provenance": {
+            "seed": seed, "slo_spec": slo_spec.name,
+            "slo_spec_path": spec_path, "steps_per_s": steps_per_s,
+            "duration_s": duration, "base_rate_rps": rate,
+            "replayed_trace": trace_path,
+            "bucket_overrides": {k: list(v) for k, v in overrides.items()},
+        },
+        "backend": backend,
+        "error": None,
+    }), flush=True)
+
+
 def child_main():
     _maybe_pin_cpu()
     mode = os.environ.get("BENCH_MODE")
@@ -1370,6 +1568,8 @@ def child_main():
         bench_elastic()
     elif mode == "compile_cache":
         bench_compile_cache()
+    elif mode == "traffic":
+        bench_traffic()
     else:
         bench_evoppo()
 
@@ -1593,12 +1793,13 @@ def parent_main():
         else "sharding-plan resolution + 7B plan compile" if mode == "sharding"
         else "elastic PBT MTTR + heartbeat overhead" if mode == "elastic"
         else "replica spin-up cold vs warm executable store" if mode == "compile_cache"
+        else "traffic-harness SLO score over synthetic-load scenarios" if mode == "traffic"
         else "evo-PPO aggregate env-steps/sec"
     )
     errors = []
 
     if mode in ("pipeline", "serving", "trace", "fleet", "flywheel",
-                "anakin", "sharding", "elastic", "compile_cache"):
+                "anakin", "sharding", "elastic", "compile_cache", "traffic"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
@@ -1625,6 +1826,7 @@ def parent_main():
                      else "ms/resolution" if mode == "sharding"
                      else "s (MTTR)" if mode == "elastic"
                      else "s (spin-up)" if mode == "compile_cache"
+                     else "slo-score" if mode == "traffic"
                      else "env-steps/sec"),
             "vs_baseline": 0.0, "backend": None,
             "error": f"{mode} micro-bench: {err}",

@@ -1,0 +1,17 @@
+"""Thin launcher shim: the traffic harness lives in the package
+(``agilerl_tpu/benchmarking/traffic.py``) so it is graftcheck-scanned and
+unit-tested; this file keeps the repo-root ``benchmarking/`` entry point
+alongside the other standalone capture scripts. Run scenarios end-to-end
+via ``BENCH_MODE=traffic python bench.py`` (docs/serving.md)."""
+
+from agilerl_tpu.benchmarking.traffic import (  # noqa: F401
+    ScenarioSpec,
+    TrafficDriver,
+    TrafficRequest,
+    TrafficRunResult,
+    generate_trace,
+    load_trace,
+    save_trace,
+    scenario_suite,
+    trace_header,
+)

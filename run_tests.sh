@@ -38,6 +38,9 @@
 #                                # (fingerprint misses, torn entries,
 #                                # load==compile gates, warm elastic/
 #                                # serving/layout-search paths)
+#   bash run_tests.sh traffic    # traffic harness + SLO engine only
+#                                # (scenario determinism, record/replay,
+#                                # burn-rate alerting, graded degraded run)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -130,6 +133,14 @@ for arg in "$@"; do
       # autoscale policy, entry point, sharded-step anchor parity)
       MARKER=(-m "flywheel")
       SHARDS+=("tests/test_llm/test_flywheel.py tests/test_llm/test_autoscale.py tests/test_train/test_train_llm_online.py tests/test_parallel/test_plan.py")
+      ;;
+    traffic)
+      # fast path: the traffic harness + SLO engine (deterministic scenario
+      # generation, record/replay round-trips, burn-rate alert fire/clear on
+      # a fake clock, kill-under-burst failover + autoscale reaction, the
+      # end-to-end graded degraded run)
+      MARKER=(-m "traffic")
+      SHARDS+=("tests/test_llm/test_traffic.py tests/test_observability/test_slo.py")
       ;;
     *) SHARDS+=("$arg") ;;
   esac

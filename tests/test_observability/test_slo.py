@@ -1,0 +1,432 @@
+"""SLO engine (observability/slo.py): YAML spec round-trip and validation;
+exact bucket-edge error fractions (interpolation + warn-once off-grid);
+multi-window burn-rate alerting on a fake clock — fast+slow fire, fast-
+recovery clear, transitions-only (no flap) — with forced spans and
+structured events; scenario grading (attainment, vacuous-pass flagging,
+scores); bucket alignment via configure_buckets/apply_buckets and the
+aggregator's TelemetrySchemaError on fleet-wide skew; alert→scale-up
+attribution joins."""
+
+import math
+
+import pytest
+
+from agilerl_tpu.observability import (
+    AlertPolicy,
+    MemorySink,
+    MetricsRegistry,
+    Objective,
+    SLOEvaluator,
+    SLOSpec,
+    TelemetryAggregator,
+    TelemetryPublisher,
+    TelemetrySchemaError,
+    aligned_buckets,
+    attribute_scale_ups,
+    load_slo_spec,
+    registry_source,
+    save_slo_spec,
+    write_report,
+)
+from agilerl_tpu.observability.slo import _hist_errors
+from agilerl_tpu.observability.trace import Tracer
+
+pytestmark = [pytest.mark.traffic, pytest.mark.tracing]
+
+BOUNDS = (0.1, 0.5, 1.0)
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _spec(threshold=0.5, target=0.9, fast=2.0, slow=6.0, burn=1.0,
+          min_events=3, extra=()):
+    return SLOSpec(
+        name="unit",
+        objectives=[Objective(name="ttft", kind="latency",
+                              histogram="serving/ttft_s",
+                              threshold=threshold, target=target),
+                    *extra],
+        alerting=AlertPolicy(fast_window_s=fast, slow_window_s=slow,
+                             burn_threshold=burn, min_events=min_events))
+
+
+def _evaluator(spec=None, **kw):
+    clock = Clock()
+    src_reg = MetricsRegistry()
+    hist = src_reg.histogram("serving/ttft_s", buckets=BOUNDS)
+    reg = MetricsRegistry(sink=MemorySink())
+    tracer = Tracer(sink=MemorySink(), sample_rate=0.0, metrics=reg)
+    ev = SLOEvaluator(spec if spec is not None else _spec(), src_reg.dump,
+                      clock=clock, metrics=reg, tracer=tracer, **kw)
+    return ev, hist, clock, reg, tracer
+
+
+# --------------------------------------------------------------------------- #
+# spec declaration + YAML
+# --------------------------------------------------------------------------- #
+
+def test_yaml_round_trip(tmp_path):
+    spec = _spec(extra=(
+        Objective(name="shed", kind="ratio",
+                  numerator="serving/shed_requests_total",
+                  denominator="serving/requests_total", budget=0.05),
+        Objective(name="rebalance", kind="counter_ceiling",
+                  counter="fleet/rebalanced_requests_total", ceiling=3),
+    ))
+    path = save_slo_spec(spec, tmp_path / "spec.yaml")
+    loaded = load_slo_spec(path)
+    assert loaded.to_dict() == spec.to_dict()
+    assert [o.kind for o in loaded.objectives] == [
+        "latency", "ratio", "counter_ceiling"]
+
+
+def test_shipped_specs_load_and_align():
+    """The repo's own specs must parse, and every latency threshold must
+    already sit on a default bucket edge (the exactness contract the
+    config files document)."""
+    from pathlib import Path
+
+    from agilerl_tpu.llm.fleet import SCALE_UP_BUCKETS
+    from agilerl_tpu.llm.serving import DECODE_BUCKETS, TTFT_BUCKETS
+
+    base = {"serving/ttft_s": TTFT_BUCKETS,
+            "serving/decode_time_per_token_s": DECODE_BUCKETS,
+            "fleet/scale_up_latency_s": SCALE_UP_BUCKETS}
+    root = Path(__file__).resolve().parents[2] / "configs" / "slo"
+    paths = sorted(root.glob("*.yaml"))
+    assert paths, "configs/slo/*.yaml missing"
+    for path in paths:
+        spec = load_slo_spec(path)
+        assert spec.objectives
+        for name, edges in spec.bucket_overrides().items():
+            for edge in edges:
+                assert edge in base[name], (
+                    f"{path.name}: {name} threshold {edge} off-grid")
+
+
+def test_spec_validation_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="unknown kind"):
+        Objective(name="x", kind="nope")
+    with pytest.raises(ValueError, match="needs histogram"):
+        Objective(name="x", kind="latency")
+    with pytest.raises(ValueError, match="target must be"):
+        Objective(name="x", kind="latency", histogram="h", threshold=1.0,
+                  target=1.5)
+    with pytest.raises(ValueError, match="needs numerator"):
+        Objective(name="x", kind="ratio", numerator="a")
+    with pytest.raises(ValueError, match="unknown fields"):
+        Objective.from_dict({"name": "x", "kind": "latency",
+                             "histogram": "h", "threshold": 1.0,
+                             "tresh": 2.0})
+    with pytest.raises(ValueError, match="duplicate objective"):
+        SLOSpec(name="d", objectives=[
+            Objective(name="a", kind="counter_ceiling", counter="c",
+                      ceiling=1),
+            Objective(name="a", kind="counter_ceiling", counter="c",
+                      ceiling=2)])
+    with pytest.raises(ValueError, match="fast_window_s"):
+        AlertPolicy(fast_window_s=10.0, slow_window_s=5.0)
+
+
+# --------------------------------------------------------------------------- #
+# exact bucket-edge error counting
+# --------------------------------------------------------------------------- #
+
+def test_hist_errors_exact_on_edge():
+    h = {"bounds": [0.1, 0.5, 1.0], "counts": [5, 3, 1, 1],
+         "sum": 2.0, "count": 10}
+    errors, total, exact = _hist_errors(h, 0.5)
+    assert (errors, total, exact) == (2, 10, True)
+    errors, total, exact = _hist_errors(h, 0.1)
+    assert (errors, total, exact) == (5, 10, True)
+    # above the largest finite bound: only the overflow bucket is above
+    errors, total, exact = _hist_errors(h, 2.0)
+    assert (errors, total, exact) == (1, 10, True)
+
+
+def test_hist_errors_interpolates_off_edge_and_warns_once():
+    h = {"bounds": [0.1, 0.5, 1.0], "counts": [5, 4, 0, 1],
+         "sum": 2.0, "count": 10}
+    errors, total, exact = _hist_errors(h, 0.3)
+    assert not exact
+    # half the (0.1, 0.5] bucket sits above 0.3 → 2 of its 4, plus 1 overflow
+    assert math.isclose(errors, 3.0)
+    ev, hist, clock, reg, _ = _evaluator(_spec(threshold=0.3))
+    with pytest.warns(RuntimeWarning, match="not a bucket edge"):
+        hist.observe(0.05)
+        ev.evaluate()
+    clock.advance(1.0)
+    ev.evaluate()  # second tick: warn_once stays quiet
+    assert reg.counter("warnings_total").value == 1
+
+
+# --------------------------------------------------------------------------- #
+# burn-rate alerting on a fake clock
+# --------------------------------------------------------------------------- #
+
+def _tick(ev, hist, clock, values, dt=1.0):
+    for v in values:
+        hist.observe(v)
+    state = ev.evaluate()
+    clock.advance(dt)
+    return state
+
+
+def test_alert_fires_only_when_fast_and_slow_agree():
+    """A blip that breaches the fast window but not the slow one must NOT
+    page (the whole point of the multi-window shape)."""
+    ev, hist, clock, reg, _ = _evaluator()
+    for _ in range(8):
+        _tick(ev, hist, clock, [0.05] * 5)
+    # one bad tick: fast window (2s) burns hot, slow window (6s) does not
+    _tick(ev, hist, clock, [0.9] * 2 + [0.05] * 3)
+    assert ev.active_alerts == []
+    assert reg.counter("slo/alerts_fired_total").value == 0
+
+
+def test_alert_fire_then_clear_emits_transitions_only():
+    ev, hist, clock, reg, tracer = _evaluator()
+    for _ in range(8):
+        _tick(ev, hist, clock, [0.05] * 5)  # healthy baseline
+    for _ in range(6):
+        _tick(ev, hist, clock, [0.9] * 5)   # sustained breach
+    assert ev.active_alerts == ["ttft"]
+    for _ in range(4):
+        _tick(ev, hist, clock, [0.9] * 5)   # still red: must not re-fire
+    assert reg.counter("slo/alerts_fired_total").value == 1
+    for _ in range(8):
+        _tick(ev, hist, clock, [0.05] * 5)  # recovery
+    assert ev.active_alerts == []
+    assert reg.counter("slo/alerts_cleared_total").value == 1
+    phases = [h["phase"] for h in ev.alert_history]
+    assert phases == ["fire", "clear"]
+    # the fire/clear pair reached the sink as structured events...
+    kinds = [e for e in reg.sink.events if e["kind"] == "slo_alert"]
+    assert [e["phase"] for e in kinds] == ["fire", "clear"]
+    assert kinds[0]["burn_fast"] >= 1.0
+    # ...and as FORCED spans despite sample_rate=0 (anomaly contract),
+    # error status on the fire span only
+    spans = [s for s in tracer.sink.events
+             if str(s.get("name", "")).startswith("slo.")]
+    assert [s["name"] for s in spans] == ["slo.fire", "slo.clear"]
+    assert spans[0]["status"] == "error"
+    assert spans[1]["status"] == "ok"
+    assert reg.counter("trace/forced_spans_total").value == 2
+
+
+def test_no_flap_across_repeated_cycles():
+    ev, hist, clock, reg, _ = _evaluator()
+    for _ in range(3):
+        for _ in range(8):
+            _tick(ev, hist, clock, [0.05] * 5)
+        for _ in range(6):
+            _tick(ev, hist, clock, [0.9] * 5)
+    # three genuine breach cycles → exactly three fire/clear pairs, no
+    # extra transitions from ticks that did not change state
+    assert reg.counter("slo/alerts_fired_total").value == 3
+    assert reg.counter("slo/alerts_cleared_total").value == 2  # still red
+    assert len(ev.alert_history) == 5
+
+
+def test_min_events_gates_noise():
+    ev, hist, clock, reg, _ = _evaluator(_spec(min_events=10))
+    for _ in range(8):
+        _tick(ev, hist, clock, [0.9] * 2)  # all bad, but 4 events/window
+    assert ev.active_alerts == []
+
+
+def test_no_traffic_burns_no_budget():
+    ev, hist, clock, _, _ = _evaluator()
+    for _ in range(10):
+        state = _tick(ev, hist, clock, [])
+    assert state["ttft"]["burn_fast"] == 0.0
+    assert ev.active_alerts == []
+
+
+def test_ratio_objective_burns_on_counter_deltas():
+    clock = Clock()
+    src = MetricsRegistry()
+    shed = src.counter("serving/shed_requests_total")
+    total = src.counter("serving/requests_total")
+    spec = SLOSpec(
+        name="ratio",
+        objectives=[Objective(name="shed", kind="ratio",
+                              numerator="serving/shed_requests_total",
+                              denominator="serving/requests_total",
+                              budget=0.05)],
+        alerting=AlertPolicy(fast_window_s=2.0, slow_window_s=6.0,
+                             burn_threshold=1.0, min_events=3))
+    reg = MetricsRegistry(sink=MemorySink())
+    ev = SLOEvaluator(spec, registry_source(src, spec), clock=clock,
+                      metrics=reg, tracer=Tracer(sink=None))
+    for _ in range(8):
+        total.inc(5)
+        ev.evaluate()
+        clock.advance(1.0)
+    assert ev.active_alerts == []
+    for _ in range(7):
+        total.inc(5)
+        shed.inc(2)  # 40% shed vs 5% budget
+        ev.evaluate()
+        clock.advance(1.0)
+    assert ev.active_alerts == ["shed"]
+    for _ in range(6):
+        total.inc(5)
+        ev.evaluate()
+        clock.advance(1.0)
+    assert ev.active_alerts == []
+
+
+# --------------------------------------------------------------------------- #
+# grading
+# --------------------------------------------------------------------------- #
+
+def test_grade_scores_attainment_and_flags_vacuous(tmp_path):
+    spec = _spec(extra=(
+        Objective(name="shed", kind="ratio",
+                  numerator="serving/shed_requests_total",
+                  denominator="serving/requests_total", budget=0.5),
+        Objective(name="rebalance", kind="counter_ceiling",
+                  counter="fleet/rebalanced_requests_total", ceiling=1),
+    ))
+    clock = Clock()
+    src = MetricsRegistry()
+    hist = src.histogram("serving/ttft_s", buckets=BOUNDS)
+    src.counter("fleet/rebalanced_requests_total").inc(5)  # pre-existing
+    ev = SLOEvaluator(spec, src.dump, clock=clock, metrics=MetricsRegistry(),
+                      tracer=Tracer(sink=None))
+    ev.evaluate()
+    clock.advance(1.0)
+    for v in [0.05] * 8 + [0.9] * 2:  # 80% under 0.5 vs 90% target → fail
+        hist.observe(v)
+    src.counter("fleet/rebalanced_requests_total").inc(1)  # delta 1 ≤ 1
+    ev.evaluate()
+    report = ev.grade(scenario="unit", extra={"tag": 7})
+    rows = {r["name"]: r for r in report["objectives"]}
+    assert not rows["ttft"]["ok"]
+    assert math.isclose(rows["ttft"]["attained"], 0.8)
+    assert math.isclose(rows["ttft"]["budget_consumed"], 2.0)
+    # the shed counters never moved: vacuous pass, flagged as no_data —
+    # and the PRE-RUN rebalance count is excluded (delta grading)
+    assert rows["shed"]["ok"] and rows["shed"].get("no_data")
+    assert rows["rebalance"]["ok"] and rows["rebalance"]["value"] == 1.0
+    assert report["passed"] == 2 and report["total"] == 3
+    assert math.isclose(report["score"], round(100 * 2 / 3, 1))
+    assert report["tag"] == 7 and report["scenario"] == "unit"
+    path = write_report(report, tmp_path / "report.json")
+    import json
+
+    assert json.loads(path.read_text())["score"] == report["score"]
+
+
+def test_grade_before_evaluate_raises():
+    ev, _, _, _, _ = _evaluator()
+    with pytest.raises(RuntimeError, match="before any evaluate"):
+        ev.grade()
+
+
+# --------------------------------------------------------------------------- #
+# bucket alignment across the fleet plane
+# --------------------------------------------------------------------------- #
+
+def test_aligned_buckets_and_apply():
+    spec = _spec(threshold=0.3)
+    reg = MetricsRegistry()
+    applied = spec.apply_buckets(reg, base={"serving/ttft_s": BOUNDS})
+    assert applied["serving/ttft_s"] == sorted(set(BOUNDS) | {0.3})
+    h = reg.histogram("serving/ttft_s", buckets=BOUNDS)  # call-site bounds
+    assert 0.3 in h.bounds  # override won
+    assert aligned_buckets((1.0, 0.5), (0.5, 2.0)) == [0.5, 1.0, 2.0]
+
+
+def test_bucket_skew_across_pods_raises_schema_error(tmp_path):
+    """Two pods whose SLO-aligned bounds disagree CANNOT be merged — the
+    aggregator refuses loudly instead of grading garbage. This is the
+    failure configure_buckets/bucket_overrides exists to prevent."""
+    a = MetricsRegistry(bucket_overrides={"serving/ttft_s": BOUNDS})
+    b = MetricsRegistry(
+        bucket_overrides={"serving/ttft_s": BOUNDS + (2.0,)})
+    a.histogram("serving/ttft_s").observe(0.2)
+    b.histogram("serving/ttft_s").observe(0.2)
+    for pod, reg in (("a", a), ("b", b)):
+        TelemetryPublisher(tmp_path, pod, reg, interval_s=0.0,
+                           clock=lambda: 1.0).publish()
+    agg = TelemetryAggregator(tmp_path, metrics=MetricsRegistry())
+    agg.poll()
+    with pytest.raises(TelemetrySchemaError, match="serving/ttft_s"):
+        agg.merged_dump()
+
+
+def test_evaluator_over_aggregator_snapshots(tmp_path):
+    """The cross-process wiring: two pods publish SLO-aligned snapshots,
+    the evaluator grades the AGGREGATOR's merged view."""
+    spec = _spec(threshold=0.5, target=0.9)
+    pods = {p: MetricsRegistry(bucket_overrides={"serving/ttft_s": BOUNDS})
+            for p in ("a", "b")}
+    clock = Clock()
+    agg = TelemetryAggregator(tmp_path, metrics=MetricsRegistry())
+
+    def source():
+        agg.poll()
+        return agg.merged_dump()
+
+    ev = SLOEvaluator(spec, source, clock=clock, metrics=MetricsRegistry(),
+                      tracer=Tracer(sink=None))
+    seq = [0]
+
+    def publish_all():
+        seq[0] += 1
+        for p, reg in pods.items():
+            TelemetryPublisher(tmp_path, p, reg, interval_s=0.0,
+                               clock=lambda: float(seq[0])).publish()
+
+    publish_all()
+    ev.evaluate()
+    clock.advance(1.0)
+    for reg in pods.values():
+        h = reg.histogram("serving/ttft_s")
+        for v in [0.05] * 9 + [0.9]:
+            h.observe(v)
+    publish_all()
+    ev.evaluate()
+    report = ev.grade(scenario="xproc")
+    row = report["objectives"][0]
+    assert row["events"] == 20.0  # both pods' traffic merged
+    assert math.isclose(row["attained"], 0.9) and row["ok"]
+
+
+# --------------------------------------------------------------------------- #
+# attribution
+# --------------------------------------------------------------------------- #
+
+def test_attribute_scale_ups_joins_alert_to_reaction():
+    events = [
+        {"kind": "autoscale_decision", "verdict": "up", "actioned": True,
+         "replica": 9},  # before any alert: not attributed
+        {"kind": "slo_alert", "phase": "fire", "objective": "shed",
+         "at_s": 3.0, "burn_fast": 4.0},
+        {"kind": "autoscale_decision", "verdict": "up", "actioned": False,
+         "replica": None, "triggers": ["shedding"]},  # blocked: skipped
+        {"kind": "autoscale_decision", "verdict": "up", "actioned": True,
+         "replica": 2, "triggers": ["shedding"], "signals": {"replicas": 1}},
+        {"kind": "autoscale_decision", "verdict": "up", "actioned": True,
+         "replica": 3},  # later scale-up: first one already joined
+        {"kind": "slo_alert", "phase": "clear", "objective": "shed",
+         "at_s": 6.0},
+    ]
+    incidents = attribute_scale_ups(events)
+    assert len(incidents) == 1
+    inc = incidents[0]
+    assert inc["objective"] == "shed" and inc["fired_at_s"] == 3.0
+    assert inc["scale_up"]["replica"] == 2
+    assert inc["scale_up"]["triggers"] == ["shedding"]
+    assert inc["cleared_at_s"] == 6.0
