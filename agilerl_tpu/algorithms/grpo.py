@@ -158,6 +158,8 @@ class GRPO(EvolvableAlgorithm):
         sequence_parallel_axis: Optional[str] = None,
         bucketed_decode: bool = True,
         continuous_decode: bool = False,
+        speculative_decode=None,
+        capture_logprobs: bool = False,
         **kwargs,
     ):
         super().__init__(index=index, hp_config=hp_config or default_hp_config(), **kwargs)
@@ -207,6 +209,17 @@ class GRPO(EvolvableAlgorithm):
                 "AGILERL_TPU_CONTINUOUS_DECODE", ""
             ).strip().lower() in ("1", "true", "yes")
         ) and not serving_killed
+        # continuous-tier extras (NOT part of _serving_knobs: the bucketed
+        # generator takes neither, and attach_rollout_fleet's recipe check
+        # compares the SAMPLING contract — speculation never changes the
+        # greedy stream and capture only adds a side channel)
+        # speculative_decode: None/False off, True defaults, dict/SpecConfig
+        # knobs (llm/speculate.SpecConfig) — continuous_decode only
+        self.speculative_decode = speculative_decode
+        # capture_logprobs: the continuous tier records each emitted token's
+        # behavior logprob during decode so rollout_once skips the extra
+        # dense behavior_logprobs forward (llm/flywheel.py)
+        self.capture_logprobs = bool(capture_logprobs)
         self._bucketed_gen = None
         self._bucketed_gen_knobs = None
         self._continuous_gen = None
@@ -267,6 +280,8 @@ class GRPO(EvolvableAlgorithm):
             "sequence_parallel_axis": self.sequence_parallel_axis,
             "bucketed_decode": self.bucketed_decode,
             "continuous_decode": self.continuous_decode,
+            "speculative_decode": self.speculative_decode,
+            "capture_logprobs": self.capture_logprobs,
         }
 
     def _on_clone(self, parent) -> None:
@@ -311,7 +326,9 @@ class GRPO(EvolvableAlgorithm):
         rollouts are the no-shed path: every row must come back."""
         from agilerl_tpu.llm.serving import ContinuousGenerator
 
-        knobs = self._serving_knobs()
+        knobs = dict(self._serving_knobs(),
+                     speculate=self.speculative_decode,
+                     capture_logprobs=self.capture_logprobs)
         if self._continuous_gen is None or self._continuous_gen_knobs != knobs:
             self._continuous_gen = ContinuousGenerator(
                 self.model_config, **knobs)

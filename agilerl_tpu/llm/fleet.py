@@ -164,13 +164,17 @@ class PrefillWorker:
         Pb = prompt.shape[1]
         dense = _constrain_kv(self, M.init_caches(
             self.config, 1, Pb + self._decode_extent))
-        carry, (tok0, _emit0) = prefill_head(
+        carry, (tok0, _emit0), last_logits = prefill_head(
             self.config, params, prompt, prompt_mask, dense, key,
-            **self._knobs(greedy, lora),
+            return_logits=True, **self._knobs(greedy, lora),
         )
         filled, _tok0, _rv, _pos, done0, key_next = carry
+        # raw log p(tok0) ships with every payload (negligible next to the
+        # prompt KV) so a capture_logprobs replica's imported stream stays
+        # aligned — see ContinuousGenerator._record_lp0
+        lp0 = jax.nn.log_softmax(last_logits, axis=-1)[0, tok0[0]]
         return (filled.k[:, 0, :Pb], filled.v[:, 0, :Pb], tok0[0], done0[0],
-                key_next)
+                key_next, lp0)
 
     def prefill(self, tokens, key, params, lora=None, greedy: bool = False,
                 hashes: Optional[List[bytes]] = None) -> Dict[str, Any]:
@@ -187,7 +191,7 @@ class PrefillWorker:
         Pb = _round_up(tokens.size, self.prompt_buckets)
         toks_row, mask_row = left_pad([tokens], self.pad_id, Pb)
         t0 = time.perf_counter()
-        k, v, tok0, done0, key_next = self._prefill(
+        k, v, tok0, done0, key_next, lp0 = self._prefill(
             params, lora, jnp.asarray(toks_row), jnp.asarray(mask_row),
             jnp.asarray(key, np.uint32), greedy=greedy)
         payload = dict(
@@ -195,6 +199,7 @@ class PrefillWorker:
             k=np.asarray(k), v=np.asarray(v),
             tok0=int(np.asarray(tok0)), done0=bool(np.asarray(done0)),
             key_next=np.asarray(key_next, np.uint32),
+            lp0=float(np.asarray(lp0)),
             hashes=(list(hashes) if hashes is not None else
                     chain_hashes(toks_row[0], mask_row[0], self.block_size)),
         )
@@ -412,6 +417,7 @@ class ServingFleet:
         self._next_ticket = 0
         self._requests: Dict[int, _FleetRequest] = {}
         self._results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._result_lps: Dict[int, np.ndarray] = {}
         self._open = 0
         self._prefill_pending: "collections.deque[_FleetRequest]" = collections.deque()
         self._transfers: "collections.deque[_FleetRequest]" = collections.deque()
@@ -932,6 +938,10 @@ class ServingFleet:
                 fr = self._requests[ft]
                 fr.stage = "done"
                 self._results[ft] = m.gen.result(rt)
+                if getattr(m.gen, "capture_logprobs", False):
+                    lp = m.gen.result_logprobs(rt)
+                    if lp is not None:
+                        self._result_lps[ft] = lp
                 self._open -= 1
                 if fr.decode_span is not None:
                     fr.decode_span.end()
@@ -1024,6 +1034,7 @@ class ServingFleet:
                     payload["tokens"], k_prompt=payload["k"],
                     v_prompt=payload["v"], tok0=payload["tok0"],
                     done0=payload["done0"], key_next=payload["key_next"],
+                    lp0=payload.get("lp0"),
                     key=fr.key, max_new=fr.max_new, arrival_s=fr.arrival_s,
                     no_shed=True, hashes=fr.hashes, trace_ctx=ctx)
 
@@ -1045,6 +1056,13 @@ class ServingFleet:
         out = self._results.pop(ticket)
         self._requests.pop(ticket, None)
         return out
+
+    def result_logprobs(self, ticket: int) -> Optional[np.ndarray]:
+        """Decode-captured behavior logprobs [max_new] for a finished fleet
+        ticket (None unless the replicas run ``capture_logprobs``); pops
+        the record. Call BEFORE :meth:`result` or right after — both pop
+        independent maps."""
+        return self._result_lps.pop(ticket, None)
 
     def run_until_drained(self, params, lora=None, greedy: bool = False,
                           max_steps: int = 100_000) -> List[int]:
@@ -1089,7 +1107,13 @@ class ServingFleet:
         N = self._ref_attrs["max_new_tokens"]
         comp = np.full((B, N), self._ref_attrs["pad_id"], np.int32)
         cmask = np.zeros((B, N), np.int32)
+        lps = (np.zeros((B, N), np.float32)
+               if self._gen_kwargs.get("capture_logprobs") else None)
         for i, t in enumerate(tickets):
+            if lps is not None:
+                row = self.result_logprobs(t)
+                if row is not None:
+                    lps[i, :row.size] = row
             toks, emits = self.result(t)
             comp[i, :toks.size] = toks
             cmask[i, :emits.size] = emits
@@ -1102,6 +1126,9 @@ class ServingFleet:
             "max_new_tokens": N,
         }
         self.metrics.emit("fleet_generate", rows=B, **info)
+        if lps is not None:
+            # after emit(): telemetry lines carry scalars, not [B, N] arrays
+            info["logprobs"] = lps
         return comp, cmask, info
 
     # -- telemetry -----------------------------------------------------------

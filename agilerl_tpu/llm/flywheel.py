@@ -376,6 +376,34 @@ class RolloutPod:
                           weight_epoch=int(epoch))
         return True
 
+    def _behavior_lp(self, agent, ids, action_masks, completions,
+                     completion_mask) -> np.ndarray:
+        """Behavior logprobs for the batch: consume the logprobs the serving
+        tier captured AT DECODE TIME when they are present and shaped for
+        this batch (``capture_logprobs`` generators/fleets publish them in
+        ``last_generation_info`` — the decode forward already computed
+        them, so the dense recompute is pure waste), else fall back to the
+        dense ``behavior_logprobs`` forward unchanged.
+
+        Layout: ``ids = [prompt | completion]`` so completion token j is
+        the prediction at position P-1+j — exactly where
+        ``assemble_learn_batch`` puts the action mask."""
+        info = getattr(agent, "last_generation_info", None) or {}
+        dlp = info.get("logprobs")
+        ids = np.asarray(ids)
+        cmask = np.asarray(completion_mask, np.float32)
+        if (dlp is not None and dlp.shape == cmask.shape
+                and ids.shape[1] > cmask.shape[1]):
+            P = ids.shape[1] - cmask.shape[1]
+            out = np.zeros((ids.shape[0], ids.shape[1] - 1), np.float32)
+            out[:, P - 1:] = np.asarray(dlp, np.float32) * cmask
+            self.metrics.counter(
+                "flywheel/logprob_forwards_saved_total",
+                help="dense behavior-logprob forwards skipped because the "
+                     "serving tier captured logprobs at decode time").inc()
+            return out
+        return agent.behavior_logprobs(ids, action_masks)
+
     def rollout_once(self, greedy: bool = False) -> TrajectoryBatch:
         """ONE group-batch rollout: generate ``group_size`` completions per
         prompt, record the behavior logprobs, score rewards, publish the
@@ -401,7 +429,8 @@ class RolloutPod:
                 prompts, training=not greedy)
             ids, action_masks = env.assemble_learn_batch(
                 completions, completion_mask)
-            behavior_lp = agent.behavior_logprobs(ids, action_masks)
+            behavior_lp = self._behavior_lp(
+                agent, ids, action_masks, completions, completion_mask)
             next_prompts, rewards = env.step(completions, completion_mask)
             self._prompts = next_prompts
             batch = TrajectoryBatch(

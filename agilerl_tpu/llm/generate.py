@@ -83,25 +83,30 @@ def _sample_token_per_row(logits, keys, temperature, top_k, top_p=None):
 
 def _suppress_eos(logits, step, eos_id, min_new_tokens):
     """EOS logit floor for the first min_new_tokens sampled tokens
-    (parity: vllm/HF min_output_tokens). step: [] (batch-aligned decode)
-    or [B] (per-slot step indices in the continuous path)."""
+    (parity: vllm/HF min_output_tokens). step: [] (batch-aligned decode),
+    [B] (per-slot step indices in the continuous path), or [B, T]
+    (per-candidate indices in the speculative verify window — logits then
+    [B, T, V])."""
     if eos_id is None or not min_new_tokens:
         return logits
     lt = jnp.asarray(step) < min_new_tokens
     if lt.ndim:
-        lt = lt[:, None]
+        lt = lt[..., None]
     return jnp.where(
-        lt & (jnp.arange(logits.shape[-1]) == eos_id)[None, :],
+        lt & (jnp.arange(logits.shape[-1]) == eos_id),
         -1e9, logits,
     )
 
 
 def prefill_head(config, params, prompt, prompt_mask, caches, key, *,
                  lora, lora_scale, temperature, top_k, top_p, eos_id,
-                 pad_id, min_new_tokens, row_valid=None):
+                 pad_id, min_new_tokens, row_valid=None,
+                 return_logits=False):
     """Prompt forward + first sampled token. Returns the decode carry and
     the first (token, emit_mask) pair. row_valid marks real rows (bucket
     padding rows are born done); None means every row is real.
+    return_logits=True appends the raw last-position logits [B, V] to the
+    return (the serving tier's behavior-logprob capture hook).
 
     SHARED between generate() and llm/serving.BucketedGenerator so the two
     paths cannot drift (review finding)."""
@@ -124,7 +129,10 @@ def prefill_head(config, params, prompt, prompt_mask, caches, key, *,
     done0 = ~row_valid
     if eos_id is not None:
         done0 = done0 | (tok0 == eos_id)
-    return (caches, tok0, row_valid, pos, done0, key), (tok0, row_valid)
+    carry = (caches, tok0, row_valid, pos, done0, key)
+    if return_logits:
+        return carry, (tok0, row_valid), last_logits
+    return carry, (tok0, row_valid)
 
 
 def decode_step(config, params, carry, i, *, lora, lora_scale, temperature,
@@ -219,7 +227,8 @@ def generate(
 
 
 def paged_decode_step(config, params, carry, *, lora, lora_scale, temperature,
-                      top_k, top_p, eos_id, pad_id, min_new_tokens):
+                      top_k, top_p, eos_id, pad_id, min_new_tokens,
+                      capture_lp=False):
     """One decode step for every slot in the pool.
 
     carry:
@@ -236,7 +245,11 @@ def paged_decode_step(config, params, carry, *, lora, lora_scale, temperature,
       done         [slots] bool (free slots are parked done=True)
       keys         [slots, 2] per-slot PRNG keys
 
-    Returns (carry', (tok, emit)). Greedy outputs are bit-identical to
+    Returns (carry', (tok, emit)) — with capture_lp=True, (carry', (tok,
+    emit, lp)) where lp is log p(tok) under the RAW logits (temperature
+    1.0, no EOS floor: exactly the model.token_logprobs convention, so the
+    GRPO flywheel can consume decode-captured behavior logprobs without a
+    second forward). Greedy outputs are bit-identical to
     decode_step for a slot whose slab content matches the dense cache (the
     serving equivalence tests pin this)."""
     (cache, block_tables, slot_mask, lengths, prev_tok, prev_ok, pos,
@@ -269,5 +282,10 @@ def paged_decode_step(config, params, carry, *, lora, lora_scale, temperature,
         done = jnp.logical_or(done, tok == eos_id)
     lengths = lengths + 1
     step_idx = step_idx + 1
-    return (cache, block_tables, slot_mask, lengths, tok, emit, pos,
-            step_idx, done, keys), (tok, emit)
+    carry = (cache, block_tables, slot_mask, lengths, tok, emit, pos,
+             step_idx, done, keys)
+    if capture_lp:
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(lsm, tok[:, None], axis=-1)[:, 0]
+        return carry, (tok, emit, lp)
+    return carry, (tok, emit)

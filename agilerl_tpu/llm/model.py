@@ -725,6 +725,35 @@ def paged_scatter_tokens(cache: PagedKVCache, block_tables: jax.Array,
                         v=flat_v.reshape(L, nb, bs, KV, hd))
 
 
+def paged_scatter_multi(cache: PagedKVCache, block_tables: jax.Array,
+                        write_pos: jax.Array, new_k: jax.Array,
+                        new_v: jax.Array) -> PagedKVCache:
+    """Bulk write of a multi-token verify window into the pool.
+
+    new_k/new_v: [L, B, T, KV, hd]; write_pos: [B, T] logical slot indices
+    (lengths + arange(T) in the speculative verify step). Unlike the
+    single-token path, positions at or past the logical extent S are
+    REDIRECTED to the reserved garbage block 0 instead of clamping into the
+    last table entry: a full-table slot speculating near its budget must
+    never corrupt its own (possibly shared) final block. Rejected-draft
+    positions inside the extent are written as-is — they sit past the
+    slot's accepted length, are invisible to every mask, and are rewritten
+    before the sequence ever reaches them."""
+    L, nb, bs, KV, hd = cache.k.shape
+    B, T = write_pos.shape
+    mb = block_tables.shape[1]
+    bidx = jnp.minimum(write_pos // bs, mb - 1)
+    phys = jnp.take_along_axis(block_tables, bidx, axis=1)
+    phys = jnp.where(write_pos < mb * bs, phys, 0)
+    idx = (phys * bs + write_pos % bs).reshape(-1)
+    flat_k = cache.k.reshape(L, nb * bs, KV, hd).at[:, idx].set(
+        new_k.reshape(L, B * T, KV, hd))
+    flat_v = cache.v.reshape(L, nb * bs, KV, hd).at[:, idx].set(
+        new_v.reshape(L, B * T, KV, hd))
+    return PagedKVCache(k=flat_k.reshape(L, nb, bs, KV, hd),
+                        v=flat_v.reshape(L, nb, bs, KV, hd))
+
+
 def paged_scatter_prompt(cache: PagedKVCache, block_ids: jax.Array,
                          k_prompt: jax.Array, v_prompt: jax.Array) -> PagedKVCache:
     """Write one request's prefilled prompt KV ([L, Pb, KV, hd], Pb a whole
@@ -748,9 +777,9 @@ def paged_copy_block(cache: PagedKVCache, src, dst) -> PagedKVCache:
 def forward_paged(
     config: GPTConfig,
     params: Params,
-    tokens: jax.Array,       # [B, 1] the current token per slot
-    positions: jax.Array,    # [B] RoPE position (count of real prior tokens)
-    write_pos: jax.Array,    # [B] logical cache slot for this token's K/V
+    tokens: jax.Array,       # [B, T] the current token(s) per slot
+    positions: jax.Array,    # [B] or [B, T] RoPE position(s)
+    write_pos: jax.Array,    # [B] or [B, T] logical cache slot(s) for K/V
     cache: PagedKVCache,
     block_tables: jax.Array,  # [B, max_blocks] int32
     slot_mask: jax.Array,    # [B, S] 1 where the LOGICAL slot holds a real
@@ -758,9 +787,9 @@ def forward_paged(
     lora: Optional[Params] = None,
     lora_scale: float = 2.0,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
-    """One decode forward over the slot pool: returns (hidden [B, 1, D]
-    float32, (new_k, new_v) [L, B, KV, hd]) — the caller scatters the new
-    KV into the pool (paged_scatter_tokens) exactly once.
+    """One decode forward over the slot pool: returns (hidden [B, T, D]
+    float32, (new_k, new_v)) — the caller scatters the new KV into the pool
+    (paged_scatter_tokens / paged_scatter_multi) exactly once.
 
     Per-slot `write_pos` is what distinguishes this from forward-with-cache:
     continuous batching admits slots at different times, so there is no
@@ -768,27 +797,42 @@ def forward_paged(
     (gather + in-slab insert), the same pre-update discipline as forward's
     block_fn; greedy outputs are bit-identical to the dense path because the
     projection/FFN maths is the SAME code (_qkv_rope/_block_ffn) and masked
-    slab positions contribute exact zeros to the softmax."""
+    slab positions contribute exact zeros to the softmax.
+
+    T == 1 is the per-token decode step (positions/write_pos [B]; new KV
+    [L, B, KV, hd]). T > 1 is the speculative verify window (positions and
+    write_pos [B, T], consecutive per row with write_pos[:, 0] = lengths;
+    new KV [L, B, T, KV, hd]). The in-slab insert places all T candidate
+    K/Vs, and visibility is the SAME rule both ways: query t attends to
+    logical slots <= write_pos[:, 0] + t that slot_mask marks valid, so
+    candidate j sees exactly the prefix plus candidates < j."""
     B, T = tokens.shape
     dtype = config.dtype
     chunked_decode = use_chunked_decode()
     h = jnp.take(params["tok_emb"], tokens, axis=0).astype(dtype)
-    pos2d = positions[:, None]
+    pos2d = positions if positions.ndim == 2 else positions[:, None]
+    wp_start = write_pos[:, 0] if write_pos.ndim == 2 else write_pos
     arange_b = jnp.arange(B)
 
     def block_fn(h, blk, layer_kv, lora_layer):
         x = _rms(h, blk["ln1"], config.rms_eps)
         q, k, v = _qkv_rope(config, blk, x, pos2d, lora_layer, lora_scale)
         k_slab, v_slab = paged_gather(layer_kv[0], layer_kv[1], block_tables)
-        k_slab = k_slab.at[arange_b, write_pos].set(k[:, 0])
-        v_slab = v_slab.at[arange_b, write_pos].set(v[:, 0])
+        if write_pos.ndim == 2:
+            # multi-token insert: out-of-extent rows (a released slot whose
+            # lengths ran past S) drop — jax scatter OOB semantics
+            k_slab = k_slab.at[arange_b[:, None], write_pos].set(k)
+            v_slab = v_slab.at[arange_b[:, None], write_pos].set(v)
+        else:
+            k_slab = k_slab.at[arange_b, write_pos].set(k[:, 0])
+            v_slab = v_slab.at[arange_b, write_pos].set(v[:, 0])
         if chunked_decode:
             from agilerl_tpu.ops.decode_attention import (
                 chunked_cached_attention,
             )
 
             attn = chunked_cached_attention(q, k_slab, v_slab, slot_mask,
-                                            write_pos)
+                                            wp_start)
         else:
             # dense fallback — same repeat-heads formulation as forward's
             # kill-switch branch so the two kill-switch paths match exactly
@@ -802,7 +846,7 @@ def forward_paged(
             vh = jnp.moveaxis(v_slab, 2, 1)
             kv_slot = jnp.arange(S)
             causal = (kv_slot[None, None, :]
-                      <= (write_pos[:, None] + jnp.arange(T)[None, :])[:, :, None])
+                      <= (wp_start[:, None] + jnp.arange(T)[None, :])[:, :, None])
             mask = jnp.logical_and(causal, slot_mask[:, None, :].astype(bool))
             scores = jnp.einsum("bhtd,bhsd->bhts", qh, kh).astype(jnp.float32)
             scores = scores / math.sqrt(config.head_dim)
@@ -814,7 +858,7 @@ def forward_paged(
         attn = _maybe_lora(attn, blk["wo"], lora_layer, "wo", lora_scale, dtype)
         h = h + attn
         h, _ = _block_ffn(config, blk, h, lora_layer, lora_scale)
-        return h, (k[:, 0], v[:, 0])
+        return h, ((k, v) if write_pos.ndim == 2 else (k[:, 0], v[:, 0]))
 
     blocks = [params["blocks"][str(i)] for i in range(config.n_layer)]
     lora_layers = [

@@ -42,6 +42,13 @@ from agilerl_tpu.llm.generate import (
     paged_decode_step,
     prefill_head,
 )
+from agilerl_tpu.llm.speculate import (
+    CompletionCache,
+    NgramProposer,
+    SpecConfig,
+    as_spec_config,
+    paged_verify_step,
+)
 
 #: TTFT buckets (s): serving SLO granularity — sub-ms compile-cached prefill
 #: through multi-second cold compiles
@@ -52,6 +59,9 @@ DECODE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
                   5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0)
 #: queue-depth buckets (rows in flight) — mirrors the row bucket grid
 QUEUE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+#: accepted-draft-length buckets (tokens) — 0 is a real outcome (all drafts
+#: rejected) and must stay observable, so the first bound sits at 0
+SPEC_LEN_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
 
 
 def _round_up(n: int, buckets: Sequence[int]) -> int:
@@ -597,6 +607,13 @@ class _Request:
     toks: List[np.ndarray] = dataclasses.field(default_factory=list)
     emits: List[np.ndarray] = dataclasses.field(default_factory=list)
     n_emitted: int = 0
+    #: per-request speculation opt-out (submit(speculate=False)): the slot
+    #: rides the verify step with zero drafts — exactly one plain decode
+    #: step, same tokens AND same RNG stream as speculation off
+    speculate: bool = True
+    #: decode-captured per-token logprobs (capture_logprobs generators):
+    #: same per-chunk row layout as ``toks``/``emits``
+    lps: List[np.ndarray] = dataclasses.field(default_factory=list)
     hashes: Optional[List[bytes]] = None  # chain hashes, computed once
     #: externally prefilled prompt KV (disaggregated topology): dict with
     #: k/v [L, Pb, KV, hd], tok0, done0, key_next — admission scatters it
@@ -670,6 +687,8 @@ class ContinuousGenerator:
         admission: Optional[AdmissionPolicy] = None,
         tracer=None,
         compile_cache=None,
+        speculate=None,
+        capture_logprobs: bool = False,
     ):
         self.config = config
         self.metrics = metrics if metrics is not None else observability.get_registry()
@@ -722,6 +741,24 @@ class ContinuousGenerator:
                 free_block_watermark=free_block_watermark,
                 metrics=self.metrics))
         self.prefix_cache = bool(prefix_cache)
+        # draft-free speculative decoding (ROADMAP item 3; llm/speculate.py):
+        # a host-side prompt-lookup proposer drafts per-slot continuations
+        # and ONE fixed-shape verify program scores K candidates per slot per
+        # step. None/False disables; True/dict/SpecConfig enable. Greedy
+        # streams are token-for-token identical either way; sampled streams
+        # keep the distribution (rejection sampling) but consume different
+        # RNG draws.
+        self.speculate = as_spec_config(speculate)
+        #: capture per-token behavior logprobs during decode (the GRPO
+        #: flywheel's record — saves RolloutPod the extra behavior_logprobs
+        #: forward; see result_logprobs / generate()'s info["logprobs"])
+        self.capture_logprobs = bool(capture_logprobs)
+        self._proposer = (NgramProposer(self.speculate)
+                          if self.speculate is not None else None)
+        self._completions = (
+            CompletionCache(self.speculate.completion_cache_size)
+            if self.speculate is not None and self.speculate.completion_cache
+            else None)
 
         # persistent executable store (ROADMAP item 5): replica spin-up
         # LOADS the plan-compiled decode-chunk + per-bucket prefill
@@ -748,6 +785,11 @@ class ContinuousGenerator:
         self._decode = jax.jit(self._decode_chunk_impl,
                                static_argnames=("greedy",),
                                donate_argnums=(2,) if donate else ())
+        # multi-token verify (speculative decoding): built unconditionally —
+        # jit is lazy, an unused verify contributes zero compiled programs
+        self._verify = jax.jit(self._verify_impl,
+                               static_argnames=("greedy",),
+                               donate_argnums=(2,) if donate else ())
         self._copy_block = jax.jit(
             M.paged_copy_block, donate_argnums=(0,) if donate else ())
         # decode-side import of a prefill worker's exported prompt KV
@@ -770,6 +812,14 @@ class ContinuousGenerator:
                 static_argnames=("greedy",), **wrap)
             self._decode = CachedFunction(
                 self._decode, name="serving/decode_chunk",
+                donate_argnums=(2,) if donate else (),
+                static_argnames=("greedy",), **wrap)
+            # verify fingerprint covers K and the bucket grid through the
+            # drafts/pool arg signature and every sampler knob through the
+            # lowered-HLO sha — a knob change is a MISS, never a wrong
+            # executable (tests/test_llm/test_speculative.py pins the skew)
+            self._verify = CachedFunction(
+                self._verify, name="serving/paged_verify",
                 donate_argnums=(2,) if donate else (),
                 static_argnames=("greedy",), **wrap)
             self._copy_block = CachedFunction(
@@ -809,6 +859,14 @@ class ContinuousGenerator:
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._slot_shared: List[List[int]] = [[] for _ in range(self.slots)]
         self._slot_private: List[List[int]] = [[] for _ in range(self.slots)]
+        # speculation host state: per-slot token history (prompt + emitted —
+        # the proposer's lookup corpus) and the finished completion the slot
+        # is currently following (the GRPO group-repeat draft source)
+        self._slot_hist: List[List[int]] = [[] for _ in range(self.slots)]
+        self._slot_plen: List[int] = [0] * self.slots
+        self._slot_follow: List[Optional[np.ndarray]] = [None] * self.slots
+        # decode-captured logprob results, keyed like _results
+        self._result_lps: Dict[int, np.ndarray] = {}
         # strong refs to the last-served weight trees: cached prompt KV is
         # only valid for the weights that prefilled it
         self._weights: Optional[Tuple[Any, Any]] = None
@@ -826,13 +884,24 @@ class ContinuousGenerator:
         # dense-parity extent: the same Pb + chunks*chunk the bucketed/dense
         # paths allocate, so chunked-attention chunking is identical
         dense = M.init_caches(self.config, 1, Pb + self._decode_extent)
-        carry, (tok0, _emit0) = prefill_head(
-            self.config, params, prompt, prompt_mask, dense, key,
-            **self._knobs(greedy, lora),
-        )
+        if self.capture_logprobs:
+            carry, (tok0, _emit0), last_logits = prefill_head(
+                self.config, params, prompt, prompt_mask, dense, key,
+                return_logits=True, **self._knobs(greedy, lora),
+            )
+        else:
+            carry, (tok0, _emit0) = prefill_head(
+                self.config, params, prompt, prompt_mask, dense, key,
+                **self._knobs(greedy, lora),
+            )
         filled, _tok0, _rv, pos, done0, key_next = carry
         cache = M.paged_scatter_prompt(
             cache, block_ids, filled.k[:, 0, :Pb], filled.v[:, 0, :Pb])
+        if self.capture_logprobs:
+            # raw log p(tok0) — the token_logprobs convention the flywheel's
+            # behavior-logprob record uses (temperature 1.0, no EOS floor)
+            lp0 = jax.nn.log_softmax(last_logits, axis=-1)[0, tok0[0]]
+            return cache, tok0[0], pos[0], done0[0], key_next, lp0
         return cache, tok0[0], pos[0], done0[0], key_next
 
     def _decode_chunk_impl(self, params, lora, cache, tables, slot_mask,
@@ -843,13 +912,33 @@ class ContinuousGenerator:
         knobs = self._knobs(greedy, lora)
 
         def step(carry, _):
-            return paged_decode_step(self.config, params, carry, **knobs)
+            return paged_decode_step(self.config, params, carry,
+                                     capture_lp=self.capture_logprobs,
+                                     **knobs)
 
         carry = (cache, tables, slot_mask, lengths, prev_tok, prev_ok, pos,
                  step_idx, done, keys)
-        carry, (toks, emits) = jax.lax.scan(
-            step, carry, None, length=self.decode_chunk)
+        carry, ys = jax.lax.scan(step, carry, None, length=self.decode_chunk)
+        if self.capture_logprobs:
+            toks, emits, lps = ys
+            return carry, (toks.T, emits.T, lps.T)  # [slots, chunk]
+        toks, emits = ys
         return carry, (toks.T, emits.T)  # [slots, chunk]
+
+    def _verify_impl(self, params, lora, cache, tables, slot_mask, lengths,
+                     prev_tok, prev_ok, pos, step_idx, done, keys, drafts,
+                     draft_len, greedy=False):
+        """Score K drafted tokens per slot in ONE forward and advance each
+        slot by its traced accepted length (llm/speculate.paged_verify_step
+        — the multi-token twin of the decode chunk). A slot with
+        draft_len 0 takes exactly one plain decode step: same token, same
+        RNG stream, so opt-outs and proposer misses riding a mixed verify
+        step stay stream-identical to speculation off."""
+        carry = (cache, tables, slot_mask, lengths, prev_tok, prev_ok, pos,
+                 step_idx, done, keys)
+        return paged_verify_step(
+            self.config, params, carry, drafts, draft_len,
+            capture_lp=self.capture_logprobs, **self._knobs(greedy, lora))
 
     # -- host API ----------------------------------------------------------
     @property
@@ -874,7 +963,8 @@ class ContinuousGenerator:
                  arrival_s: Optional[float] = None,
                  prefilled: Optional[Dict[str, Any]] = None,
                  shed_source: str = "generator",
-                 trace_ctx: Optional[Any] = None) -> Optional[int]:
+                 trace_ctx: Optional[Any] = None,
+                 speculate: bool = True) -> Optional[int]:
         """The shared admission preamble behind :meth:`submit` and
         :meth:`submit_prefilled` — ONE home for bucket validation, the shed
         probe/record, budget clamping, ticket allocation, key defaulting,
@@ -934,7 +1024,8 @@ class ContinuousGenerator:
             arrival_s=(float(arrival_s) if arrival_s is not None
                        else time.perf_counter()),
             hashes=list(hashes) if hashes is not None else None,
-            prefilled=prefilled, trace_ctx=trace_ctx, span=span))
+            prefilled=prefilled, trace_ctx=trace_ctx, span=span,
+            speculate=bool(speculate)))
         self.metrics.histogram(
             "serving/queue_depth_rows", buckets=QUEUE_BUCKETS,
             help="rows in flight when a batch is admitted",
@@ -944,7 +1035,8 @@ class ContinuousGenerator:
     def submit(self, tokens, *, max_new: Optional[int] = None, key=None,
                no_shed: bool = False,
                hashes: Optional[List[bytes]] = None,
-               trace_ctx: Optional[Any] = None) -> Optional[int]:
+               trace_ctx: Optional[Any] = None,
+               speculate: bool = True) -> Optional[int]:
         """Enqueue one request; returns a ticket, or None when admission
         control sheds it (queue overflow / TTFT SLO breach / free-block
         watermark). ``no_shed`` bypasses shedding — the training-rollout
@@ -953,11 +1045,14 @@ class ContinuousGenerator:
         chain (at THIS generator's bucket/block layout) skip the re-hash at
         admission. ``trace_ctx`` parents the decode-admission span onto an
         upstream (fleet-level) trace; without one, a configured tracer
-        opens a per-request root span instead."""
+        opens a per-request root span instead. ``speculate=False`` opts
+        THIS request out of speculative decoding (it rides the verify step
+        with zero drafts — exactly one plain decode step per step, same
+        tokens and same RNG stream as a speculation-off generator)."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         return self._enqueue(tokens, max_new=max_new, key=key,
                              no_shed=no_shed, hashes=hashes,
-                             trace_ctx=trace_ctx)
+                             trace_ctx=trace_ctx, speculate=speculate)
 
     def submit_prefilled(
         self,
@@ -968,12 +1063,14 @@ class ContinuousGenerator:
         tok0: int,
         done0: bool,
         key_next,
+        lp0: Optional[float] = None,
         key=None,
         max_new: Optional[int] = None,
         arrival_s: Optional[float] = None,
         no_shed: bool = False,
         hashes: Optional[List[bytes]] = None,
         trace_ctx: Optional[Any] = None,
+        speculate: bool = True,
     ) -> Optional[int]:
         """Enqueue a request whose prompt KV was already computed by a
         prefill worker (the disaggregated topology's decode-side entry).
@@ -1019,10 +1116,12 @@ class ContinuousGenerator:
             tokens, max_new=max_new, key=key, no_shed=no_shed,
             hashes=hashes, arrival_s=arrival_s,
             shed_source="decode_import", trace_ctx=trace_ctx,
+            speculate=speculate,
             prefilled=dict(
                 k=np.asarray(k_prompt), v=np.asarray(v_prompt),
                 tok0=int(tok0), done0=bool(done0),
                 key_next=np.asarray(key_next, np.uint32),
+                lp0=(float(lp0) if lp0 is not None else None),
             ))
 
     def _shed_reason(self) -> Optional[str]:
@@ -1167,6 +1266,12 @@ class ContinuousGenerator:
             infos.append(self._decode.prepare(
                 params_abs, lora, pool_abs, *decode_args,
                 only_cached=only_cached, greedy=g))
+            if self._proposer is not None:
+                infos.append(self._verify.prepare(
+                    params_abs, lora, pool_abs, *decode_args,
+                    a((self.slots, self.speculate.k), jnp.int32),  # drafts
+                    a((self.slots,), jnp.int32),                   # draft_len
+                    only_cached=only_cached, greedy=g))
             for Pb in self.prompt_buckets:
                 # mirror the _admit dispatch exactly (line ~1200): bucketed
                 # prompt/mask, request key, pool, whole-prompt block list
@@ -1194,7 +1299,7 @@ class ContinuousGenerator:
         allocation / left_pad for request i+1 overlaps request i's prefill
         on the device."""
         finished: List[int] = []
-        pending: List[Tuple[int, _Request, Any, Any, Any]] = []
+        pending: List[Tuple[int, _Request, Any, Any, Any, Any]] = []
         while self._queue:
             try:
                 slot = self._slot_req.index(None)
@@ -1275,6 +1380,7 @@ class ContinuousGenerator:
                 self._mask[slot] = 0
                 self._mask[slot, :Pb] = mask_row
                 self._mask[slot, Pb - 1] = 0  # set by the first decode step
+                self._seed_spec_slot(slot, req)
             elif req.prefilled is not None:
                 # disaggregated import: the prompt KV arrived from a prefill
                 # worker — scatter it instead of dispatching a local prefill
@@ -1284,14 +1390,18 @@ class ContinuousGenerator:
             else:
                 self.metrics.counter("serving/prefix_cache_misses_total").inc()
                 prompt_blocks, dec_blocks = private[:nb_p], private[nb_p:]
-                self._pool, tok0, _pos0, done0, key_next = self._prefill(
+                out = self._prefill(
                     params, lora, jnp.asarray(toks_row[None]),
                     jnp.asarray(mask_row[None]), jnp.asarray(req.key),
                     self._pool, jnp.asarray(np.asarray(prompt_blocks,
                                                        np.int32)),
                     greedy=greedy,
                 )
-                pending.append((slot, req, tok0, done0, key_next))
+                if self.capture_logprobs:
+                    self._pool, tok0, _pos0, done0, key_next, lp0 = out
+                else:
+                    (self._pool, tok0, _pos0, done0, key_next), lp0 = out, None
+                pending.append((slot, req, tok0, done0, key_next, lp0))
                 shared_blocks, dup_private = [], []
                 if self.prefix_cache:
                     for h, bid in zip(req.hashes[:nb_p], prompt_blocks):
@@ -1310,6 +1420,7 @@ class ContinuousGenerator:
                 self._step_idx[slot] = 1
                 self._mask[slot] = 0
                 self._mask[slot, :Pb] = mask_row
+                self._seed_spec_slot(slot, req)
             self._tables[slot] = table
             self._prev_ok[slot] = True
             self._slot_req[slot] = req
@@ -1321,7 +1432,7 @@ class ContinuousGenerator:
             self.metrics.counter("serving/requests_total").inc()
             self.metrics.counter("serving/rows_total").inc()
         # ONE sync pass over every prefill dispatched above
-        for slot, req, tok0, done0, key_next in pending:
+        for slot, req, tok0, done0, key_next, lp0 in pending:
             tok0 = int(np.asarray(tok0))
             # TTFT from ARRIVAL (includes queue wait — the SLO the
             # admission controller sheds on), matching the hit path
@@ -1331,6 +1442,9 @@ class ContinuousGenerator:
             self._prev_tok[slot] = tok0
             self._done[slot] = bool(np.asarray(done0))
             self._keys[slot] = np.asarray(key_next, np.uint32)
+            self._record_lp0(req, lp0)
+            if self._proposer is not None:
+                self._slot_hist[slot].append(tok0)
         for slot in list(range(self.slots)):
             req = self._slot_req[slot]
             if req is not None and (self._done[slot]
@@ -1388,6 +1502,99 @@ class ContinuousGenerator:
         self._keys[slot] = np.asarray(pf["key_next"], np.uint32)
         self._mask[slot] = 0
         self._mask[slot, :Pb] = mask_row
+        self._seed_spec_slot(slot, req, tok0)
+        self._record_lp0(req, pf.get("lp0"))
+
+    # ---- speculative decoding: host-side proposer plumbing --------------- #
+
+    def _seed_spec_slot(self, slot: int, req: _Request,
+                        tok0: Optional[int] = None) -> None:
+        """Seed the slot's token history (what the n-gram proposer suffix-
+        matches against: the prompt, plus the prefill-produced first token
+        when the admission path already has one) and look up a cached
+        completion of this exact prompt — the GRPO group-repeat fast path."""
+        if self._proposer is None:
+            return
+        hist = req.tokens.tolist()
+        if tok0 is not None:
+            hist.append(int(tok0))
+        self._slot_hist[slot] = hist
+        self._slot_plen[slot] = int(req.tokens.size)
+        follow = None
+        if self._completions is not None and req.speculate and req.hashes:
+            follow = self._completions.get(req.hashes[-1])
+        self._slot_follow[slot] = follow
+
+    def _record_lp0(self, req: _Request, lp0) -> None:
+        """First-token logprob (prefill-produced) into the request's
+        captured stream — row 0 of the result's [max_new] logprob vector."""
+        if not self.capture_logprobs:
+            return
+        if lp0 is None:
+            # imported payload without lp0 (pre-speculation prefill worker):
+            # keep the stream aligned; token 0 reads as 0.0
+            req.lps.append(np.zeros(1, np.float32))
+            return
+        req.lps.append(np.asarray(lp0, np.float32).reshape(1))
+
+    def _propose_slot(self, slot: int) -> List[int]:
+        """Draft tokens for ONE slot: the completion-cache follow while the
+        cached completion still agrees with what the slot actually emitted,
+        else the n-gram suffix match over the slot's own history. [] for
+        parked/done/opted-out slots, budget-exhausted slots, and proposer
+        misses — a [] slot rides a verify step as EXACTLY one plain decode
+        step (draft_len 0)."""
+        req = self._slot_req[slot]
+        if req is None or not req.speculate or self._done[slot]:
+            return []
+        # cap: n_emit <= cap + 1, so a full accept never overshoots max_new
+        cap = min(self.speculate.k, req.max_new - req.n_emitted - 1)
+        if cap <= 0:
+            return []
+        hist = self._slot_hist[slot]
+        emitted = hist[self._slot_plen[slot]:]
+        follow = self._slot_follow[slot]
+        if follow is not None:
+            n = len(emitted)
+            if follow.size > n and (n == 0 or np.array_equal(
+                    follow[:n], np.asarray(emitted, follow.dtype))):
+                self.metrics.counter(
+                    "serving/spec_follow_hits_total",
+                    help="draft windows served by the completion "
+                         "cache").inc()
+                return follow[n:n + cap].tolist()
+            self._slot_follow[slot] = None  # diverged: stop consulting it
+        d = self._proposer.propose(np.asarray(hist, np.int32), cap)
+        if d.size:
+            self.metrics.counter(
+                "serving/spec_ngram_hits_total",
+                help="draft windows served by the n-gram proposer").inc()
+            return d.tolist()
+        self.metrics.counter(
+            "serving/spec_proposer_misses_total",
+            help="live slots with no draft this verify step").inc()
+        return []
+
+    def _propose_all(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(drafts [slots, K], draft_len [slots]) — fixed verify shapes;
+        un-drafted positions are pad filler the verify step never reads."""
+        K = self.speculate.k
+        drafts = np.full((self.slots, K), self.pad_id, np.int32)
+        dlens = np.zeros(self.slots, np.int32)
+        for slot in range(self.slots):
+            d = self._propose_slot(slot)
+            if d:
+                drafts[slot, :len(d)] = d
+                dlens[slot] = len(d)
+        return drafts, dlens
+
+    def _harvest_hist(self, slot: int, toks_row: np.ndarray,
+                      emits_row: np.ndarray) -> None:
+        """Append a step's emitted tokens to the slot's proposer history."""
+        if self._proposer is None:
+            return
+        self._slot_hist[slot].extend(
+            toks_row[emits_row.astype(bool)].tolist())
 
     def _finish_slot(self, slot: int) -> int:
         """Assemble the result, release the slot's blocks to the free
@@ -1405,6 +1612,23 @@ class ContinuousGenerator:
         # masked positions are pad (the dense path's post-EOS convention)
         toks = np.where(emits.astype(bool), toks, self.pad_id).astype(np.int32)
         self._results[req.ticket] = (toks, emits)
+        if self.capture_logprobs:
+            lps = (np.concatenate(req.lps) if req.lps
+                   else np.zeros(0, np.float32))
+            lps = lps[:N].astype(np.float32)
+            if lps.size < N:
+                lps = np.pad(lps, (0, N - lps.size))
+            # masked positions are 0.0 (the dense behavior_logprobs
+            # convention under loss_mask)
+            self._result_lps[req.ticket] = np.where(
+                emits.astype(bool), lps, 0.0).astype(np.float32)
+        if self._completions is not None and req.speculate and req.hashes:
+            # finished completion becomes next repeat's draft stream (the
+            # GRPO group-repeat case: same prompt => same tail chain hash)
+            self._completions.put(req.hashes[-1], toks[emits.astype(bool)])
+        self._slot_hist[slot] = []
+        self._slot_plen[slot] = 0
+        self._slot_follow[slot] = None
         self.metrics.counter("serving/tokens_decoded_total").inc(
             int(emits.sum()))
         if req.span is not None:
@@ -1464,6 +1688,12 @@ class ContinuousGenerator:
                     "serving/stale_imports_dropped_total",
                     help="queued prefilled imports dropped on a weight "
                          "update (recomputed by local prefill)").inc(stale)
+            if self._completions is not None:
+                # cached completions are a function of the weights too —
+                # a stale follow would just be rejected by verify, but at
+                # zero accept rate it costs a wider forward for nothing
+                self._completions.clear()
+                self._slot_follow = [None] * self.slots
         self._weights = (params, lora)
 
     def step(self, params, lora=None, greedy: bool = False) -> List[int]:
@@ -1479,8 +1709,16 @@ class ContinuousGenerator:
                     f"but none admittable (pool of {self.n_blocks} blocks "
                     "too small for a single request?)")
             return finished
+        if self._proposer is not None:
+            # hybrid scheduler: any drafted slot => ONE verify step (the
+            # other slots ride it at draft_len 0); no drafts anywhere =>
+            # the plain decode chunk below, exactly as without speculation
+            drafts, dlens = self._propose_all()
+            if int(dlens.sum()):
+                return self._step_verify(params, lora, greedy, drafts,
+                                         dlens, finished)
         t0 = time.perf_counter()
-        carry, (toks, emits) = self._decode(
+        carry, ys = self._decode(
             params, lora, self._pool, jnp.asarray(self._tables),
             jnp.asarray(self._mask), jnp.asarray(self._lengths),
             jnp.asarray(self._prev_tok), jnp.asarray(self._prev_ok),
@@ -1488,6 +1726,11 @@ class ContinuousGenerator:
             jnp.asarray(self._done), jnp.asarray(self._keys),
             greedy=greedy,
         )
+        if self.capture_logprobs:
+            toks, emits, lps = ys
+            lps = np.asarray(lps)
+        else:
+            (toks, emits), lps = ys, None
         (self._pool, _tables, slot_mask, lengths, prev_tok, prev_ok, pos,
          step_idx, done, keys) = carry
         toks = np.asarray(toks)
@@ -1510,6 +1753,8 @@ class ContinuousGenerator:
                 continue
             req.toks.append(toks[slot])
             req.emits.append(emits[slot])
+            if lps is not None:
+                req.lps.append(lps[slot])
             chunk_emitted = int(emits[slot].sum())
             delivered += min(chunk_emitted, req.max_new - req.n_emitted)
             req.n_emitted += chunk_emitted
@@ -1517,6 +1762,7 @@ class ContinuousGenerator:
                 # prefix-hit requests produce their first token here
                 req.ttft_observed = True
                 self._observe_ttft(now - req.arrival_s)
+            self._harvest_hist(slot, toks[slot], emits[slot])
         if delivered:
             self.metrics.histogram(
                 "serving/decode_time_per_token_s", buckets=DECODE_BUCKETS,
@@ -1532,10 +1778,109 @@ class ContinuousGenerator:
             self.allocator.available())
         return finished
 
+    def _step_verify(self, params, lora, greedy: bool, drafts: np.ndarray,
+                     dlens: np.ndarray, finished: List[int]) -> List[int]:
+        """ONE verify step over the pool: score every slot's pending token
+        plus its drafts in a single fixed-shape forward and advance each
+        slot by its accepted length + 1. Greedy output is token-for-token
+        identical to the decode-chunk path; sampled output preserves the
+        sampler's distribution (rejection sampling — llm/speculate.py)."""
+        t0 = time.perf_counter()
+        carry, ys = self._verify(
+            params, lora, self._pool, jnp.asarray(self._tables),
+            jnp.asarray(self._mask), jnp.asarray(self._lengths),
+            jnp.asarray(self._prev_tok), jnp.asarray(self._prev_ok),
+            jnp.asarray(self._pos), jnp.asarray(self._step_idx),
+            jnp.asarray(self._done), jnp.asarray(self._keys),
+            jnp.asarray(drafts), jnp.asarray(dlens),
+            greedy=greedy,
+        )
+        if self.capture_logprobs:
+            toks, emits, n_emit, n_acc, lps = ys
+            lps = np.asarray(lps)
+        else:
+            (toks, emits, n_emit, n_acc), lps = ys, None
+        (self._pool, _tables, slot_mask, lengths, prev_tok, prev_ok, pos,
+         step_idx, done, keys) = carry
+        toks = np.asarray(toks)
+        emits = np.asarray(emits)
+        dt_step = time.perf_counter() - t0
+        self._mask = np.array(slot_mask)
+        self._lengths = np.array(lengths)
+        self._prev_tok = np.array(prev_tok)
+        self._prev_ok = np.array(prev_ok)
+        self._pos = np.array(pos)
+        self._step_idx = np.array(step_idx)
+        self._done = np.array(done)
+        self._keys = np.array(keys)
+        n_emit_l = np.asarray(n_emit).tolist()
+        n_acc_l = np.asarray(n_acc).tolist()
+        dlens_l = dlens.tolist()
+        proposed = int(dlens.sum())
+        accepted = 0
+        delivered = 0
+        now = time.perf_counter()
+        acc_hist = self.metrics.histogram(
+            "serving/spec_accepted_len", buckets=SPEC_LEN_BUCKETS,
+            help="accepted draft tokens per drafted slot per verify step")
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            # harvest ONLY the emitted prefix — a verify row's tail is pad
+            # filler, and the NEXT step keeps emitting, so keeping it would
+            # break _finish_slot's emitted-tokens-are-a-stream-prefix trim
+            ne = n_emit_l[slot]
+            req.toks.append(toks[slot][:ne])
+            req.emits.append(emits[slot][:ne].astype(np.int32))
+            if lps is not None:
+                req.lps.append(lps[slot][:ne])
+            # the draft cap bounds n_emit by the remaining budget, so every
+            # emitted token is a delivered token (unlike the chunk path,
+            # which may overshoot max_new inside a chunk)
+            delivered += ne
+            req.n_emitted += ne
+            accepted += n_acc_l[slot]
+            if dlens_l[slot]:
+                acc_hist.observe(n_acc_l[slot])
+            if not req.ttft_observed and ne:
+                req.ttft_observed = True
+                self._observe_ttft(now - req.arrival_s)
+            self._harvest_hist(slot, toks[slot], emits[slot])
+        self.metrics.counter(
+            "serving/spec_proposed_tokens_total",
+            help="draft tokens submitted to verify").inc(proposed)
+        self.metrics.counter(
+            "serving/spec_accepted_tokens_total",
+            help="draft tokens accepted by verify").inc(accepted)
+        self.metrics.counter(
+            "serving/spec_rejected_tokens_total",
+            help="draft tokens rejected by verify").inc(proposed - accepted)
+        if delivered:
+            self.metrics.histogram(
+                "serving/decode_time_per_token_s", buckets=DECODE_BUCKETS,
+                help="decode-chunk wall time / delivered chunk tokens",
+            ).observe(dt_step / delivered)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if self._done[slot] or req.n_emitted >= req.max_new:
+                finished.append(self._finish_slot(slot))
+        self.metrics.gauge("serving/slot_occupancy").set(self._occupancy())
+        self.metrics.gauge("serving/free_blocks").set(
+            self.allocator.available())
+        return finished
+
     def result(self, ticket: int) -> Tuple[np.ndarray, np.ndarray]:
         """(tokens [max_new], emit mask [max_new]) for a finished ticket
         (pops it)."""
         return self._results.pop(ticket)
+
+    def result_logprobs(self, ticket: int) -> Optional[np.ndarray]:
+        """Decode-captured behavior logprobs [max_new] for a finished ticket
+        (pops the record; None unless ``capture_logprobs``). Masked
+        positions are 0.0 — the dense ``behavior_logprobs`` convention
+        under a loss mask, so the flywheel consumes rows verbatim."""
+        return self._result_lps.pop(ticket, None)
 
     def run_until_drained(self, params, lora=None,
                           greedy: bool = False) -> List[int]:
@@ -1579,10 +1924,16 @@ class ContinuousGenerator:
         N = self.max_new_tokens
         comp = np.full((B, N), self.pad_id, np.int32)
         cmask = np.zeros((B, N), np.int32)
+        lps = (np.zeros((B, N), np.float32) if self.capture_logprobs
+               else None)
         for i, t in enumerate(tickets):
             toks, emits = self.result(t)
             comp[i, :toks.size] = toks
             cmask[i, :emits.size] = emits
+            if lps is not None:
+                row = self.result_logprobs(t)
+                if row is not None:
+                    lps[i, :row.size] = row
         info = {
             "slots": self.slots,
             "block_size": self.block_size,
@@ -1593,6 +1944,9 @@ class ContinuousGenerator:
             "max_new_tokens": N,
         }
         self.metrics.emit("serving", rows=B, **info)
+        if lps is not None:
+            # after emit(): telemetry lines carry scalars, not [B, N] arrays
+            info["logprobs"] = lps
         return comp, cmask, info
 
     def latency_summary(self) -> Dict[str, Any]:
@@ -1619,13 +1973,25 @@ class ContinuousGenerator:
                 "serving/prefix_cache_hits_total").value,
             "slot_occupancy": reg.gauge("serving/slot_occupancy").value,
             "free_blocks": reg.gauge("serving/free_blocks").value,
+            "spec_proposed_tokens_total": reg.counter(
+                "serving/spec_proposed_tokens_total").value,
+            "spec_accepted_tokens_total": reg.counter(
+                "serving/spec_accepted_tokens_total").value,
+            "spec_rejected_tokens_total": reg.counter(
+                "serving/spec_rejected_tokens_total").value,
+            "spec_accepted_len": reg.histogram(
+                "serving/spec_accepted_len",
+                buckets=SPEC_LEN_BUCKETS).summary(),
         }
 
     @property
     def compiled_programs(self) -> int:
-        """Prefill (per prompt bucket) + decode chunk (ONE program) + block
-        copy + import scatter (per prompt bucket, disaggregated only) —
-        bounded by the grid, constant in request count/order (the tier-1
-        regression test pins this; see measured_cache_size)."""
+        """Prefill (per prompt bucket) + decode chunk (ONE program) + verify
+        (ONE program when speculating — fixed [slots, K] draft shape, so
+        accept outcomes never add programs) + block copy + import scatter
+        (per prompt bucket, disaggregated only) — bounded by the grid,
+        constant in request count/order (the tier-1 regression test pins
+        this; see measured_cache_size)."""
         return measured_cache_size(self._prefill, self._decode,
-                                   self._copy_block, self._scatter_import)
+                                   self._verify, self._copy_block,
+                                   self._scatter_import)

@@ -1,0 +1,320 @@
+"""Draft-free speculative decoding for the continuous generator (ROADMAP
+item 3, vLLM/Medusa lineage; Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding", 2023).
+
+Two halves, split along the repo's host/device line:
+
+- **Host proposer** (:class:`NgramProposer` + :class:`CompletionCache`) —
+  prompt-lookup speculation: no second model, no extra device program. A
+  slot's draft is read off its own already-tracked token history (suffix
+  n-gram match, the prompt-lookup-decoding trick) or off a FINISHED
+  completion of the same prompt (keyed by the prefix cache's tail chain
+  hash — the GRPO rollout case, where ``group_size`` repeats of one prompt
+  decode near-identical continuations). Pure numpy between decode steps;
+  proposes nothing rather than something expensive.
+
+- **Device verify** (:func:`paged_verify_step`) — ONE fixed-shape forward
+  scores the K drafted tokens of every slot against the model and advances
+  each slot by a *traced* accepted length: the same per-slot raggedness
+  discipline (lengths / RoPE positions / step indices / slot masks)
+  ``generate.paged_decode_step`` carries, so the compiled-program set stays
+  bounded by the bucket grid x {decode, verify} and NO accept outcome ever
+  recompiles (CompileGuard-enforced in tier-1).
+
+Correctness contract (pinned by tests/test_llm/test_speculative.py):
+
+- **Greedy** — a draft token is accepted iff it equals the argmax the
+  sequential path would have taken; the first mismatch position emits the
+  argmax correction instead. Token-for-token identical to non-speculative
+  decode by construction.
+- **Sampled** — per-draft rejection sampling against the SAME
+  ``_filter_logits`` recipe the sequential sampler uses: draft ``d_j`` is
+  accepted with probability ``p_j(d_j)`` (the proposal is a point mass, so
+  the classic ``min(1, p/q)`` acceptance reduces to ``p(d)``); on rejection
+  the emitted token is drawn from the residual ``p_j`` with ``d_j`` masked
+  out and renormalised. The emitted marginal at every position is exactly
+  ``p_j`` — speculation changes WHICH RNG stream is consumed, never the
+  distribution.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.generate import _filter_logits, _suppress_eos
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculation knobs for ``ContinuousGenerator(speculate=...)``.
+
+    k                      max drafted tokens per slot per verify step (the
+                           verify window is k+1 wide: k drafts + the
+                           correction/bonus position).
+    ngram_max / ngram_min  suffix n-gram lengths tried (longest first) by
+                           the prompt-lookup proposer over the slot's own
+                           prompt+completion history.
+    completion_cache       reuse FINISHED completions of the same prompt
+                           (tail-chain-hash keyed) as drafts — the GRPO
+                           group-repeat fast path. Invalidated with the
+                           prefix cache on every weight-epoch swap.
+    completion_cache_size  LRU bound on cached completions.
+    """
+
+    k: int = 6
+    ngram_max: int = 4
+    ngram_min: int = 2
+    completion_cache: bool = True
+    completion_cache_size: int = 512
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"SpecConfig.k must be >= 1, got {self.k}")
+        if not (1 <= self.ngram_min <= self.ngram_max):
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"({self.ngram_min}, {self.ngram_max})")
+
+
+def as_spec_config(spec) -> Optional[SpecConfig]:
+    """Normalise the user-facing ``speculate=`` value: None/False -> off,
+    True -> defaults, dict -> kwargs, SpecConfig -> itself."""
+    if spec is None or spec is False:
+        return None
+    if spec is True:
+        return SpecConfig()
+    if isinstance(spec, SpecConfig):
+        return spec
+    if isinstance(spec, dict):
+        return SpecConfig(**spec)
+    raise TypeError(f"speculate= expects None/bool/dict/SpecConfig, "
+                    f"got {type(spec).__name__}")
+
+
+class CompletionCache:
+    """LRU of finished completions keyed by the prompt's tail chain hash
+    (the same sha1 chain the prefix cache routes on, so "same prompt" means
+    the same thing in both caches). The proposer FOLLOWS a cached
+    completion while the slot's emitted tokens match it — under greedy
+    repeats the whole continuation drafts perfectly."""
+
+    def __init__(self, size: int):
+        self.size = int(size)
+        self._d: "collections.OrderedDict[bytes, np.ndarray]" = (
+            collections.OrderedDict())
+
+    def put(self, key: Optional[bytes], tokens: np.ndarray) -> None:
+        if key is None or self.size <= 0:
+            return
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        if toks.size == 0:
+            return
+        self._d[key] = toks
+        self._d.move_to_end(key)
+        while len(self._d) > self.size:
+            self._d.popitem(last=False)
+
+    def get(self, key: Optional[bytes]) -> Optional[np.ndarray]:
+        if key is None:
+            return None
+        toks = self._d.get(key)
+        if toks is not None:
+            self._d.move_to_end(key)
+        return toks
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class NgramProposer:
+    """Prompt-lookup drafting: match the history's trailing n-gram against
+    its own earlier content and propose the continuation of the most recent
+    earlier occurrence. O(len(history) * ngram span) numpy per slot per
+    step — cheap next to a decode forward, and a miss costs nothing (the
+    scheduler falls back to the plain decode chunk)."""
+
+    def __init__(self, cfg: SpecConfig):
+        self.cfg = cfg
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        h = np.asarray(history, np.int32).reshape(-1)
+        L = h.size
+        top = min(self.cfg.ngram_max, L - 1)
+        for n in range(top, self.cfg.ngram_min - 1, -1):
+            if L - n < 1:
+                continue
+            suffix = h[L - n:]
+            # windows over h[:-1]: candidate occurrences strictly before
+            # the suffix itself
+            windows = np.lib.stride_tricks.sliding_window_view(h[:-1], n)
+            hits = np.nonzero((windows == suffix[None, :]).all(axis=1))[0]
+            if hits.size:
+                start = int(hits[-1]) + n  # most recent occurrence
+                cont = h[start:start + k]
+                if cont.size:
+                    return cont.astype(np.int32)
+        return np.zeros(0, np.int32)
+
+
+# --------------------------------------------------------------------------- #
+# Device verify step — the multi-token twin of generate.paged_decode_step.
+# --------------------------------------------------------------------------- #
+
+
+def paged_verify_step(config, params, carry, drafts, draft_len, *, lora,
+                      lora_scale, temperature, top_k, top_p, eos_id, pad_id,
+                      min_new_tokens, capture_lp=False):
+    """Score K drafted tokens per slot in ONE forward and advance every slot
+    by its traced accepted length.
+
+    carry — the 10-tuple ``generate.paged_decode_step`` carries (cache,
+    block_tables, slot_mask, lengths, prev_tok, prev_ok, pos, step_idx,
+    done, keys). drafts: [slots, K] int32 (positions past draft_len are
+    ignored — pad with anything); draft_len: [slots] int32 in [0, K], 0 for
+    slots that must behave exactly like one plain decode step (proposer
+    miss, opt-out, parked slots).
+
+    Window layout (T = K + 1 input positions per slot, entering length L):
+
+      input j:   0 -> prev_tok (KV written at L, exactly like decode)
+                 j -> drafts[j-1] (KV written at L + j)
+      output j:  the token the sequential path would emit given the prefix
+                 plus drafts[< j]; drafts accept as a prefix chain, the
+                 first rejection emits the model's own token instead, and
+                 full acceptance emits a bonus token from position K.
+
+    Raggedness: n_emit in [1, draft_len+1] tokens emit per live slot (0 for
+    done slots); lengths/pos/step_idx advance by the traced n_emit and the
+    carried slot_mask marks exactly the emitted prefix — the step after an
+    EOS-cut or rejection behaves as if the rejected tail never happened.
+
+    Returns (carry', (tok [slots, T], emit [slots, T], n_emit [slots],
+    n_acc [slots])) — plus lp [slots, T] (raw log p of each emitted token,
+    the token_logprobs convention) when capture_lp=True. n_acc is the
+    accepted-draft chain length (the accept-rate telemetry measure)."""
+    (cache, block_tables, slot_mask, lengths, prev_tok, prev_ok, pos,
+     step_idx, done, keys) = carry
+    B, K = drafts.shape
+    T = K + 1
+    S = slot_mask.shape[1]
+    V = config.vocab_size
+    j = jnp.arange(T)
+    draft_len = jnp.minimum(draft_len, K)
+    dle = jnp.where(done, 0, draft_len)  # done slots verify nothing
+
+    # -- forward over the window ------------------------------------------ #
+    cand_in = jnp.concatenate([prev_tok[:, None], drafts], axis=1)  # [B, T]
+    positions = pos[:, None] + jnp.where(
+        j[None, :] == 0, 0, prev_ok.astype(pos.dtype)[:, None] + j[None, :] - 1)
+    write_pos = lengths[:, None] + j[None, :]
+    rel = jnp.arange(S)[None, :] - lengths[:, None]
+    # forward visibility: prev_tok at rel 0 (decode's pre-step insert),
+    # drafts at rel 1..dle; everything else as carried. Candidate j only
+    # SEES slots <= lengths + j (the attention start rule), so marking the
+    # whole draft span valid leaks nothing acausal.
+    vm = jnp.where(rel == 0, prev_ok.astype(slot_mask.dtype)[:, None],
+                   slot_mask)
+    vm = jnp.where((rel >= 1) & (rel <= dle[:, None]),
+                   jnp.ones((), slot_mask.dtype), vm)
+    hidden, (new_k, new_v) = M.forward_paged(
+        config, params, cand_in, positions, write_pos, cache, block_tables,
+        vm, lora=lora, lora_scale=lora_scale,
+    )
+    cache = M.paged_scatter_multi(cache, block_tables, write_pos, new_k,
+                                  new_v)
+    logits = M.logits_fn(config, params, hidden)  # [B, T, V] f32
+    steps = step_idx[:, None] + j[None, :]
+    logits_s = _suppress_eos(logits, steps, eos_id, min_new_tokens)
+
+    # -- accept / emit ----------------------------------------------------- #
+    in_window = j[None, :K] < dle[:, None]  # [B, K]
+    split = jax.vmap(jax.random.split)(keys)
+    keys_next, k_s = split[:, 0], split[:, 1]
+    if temperature == 0.0:
+        # greedy: accepted iff the draft IS the argmax — candidates are the
+        # sequential argmax stream by induction
+        cand = jnp.argmax(logits_s, axis=-1).astype(drafts.dtype)  # [B, T]
+        accept = (cand[:, :K] == drafts) & in_window
+        emitted = cand
+    else:
+        flat = _filter_logits(logits_s.reshape(B * T, V), temperature,
+                              top_k, top_p).reshape(B, T, V)
+        probs = jax.nn.softmax(flat, axis=-1)
+        # 2T subkeys per slot: T accept draws + T residual/bonus draws
+        subs = jax.vmap(lambda kk: jax.random.split(kk, 2 * T))(k_s)
+        u = jax.vmap(jax.vmap(jax.random.uniform))(subs[:, :K])  # [B, K]
+        p_draft = jnp.take_along_axis(
+            probs[:, :K], drafts[..., None], axis=-1)[..., 0]
+        accept = (u < p_draft) & in_window
+        # residual at j < K: p_j with the rejected draft masked out,
+        # renormalised by categorical; bonus at j = K: the full p_K.
+        # Positions PAST the draft window carry no rejected mass — they
+        # resample from the full p_j (masking the pad filler would bias
+        # the emitted marginal)
+        resid = jnp.where(
+            (jnp.arange(V)[None, None, :] == drafts[..., None])
+            & in_window[..., None],
+            -1e9, flat[:, :K])
+        resample_logits = jnp.concatenate([resid, flat[:, K:]], axis=1)
+        # a draft-len-0 slot's only emission is position 0 — sample it with
+        # the SAME per-slot key paged_decode_step would use (k_s directly),
+        # so proposer misses / opt-outs riding a mixed verify step are
+        # stream-identical to the plain decode step, not just
+        # distribution-identical
+        resample_keys = subs[:, T:]
+        key0 = jnp.where((dle == 0)[:, None], k_s, resample_keys[:, 0])
+        resample_keys = jnp.concatenate(
+            [key0[:, None], resample_keys[:, 1:]], axis=1)
+        emitted = jax.vmap(jax.vmap(jax.random.categorical))(
+            resample_keys, resample_logits).astype(drafts.dtype)
+        # accepted positions emit the draft itself
+        emitted = jnp.where(
+            jnp.concatenate([accept, jnp.zeros((B, 1), bool)], axis=1),
+            jnp.concatenate([drafts, drafts[:, :1]], axis=1), emitted)
+    chain = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = chain.sum(axis=1)  # [B] accepted chain length in [0, K]
+
+    # window = accepted chain + the correction/bonus at position n_acc,
+    # then cut at the first EOS and by done
+    in_emit = j[None, :] <= n_acc[:, None]
+    is_eos = ((emitted == eos_id) if eos_id is not None
+              else jnp.zeros((B, T), bool))
+    e = (is_eos & in_emit).astype(jnp.int32)
+    no_prior_eos = (jnp.cumsum(e, axis=1) - e) == 0
+    emit = in_emit & no_prior_eos & ~done[:, None]
+    n_emit = emit.sum(axis=1)  # [B]; >= 1 for live slots, 0 for done
+    tok = jnp.where(emit, emitted, pad_id)
+
+    # -- advance the ragged per-slot state -------------------------------- #
+    last = jnp.take_along_axis(
+        emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    prev_tok_n = jnp.where(n_emit > 0, last, pad_id)
+    prev_ok_n = n_emit > 0
+    done_n = done | (emit & is_eos).any(axis=1)
+    # carried mask: prev_tok's slot becomes prev_ok (decode discipline) and
+    # emitted tokens except the LAST become valid — the last one is the new
+    # pending prev_tok, made visible by the NEXT step's rel==0 write
+    new_mask = jnp.where(rel == 0, prev_ok.astype(slot_mask.dtype)[:, None],
+                         slot_mask)
+    new_mask = jnp.where((rel >= 1) & (rel <= (n_emit - 1)[:, None]),
+                         jnp.ones((), slot_mask.dtype), new_mask)
+    lengths_n = lengths + n_emit
+    pos_n = pos + prev_ok.astype(pos.dtype) + jnp.maximum(n_emit - 1, 0)
+    step_idx_n = step_idx + n_emit
+    carry_n = (cache, block_tables, new_mask, lengths_n, prev_tok_n,
+               prev_ok_n, pos_n, step_idx_n, done_n, keys_next)
+    if capture_lp:
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(lsm, tok[..., None], axis=-1)[..., 0]
+        return carry_n, (tok, emit, n_emit, n_acc, lp)
+    return carry_n, (tok, emit, n_emit, n_acc)

@@ -14,8 +14,21 @@ and materialized a GQA-repeated copy of K/V. This op fixes both:
 - GQA folded into the einsum (q reshaped [B,T,Hkv,rep,d]) so K/V are never
   repeated in HBM.
 
+Two callers share this op with different window shapes, both covered by the
+same visibility rule (slot j visible to query t iff j <= start[b] + t and
+valid[j]):
+
+- plain decode: T = 1, ``start`` = per-row cache depth before the step;
+- speculative verify (llm/speculate.py): T = K + 1 — the committed last token
+  plus K draft tokens are scored in ONE forward, with ``start`` = per-row
+  depth of the committed prefix and the window's K/V already inserted at
+  slots start[b]..start[b]+T-1. Query t attends to the committed prefix plus
+  the first t window tokens, exactly as if the drafts had been decoded one
+  step at a time — which is what makes accept/reject token-exact.
+
 Numerics match the dense masked-softmax path bit-for-bit at f32 accumulation
-(tests/test_ops/test_decode_attention.py). A Pallas kernel is deliberately NOT
+(tests/test_ops/test_decode_attention.py, incl. the per-row-start T>1
+verify-window case). A Pallas kernel is deliberately NOT
 used here: with BlockSpec pipelining the operand fetch for a grid step happens
 whether or not ``pl.when`` skips the compute, so a static-grid Pallas kernel
 cannot skip the dead cache tail — the dynamic-bound XLA loop can, and the

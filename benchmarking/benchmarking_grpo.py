@@ -78,6 +78,9 @@ def main(config_path: str, model_name: str = None, steps: int = 10):
         lr=hp.get("LR", 5e-6), beta=hp.get("BETA", 0.04),
         max_output_tokens=hp.get("MAX_OUTPUT_TOKENS", 32),
         lora_rank=hp.get("LORA_RANK", 8), seed=0,
+        continuous_decode=hp.get("CONTINUOUS_DECODE", False),
+        speculative_decode=hp.get("SPECULATIVE_DECODE"),
+        capture_logprobs=hp.get("CAPTURE_LOGPROBS", False),
     )
 
     timer = StepTimer()

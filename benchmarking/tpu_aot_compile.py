@@ -22,6 +22,9 @@ memory stats, and a sha256 fingerprint of the serialized TPU executable):
   at llama3 head dims (H=32, d=128, T=2048).
 - ``decode_chunk`` — one BucketedGenerator decode chunk (llm/serving.py, the
   vLLM-role path, ref core/base.py:3101) for the llama3-8b preset.
+- ``paged_verify`` — the speculative-decoding verify step
+  (llm/speculate.paged_verify_step through ContinuousGenerator._verify):
+  K drafts per slot scored in one forward over the paged pool.
 - ``grpo_step_small`` — the PRODUCTION fused GRPO update
   (algorithms/grpo.make_update_fn with flash + fused-loss Pallas kernels ON)
   compiled natively for one v5p core.
@@ -368,6 +371,53 @@ def main(argv=None):
                         args.topology, 1)
 
     run("decode_chunk", decode_chunk)
+
+    # ---- paged verify (speculative decoding, llm/speculate.py) ----------
+    from agilerl_tpu.llm.serving import ContinuousGenerator
+
+    def paged_verify():
+        cfg = preset("llama3-8b", max_seq_len=2048,
+                     use_flash_attention=False)
+        if args.quick:
+            cfg = Mod.GPTConfig(
+                vocab_size=1024, n_layer=2, n_head=4, n_kv_head=2,
+                d_model=128, d_ff=256, max_seq_len=512)
+        slots, bsz, pb = (8, 16, 64) if args.quick else (32, 32, 1024)
+        gen = ContinuousGenerator(
+            cfg, max_new_tokens=64, decode_chunk=32, eos_id=2, slots=slots,
+            block_size=bsz, prompt_buckets=(pb,), speculate=True)
+        a = jax.ShapeDtypeStruct
+
+        def _abs(l):
+            return a(l.shape, l.dtype, sharding=s1)
+
+        params_abs = jax.tree_util.tree_map(
+            _abs, jax.eval_shape(lambda k: Mod.init_params(k, cfg),
+                                 jax.random.PRNGKey(0)))
+        pool_abs = jax.tree_util.tree_map(
+            _abs, jax.eval_shape(
+                lambda: Mod.init_paged_cache(cfg, gen.n_blocks,
+                                             gen.block_size)))
+        S = gen.max_blocks * gen.block_size
+        # the decode-chunk carry plus the [slots, K] draft block — the ONE
+        # verify program every accept outcome reuses (CompileGuard bound)
+        vargs = (
+            a((slots, gen.max_blocks), jnp.int32),       # tables
+            a((slots, S), jnp.int32),                    # slot mask
+            a((slots,), jnp.int32),                      # lengths
+            a((slots,), jnp.int32),                      # prev_tok
+            a((slots,), jnp.bool_),                      # prev_ok
+            a((slots,), jnp.int32),                      # pos
+            a((slots,), jnp.int32),                      # step_idx
+            a((slots,), jnp.bool_),                      # done
+            a((slots, 2), jnp.uint32),                   # keys
+            a((slots, gen.speculate.k), jnp.int32),      # drafts
+            a((slots,), jnp.int32),                      # draft_len
+        )
+        return _compile(gen._verify, (params_abs, None, pool_abs) + vargs,
+                        args.topology, 1, kwargs={"greedy": True})
+
+    run("paged_verify", paged_verify)
 
     # ---- fused GRPO step, single core, Pallas kernels ON ----------------
     from agilerl_tpu.algorithms.grpo import make_update_fn

@@ -142,6 +142,15 @@ for arg in "$@"; do
       MARKER=(-m "traffic")
       SHARDS+=("tests/test_llm/test_traffic.py tests/test_observability/test_slo.py")
       ;;
+    spec_decode)
+      # fast path: speculative decoding (proposer/completion-cache units,
+      # greedy token parity incl. EOS-in-window and fleet failover,
+      # rejection-sampling distribution preservation, CompileGuard program
+      # bound, delivered-token telemetry, flywheel captured-logprob reuse,
+      # paged_verify fingerprint skew)
+      MARKER=(-m "spec_decode")
+      SHARDS+=("tests/test_llm/test_speculative.py tests/test_parallel/test_compile_cache.py tests/test_ops/test_decode_attention.py")
+      ;;
     *) SHARDS+=("$arg") ;;
   esac
 done

@@ -1,0 +1,464 @@
+"""Speculative decoding in the continuous generator (ISSUE 17 tentpole):
+draft-free prompt-lookup/completion-cache proposals verified by ONE
+fixed-shape multi-token forward per step. Greedy speculation must be
+token-for-token identical to the non-speculative path (including ragged
+EOS, slot reuse, fleet routing and failover re-dispatch); sampled
+speculation must preserve the sampling distribution (rejection sampling);
+the program set stays bounded by the bucket grid regardless of accept
+outcomes; and per-token telemetry meters DELIVERED tokens per step."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.analysis import CompileGuard
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm import serving as serving_mod
+from agilerl_tpu.llm.fleet import ServingFleet
+from agilerl_tpu.llm.generate import generate, left_pad
+from agilerl_tpu.llm.serving import ContinuousGenerator
+from agilerl_tpu.llm.speculate import (
+    CompletionCache,
+    NgramProposer,
+    SpecConfig,
+    as_spec_config,
+)
+from agilerl_tpu.observability import MetricsRegistry
+
+pytestmark = [pytest.mark.spec_decode, pytest.mark.serving]
+
+CFG = M.GPTConfig(vocab_size=96, n_layer=2, n_head=4, n_kv_head=2,
+                  d_model=32, max_seq_len=256, dtype=jnp.float32)
+KW = dict(max_new_tokens=8, pad_id=0, eos_id=None, prompt_buckets=(32,),
+          slots=3, block_size=8, decode_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _gen(**kw):
+    d = dict(KW, metrics=MetricsRegistry())
+    d.update(kw)
+    return ContinuousGenerator(CFG, **d)
+
+
+def _ragged(rng, n, lo=4, hi=28):
+    return [rng.integers(3, CFG.vocab_size - 1,
+                         size=int(rng.integers(lo, hi))).astype(np.int32)
+            for _ in range(n)]
+
+
+def _dense(seqs, params, key, max_new=8, eos_id=None):
+    toks, mask = left_pad(seqs, 0, 32)
+    return generate(CFG, params, jnp.asarray(toks), jnp.asarray(mask), key,
+                    max_new_tokens=max_new, temperature=0.0, eos_id=eos_id)
+
+
+# --------------------------------------------------------------------------- #
+# proposer / config units
+# --------------------------------------------------------------------------- #
+
+
+def test_spec_config_coercion():
+    assert as_spec_config(None) is None
+    assert as_spec_config(True).k == SpecConfig().k
+    cfg = as_spec_config({"k": 3, "completion_cache": False})
+    assert cfg.k == 3 and not cfg.completion_cache
+    same = as_spec_config(cfg)
+    assert same is cfg
+
+
+def test_ngram_proposer_suffix_match():
+    p = NgramProposer(SpecConfig(ngram_max=3, ngram_min=2))
+    hist = np.asarray([5, 6, 7, 8, 9, 5, 6, 7], np.int32)
+    # suffix [5,6,7] recurs at the start: continuation is [8, 9]
+    np.testing.assert_array_equal(p.propose(hist, 4), [8, 9, 5, 6])
+    assert p.propose(np.asarray([1, 2, 3], np.int32), 4).size == 0
+
+
+def test_completion_cache_lru_and_identity():
+    c = CompletionCache(2)
+    c.put(b"a", np.asarray([1, 2], np.int32))
+    c.put(b"b", np.asarray([3], np.int32))
+    np.testing.assert_array_equal(c.get(b"a"), [1, 2])  # refreshes a
+    c.put(b"c", np.asarray([4], np.int32))              # evicts b
+    assert c.get(b"b") is None and len(c) == 2
+    c.put(None, np.asarray([9], np.int32))              # unkeyed: ignored
+    c.put(b"d", np.asarray([], np.int32))               # empty: ignored
+    assert len(c) == 2
+
+
+# --------------------------------------------------------------------------- #
+# greedy: token-for-token identical to the non-speculative path
+# --------------------------------------------------------------------------- #
+
+
+def test_greedy_parity_more_requests_than_slots(params):
+    """7 ragged requests over 3 slots: slots free mid-trace and are reused
+    by later admissions — still token-identical to the dense reference."""
+    seqs = _ragged(np.random.default_rng(0), 7)
+    reg = MetricsRegistry()
+    gen = _gen(metrics=reg, speculate=True)
+    comp, cmask, _ = gen.generate(seqs, jax.random.PRNGKey(1), params,
+                                  greedy=True)
+    dcomp, dcmask = _dense(seqs, params, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(comp, np.asarray(dcomp))
+    np.testing.assert_array_equal(cmask, np.asarray(dcmask))
+    summ = gen.latency_summary()
+    assert summ["spec_proposed_tokens_total"] > 0
+    assert (summ["spec_accepted_tokens_total"]
+            + summ["spec_rejected_tokens_total"]
+            == summ["spec_proposed_tokens_total"])
+    assert summ["spec_accepted_len"]["count"] > 0
+
+
+def test_greedy_parity_eos_inside_accepted_window(params):
+    """EOS can land anywhere inside a multi-token accepted window: emission
+    must stop at it exactly as the one-token path would, and the freed slot
+    is reused by a queued request."""
+    rng = np.random.default_rng(2)
+    seqs = _ragged(rng, 7)
+    free, _ = _dense(seqs, params, jax.random.PRNGKey(1), max_new=16)
+    eos = int(np.asarray(free)[0, 2])  # appears early in row 0's stream
+    dcomp, dcmask = _dense(seqs, params, jax.random.PRNGKey(1), max_new=16,
+                           eos_id=eos)
+    gen = _gen(max_new_tokens=16, eos_id=eos, speculate=True)
+    for _ in range(2):  # 2nd run: completion cache drafts THROUGH the EOS
+        comp, cmask, _ = gen.generate(seqs, jax.random.PRNGKey(1), params,
+                                      greedy=True)
+        np.testing.assert_array_equal(comp, np.asarray(dcomp))
+        np.testing.assert_array_equal(cmask, np.asarray(dcmask))
+    assert gen.latency_summary()["spec_accepted_tokens_total"] > 0
+
+
+def test_repeat_batch_drafts_from_completion_cache(params):
+    """The GRPO-repeat case: a second identical batch drafts whole
+    continuations from the completion cache — near-total acceptance — and
+    stays token-identical."""
+    seqs = _ragged(np.random.default_rng(3), 5)
+    reg = MetricsRegistry()
+    gen = _gen(metrics=reg, speculate=True)
+    gen.generate(seqs, jax.random.PRNGKey(1), params, greedy=True)
+    before = gen.latency_summary()["spec_accepted_tokens_total"]
+    comp, _, _ = gen.generate(seqs, jax.random.PRNGKey(1), params,
+                              greedy=True)
+    dcomp, _ = _dense(seqs, params, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(comp, np.asarray(dcomp))
+    summ = gen.latency_summary()
+    assert reg.counter("serving/spec_follow_hits_total").value > 0
+    # repeat batch: every request's continuation is drafted from the cache
+    # and fully accepted (k caps each window at max_new - 2 drafts)
+    min_cap = min(SpecConfig().k, KW["max_new_tokens"] - 2)
+    assert summ["spec_accepted_tokens_total"] - before >= min_cap * len(seqs)
+
+
+def test_accept_zero_is_exactly_the_one_token_step(params):
+    """A proposer that is ALWAYS wrong (it knows the dense greedy stream
+    and proposes something else) degrades every verify step to the plain
+    one-token step: same tokens as the dense path, ZERO accepts."""
+    seqs = _ragged(np.random.default_rng(4), 3)
+    dcomp, dcmask = _dense(seqs, params, jax.random.PRNGKey(1))
+    rows = np.asarray(dcomp)
+    reg = MetricsRegistry()
+    gen = _gen(metrics=reg,
+               speculate={"k": 2, "completion_cache": False})
+
+    class AlwaysWrong:
+        def propose(self, history, k):
+            hist = np.asarray(history)
+            for i, s in enumerate(seqs):
+                if hist.size > s.size and np.array_equal(hist[:s.size], s):
+                    n = hist.size - s.size  # tokens emitted so far
+                    if n < rows.shape[1]:
+                        return (rows[i, n:n + k].astype(np.int32) + 1) % 96
+            return np.zeros(0, np.int32)
+
+    gen._proposer = AlwaysWrong()
+    comp, cmask, _ = gen.generate(seqs, jax.random.PRNGKey(1), params,
+                                  greedy=True)
+    summ = gen.latency_summary()
+    assert summ["spec_proposed_tokens_total"] > 0
+    assert summ["spec_accepted_tokens_total"] == 0
+    assert (summ["spec_rejected_tokens_total"]
+            == summ["spec_proposed_tokens_total"])
+    np.testing.assert_array_equal(comp, np.asarray(dcomp))
+    np.testing.assert_array_equal(cmask, np.asarray(dcmask))
+
+
+# --------------------------------------------------------------------------- #
+# program-set bound: bucket grid x {prefill, decode, verify} — accept
+# outcomes are DATA, never new programs
+# --------------------------------------------------------------------------- #
+
+
+def test_compileguard_program_set_constant_across_accept_outcomes(params):
+    gen = _gen(speculate=True)
+    rng = np.random.default_rng(5)
+    seqs = _ragged(rng, 5)
+    gen.generate(seqs, jax.random.PRNGKey(0), params, greedy=True)
+    # one bucket: prefill + decode + verify (+ maybe the copy program)
+    assert 0 < gen.compiled_programs <= 4
+    with CompileGuard(sizer=lambda: gen.compiled_programs, max_new=1,
+                      label="spec waves") as guard:
+        for wave in range(3):
+            # fresh prompts + repeats: K-accept outcomes range over
+            # [0, k] (misses, partial accepts, full follow accepts)
+            wave_seqs = [seqs[i] for i in rng.permutation(len(seqs))]
+            wave_seqs += _ragged(rng, 3)
+            gen.generate(wave_seqs, jax.random.PRNGKey(wave + 1), params,
+                         greedy=True)
+    assert guard.new_compilations <= 1  # the block-copy program at most
+    with CompileGuard(sizer=lambda: gen.compiled_programs,
+                      label="spec steady state"):
+        gen.generate(seqs, jax.random.PRNGKey(99), params, greedy=True)
+
+
+# --------------------------------------------------------------------------- #
+# sampled mode
+# --------------------------------------------------------------------------- #
+
+
+def test_sampled_optout_mixed_pool_stream_identity(params):
+    """A request that opts out rides verify steps with draft_len 0 while
+    its neighbours draft — its sampled stream must be bit-identical to the
+    plain non-speculative run (the key0-substitution contract)."""
+    rng = np.random.default_rng(6)
+    spec_prompt = rng.integers(3, 95, size=12).astype(np.int32)
+    plain_prompt = rng.integers(3, 95, size=9).astype(np.int32)
+    key = jax.random.PRNGKey(8)
+
+    ref = _gen(speculate=None)
+    rt = [ref.submit(p, key=jax.random.fold_in(key, i), no_shed=True)
+          for i, p in enumerate([spec_prompt, spec_prompt, plain_prompt])]
+    ref.run_until_drained(params, greedy=False)
+    want = np.asarray(ref.result(rt[2])[0])
+
+    class ConstDraft:
+        def propose(self, history, k):
+            return np.asarray([5, 9], np.int32)[:k]
+
+    reg = MetricsRegistry()
+    gen = _gen(metrics=reg,
+               speculate={"k": 2, "completion_cache": False})
+    gen._proposer = ConstDraft()  # neighbours ALWAYS draft
+    t1 = gen.submit(spec_prompt, key=jax.random.fold_in(key, 0),
+                    no_shed=True)
+    t2 = gen.submit(spec_prompt, key=jax.random.fold_in(key, 1),
+                    no_shed=True)
+    t3 = gen.submit(plain_prompt, key=jax.random.fold_in(key, 2),
+                    no_shed=True, speculate=False)
+    gen.run_until_drained(params, greedy=False)
+    gen.result(t1), gen.result(t2)
+    got = np.asarray(gen.result(t3)[0])
+    assert gen.latency_summary()["spec_proposed_tokens_total"] > 0
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sampled_distribution_preserved():
+    """Rejection sampling must leave the per-position sampling distribution
+    unchanged. Tiny vocab, fixed (often-wrong) drafts, many seeds: the
+    empirical distribution of the verified token matches the plain decode
+    path's within TV noise."""
+    cfg = M.GPTConfig(vocab_size=12, n_layer=1, n_head=2, n_kv_head=2,
+                      d_model=16, max_seq_len=64, dtype=jnp.float32)
+    params = M.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray([3, 5, 7, 4], np.int32)
+    n_seeds, kw = 400, dict(
+        max_new_tokens=2, pad_id=0, eos_id=None, prompt_buckets=(8,),
+        slots=4, block_size=4, decode_chunk=2, max_queue=2 * 400 + 8)
+
+    class FixedDraft:
+        def propose(self, history, k):
+            return np.asarray([5], np.int32)[:k]
+
+    counts = {}
+    for mode in ("plain", "spec"):
+        gen = ContinuousGenerator(
+            cfg, metrics=MetricsRegistry(),
+            speculate=({"k": 1, "completion_cache": False}
+                       if mode == "spec" else None), **kw)
+        if mode == "spec":
+            gen._proposer = FixedDraft()
+        base = jax.random.PRNGKey(42)
+        tickets = [gen.submit(prompt, key=jax.random.fold_in(base, i),
+                              no_shed=True) for i in range(n_seeds)]
+        gen.run_until_drained(params, greedy=False)
+        toks = np.stack([gen.result(t)[0] for t in tickets])
+        # position 0 is the prefill token (spec-independent); position 1
+        # is produced by the verify step under test
+        counts[mode] = np.bincount(toks[:, 1], minlength=cfg.vocab_size)
+        if mode == "spec":
+            s = gen.latency_summary()
+            # cold-miss admissions may land with no draft
+            # budget left; every prefix-hit request drafts once
+            assert s["spec_proposed_tokens_total"] >= n_seeds - kw["slots"]
+            assert s["spec_accepted_tokens_total"] > 0
+            assert s["spec_rejected_tokens_total"] > 0
+    p = counts["plain"] / n_seeds
+    q = counts["spec"] / n_seeds
+    tv = 0.5 * np.abs(p - q).sum()
+    assert tv < 0.15, (tv, counts)
+
+
+# --------------------------------------------------------------------------- #
+# telemetry: per-token decode time meters DELIVERED tokens per step
+# --------------------------------------------------------------------------- #
+
+
+class _FakeTime:
+    def __init__(self):
+        self.t = 0.0
+
+    def perf_counter(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _advance_on_call(clock, fn, dt=1.0):
+    def wrapped(*a, **k):
+        clock.advance(dt)
+        return fn(*a, **k)
+    return wrapped
+
+
+def test_decode_per_token_telemetry_meters_delivered_tokens(
+        params, monkeypatch):
+    """Fake clock: each device dispatch costs exactly 1.0s. A verify step
+    delivering 8 tokens must observe 1/8 s/token — NOT 1.0 — and the
+    chunk path likewise divides by its delivered count."""
+    clock = _FakeTime()
+    monkeypatch.setattr(serving_mod, "time", clock)
+    prompt = np.random.default_rng(7).integers(3, 95, size=10).astype(
+        np.int32)
+
+    reg = MetricsRegistry()
+    gen = _gen(metrics=reg, max_new_tokens=9, slots=1,
+               speculate={"k": 8})
+    gen._verify = _advance_on_call(clock, gen._verify)
+    gen._decode = _advance_on_call(clock, gen._decode)
+    gen.generate([prompt], jax.random.PRNGKey(1), params, greedy=True)
+    # run 2: the completion cache drafts the whole continuation -> ONE
+    # verify step delivering all 8 post-prefill tokens
+    gen.metrics = reg = MetricsRegistry()
+    gen.generate([prompt], jax.random.PRNGKey(1), params, greedy=True)
+    h = reg.histogram("serving/decode_time_per_token_s",
+                      buckets=serving_mod.DECODE_BUCKETS).summary()
+    assert h["count"] == 1
+    assert h["sum"] == pytest.approx(1.0 / 8)
+
+    reg2 = MetricsRegistry()
+    gen2 = _gen(metrics=reg2, max_new_tokens=9, slots=1, decode_chunk=4)
+    gen2._decode = _advance_on_call(clock, gen2._decode)
+    gen2.generate([prompt], jax.random.PRNGKey(1), params, greedy=True)
+    h2 = reg2.histogram("serving/decode_time_per_token_s",
+                        buckets=serving_mod.DECODE_BUCKETS).summary()
+    # chunks deliver 4, 4 (budget caps the last chunk's emission)
+    assert h2["count"] == 2
+    assert h2["sum"] == pytest.approx(1.0 / 4 + 1.0 / 4)
+
+
+# --------------------------------------------------------------------------- #
+# fleet: pass-through, failover re-dispatch, merged telemetry
+# --------------------------------------------------------------------------- #
+
+
+def test_fleet_failover_redispatch_token_identical_with_spec(params):
+    """Kill a replica mid-trace with speculation on fleet-wide: every
+    request still completes token-for-token identical to the plain
+    non-speculative single-generator reference, and the spec counters
+    surface in the fleet-wide merged dump."""
+    rng = np.random.default_rng(8)
+    base = rng.integers(3, 95, size=12).astype(np.int32)
+    seqs = []
+    for i in range(10):
+        seqs.append(base if i % 3 == 2 else _ragged(rng, 1)[0])
+    ref = _gen()
+    rcomp, rcmask, _ = ref.generate(seqs, jax.random.PRNGKey(1), params,
+                                    greedy=True)
+    fleet = ServingFleet(CFG, 2, metrics=MetricsRegistry(),
+                         speculate={"k": 4}, **KW)
+    tickets = [fleet.submit(s, key=jax.random.fold_in(
+        jax.random.PRNGKey(1), i), no_shed=True)
+        for i, s in enumerate(seqs)]
+    fleet.step(params, greedy=True)  # both replicas mid-flight
+    fleet.kill_replica(fleet.replica_ids[0])
+    fleet.run_until_drained(params, greedy=True)
+    for i, t in enumerate(tickets):
+        toks, emits = fleet.result(t)
+        np.testing.assert_array_equal(toks, rcomp[i])
+        np.testing.assert_array_equal(emits, rcmask[i])
+    dump = fleet.merged_dump()
+    assert dump["counters"]["serving/spec_proposed_tokens_total"] > 0
+    assert "serving/spec_accepted_len" in dump["histograms"]
+
+
+# --------------------------------------------------------------------------- #
+# flywheel: decode-captured logprobs replace the behavior-logprob forward
+# --------------------------------------------------------------------------- #
+
+
+class _FlyHarness:
+    def __init__(self, tmp_path):
+        from agilerl_tpu.utils.llm_utils import CharTokenizer, ReasoningGym
+
+        self.tok = CharTokenizer()
+        self.cfg = M.GPTConfig(vocab_size=self.tok.vocab_size, n_layer=2,
+                               n_head=4, d_model=32, max_seq_len=64,
+                               dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        self.rows = [{"question": f"{a}+{b}=", "answer": str(a + b)}
+                     for a, b in rng.integers(0, 5, (16, 2))]
+        self.tmp = tmp_path
+
+        def reward(completion, answer, prompt):
+            return 0.1 * len(completion) + float(
+                completion.startswith(str(answer)))
+
+        self.reward = reward
+        self.ReasoningGym = ReasoningGym
+
+    def pod(self, name, **over):
+        from agilerl_tpu.algorithms.grpo import GRPO
+        from agilerl_tpu.llm.flywheel import (RolloutPod, TrajectoryStore,
+                                              WeightStore)
+
+        reg = MetricsRegistry()
+        kw = dict(config=self.cfg, pad_token_id=self.tok.pad_token_id,
+                  eos_token_id=self.tok.eos_token_id, group_size=2,
+                  batch_size=8, max_output_tokens=4, seed=0)
+        kw.update(over)
+        agent = GRPO(**kw)
+        env = self.ReasoningGym(self.rows, self.rows[:4], self.tok,
+                                reward_fn=self.reward, data_batch_size=4)
+        ws = WeightStore(self.tmp / (name + "-w"), metrics=reg)
+        ts = TrajectoryStore(self.tmp / (name + "-t"), metrics=reg)
+        ws.publish(0, agent.actor.params)
+        pod = RolloutPod(agent, env, ws, ts, metrics=reg)
+        pod.poll_weights()
+        return pod, reg
+
+
+def test_flywheel_captured_logprobs_match_scoring_forward(tmp_path):
+    """With speculation + capture on, the flywheel reuses decode-captured
+    logprobs as the behavior policy: identical batches, behavior_lp equal
+    to the scoring forward within rtol 1e-5, and the saved-forward counter
+    ticks. The reference pod (no capture) takes the fallback path and
+    never ticks it."""
+    h = _FlyHarness(tmp_path)
+    p1, r1 = h.pod("ref", continuous_decode=True)
+    b1 = p1.rollout_once(greedy=True)
+    p2, r2 = h.pod("cap", continuous_decode=True, speculative_decode=True,
+                   capture_logprobs=True)
+    b2 = p2.rollout_once(greedy=True)
+    np.testing.assert_array_equal(b1.ids, b2.ids)
+    np.testing.assert_allclose(b1.behavior_lp, b2.behavior_lp, rtol=1e-5,
+                               atol=1e-6)
+    saved = "flywheel/logprob_forwards_saved_total"
+    assert r2.counter(saved).value == 1.0
+    assert r1.counter(saved).value == 0.0

@@ -79,6 +79,36 @@ def test_matches_dense(B, T, S, Hq, Hkv, d, start, block):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.spec_decode
+def test_per_row_start_multi_token_window():
+    """Speculative verify (llm/speculate.py) scores a T=K+1 window per slot
+    with heterogeneous per-row cache depths (start=[B]) in one forward. Row
+    b's query t must see exactly slots <= start[b] + t — equivalent to
+    running each row alone with its scalar start."""
+    rng = np.random.default_rng(11)
+    B, T, S, Hq, Hkv, d, block = 3, 5, 64, 8, 2, 16, 16
+    starts = np.asarray([3, 17, 40], np.int32)  # deepest row crosses chunks
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, d)).astype(np.float32))
+    ck = np.zeros((B, S, Hkv, d), np.float32)
+    cv = np.zeros((B, S, Hkv, d), np.float32)
+    cm = np.zeros((B, S), np.int32)
+    for b, st in enumerate(starts):
+        live = int(st) + T
+        ck[b, :live] = rng.normal(size=(live, Hkv, d))
+        cv[b, :live] = rng.normal(size=(live, Hkv, d))
+        cm[b, :live] = 1
+        cm[b, : int(rng.integers(0, max(1, st // 2)))] = 0  # ragged left pad
+    ck, cv, cm = jnp.asarray(ck), jnp.asarray(cv), jnp.asarray(cm)
+
+    out = chunked_cached_attention(q, ck, cv, cm, jnp.asarray(starts),
+                                   block=block)
+    for b, st in enumerate(starts):
+        ref = dense_reference(q[b:b + 1], ck[b:b + 1], cv[b:b + 1],
+                              cm[b:b + 1], int(st))
+        np.testing.assert_allclose(np.asarray(out[b:b + 1]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
 def test_dead_tail_is_never_read():
     """Slots beyond the live prefix may contain NaN and must not poison the
     output — the dynamic-bound loop never touches them (the dense path would

@@ -495,3 +495,50 @@ class TestAotSweepStore:
         assert rec2["cache"]["stored_compile_seconds"] == rec[
             "compile_seconds"]
         assert rec2["fingerprint_sha256"] == rec["fingerprint_sha256"]
+
+
+# --------------------------------------------------------------------------- #
+# speculative verify program: every knob that changes semantics skews the
+# fingerprint to a MISS (ISSUE 17 — K via the drafts arg shape, prompt
+# bucket via the pool/table shapes, sampler knobs via the lowered-HLO sha)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.spec_decode
+class TestPagedVerifyFingerprint:
+    def _verify_fp(self, tmp_path, *, k=4, bucket=32, **sampler):
+        from agilerl_tpu.llm import model as M
+        from agilerl_tpu.llm.serving import ContinuousGenerator
+
+        cfg = M.GPTConfig(vocab_size=64, n_layer=1, n_head=2, n_kv_head=2,
+                          d_model=16, max_seq_len=256)
+        gen = ContinuousGenerator(
+            cfg, max_new_tokens=8, pad_id=0, prompt_buckets=(bucket,),
+            slots=2, block_size=8, decode_chunk=4,
+            metrics=MetricsRegistry(), speculate={"k": k},
+            compile_cache=ExecutableStore(tmp_path), **sampler)
+        # only_cached probe: lowers (which is what the fingerprint hashes)
+        # without paying a backend compile per parametrization
+        infos = gen.warm_start(greedy=False, only_cached=True)
+        fps = [i["fingerprint"] for i in infos
+               if i["name"] == "serving/paged_verify"]
+        assert len(fps) == 1
+        return fps[0]
+
+    def test_same_knobs_same_fingerprint(self, tmp_path):
+        assert (self._verify_fp(tmp_path)
+                == self._verify_fp(tmp_path))
+
+    def test_k_skew_misses(self, tmp_path):
+        assert (self._verify_fp(tmp_path, k=4)
+                != self._verify_fp(tmp_path, k=6))
+
+    def test_bucket_skew_misses(self, tmp_path):
+        assert (self._verify_fp(tmp_path, bucket=32)
+                != self._verify_fp(tmp_path, bucket=64))
+
+    def test_sampler_knob_skew_misses(self, tmp_path):
+        base = self._verify_fp(tmp_path)
+        assert base != self._verify_fp(tmp_path, temperature=0.7)
+        assert base != self._verify_fp(tmp_path, top_k=8)
+        assert base != self._verify_fp(tmp_path, top_p=0.9)
