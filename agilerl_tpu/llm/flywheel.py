@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import json
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -64,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from agilerl_tpu import observability
+from agilerl_tpu.resilience.atomic import atomic_write_bytes
 from agilerl_tpu.resilience.store import CommitDirStore, entry_seq
 
 #: entry-name prefixes (the stores' GC and ordering key on these)
@@ -98,13 +100,20 @@ class WeightStore:
 
     def publish(self, epoch: int, lora: Any,
                 meta: Optional[Dict[str, Any]] = None,
-                trace_ctx: Optional[Dict[str, Any]] = None) -> Path:
+                trace_ctx: Optional[Dict[str, Any]] = None,
+                extra_payload: Optional[Dict[str, Any]] = None) -> Path:
         """Atomically publish one adapter epoch (host copies — device
         arrays are fetched here so a learner's donated buffers never leak
         into the pickle). ``trace_ctx`` (the publishing span's injected
         context) rides the payload and manifest so an actor's adoption
-        span stitches onto the learn step that produced the epoch."""
+        span stitches onto the learn step that produced the epoch.
+        ``extra_payload`` keys ride the pickled payload only (NOT the
+        manifest — they may hold arrays): the learner's warm-restart state
+        travels with the epoch it belongs to, so a respawned learner
+        resumes from whatever epoch actors can already see."""
         payload = {"epoch": int(epoch), "lora": jax.device_get(lora)}
+        if extra_payload:
+            payload.update(extra_payload)
         if trace_ctx is not None:
             payload["trace"] = trace_ctx
         extra = {"epoch": int(epoch), **(meta or {})}
@@ -260,22 +269,44 @@ class TrajectoryStore:
                 self.pending())
         return removed
 
-    def poll(self, max_batches: Optional[int] = None) -> List[TrajectoryBatch]:
-        """Read + consume committed batches in seq order. Torn entries are
-        counted, warned about, consumed (so they cannot wedge the queue),
-        and excluded from the result — never trained on."""
-        out: List[TrajectoryBatch] = []
+    def poll_entries(
+        self, max_batches: Optional[int] = None
+    ) -> List[Tuple[Path, TrajectoryBatch]]:
+        """Read committed batches in seq order WITHOUT consuming them —
+        the caller calls :meth:`consume` per entry once whatever depends on
+        the batch is durably committed (the learner consumes AFTER its
+        weight publish, so a kill between learn and consume replays or
+        staleness-drops the batch instead of losing it). Torn entries are
+        counted, warned about, and consumed here (they cannot wedge the
+        queue) but never returned."""
+        out: List[Tuple[Path, TrajectoryBatch]] = []
         entries = self._store.entries()
         if max_batches is not None:
             entries = entries[: int(max_batches)]
         for path in entries:
             payload = self._store.load(path)
-            self._store.consume(path)
             if payload is None:
+                self._store.consume(path)  # torn: never returned
                 continue
-            self.metrics.counter(
-                "flywheel/trajectories_consumed_total",
-                help="trajectory batches consumed by learner pods").inc()
+            out.append((path, payload))
+        return out
+
+    def consume(self, path: Union[str, Path]) -> None:
+        """Delete one polled entry (counted as consumed)."""
+        self._store.consume(path)
+        self.metrics.counter(
+            "flywheel/trajectories_consumed_total",
+            help="trajectory batches consumed by learner pods").inc()
+        self.metrics.gauge("flywheel/trajectories_pending").set(
+            self.pending())
+
+    def poll(self, max_batches: Optional[int] = None) -> List[TrajectoryBatch]:
+        """Read + consume committed batches in seq order. Torn entries are
+        counted, warned about, consumed (so they cannot wedge the queue),
+        and excluded from the result — never trained on."""
+        out: List[TrajectoryBatch] = []
+        for path, payload in self.poll_entries(max_batches):
+            self.consume(path)
             out.append(payload)
         self.metrics.gauge("flywheel/trajectories_pending").set(
             self.pending())
@@ -314,6 +345,7 @@ class RolloutPod:
         fleet=None,
         autoscaler=None,
         tracer=None,
+        cursor_path: Optional[Union[str, Path]] = None,
     ):
         self.agent = agent
         self.env = env
@@ -330,6 +362,29 @@ class RolloutPod:
         self.weight_epoch = -1  # nothing adopted yet
         self.seq = 0
         self._prompts = None
+        #: durable per-actor seq cursor (the process-launcher respawn path):
+        #: the NEXT seq is committed before each publish, so a crash between
+        #: cursor write and publish skips a seq (harmless — the learner's
+        #: seq-ordered consume tolerates gaps) but can never publish the same
+        #: seq twice under two different weight epochs
+        self.cursor_path = Path(cursor_path) if cursor_path else None
+        if self.cursor_path is not None and self.cursor_path.exists():
+            try:
+                cur = json.loads(self.cursor_path.read_text())
+                self.seq = int(cur["seq"])
+            except (OSError, ValueError, KeyError, TypeError):
+                # unreadable cursor == fresh actor (atomic_write_bytes makes
+                # this external corruption, not a crash artifact)
+                pass
+
+    def _commit_cursor(self) -> None:
+        """Persist the NEXT seq (``self.seq`` post-increment) atomically."""
+        if self.cursor_path is None:
+            return
+        atomic_write_bytes(
+            self.cursor_path,
+            json.dumps({"actor_id": self.actor_id,
+                        "seq": int(self.seq)}).encode())
 
     @property
     def tracer(self):
@@ -444,6 +499,9 @@ class RolloutPod:
             rsp.set_attributes(data_epoch=data_epoch,
                                prompt_sha1=list(batch.prompt_hashes))
             self.seq += 1
+            # cursor BEFORE publish: crash in between skips a seq (safe);
+            # the reverse order could replay a published seq after respawn
+            self._commit_cursor()
             with tr.span("flywheel.publish", seq=batch.seq) as psp:
                 batch.trace_ctx = tr.inject(psp)
                 self.traj_store.publish(batch)
@@ -481,6 +539,7 @@ class LearnerPod:
         mesh=None,
         publish_initial: bool = True,
         tracer=None,
+        carry_state: bool = False,
     ):
         if max_staleness_epochs < 0:
             raise ValueError("max_staleness_epochs must be >= 0")
@@ -493,6 +552,11 @@ class LearnerPod:
         self.metrics = (metrics if metrics is not None
                         else observability.get_registry())
         self._tracer = tracer
+        #: ship the full learner state (optimizer, reference adapter, RNG
+        #: streams, loss history) INSIDE every weight-epoch payload so a
+        #: respawned learner process warm-restarts from the store alone —
+        #: the process launcher's kill -9 recovery path
+        self.carry_state = bool(carry_state)
         if plan is not None or mesh is not None:
             agent.to_mesh(mesh=mesh, plan=plan)
         self.epoch = 0
@@ -516,27 +580,106 @@ class LearnerPod:
         return (self._tracer if self._tracer is not None
                 else observability.get_tracer())
 
+    def _carry_payload(self) -> Dict[str, Any]:
+        """Everything beyond the adapter a respawned learner needs to
+        continue the EXACT run: optimizer moments, the reference adapter +
+        its refresh epoch, both RNG streams, and the history lists the
+        driver/telemetry read. Host copies throughout — the pickle must not
+        capture donated device buffers."""
+        a = self.agent
+        return {
+            "opt_state": jax.device_get(a.optimizer.opt_state),
+            "reference": jax.device_get(a.reference.params),
+            "reference_epoch": int(a._reference_epoch),
+            "rng": a.rng_state(),
+            "steps": list(a.steps),
+            "losses": list(self.losses),
+            "kls": list(self.kls),
+            "trained_seqs": list(self.trained_seqs),
+            "dropped_seqs": list(self.dropped_seqs),
+            "tokens_trained": int(self.tokens_trained),
+        }
+
     def publish(self) -> None:
         tr = self.tracer
+        extra = ({"learner_state": self._carry_payload()}
+                 if self.carry_state else None)
+        # the loss stream rides the MANIFEST too: the launcher/bench read
+        # per-epoch losses without unpickling adapter payloads
+        meta: Dict[str, Any] = {"learn_calls": self.learn_calls}
+        if self.losses:
+            meta["loss"] = self.losses[-1]
         with tr.span("flywheel.weight_publish", epoch=self.epoch) as sp:
             # the publish span's context rides the weight payload: the
             # actor's adoption span stitches onto THIS learn step
             self.weight_store.publish(self.epoch, self.agent.actor.params,
-                                      trace_ctx=tr.inject(sp))
+                                      meta=meta, trace_ctx=tr.inject(sp),
+                                      extra_payload=extra)
         self.metrics.gauge(
             "flywheel/learner_weight_epoch",
             help="newest adapter epoch published by the learner").set(
             self.epoch)
+
+    def restore_from_store(self) -> bool:
+        """Warm-restart from the newest loadable weight epoch (the process
+        launcher's learner-respawn path). Adopts the published adapter and
+        — when the epoch was published with ``carry_state`` — the optimizer
+        state, reference adapter, RNG streams, and history lists, so the
+        restarted learner continues the exact loss/param stream. Returns
+        False when the store holds no loadable epoch (fresh start: the
+        caller's ``publish_initial`` epoch-0 publish applies instead)."""
+        payload = self.weight_store.load_latest_payload()
+        if payload is None:
+            return False
+        a = self.agent
+        lora = jax.tree_util.tree_map(jnp.asarray, payload["lora"])
+        plan = getattr(a, "sharding_plan", None)
+        mesh = getattr(a, "mesh", None)
+        if plan is not None and mesh is not None:
+            lora = plan.place("lora", lora, mesh)
+        a.actor.params = lora
+        self.epoch = int(payload["epoch"])
+        state = payload.get("learner_state")
+        if state:
+            opt = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+            ref = jax.tree_util.tree_map(jnp.asarray, state["reference"])
+            if plan is not None and mesh is not None:
+                opt = plan.place("optimizer", opt, mesh)
+                ref = plan.place("lora", ref, mesh)
+            a.optimizer.opt_state = opt
+            a.reference.params = ref
+            a._reference_epoch = int(state["reference_epoch"])
+            a.set_rng_state(state["rng"])
+            a.steps = [int(s) for s in state["steps"]]
+            self.losses = [float(x) for x in state["losses"]]
+            self.kls = [float(x) for x in state["kls"]]
+            self.trained_seqs = [int(s) for s in state["trained_seqs"]]
+            self.dropped_seqs = [int(s) for s in state["dropped_seqs"]]
+            self.tokens_trained = int(state["tokens_trained"])
+        self.metrics.counter(
+            "flywheel/learner_restores_total",
+            help="learner warm-restarts from the weight store").inc()
+        self.metrics.emit("flywheel_learner_restore", epoch=self.epoch,
+                          carried=bool(state))
+        return True
 
     def step(self, max_batches: Optional[int] = None) -> int:
         """Consume available batches (seq order): train on those within
         the staleness budget (one learn step + weight publish each), drop
         and count the rest. Returns the number of batches CONSUMED
         (trained + dropped); 0 means the learner idled — that wall time is
-        accumulated in ``flywheel/learner_idle_s``."""
+        accumulated in ``flywheel/learner_idle_s``.
+
+        Consumption is **after** the batch's outcome is durable (the
+        weight publish, or the drop decision): a learner killed mid-step
+        leaves the in-flight batch in the store, and the respawned
+        learner's restored epoch classifies it — lag 0 replays the learn
+        with the restored RNG stream (bit-identical), a batch whose learn
+        already published drops as stale. Nothing is ever lost OR trained
+        twice across a kill."""
         now0 = time.perf_counter()
-        batches = self.traj_store.poll(max_batches)
-        if not batches:
+        entries = self.traj_store.poll_entries(max_batches)
+        if not entries:
             if self._last_step_end is not None:
                 self.metrics.counter(
                     "flywheel/learner_idle_s",
@@ -546,7 +689,8 @@ class LearnerPod:
             self._last_step_end = time.perf_counter()
             return 0
         consumed = 0
-        for b in sorted(batches, key=lambda b: (b.seq, b.actor_id)):
+        for path, b in sorted(entries,
+                              key=lambda e: (e[1].seq, e[1].actor_id)):
             consumed += 1
             lag = self.epoch - int(b.weight_epoch)
             self.metrics.gauge(
@@ -577,6 +721,7 @@ class LearnerPod:
                     "flywheel_drop_stale", seq=int(b.seq),
                     actor=int(b.actor_id), lag=int(lag),
                     max_staleness=self.max_staleness_epochs)
+                self.traj_store.consume(path)  # the drop IS the outcome
                 continue
             with tr.span("flywheel.learn", parent=batch_ctx,
                          seq=int(b.seq), actor=int(b.actor_id),
@@ -604,6 +749,9 @@ class LearnerPod:
                 # inside the learn span: the weight_publish span (and the
                 # trace context shipped with the epoch) parents onto it
                 self.publish()
+            # consume ONLY once the epoch that embodies this batch is
+            # committed — the kill-anywhere replay/drop invariant above
+            self.traj_store.consume(path)
         self._last_step_end = time.perf_counter()
         return consumed
 

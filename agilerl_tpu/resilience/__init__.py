@@ -22,8 +22,18 @@ from agilerl_tpu.resilience.membership import (
     HeartbeatStore,
     MembershipChange,
     MembershipEvent,
+    pid_alive,
 )
 from agilerl_tpu.resilience.preemption import PreemptionGuard
+from agilerl_tpu.resilience.proc import (
+    ProcessSupervisor,
+    RoleContext,
+    RoleSpec,
+    SupervisedProcess,
+    read_statuses,
+    resolve_target,
+    run_role,
+)
 from agilerl_tpu.resilience.retry import (
     DEFAULT_ENV_POLICY,
     RetryingEnv,
@@ -58,7 +68,9 @@ __all__ = [
     "RetryPolicy", "RetryingEnv", "call_with_retries", "with_retries",
     "DEFAULT_ENV_POLICY",
     "FaultInjector", "InjectedCrash", "ScheduledFailureEnv",
-    "HeartbeatStore", "MembershipChange", "MembershipEvent",
+    "HeartbeatStore", "MembershipChange", "MembershipEvent", "pid_alive",
+    "ProcessSupervisor", "RoleContext", "RoleSpec", "SupervisedProcess",
+    "read_statuses", "resolve_target", "run_role",
     "CorruptSnapshotError", "set_fault_hook",
     "atomic_write_bytes", "atomic_pickle", "commit_dir", "content_hash",
     "staged_write_bytes", "staged_pickle",

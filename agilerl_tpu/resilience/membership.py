@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import time
 import types
 from pathlib import Path
@@ -42,6 +43,28 @@ def _registry():
     from agilerl_tpu.observability import get_registry
 
     return get_registry()
+
+
+def pid_alive(pid: int) -> bool:
+    """Cheap same-host liveness probe: does ``pid`` still exist?
+
+    ``os.kill(pid, 0)`` performs permission checks but delivers nothing.
+    ``PermissionError`` means the pid exists but belongs to another user —
+    alive for our purposes. A zombie (exited, unreaped) still probes alive;
+    the process supervisor reaps its children promptly, so that window is
+    the supervisor's poll interval, not the lease window.
+    """
+    if pid is None or int(pid) <= 0:
+        return False
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
 
 
 class MembershipChange(RuntimeError):
@@ -100,6 +123,14 @@ class HeartbeatStore:
     ``incarnation`` distinguishes a host that died and came back from one
     that never left: a rejoin after an observed loss is reported as
     ``joined`` even if the id is the same.
+
+    **Fast same-host failure detection** (``probe_pids``, default on): every
+    beat records the writer's pid and node name, and :meth:`alive` probes
+    the pid of any lease written from *this* node via :func:`pid_alive`. A
+    crashed local process therefore drops out of the live set on the very
+    next observation instead of after ``lease_timeout`` — the MTTR path the
+    single-machine process launcher rides. Leases from other nodes (or
+    pre-probe leases without a pid) still age out by lease timeout only.
     """
 
     def __init__(
@@ -108,12 +139,15 @@ class HeartbeatStore:
         lease_timeout: float = 5.0,
         registry=None,
         clock=time.time,
+        probe_pids: bool = True,
     ):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.lease_timeout = float(lease_timeout)
         self._registry_override = registry
         self.clock = clock
+        self.probe_pids = bool(probe_pids)
+        self.node = socket.gethostname()
         #: last observed view: host id -> incarnation (None until baselined)
         self._last_view: Optional[Dict[int, int]] = None
 
@@ -138,17 +172,27 @@ class HeartbeatStore:
         tmp.write_bytes(json.dumps(payload).encode())  # graftcheck: disable=GX004
         os.replace(tmp, path)  # graftcheck: disable=GX004 — see above
 
-    def beat(self, host_id: int, incarnation: int = 0, meta: Optional[dict] = None) -> None:
+    def beat(
+        self,
+        host_id: int,
+        incarnation: int = 0,
+        meta: Optional[dict] = None,
+        pid: Optional[int] = None,
+        node: Optional[str] = None,
+    ) -> None:
         """Renew ``host_id``'s lease (call once per generation/heartbeat
         interval; must beat faster than ``lease_timeout`` to stay live).
         ``meta`` is a small JSON payload recorded in the lease — the serving
         fleet writes ``{"role": "prefill"|"decode"|"unified", "replica": id}``
         so :meth:`poll`/:meth:`roles` surface the topology, not just
-        liveness."""
+        liveness. ``pid``/``node`` default to the writing process and this
+        node; tests override them to fabricate a crashed-process lease."""
         payload = {
             "host": int(host_id),
             "time": float(self.clock()),
             "incarnation": int(incarnation),
+            "pid": int(os.getpid() if pid is None else pid),
+            "node": self.node if node is None else str(node),
         }
         if meta:
             payload["meta"] = meta
@@ -177,12 +221,31 @@ class HeartbeatStore:
                 continue
         return out
 
+    def _probed_dead(self, payload: dict) -> bool:
+        """True when a lease was written by a process on THIS node whose pid
+        no longer exists — a crashed local process whose lease is still
+        fresh. Cross-node leases (or pre-probe leases without a pid) are
+        never probed; they age out by lease timeout only."""
+        if not self.probe_pids:
+            return False
+        pid = payload.get("pid")
+        if pid is None or payload.get("node") != self.node:
+            return False
+        try:
+            return not pid_alive(int(pid))
+        except (TypeError, ValueError):
+            return False
+
     def alive(self, now: Optional[float] = None) -> Dict[int, dict]:
-        """Hosts with a fresh lease (age ≤ ``lease_timeout``)."""
+        """Hosts with a fresh lease (age ≤ ``lease_timeout``) whose writer —
+        when it lives on this node and the probe is enabled — still exists.
+        The pid probe turns a same-host crash into an immediate loss instead
+        of a lease-window wait."""
         now = float(self.clock()) if now is None else float(now)
         return {
             h: payload for h, payload in self.leases().items()
             if now - float(payload.get("time", -float("inf"))) <= self.lease_timeout
+            and not self._probed_dead(payload)
         }
 
     def leader(self, alive: Optional[Dict[int, dict]] = None) -> Optional[int]:

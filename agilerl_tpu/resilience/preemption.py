@@ -10,6 +10,15 @@ pytrees, touch JAX, or take locks.
 
 A second SIGINT still raises ``KeyboardInterrupt`` so an interactive ^C ^C
 retains its "no really, stop NOW" meaning.
+
+Guards do NOT assume they own the process-wide handlers: when a supervised
+child (the pod launcher's role harness) installs an outer guard and a
+training loop later installs its own, the inner guard's handler **chains**
+to the previously-installed callable handler after flag-flipping. Both
+guards observe the signal, so a launcher-forwarded SIGTERM plus the
+process-group delivery of the same signal (double delivery) latches both
+flags and stays on the graceful path — signal latching is idempotent,
+mirroring the "one ^C after SIGTERM stays graceful" rule.
 """
 
 from __future__ import annotations
@@ -130,6 +139,16 @@ class PreemptionGuard:
             # second ^C: the user means it — don't trap them in a slow
             # final-snapshot path
             raise KeyboardInterrupt
+        # chain to whoever held this signal before us: a supervised child's
+        # harness guard must still see the signal when an inner loop guard
+        # installed over it. Only real callables chain — SIG_DFL/SIG_IGN are
+        # sentinels, and the interpreter's default_int_handler would raise
+        # KeyboardInterrupt mid-step, exactly what the graceful path avoids.
+        prev = self._prev_handlers.get(signum)
+        if callable(prev) and prev not in (
+            signal.default_int_handler, self._handler
+        ):
+            prev(signum, frame)
 
     def install(self) -> "PreemptionGuard":
         """Install handlers (main thread only — a no-op elsewhere, where

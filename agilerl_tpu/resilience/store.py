@@ -29,6 +29,7 @@ the fleet/flywheel stores share verbatim.
 from __future__ import annotations
 
 import json
+import os
 import re
 import shutil
 from pathlib import Path
@@ -70,7 +71,13 @@ def publish_entry(
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     final = directory / name
-    tmp = directory / (name + TMP_DIR_SUFFIX)
+    # pid-scoped staging: two processes racing the SAME entry name must not
+    # rmtree each other's in-flight staging dir (the PR 12 executable-store
+    # lesson, applied store-wide). Both still commit to `final` — commit_dir's
+    # rename makes the last writer win, wholesale, never interleaved. The
+    # name keeps the ``.tmp`` suffix so committed_entries() and
+    # remove_stale_tmp_dirs() continue to classify it as staging.
+    tmp = directory / f"{name}.{os.getpid()}{TMP_DIR_SUFFIX}"
     if tmp.exists():
         shutil.rmtree(tmp)
     tmp.mkdir(parents=True)

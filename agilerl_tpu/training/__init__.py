@@ -1,3 +1,12 @@
+from agilerl_tpu.training.launch import (
+    PodLauncher,
+    driver_role,
+    idle_role,
+    launch_flywheel,
+    learner_role,
+    read_loss_stream,
+    rollout_role,
+)
 from agilerl_tpu.training.train_bandits import train_bandits
 from agilerl_tpu.training.train_elastic import train_elastic_pbt
 from agilerl_tpu.training.train_llm_online import finetune_llm_reasoning_online
@@ -8,6 +17,8 @@ from agilerl_tpu.training.train_offline import train_offline
 from agilerl_tpu.training.train_on_policy import train_on_policy
 
 __all__ = [
+    "PodLauncher", "launch_flywheel", "read_loss_stream",
+    "rollout_role", "learner_role", "driver_role", "idle_role",
     "train_off_policy",
     "train_on_policy",
     "train_offline",

@@ -836,6 +836,226 @@ def bench_flywheel():
     }), flush=True)
 
 
+_LAUNCH_ROLES_SRC = '''\
+"""Factories the bench's launch-role child processes import by entry
+point (written into the bench tmpdir, PYTHONPATH'd into every child)."""
+import numpy as np
+import jax.numpy as jnp
+
+from agilerl_tpu.algorithms.grpo import GRPO
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.utils.llm_utils import CharTokenizer, ReasoningGym
+
+TOK = CharTokenizer()
+
+
+def _rows(n, seed):
+    rng = np.random.default_rng(seed)
+    return [{"question": f"{a}+{b}=", "answer": str(a + b)}
+            for a, b in rng.integers(0, 9, (n, 2))]
+
+
+def make_env(seed=0):
+    return ReasoningGym(
+        _rows(64, 0), _rows(8, 1), TOK,
+        reward_fn=lambda c, a, p: 0.1 * len(c) + float(c.startswith(str(a))),
+        data_batch_size=4)
+
+
+def make_agent(seed=0, d_model=64):
+    cfg = M.GPTConfig(vocab_size=TOK.vocab_size, n_layer=2, n_head=4,
+                      d_model=int(d_model), max_seq_len=128,
+                      dtype=jnp.float32)
+    return GRPO(config=cfg, pad_token_id=TOK.pad_token_id,
+                eos_token_id=TOK.eos_token_id, group_size=4, batch_size=16,
+                max_output_tokens=16, seed=seed)
+'''
+
+
+def bench_launch():
+    """CPU A/B for the multi-process pod launcher (docs/launch.md): the
+    SAME flywheel recipe run (a) in-process (OnlineGRPOFlywheel, pods
+    timesharing one interpreter) and (b) as REAL OS processes (1 learner +
+    2 rollout children supervised by PodLauncher over one root), staleness
+    budget 2 both sides. The N-process run also injects one kill -9 into a
+    rollout child mid-run and meters kill->respawn (pid-probe detection)
+    and kill->next-published-batch MTTR. On one host the processes
+    timeshare cores, so vs_baseline meters the PROCESS-BOUNDARY cost
+    (store round-trips + per-child compile); the decode-never-blocks win
+    needs separate hosts. Run with BENCH_MODE=launch; knobs
+    BENCH_LAUNCH_EPOCHS / BENCH_LAUNCH_DMODEL."""
+    import signal as _signal
+    import tempfile
+
+    import jax
+
+    from agilerl_tpu.llm.flywheel import (
+        LearnerPod, OnlineGRPOFlywheel, RolloutPod, TrajectoryStore,
+        WeightStore,
+    )
+    from agilerl_tpu.observability import MetricsRegistry
+    from agilerl_tpu.training.launch import CURSORS_DIR, PodLauncher
+
+    backend = jax.default_backend()
+    n_epochs = int(os.environ.get("BENCH_LAUNCH_EPOCHS", 8))
+    d_model = int(os.environ.get("BENCH_LAUNCH_DMODEL", 64))
+
+    with tempfile.TemporaryDirectory() as d:
+        roles_py = os.path.join(d, "bench_launch_roles.py")
+        with open(roles_py, "w") as f:
+            f.write(_LAUNCH_ROLES_SRC)
+        sys.path.insert(0, d)
+        try:
+            import bench_launch_roles as roles
+
+            # A: in-process flywheel (one interpreter, pods timeshare)
+            reg = MetricsRegistry()
+            ws = WeightStore(os.path.join(d, "inproc", "w"), metrics=reg)
+            ts = TrajectoryStore(os.path.join(d, "inproc", "t"), metrics=reg)
+            agent = roles.make_agent(0, d_model)
+            learner = LearnerPod(agent, ws, ts, max_staleness_epochs=2,
+                                 metrics=reg)
+            rollout = RolloutPod(agent, roles.make_env(), ws, ts, metrics=reg)
+            fly = OnlineGRPOFlywheel(rollout, learner, metrics=reg)
+            fly.run(1)  # warm the compile caches
+            tok0 = reg.counter("flywheel/rollout_tokens_total").value
+            t0 = time.perf_counter()
+            fly.run(1 + n_epochs)
+            inproc_dt = time.perf_counter() - t0
+            inproc_tokens = (reg.counter("flywheel/rollout_tokens_total")
+                             .value - tok0)
+            inproc_tps = inproc_tokens / inproc_dt
+            inproc_sps = n_epochs / inproc_dt
+
+            # B: the same recipe as real OS processes + one injected kill
+            root = os.path.join(d, "nproc")
+            child_env = {
+                "PYTHONPATH": os.pathsep.join(
+                    p for p in (d, os.path.dirname(os.path.abspath(__file__)),
+                                os.environ.get("PYTHONPATH")) if p),
+                "JAX_PLATFORMS": "cpu",
+            }
+            launcher = PodLauncher(root, lease_timeout=5.0, grace_s=30.0)
+            # actor 1 is capped at 3 seqs, so with kill at epoch>=2 the
+            # learner can only reach n_steps if actor 0 keeps publishing
+            # AFTER its kill -9 + respawn — otherwise the surviving actor
+            # could finish the learner alone during the respawn recompile,
+            # the learner would exit, the pending gate would fill, and the
+            # respawned actor would idle forever (the recovery wait would
+            # then burn its whole deadline and poison the throughput
+            # window). Same arithmetic as the rollout-kill launch test.
+            n_steps = max(12, 1 + n_epochs)
+            launcher.add_role(
+                "learner", "agilerl_tpu.training.launch:learner_role",
+                kwargs={"make_agent": "bench_launch_roles:make_agent",
+                        "agent_kwargs": {"seed": 0, "d_model": d_model},
+                        "max_epochs": n_steps,
+                        "max_staleness_epochs": 2},
+                env=child_env, poll_interval=0.01)
+            for i, seqs in enumerate((10_000, 3)):
+                launcher.add_role(
+                    f"rollout_{i}",
+                    "agilerl_tpu.training.launch:rollout_role",
+                    kwargs={"make_agent": "bench_launch_roles:make_agent",
+                            "agent_kwargs": {"seed": i, "d_model": d_model},
+                            "make_env": "bench_launch_roles:make_env",
+                            "actor_id": i, "max_seqs": seqs,
+                            "max_staleness_epochs": 2},
+                    replica=i, env=child_env, poll_interval=0.01)
+            t_spawn = time.perf_counter()
+            launcher.start(join_timeout=300.0)
+            nws = WeightStore(os.path.join(root, "weights"),
+                              metrics=MetricsRegistry())
+
+            def _epoch():
+                return nws.latest_epoch() or 0
+
+            def _wait(cond, timeout_s):
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline and not cond():
+                    launcher.poll()
+                    time.sleep(0.02)
+                return cond()
+
+            _wait(lambda: _epoch() >= 1, 600.0)
+            t_first = time.perf_counter()
+
+            # kill -9 one rollout mid-run; meter detection + recovery
+            _wait(lambda: _epoch() >= 2, 600.0)
+            cursor = os.path.join(root, CURSORS_DIR, "actor_000.json")
+
+            def _cursor_seq():
+                try:
+                    with open(cursor) as f:
+                        return int(json.load(f)["seq"])
+                except (OSError, ValueError, KeyError):
+                    return 0
+
+            seq_at_kill = _cursor_seq()
+            victim = launcher.supervisor.procs["rollout_0"].pid
+            t_kill = time.monotonic()
+            os.kill(victim, _signal.SIGKILL)
+            restarted = []
+
+            def _saw_restart():
+                restarted.extend(
+                    e for e in launcher.supervisor.poll()
+                    if e["role"] == "rollout_0"
+                    and e["action"] == "restarted")
+                return bool(restarted)
+
+            _wait(_saw_restart, 120.0)
+            mttr_detect = time.monotonic() - t_kill
+            _wait(lambda: _cursor_seq() > seq_at_kill, 600.0)
+            mttr_recover = time.monotonic() - t_kill
+
+            done = lambda: (launcher.statuses().get("learner", {})  # noqa: E731
+                            .get("state") == "done")
+            summary = launcher.run(timeout=900.0, until=done)
+            t_done = time.perf_counter()
+            agg = launcher.aggregate_telemetry()
+            nproc_tokens = agg["counters"].get(
+                "flywheel/rollout_tokens_total", 0.0)
+            nproc_dt = t_done - t_first
+            nproc_tps = nproc_tokens / max(nproc_dt, 1e-9)
+            nproc_sps = _epoch() / max(nproc_dt, 1e-9)
+            startup_s = t_first - t_spawn
+            err = None
+            if not done() or summary["orphans"]:
+                err = f"launch bench fleet did not drain clean: {summary}"
+        finally:
+            sys.path.remove(d)
+
+    ratio = nproc_tps / max(inproc_tps, 1e-9)
+    log(f"bench_launch: in-process {inproc_tps:.0f} rollout-tokens/s "
+        f"{inproc_sps:.2f} learn-steps/s vs N-process {nproc_tps:.0f} tok/s "
+        f"{nproc_sps:.2f} steps/s ({ratio:.2f}x, 3 children timesharing; "
+        f"startup {startup_s:.1f}s, kill->respawn {mttr_detect:.2f}s, "
+        f"kill->recovered {mttr_recover:.1f}s)")
+    print(json.dumps({
+        "metric": ("pod-launcher rollout tokens/sec, 1 learner + 2 rollout "
+                   f"OS processes vs in-process flywheel ({n_steps} vs "
+                   f"{n_epochs} learn steps, staleness 2, one kill -9 "
+                   "injected into a rollout child mid-run — processes "
+                   "TIMESHARE one host, so "
+                   "vs_baseline meters the process-boundary cost; MTTR is "
+                   "SIGKILL->pid-probe-respawn and SIGKILL->next published "
+                   "batch from the respawned actor)"),
+        "value": round(nproc_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(ratio, 3),
+        "inproc_tokens_per_sec": round(inproc_tps, 1),
+        "inproc_learn_steps_per_sec": round(inproc_sps, 3),
+        "nproc_tokens_per_sec": round(nproc_tps, 1),
+        "nproc_learn_steps_per_sec": round(nproc_sps, 3),
+        "nproc_startup_s": round(startup_s, 2),
+        "mttr_kill_to_respawn_s": round(mttr_detect, 3),
+        "mttr_kill_to_recovered_s": round(mttr_recover, 2),
+        "backend": backend,
+        "error": err,
+    }), flush=True)
+
+
 def bench_anakin():
     """CPU-backend A/B for the scan-native generation engine
     (docs/performance.md): per-algorithm env-steps/sec of the SCAN-RESIDENT
@@ -1654,6 +1874,8 @@ def child_main():
         bench_fleet()
     elif mode == "flywheel":
         bench_flywheel()
+    elif mode == "launch":
+        bench_launch()
     elif mode == "anakin":
         bench_anakin()
     elif mode == "sharding":
@@ -1883,6 +2105,7 @@ def parent_main():
         else "serving tracing-off vs anomaly-only-tracing tokens/sec" if mode == "trace"
         else "serving-fleet 2-replica vs 1-replica tokens/sec" if mode == "fleet"
         else "flywheel vs interleaved GRPO rollout tokens/sec" if mode == "flywheel"
+        else "pod-launcher N-process vs in-process rollout tokens/sec" if mode == "launch"
         else "scan-resident vs interop off-policy env-steps/sec" if mode == "anakin"
         else "sharding-plan resolution + 7B plan compile" if mode == "sharding"
         else "elastic PBT MTTR + heartbeat overhead" if mode == "elastic"
@@ -1893,7 +2116,8 @@ def parent_main():
     errors = []
 
     if mode in ("pipeline", "serving", "trace", "fleet", "flywheel",
-                "anakin", "sharding", "elastic", "compile_cache", "traffic"):
+                "launch", "anakin", "sharding", "elastic", "compile_cache",
+                "traffic"):
         # A/B micro-benches (per-step vs chunked+fused; batch-sync vs
         # continuous serving; interop vs scan-resident): defined as
         # CPU-backend comparisons on the same host — no accelerator phase,
@@ -1916,7 +2140,7 @@ def parent_main():
         print(json.dumps({
             "metric": metric, "value": 0,
             "unit": ("tokens/sec" if mode in ("serving", "trace", "fleet",
-                                              "flywheel")
+                                              "flywheel", "launch")
                      else "ms/resolution" if mode == "sharding"
                      else "s (MTTR)" if mode == "elastic"
                      else "s (spin-up)" if mode == "compile_cache"

@@ -41,6 +41,9 @@
 #   bash run_tests.sh traffic    # traffic harness + SLO engine only
 #                                # (scenario determinism, record/replay,
 #                                # burn-rate alerting, graded degraded run)
+#   bash run_tests.sh launch     # multi-process pod launcher only (role
+#                                # harness/supervisor, pid-probe detection,
+#                                # SIGTERM drain, N-process flywheel gates)
 #   bash run_tests.sh tests/test_ops   # one shard
 #   JOBS=4 bash run_tests.sh fast      # run up to 4 shards concurrently
 #
@@ -141,6 +144,14 @@ for arg in "$@"; do
       # end-to-end graded degraded run)
       MARKER=(-m "traffic")
       SHARDS+=("tests/test_llm/test_traffic.py tests/test_observability/test_slo.py")
+      ;;
+    launch)
+      # fast path: the multi-process pod launcher (role harness + supervisor
+      # over real OS processes, pid-probe fast failure detection, SIGTERM
+      # fleet drain, concurrent same-name commit-dir racers, N-process
+      # flywheel equivalence + kill -9 warm-restart gates)
+      MARKER=(-m "launch")
+      SHARDS+=("tests/test_resilience/test_proc.py tests/test_train/test_launch.py")
       ;;
     spec_decode)
       # fast path: speculative decoding (proposer/completion-cache units,

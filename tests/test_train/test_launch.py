@@ -1,0 +1,333 @@
+"""Multi-process pod launcher × GRPO flywheel (ISSUE 19 acceptance gates).
+
+The heavy gates: an N-process flywheel (separate rollout + learner +
+launcher processes) reproduces the in-process ``OnlineGRPOFlywheel``
+loss/param stream exactly at staleness 0; ``kill -9`` on the learner
+warm-restarts from the carried store state and CONTINUES the exact
+stream; ``kill -9`` on one of two rollout processes recovers within the
+probe window while both actors keep feeding one learner.
+
+Each child process pays a full package import + GRPO compile, so these
+are ``slow`` + ``launch`` (``run_tests.sh launch``); the cheap
+real-subprocess harness tests live in ``tests/test_resilience/test_proc``.
+
+The ``make_agent``/``make_env`` factories below are the children's entry
+points (``tests.test_train.test_launch:make_agent``) — the SAME seed in
+every process is what makes per-agent RNG streams line up across the
+process split, mirroring the in-process reference built from two
+separately-seeded clones (one per pod)."""
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agilerl_tpu.algorithms.grpo import GRPO
+from agilerl_tpu.llm import model as M
+from agilerl_tpu.llm.flywheel import (
+    LearnerPod,
+    OnlineGRPOFlywheel,
+    RolloutPod,
+    TrajectoryStore,
+    WeightStore,
+)
+from agilerl_tpu.observability import MetricsRegistry
+from agilerl_tpu.training.launch import (
+    CURSORS_DIR,
+    WEIGHTS_DIR,
+    PodLauncher,
+    launch_flywheel,
+    read_loss_stream,
+)
+from agilerl_tpu.utils.llm_utils import CharTokenizer, ReasoningGym
+
+pytestmark = [pytest.mark.launch, pytest.mark.slow]
+
+REPO_ROOT = str(Path(__file__).resolve().parents[2])
+_ENV = {"PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"}
+
+TOK = CharTokenizer()
+CFG = M.GPTConfig(vocab_size=TOK.vocab_size, n_layer=2, n_head=4, d_model=32,
+                  max_seq_len=64, dtype=jnp.float32)
+
+
+def reasoning_rows(n, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        {"question": f"{a}+{b}=", "answer": str(a + b)}
+        for a, b in rng.integers(0, 5, (n, 2))
+    ]
+
+
+def spread_reward(completion, answer, prompt):
+    return 0.1 * len(completion) + float(completion.startswith(str(answer)))
+
+
+def make_env(seed=0):
+    return ReasoningGym(reasoning_rows(16, 0), reasoning_rows(4, 1), TOK,
+                        reward_fn=spread_reward, data_batch_size=4)
+
+
+def make_agent(seed=0):
+    return GRPO(config=CFG, pad_token_id=TOK.pad_token_id,
+                eos_token_id=TOK.eos_token_id, group_size=2, batch_size=8,
+                max_output_tokens=4, seed=seed)
+
+
+MAKE_AGENT = "tests.test_train.test_launch:make_agent"
+MAKE_ENV = "tests.test_train.test_launch:make_env"
+
+
+def _inprocess_reference(tmp_path, max_epochs, seed=0):
+    """The in-process driver built the way the process split decomposes
+    it: SEPARATE rollout/learner agent clones (same seed), so each pod's
+    RNG stream matches its process counterpart draw for draw."""
+    reg = MetricsRegistry()
+    ws = WeightStore(tmp_path / "w", keep_last=max_epochs + 1, metrics=reg)
+    ts = TrajectoryStore(tmp_path / "t", metrics=reg)
+    learner = LearnerPod(make_agent(seed), ws, ts, max_staleness_epochs=0,
+                         metrics=reg, carry_state=True)
+    rollout = RolloutPod(make_agent(seed), make_env(), ws, ts, metrics=reg)
+    OnlineGRPOFlywheel(rollout, learner, metrics=reg).run(max_epochs)
+    return learner, ws
+
+
+def _weights(root):
+    return WeightStore(Path(root) / WEIGHTS_DIR, metrics=MetricsRegistry())
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------- #
+# carry-state restore (in-process unit for the learner warm-restart path)
+# --------------------------------------------------------------------------- #
+def _drive_lockstep(rollout, learner, to_epoch):
+    while learner.epoch < to_epoch:
+        rollout.poll_weights()
+        rollout.rollout_once()
+        learner.step()
+
+
+def test_learner_carry_state_restore_continues_exact_stream(tmp_path):
+    reg = MetricsRegistry()
+    ref_ws = WeightStore(tmp_path / "rw", keep_last=8, metrics=reg)
+    ref_ts = TrajectoryStore(tmp_path / "rt", metrics=reg)
+    ref_learner = LearnerPod(make_agent(0), ref_ws, ref_ts, metrics=reg,
+                             carry_state=True)
+    ref_rollout = RolloutPod(make_agent(0), make_env(), ref_ws, ref_ts,
+                             metrics=reg)
+    _drive_lockstep(ref_rollout, ref_learner, 4)
+
+    # interrupted run: drive to epoch 2, then REPLACE the learner with a
+    # fresh agent restored from the store (the respawn path, minus the OS
+    # process) and continue to epoch 4
+    ws = WeightStore(tmp_path / "w", keep_last=8, metrics=reg)
+    ts = TrajectoryStore(tmp_path / "t", metrics=reg)
+    learner = LearnerPod(make_agent(0), ws, ts, metrics=reg, carry_state=True)
+    rollout = RolloutPod(make_agent(0), make_env(), ws, ts, metrics=reg)
+    _drive_lockstep(rollout, learner, 2)
+
+    restored = LearnerPod(make_agent(0), ws, ts, metrics=reg,
+                          carry_state=True, publish_initial=False)
+    assert restored.restore_from_store() is True
+    assert restored.epoch == 2
+    assert restored.losses == learner.losses
+    _drive_lockstep(rollout, restored, 4)
+
+    assert restored.losses == ref_learner.losses
+    assert restored.kls == ref_learner.kls
+    assert restored.trained_seqs == ref_learner.trained_seqs
+    _assert_tree_equal(restored.agent.actor.params,
+                       ref_learner.agent.actor.params)
+    _assert_tree_equal(restored.agent.optimizer.opt_state,
+                       ref_learner.agent.optimizer.opt_state)
+
+
+def test_restore_from_store_returns_false_on_fresh_root(tmp_path):
+    reg = MetricsRegistry()
+    ws = WeightStore(tmp_path / "w", metrics=reg)
+    ts = TrajectoryStore(tmp_path / "t", metrics=reg)
+    pod = LearnerPod(make_agent(0), ws, ts, metrics=reg, carry_state=True,
+                     publish_initial=False)
+    assert pod.restore_from_store() is False
+    assert ws.latest_epoch() is None  # restore never publishes
+
+
+# --------------------------------------------------------------------------- #
+# equivalence gate
+# --------------------------------------------------------------------------- #
+def test_nproc_flywheel_matches_inprocess_driver_at_staleness_0(tmp_path):
+    max_epochs = 3
+    ref_learner, ref_ws = _inprocess_reference(tmp_path / "ref", max_epochs)
+
+    root = tmp_path / "launch"
+    summary = launch_flywheel(
+        root, MAKE_AGENT, MAKE_ENV, max_epochs=max_epochs, num_rollouts=1,
+        max_staleness_epochs=0, agent_kwargs={"seed": 0},
+        lease_timeout=10.0, grace_s=30.0, timeout=600.0, env=_ENV)
+
+    assert summary["exits"] == {"learner": 0, "rollout_0": 0}, \
+        summary["statuses"]
+    assert summary["orphans"] == []
+
+    # loss stream ≡ (read back from weight-epoch manifests)
+    np.testing.assert_array_equal(np.asarray(summary["losses"]),
+                                  np.asarray(ref_learner.losses))
+    assert len(summary["losses"]) == max_epochs
+
+    # final params ≡ (bit-for-bit across the process split)
+    got_epoch, got_lora = _weights(root).load_latest()
+    ref_epoch, ref_lora = ref_ws.load_latest()
+    assert got_epoch == ref_epoch == max_epochs
+    _assert_tree_equal(got_lora, ref_lora)
+
+
+# --------------------------------------------------------------------------- #
+# kill -9 the learner: warm restart continues the exact stream
+# --------------------------------------------------------------------------- #
+def test_kill9_learner_warm_restarts_and_continues_exact_stream(tmp_path):
+    max_epochs = 4
+    ref_learner, ref_ws = _inprocess_reference(tmp_path / "ref", max_epochs)
+
+    root = tmp_path / "launch"
+    launcher = PodLauncher(root, lease_timeout=10.0, grace_s=30.0)
+    kwargs = {"make_agent": MAKE_AGENT, "agent_kwargs": {"seed": 0},
+              "max_epochs": max_epochs, "max_staleness_epochs": 0,
+              "keep_last": max_epochs + 1}
+    launcher.add_role("learner", "agilerl_tpu.training.launch:learner_role",
+                      kwargs=kwargs, env=_ENV, poll_interval=0.01)
+    launcher.add_role(
+        "rollout_0", "agilerl_tpu.training.launch:rollout_role",
+        kwargs={"make_agent": MAKE_AGENT, "agent_kwargs": {"seed": 0},
+                "make_env": MAKE_ENV, "actor_id": 0,
+                "max_seqs": max_epochs, "max_staleness_epochs": 0,
+                "lockstep": True, "keep_last": max_epochs + 1},
+        env=_ENV, poll_interval=0.01)
+    launcher.start(join_timeout=300.0)
+
+    # let the run make real progress, then SIGKILL the learner mid-flight
+    ws = _weights(root)
+    deadline = time.monotonic() + 300.0
+    while time.monotonic() < deadline and (ws.latest_epoch() or 0) < 2:
+        launcher.poll()
+        time.sleep(0.05)
+    assert (ws.latest_epoch() or 0) >= 2, "no progress before kill"
+    victim_pid = launcher.supervisor.procs["learner"].pid
+    os.kill(victim_pid, signal.SIGKILL)
+
+    # supervisor respawns the learner (bumped incarnation)
+    deadline = time.monotonic() + 60.0
+    restarted = []
+    while time.monotonic() < deadline and not restarted:
+        restarted = [e for e in launcher.poll()
+                     if e["role"] == "learner" and e["action"] == "restarted"]
+        time.sleep(0.05)
+    assert restarted, "learner was not respawned"
+    assert launcher.supervisor.procs["learner"].spec.incarnation == 1
+
+    summary = launcher.run(timeout=600.0)
+    assert summary["statuses"]["learner"]["state"] == "done", summary
+    assert summary["orphans"] == []
+
+    # the respawned learner restored the carried state and continued the
+    # EXACT loss/param stream of the uninterrupted reference
+    losses = read_loss_stream(root)
+    np.testing.assert_array_equal(np.asarray(losses),
+                                  np.asarray(ref_learner.losses))
+    got_epoch, got_lora = ws.load_latest()
+    ref_epoch, ref_lora = ref_ws.load_latest()
+    assert got_epoch == ref_epoch == max_epochs
+    _assert_tree_equal(got_lora, ref_lora)
+
+
+# --------------------------------------------------------------------------- #
+# kill -9 one rollout: ≥2 actors feed one learner, fast recovery
+# --------------------------------------------------------------------------- #
+def _cursor_seq(root, actor):
+    path = Path(root) / CURSORS_DIR / f"actor_{actor:03d}.json"
+    if not path.exists():
+        return 0
+    return json.loads(path.read_text())["seq"]
+
+
+def test_kill9_rollout_recovers_and_two_actors_feed_one_learner(tmp_path):
+    # actor 1 publishes only 3 batches, the learner needs 12: the run can
+    # only complete if actor 0 keeps publishing AFTER its kill -9 + respawn
+    # (the completion itself proves recovery + seq-line continuation,
+    # independent of how fast the respawn recompiles)
+    max_epochs = 12
+    root = tmp_path / "launch"
+    launcher = PodLauncher(root, lease_timeout=10.0, grace_s=30.0)
+    launcher.add_role(
+        "learner", "agilerl_tpu.training.launch:learner_role",
+        kwargs={"make_agent": MAKE_AGENT, "agent_kwargs": {"seed": 0},
+                "max_epochs": max_epochs, "max_staleness_epochs": 2},
+        env=_ENV, poll_interval=0.01)
+    for i, seqs in enumerate((10_000, 3)):
+        launcher.add_role(
+            f"rollout_{i}", "agilerl_tpu.training.launch:rollout_role",
+            kwargs={"make_agent": MAKE_AGENT, "agent_kwargs": {"seed": i},
+                    "make_env": MAKE_ENV, "actor_id": i,
+                    "max_seqs": seqs, "max_staleness_epochs": 2},
+            replica=i, env=_ENV, poll_interval=0.01)
+    launcher.start(join_timeout=300.0)
+
+    # wait for real progress, then SIGKILL one rollout process
+    ws = _weights(root)
+    deadline = time.monotonic() + 300.0
+    while time.monotonic() < deadline and (ws.latest_epoch() or 0) < 1:
+        launcher.poll()
+        time.sleep(0.05)
+    assert (ws.latest_epoch() or 0) >= 1, "no progress before kill"
+    victim = launcher.supervisor.procs["rollout_0"]
+    seq_at_kill = _cursor_seq(root, 0)
+    t_kill = time.monotonic()
+    os.kill(victim.pid, signal.SIGKILL)
+
+    # detection + respawn is pid-probe fast (well inside the lease window)
+    restarted = []
+    while time.monotonic() < t_kill + 60.0 and not restarted:
+        restarted = [e for e in launcher.poll()
+                     if e["role"] == "rollout_0"
+                     and e["action"] == "restarted"]
+        time.sleep(0.05)
+    assert restarted, "rollout_0 was not respawned"
+    mttr_detect_s = time.monotonic() - t_kill
+    assert mttr_detect_s < 60.0
+
+    until = lambda: launcher.statuses().get(  # noqa: E731
+        "learner", {}).get("state") == "done"
+    summary = launcher.run(timeout=600.0, until=until)
+    assert summary["statuses"]["learner"]["state"] == "done", summary
+    assert summary["orphans"] == [] and summary["escalated"] == []
+    # learner + the small actor finished; the unbounded respawned actor
+    # was drained gracefully by the launcher at learner completion
+    assert summary["exits"]["learner"] == 0
+    assert summary["exits"]["rollout_1"] == 0
+    assert summary["exits"]["rollout_0"] == 3
+
+    # the respawned actor CONTINUED its seq line past the kill point
+    # (restored from the per-actor cursor, not replayed from 0)
+    assert _cursor_seq(root, 0) > seq_at_kill
+    assert _cursor_seq(root, 1) > 0
+
+    # both actors' batches were TRAINED: the two seq lines overlap, so a
+    # duplicate seq in trained_seqs can only come from distinct actors
+    state = _weights(root).load_latest_payload()["learner_state"]
+    assert len(state["trained_seqs"]) == max_epochs
+    assert len(set(state["trained_seqs"])) < len(state["trained_seqs"])
+    losses = read_loss_stream(root)
+    assert len(losses) >= 1  # manifests carry the stream (keep_last-bounded)
